@@ -1,0 +1,22 @@
+"""synapseml_tpu — a TPU-native framework with the capabilities of SynapseML.
+
+Re-designed from scratch for JAX/XLA/Pallas on TPU: DataFrame-level
+``.fit()/.transform()`` pipelines whose execution backend is jit-compiled XLA
+over a ``jax.sharding.Mesh`` — histogram GBDT with Pallas kernels + ICI
+``psum`` allreduce instead of LightGBM's socket ring, pjit data/tensor
+parallel deep learning instead of Horovod/NCCL, ONNX→XLA lowering instead of
+ONNX Runtime sessions, and partition→chip placement instead of Spark
+executor→GPU placement.
+"""
+
+__version__ = "0.1.0"
+
+from .core.dataset import Dataset
+from .core.params import Params
+from .core.pipeline import (Estimator, Evaluator, Model, Pipeline,
+                            PipelineModel, PipelineStage, Transformer)
+
+__all__ = [
+    "Dataset", "Params", "Estimator", "Evaluator", "Model", "Pipeline",
+    "PipelineModel", "PipelineStage", "Transformer", "__version__",
+]

@@ -1,0 +1,10 @@
+from .dataset import Dataset, find_unused_column_name
+from .params import (ArrayParam, BoolParam, ComplexParam, DatasetParam,
+                     DictParam, EstimatorParam, FloatParam, IntParam,
+                     ListParam, Param, Params, PyObjectParam, StringParam,
+                     TransformerParam, UDFParam)
+from .pipeline import (Estimator, Evaluator, Model, Pipeline, PipelineModel,
+                       PipelineStage, Transformer, load_dataset, load_stage,
+                       save_dataset)
+from .utils import (KahanSum, SharedVariable, StopWatch, retry,
+                    retry_with_timeout, using)

@@ -1,0 +1,270 @@
+"""Columnar Dataset — the TPU build's DataFrame.
+
+The reference runs on Spark DataFrames whose partitions are the SPMD unit
+(reference: LightGBMBase.scala:596-599 ``df.rdd.barrier().mapPartitions``).
+Here a :class:`Dataset` is a host-resident columnar table (dict of numpy
+arrays) carrying a ``num_partitions`` hint; partitions map deterministically
+onto mesh devices via :mod:`synapseml_tpu.parallel.placement`.  Numeric
+columns move to device as padded dense blocks; object columns (strings,
+ragged lists) stay host-side for featurizers and service stages.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+
+def _as_column(values, n_rows: Optional[int] = None) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        arr = values
+    else:
+        values = list(values)
+        if values and isinstance(values[0], (list, tuple, np.ndarray, dict, bytes)):
+            arr = np.empty(len(values), dtype=object)
+            for i, v in enumerate(values):
+                arr[i] = v
+        else:
+            arr = np.asarray(values)
+            if arr.dtype.kind in ("U", "S"):
+                arr = arr.astype(object)
+    if n_rows is not None and len(arr) != n_rows:
+        raise ValueError(f"column length {len(arr)} != {n_rows}")
+    return arr
+
+
+class Dataset:
+    """Immutable columnar table with partition metadata."""
+
+    def __init__(self, columns: Dict[str, Any], num_partitions: int = 1):
+        if not columns:
+            raise ValueError("Dataset needs at least one column")
+        n = None
+        cols: Dict[str, np.ndarray] = {}
+        for name, vals in columns.items():
+            arr = _as_column(vals, n)
+            if n is None:
+                n = len(arr)
+            cols[name] = arr
+        self._cols = cols
+        self._n = int(n)
+        self.num_partitions = max(1, min(int(num_partitions), self._n or 1))
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_dict(d: Dict[str, Any], num_partitions: int = 1) -> "Dataset":
+        return Dataset(d, num_partitions)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]], num_partitions: int = 1) -> "Dataset":
+        if not rows:
+            raise ValueError("no rows")
+        keys = list(rows[0].keys())
+        return Dataset({k: [r[k] for r in rows] for k in keys}, num_partitions)
+
+    @staticmethod
+    def from_pandas(df, num_partitions: int = 1) -> "Dataset":
+        return Dataset({c: df[c].to_numpy() for c in df.columns}, num_partitions)
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame({k: list(v) if v.dtype == object else v
+                             for k, v in self._cols.items()})
+
+    # -- basic introspection ----------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols.keys())
+
+    @property
+    def num_rows(self) -> int:
+        return self._n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __contains__(self, col: str) -> bool:
+        return col in self._cols
+
+    def __getitem__(self, col: str) -> np.ndarray:
+        return self._cols[col]
+
+    def column(self, col: str) -> np.ndarray:
+        return self._cols[col]
+
+    def schema(self) -> Dict[str, str]:
+        return {k: str(v.dtype) for k, v in self._cols.items()}
+
+    def dtypes(self) -> Dict[str, np.dtype]:
+        return {k: v.dtype for k, v in self._cols.items()}
+
+    # -- projections -------------------------------------------------------
+    def select(self, *cols: str) -> "Dataset":
+        missing = [c for c in cols if c not in self._cols]
+        if missing:
+            raise KeyError(f"columns not found: {missing}; have {self.columns}")
+        return Dataset({c: self._cols[c] for c in cols}, self.num_partitions)
+
+    def drop(self, *cols: str) -> "Dataset":
+        keep = {k: v for k, v in self._cols.items() if k not in cols}
+        return Dataset(keep, self.num_partitions)
+
+    def with_column(self, name: str, values) -> "Dataset":
+        cols = dict(self._cols)
+        cols[name] = _as_column(values, self._n)
+        return Dataset(cols, self.num_partitions)
+
+    def with_columns(self, new: Dict[str, Any]) -> "Dataset":
+        cols = dict(self._cols)
+        for name, values in new.items():
+            cols[name] = _as_column(values, self._n)
+        return Dataset(cols, self.num_partitions)
+
+    def rename(self, old: str, new: str) -> "Dataset":
+        cols = {}
+        for k, v in self._cols.items():
+            cols[new if k == old else k] = v
+        return Dataset(cols, self.num_partitions)
+
+    # -- row ops -----------------------------------------------------------
+    def take(self, n: int) -> "Dataset":
+        return self._mask_rows(slice(0, n))
+
+    def head(self, n: int = 5) -> List[Dict[str, Any]]:
+        return self.take(min(n, self._n)).collect()
+
+    def first(self) -> Dict[str, Any]:
+        return {k: v[0] for k, v in self._cols.items()}
+
+    def collect(self) -> List[Dict[str, Any]]:
+        keys = self.columns
+        return [{k: self._cols[k][i] for k in keys} for i in range(self._n)]
+
+    def _mask_rows(self, idx) -> "Dataset":
+        return Dataset({k: v[idx] for k, v in self._cols.items()}, self.num_partitions)
+
+    def filter(self, pred: Union[np.ndarray, Callable[[Dict[str, Any]], bool]]) -> "Dataset":
+        if callable(pred):
+            mask = np.fromiter((bool(pred(r)) for r in self.iter_rows()),
+                               dtype=bool, count=self._n)
+        else:
+            mask = np.asarray(pred, dtype=bool)
+        return self._mask_rows(mask)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        keys = self.columns
+        for i in range(self._n):
+            yield {k: self._cols[k][i] for k in keys}
+
+    def sort(self, col: str, ascending: bool = True) -> "Dataset":
+        order = np.argsort(self._cols[col], kind="stable")
+        if not ascending:
+            order = order[::-1]
+        return self._mask_rows(order)
+
+    def union(self, other: "Dataset") -> "Dataset":
+        if set(self.columns) != set(other.columns):
+            raise ValueError("union requires identical column sets")
+        cols = {}
+        for k in self.columns:
+            a, b = self._cols[k], other._cols[k]
+            if a.dtype == object or b.dtype == object:
+                out = np.empty(len(a) + len(b), dtype=object)
+                out[:len(a)] = a
+                out[len(a):] = b
+                cols[k] = out
+            else:
+                cols[k] = np.concatenate([a, b])
+        return Dataset(cols, self.num_partitions)
+
+    def sample(self, fraction: float, seed: int = 0) -> "Dataset":
+        rng = np.random.default_rng(seed)
+        mask = rng.random(self._n) < fraction
+        return self._mask_rows(mask)
+
+    def random_split(self, weights: Sequence[float], seed: int = 0) -> List["Dataset"]:
+        rng = np.random.default_rng(seed)
+        w = np.asarray(weights, dtype=np.float64)
+        w = w / w.sum()
+        assignment = rng.choice(len(w), size=self._n, p=w)
+        return [self._mask_rows(assignment == i) for i in range(len(w))]
+
+    def shuffle(self, seed: int = 0) -> "Dataset":
+        rng = np.random.default_rng(seed)
+        return self._mask_rows(rng.permutation(self._n))
+
+    def group_by_agg(self, key: str, aggs: Dict[str, Tuple[str, str]]) -> "Dataset":
+        """Tiny groupBy: aggs maps out_col -> (in_col, fn) with fn in
+        {sum, mean, count, min, max}."""
+        keys = self._cols[key]
+        uniq, inv = np.unique(keys, return_inverse=True)
+        out: Dict[str, Any] = {key: uniq}
+        for out_col, (in_col, fn) in aggs.items():
+            counts = np.bincount(inv, minlength=len(uniq))
+            if fn == "count":
+                out[out_col] = counts
+                continue
+            vals = self._cols[in_col].astype(np.float64)
+            sums = np.bincount(inv, weights=vals, minlength=len(uniq))
+            if fn == "sum":
+                out[out_col] = sums
+            elif fn == "mean":
+                out[out_col] = sums / np.maximum(counts, 1)
+            elif fn in ("min", "max"):
+                red = np.full(len(uniq), np.inf if fn == "min" else -np.inf)
+                op = np.minimum if fn == "min" else np.maximum
+                op.at(red, inv, vals)
+                out[out_col] = red
+            else:
+                raise ValueError(f"unknown agg {fn}")
+        return Dataset(out, self.num_partitions)
+
+    # -- partitioning (the Spark-partition analogue) -----------------------
+    def repartition(self, n: int) -> "Dataset":
+        ds = Dataset(self._cols, num_partitions=n)
+        return ds
+
+    def coalesce(self, n: int) -> "Dataset":
+        return self.repartition(min(n, self.num_partitions))
+
+    def partition_bounds(self) -> List[Tuple[int, int]]:
+        """Deterministic contiguous row ranges, one per partition."""
+        n, p = self._n, self.num_partitions
+        base, rem = divmod(n, p)
+        bounds, start = [], 0
+        for i in range(p):
+            size = base + (1 if i < rem else 0)
+            bounds.append((start, start + size))
+            start += size
+        return bounds
+
+    def partitions(self) -> List["Dataset"]:
+        return [self._mask_rows(slice(a, b)) for a, b in self.partition_bounds()]
+
+    def iter_batches(self, batch_size: int) -> Iterator["Dataset"]:
+        for start in range(0, self._n, batch_size):
+            yield self._mask_rows(slice(start, start + batch_size))
+
+    # -- device materialization -------------------------------------------
+    def to_numpy(self, cols: Sequence[str], dtype=np.float32) -> np.ndarray:
+        """Stack numeric columns (or a single vector column) to a dense
+        (rows, features) matrix — FastVectorAssembler analogue
+        (reference: org/apache/spark/ml/feature/FastVectorAssembler.scala)."""
+        if len(cols) == 1 and self._cols[cols[0]].dtype == object:
+            col = self._cols[cols[0]]
+            return np.stack([np.asarray(v, dtype=dtype) for v in col])
+        return np.column_stack([self._cols[c].astype(dtype) for c in cols])
+
+    def __repr__(self):
+        return (f"Dataset({self._n} rows x {len(self._cols)} cols, "
+                f"{self.num_partitions} partitions: {self.schema()})")
+
+
+def find_unused_column_name(base: str, ds: Dataset) -> str:
+    """reference: core/schema/DatasetExtensions.findUnusedColumnName."""
+    if base not in ds:
+        return base
+    i = 1
+    while f"{base}_{i}" in ds:
+        i += 1
+    return f"{base}_{i}"

@@ -1,0 +1,160 @@
+"""Core runtime utilities.
+
+Analogues of the reference's ``core/utils`` package:
+- :class:`StopWatch` — core/utils/StopWatch.scala
+- :func:`retry_with_timeout` — core/utils/FaultToleranceUtils.scala:9-31
+  (retry backoffs 0/100/200/500 ms, per-attempt timeout)
+- :func:`using` — core/env/StreamUtilities.using resource bracket
+- :class:`SharedVariable` — per-process lazy singleton
+  (io/http/SharedVariable.scala:17,36; used for per-executor shared state
+  like LightGBM's SharedState, SharedState.scala:12-89)
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Generic, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+DEFAULT_BACKOFFS_MS = (0, 100, 200, 500)
+
+
+def retry_with_timeout(fn: Callable[[], T],
+                       timeout_s: Optional[float] = None,
+                       backoffs_ms: Iterable[int] = DEFAULT_BACKOFFS_MS) -> T:
+    """Run ``fn`` with per-attempt timeout, retrying on failure with the
+    reference's backoff schedule."""
+    backoffs = list(backoffs_ms)
+    last_exc: Optional[BaseException] = None
+    for i, backoff in enumerate(backoffs):
+        if backoff:
+            time.sleep(backoff / 1e3)
+        try:
+            if timeout_s is None:
+                return fn()
+            pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+            try:
+                return pool.submit(fn).result(timeout=timeout_s)
+            finally:
+                # wait=False: a hung fn must not block the caller past the
+                # timeout; the orphaned worker thread dies with the process
+                pool.shutdown(wait=False)
+        except BaseException as e:  # noqa: BLE001 - retry everything like the reference
+            last_exc = e
+    raise RuntimeError(f"retry_with_timeout exhausted {len(backoffs)} attempts") from last_exc
+
+
+def retry(fn: Callable[[], T], times: List[int]) -> T:
+    """HandlingUtils.retry analogue: try, sleep head of list, recurse on tail
+    — i.e. len(times)+1 attempts, last error rethrown."""
+    for backoff in times:
+        try:
+            return fn()
+        except BaseException:
+            time.sleep(backoff / 1e3)
+    return fn()
+
+
+@contextlib.contextmanager
+def using(resource):
+    """StreamUtilities.using: close() guaranteed."""
+    try:
+        yield resource
+    finally:
+        close = getattr(resource, "close", None)
+        if close:
+            close()
+
+
+class StopWatch:
+    """Accumulating stopwatch (reference: core/utils/StopWatch.scala)."""
+
+    def __init__(self):
+        self._elapsed_ns = 0
+        self._start: Optional[int] = None
+
+    def start(self) -> None:
+        self._start = time.perf_counter_ns()
+
+    def stop(self) -> None:
+        if self._start is not None:
+            self._elapsed_ns += time.perf_counter_ns() - self._start
+            self._start = None
+
+    def restart(self) -> None:
+        self._elapsed_ns = 0
+        self.start()
+
+    @contextlib.contextmanager
+    def measure(self):
+        self.start()
+        try:
+            yield
+        finally:
+            self.stop()
+
+    @property
+    def elapsed_ns(self) -> int:
+        running = (time.perf_counter_ns() - self._start) if self._start is not None else 0
+        return self._elapsed_ns + running
+
+    @property
+    def elapsed_ms(self) -> float:
+        return self.elapsed_ns / 1e6
+
+    @property
+    def elapsed_s(self) -> float:
+        return self.elapsed_ns / 1e9
+
+
+class SharedVariable(Generic[T]):
+    """Lazily-constructed per-process singleton value with double-checked
+    locking (reference: io/http/SharedVariable.scala,
+    lightgbm SharedState main-worker election SharedState.scala:53-61)."""
+
+    def __init__(self, ctor: Callable[[], T]):
+        self._ctor = ctor
+        self._lock = threading.Lock()
+        self._value: Optional[T] = None
+        self._built = False
+
+    def get(self) -> T:
+        if not self._built:
+            with self._lock:
+                if not self._built:
+                    self._value = self._ctor()
+                    self._built = True
+        return self._value  # type: ignore[return-value]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = None
+            self._built = False
+
+
+class KahanSum:
+    """Compensated summation (reference: vw/KahanSum.scala:68)."""
+
+    __slots__ = ("_sum", "_c")
+
+    def __init__(self, value: float = 0.0):
+        self._sum = float(value)
+        self._c = 0.0
+
+    def add(self, x: float) -> "KahanSum":
+        y = x - self._c
+        t = self._sum + y
+        self._c = (t - self._sum) - y
+        self._sum = t
+        return self
+
+    @property
+    def value(self) -> float:
+        return self._sum
+
+    def __iadd__(self, x: float) -> "KahanSum":
+        return self.add(x)

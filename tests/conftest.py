@@ -1,0 +1,32 @@
+"""Test session setup: simulate an 8-device TPU slice on CPU.
+
+The reference runs all unit tests on a shared local-mode Spark session
+(``master=local[*]``, reference: core/test/base/TestBase.scala:54-71); our
+analogue is JAX's host-platform device-count override — 8 virtual CPU
+devices form the mesh that ICI collectives ride in tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 simulated devices, got {devs}"
+    return devs[:8]

@@ -1,0 +1,220 @@
+"""Core substrate tests: Dataset, params, pipeline, persistence, utils."""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset, Estimator, Model, Pipeline, Transformer
+from synapseml_tpu.core import (BoolParam, FloatParam, IntParam, KahanSum,
+                                ListParam, PyObjectParam, StopWatch,
+                                StringParam, find_unused_column_name,
+                                load_stage, retry_with_timeout)
+from synapseml_tpu.core.pipeline import load_dataset, save_dataset
+
+from fuzzing import EstimatorFuzzing, TestObject, TransformerFuzzing
+
+
+# -- Dataset ----------------------------------------------------------------
+
+def make_ds(n=10):
+    return Dataset({
+        "x": np.arange(n, dtype=np.float32),
+        "y": np.arange(n) % 3,
+        "s": [f"row{i}" for i in range(n)],
+    }, num_partitions=4)
+
+
+def test_dataset_basics():
+    ds = make_ds()
+    assert ds.num_rows == 10
+    assert set(ds.columns) == {"x", "y", "s"}
+    assert ds["x"].dtype == np.float32
+    assert ds["s"].dtype == object
+    assert ds.first()["s"] == "row0"
+    sel = ds.select("x")
+    assert sel.columns == ["x"]
+    dropped = ds.drop("s")
+    assert set(dropped.columns) == {"x", "y"}
+
+
+def test_dataset_filter_sort_union_split():
+    ds = make_ds()
+    f = ds.filter(ds["y"] == 0)
+    assert all(v == 0 for v in f["y"])
+    f2 = ds.filter(lambda r: r["x"] > 5)
+    assert f2.num_rows == 4
+    srt = ds.sort("x", ascending=False)
+    assert srt["x"][0] == 9.0
+    u = ds.union(ds)
+    assert u.num_rows == 20
+    parts = ds.random_split([0.5, 0.5], seed=1)
+    assert sum(p.num_rows for p in parts) == 10
+
+
+def test_dataset_partitions():
+    ds = make_ds().repartition(3)
+    bounds = ds.partition_bounds()
+    assert bounds == [(0, 4), (4, 7), (7, 10)]
+    parts = ds.partitions()
+    assert [p.num_rows for p in parts] == [4, 3, 3]
+    assert sum(p.num_rows for p in ds.iter_batches(4)) == 10
+
+
+def test_dataset_to_numpy_and_vector_column():
+    ds = make_ds()
+    mat = ds.to_numpy(["x", "y"])
+    assert mat.shape == (10, 2)
+    vec_ds = Dataset({"features": [np.ones(3) * i for i in range(4)]})
+    mat2 = vec_ds.to_numpy(["features"])
+    assert mat2.shape == (4, 3)
+
+
+def test_dataset_groupby():
+    ds = make_ds(9)
+    g = ds.group_by_agg("y", {"total": ("x", "sum"), "n": ("x", "count")})
+    assert g.num_rows == 3
+    assert g["n"].sum() == 9
+
+
+def test_find_unused_column_name():
+    ds = make_ds()
+    assert find_unused_column_name("z", ds) == "z"
+    assert find_unused_column_name("x", ds) == "x_1"
+
+
+def test_dataset_save_load(tmp_path):
+    ds = make_ds()
+    save_dataset(ds, str(tmp_path / "ds"))
+    ds2 = load_dataset(str(tmp_path / "ds"))
+    assert ds2.columns == ds.columns
+    assert ds2.num_partitions == ds.num_partitions
+    np.testing.assert_array_equal(ds2["x"], ds["x"])
+    assert list(ds2["s"]) == list(ds["s"])
+
+
+# -- params ----------------------------------------------------------------
+
+class DummyT(Transformer):
+    scale = FloatParam(doc="scale factor", default=1.0)
+    offset = IntParam(doc="offset", default=0)
+    name = StringParam(doc="mode", default="a", allowed=("a", "b"))
+    flag = BoolParam(doc="flag", default=False)
+    tags = ListParam(doc="tags")
+    inputCol = StringParam(doc="in", default="x")
+    outputCol = StringParam(doc="out", default="out")
+
+    def _transform(self, ds):
+        x = ds[self.inputCol].astype(np.float64)
+        return ds.with_column(self.outputCol, x * self.scale + self.offset)
+
+
+def test_param_validation():
+    t = DummyT()
+    with pytest.raises(TypeError):
+        t.set("scale", "nope")
+    with pytest.raises(ValueError):
+        t.set("name", "c")
+    with pytest.raises(TypeError):
+        t.set("offset", True)
+    with pytest.raises(AttributeError):
+        t.set("nonexistent", 1)
+    t.set("scale", 2)         # int → float coercion
+    assert t.scale == 2.0
+    t.offset = 7              # descriptor assignment
+    assert t.offset == 7
+    assert t.get_or_default("flag") is False
+    assert not t.is_set("flag")
+    t.clear("offset")
+    assert t.offset == 0
+
+
+def test_param_copy_and_explain():
+    t = DummyT(scale=3.0)
+    c = t.copy({"offset": 5})
+    assert c.scale == 3.0 and c.offset == 5
+    assert not t.is_set("offset")
+    assert "scale" in t.explain_params()
+
+
+class TestDummyTFuzzing(TransformerFuzzing):
+    def fuzzing_objects(self):
+        return [TestObject(DummyT(scale=2.0, offset=1), make_ds())]
+
+
+# -- pipeline --------------------------------------------------------------
+
+class MeanEstimator(Estimator):
+    inputCol = StringParam(doc="in", default="x")
+    outputCol = StringParam(doc="out", default="centered")
+
+    def _fit(self, ds):
+        m = float(np.mean(ds[self.inputCol]))
+        return MeanModel(mean=m, inputCol=self.inputCol, outputCol=self.outputCol)
+
+
+class MeanModel(Model):
+    mean = FloatParam(doc="fitted mean")
+    inputCol = StringParam(doc="in", default="x")
+    outputCol = StringParam(doc="out", default="centered")
+
+    def _transform(self, ds):
+        return ds.with_column(self.outputCol, ds[self.inputCol] - self.mean)
+
+
+def test_pipeline_fit_transform():
+    ds = make_ds()
+    pipe = Pipeline([DummyT(scale=2.0, outputCol="x2"),
+                     MeanEstimator(inputCol="x2")])
+    pm = pipe.fit(ds)
+    out = pm.transform(ds)
+    assert "centered" in out.columns
+    assert abs(float(np.mean(out["centered"]))) < 1e-6
+
+
+def test_pipeline_save_load(tmp_path):
+    ds = make_ds()
+    pm = Pipeline([DummyT(scale=2.0, outputCol="x2"),
+                   MeanEstimator(inputCol="x2")]).fit(ds)
+    pm.save(str(tmp_path / "pm"))
+    pm2 = load_stage(str(tmp_path / "pm"))
+    a, b = pm.transform(ds), pm2.transform(ds)
+    np.testing.assert_allclose(a["centered"], b["centered"])
+
+
+class TestMeanEstimatorFuzzing(EstimatorFuzzing):
+    def fuzzing_objects(self):
+        return [TestObject(MeanEstimator(), make_ds())]
+
+
+# -- utils ------------------------------------------------------------------
+
+def test_retry_with_timeout():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("boom")
+        return 42
+
+    assert retry_with_timeout(flaky, timeout_s=5) == 42
+    assert len(calls) == 3
+
+    with pytest.raises(RuntimeError):
+        retry_with_timeout(lambda: 1 / 0, timeout_s=1)
+
+
+def test_stopwatch_and_kahan():
+    sw = StopWatch()
+    with sw.measure():
+        sum(range(1000))
+    assert sw.elapsed_ns > 0
+    k = KahanSum()
+    for _ in range(10):
+        k += 0.1
+    assert abs(k.value - 1.0) < 1e-15
+
+
+def test_logging_scrubber():
+    from synapseml_tpu.core.logging import scrub
+    assert "####" in scrub("https://x?sig=abcdef123&x=1")
+    assert "secret" not in scrub("key=secretsecret1234")
