@@ -1,0 +1,63 @@
+"""Deterministic partition→chip placement.
+
+The reference maps Spark partitions onto LightGBM ranks with a deterministic
+ordering — machines sorted by (host, min partition id), executor→partition
+map broadcast from the driver (reference: NetworkManager.scala:171-180,
+309-315; PartitionTaskContext offsets BasePartitionTask.scala:105-112).
+Here the same contract maps Dataset partitions onto mesh coordinates:
+partition ids are assigned round-robin over the data axis in device order,
+which is itself deterministic (mesh device grid order).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import DATA_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementMap:
+    """partition id -> (data-axis rank, device id); the machine-list analogue."""
+    partition_to_rank: Dict[int, int]
+    rank_to_partitions: Dict[int, List[int]]
+    num_ranks: int
+
+    def partitions_for_rank(self, rank: int) -> List[int]:
+        return self.rank_to_partitions.get(rank, [])
+
+
+def place_partitions(num_partitions: int, mesh: Mesh,
+                     axis: str = DATA_AXIS) -> PlacementMap:
+    """Deterministically assign partitions to data-axis ranks.
+
+    Contiguous block assignment (like Spark's executor→partition grouping):
+    rank r gets partitions [r*k, (r+1)*k) with the remainder spread over the
+    first ranks — stable across runs for a given (num_partitions, mesh).
+    """
+    num_ranks = mesh.shape[axis]
+    base, rem = divmod(num_partitions, num_ranks)
+    p2r: Dict[int, int] = {}
+    r2p: Dict[int, List[int]] = {r: [] for r in range(num_ranks)}
+    pid = 0
+    for r in range(num_ranks):
+        count = base + (1 if r < rem else 0)
+        for _ in range(count):
+            p2r[pid] = r
+            r2p[r].append(pid)
+            pid += 1
+    return PlacementMap(p2r, r2p, num_ranks)
+
+
+def rows_for_rank(ds, placement: PlacementMap, rank: int) -> Tuple[int, int]:
+    """Row range [start, end) owned by a data-axis rank, following the
+    contiguous partition blocks."""
+    parts = placement.partitions_for_rank(rank)
+    bounds = ds.partition_bounds()
+    if not parts:
+        return (0, 0)
+    return (bounds[parts[0]][0], bounds[parts[-1]][1])

@@ -1,0 +1,66 @@
+"""Cluster/device topology discovery — the ``ClusterUtil`` analogue.
+
+The reference discovers Spark executors, tasks-per-executor and driver host
+to size its training topology (reference: core/utils/ClusterUtil.scala:22-141,
+getNumTasksPerExecutor/getNumRowsPerPartition/getDriverHost/getExecutors).
+On TPU the topology is the JAX process/device mesh: hosts are TPU-VM
+workers, "tasks" are chips, and placement is mesh coordinates instead of
+executor ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    """One host (TPU-VM worker) — the 'executor' analogue."""
+    process_index: int
+    device_ids: List[int]
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.device_ids)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Snapshot of the cluster topology."""
+    num_processes: int
+    process_index: int
+    num_devices: int
+    num_local_devices: int
+    platform: str
+    hosts: List[HostInfo]
+
+    def devices_per_host(self) -> int:
+        return self.num_devices // max(1, self.num_processes)
+
+
+def get_topology(devices: Optional[Sequence[jax.Device]] = None) -> Topology:
+    """Discover hosts/chips (ClusterUtil.getExecutors analogue)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    by_proc: Dict[int, List[int]] = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d.id)
+    hosts = [HostInfo(p, sorted(ids)) for p, ids in sorted(by_proc.items())]
+    return Topology(
+        num_processes=jax.process_count(),
+        process_index=jax.process_index(),
+        num_devices=len(devs),
+        num_local_devices=jax.local_device_count(),
+        platform=devs[0].platform if devs else jax.default_backend(),
+        hosts=hosts,
+    )
+
+
+def get_num_rows_per_partition(ds, num_partitions: Optional[int] = None) -> List[int]:
+    """Per-partition row counts (ClusterUtil.getNumRowsPerPartition,
+    ClusterUtil.scala:46 — there a Spark job; here arithmetic)."""
+    if num_partitions is not None:
+        ds = ds.repartition(num_partitions)
+    return [b - a for a, b in ds.partition_bounds()]
