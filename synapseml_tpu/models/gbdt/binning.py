@@ -1,0 +1,99 @@
+"""Quantile binning: raw features → small integer bin indices.
+
+The reference samples rows to compute bin boundaries then broadcasts them to
+workers (reference: LightGBMBase.scala:499-527 calculateRowStatistics →
+sample → collect → broadcast; native binning in the LightGBM C++ lib).
+Here binning is explicit: :class:`BinMapper` holds per-feature upper bin
+boundaries; mapping is a jit-friendly ``searchsorted``.
+
+TPU notes: bins are ``int32`` (dense, static shape); missing values (NaN)
+get their own bin 0 so split decisions can route them; the last bin catches
++inf.  ``max_bin`` defaults to 255 content bins + the NaN bin = 256 total,
+keeping histograms at power-of-two lane width.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+MISSING_BIN = 0  # NaN bucket; content bins are 1..max_bin
+
+
+@dataclasses.dataclass
+class BinMapper:
+    """Per-feature quantile bin boundaries.
+
+    ``upper_bounds[f, b]`` is the inclusive upper raw-value bound of content
+    bin ``b+1``; shape (num_features, max_bin).  Unused trailing bins repeat
+    +inf.  ``num_bins[f]`` counts distinct content bins for feature f.
+    """
+    upper_bounds: np.ndarray          # (F, max_bin) float32
+    num_bins: np.ndarray              # (F,) int32
+    max_bin: int
+
+    @property
+    def num_features(self) -> int:
+        return self.upper_bounds.shape[0]
+
+    @property
+    def total_bins(self) -> int:      # content bins + missing bin
+        return self.max_bin + 1
+
+    def transform(self, features: np.ndarray) -> np.ndarray:
+        """Map raw (n, F) floats → (n, F) int32 bins ∈ [0, max_bin]."""
+        n, f = features.shape
+        out = np.empty((n, f), np.int32)
+        for j in range(f):
+            col = features[:, j]
+            # searchsorted over this feature's bounds; bin ids are 1-based
+            idx = np.searchsorted(self.upper_bounds[j], col, side="left")
+            out[:, j] = np.minimum(idx, self.max_bin - 1) + 1
+            out[np.isnan(col), j] = MISSING_BIN
+        return out
+
+    def bin_threshold_value(self, feature: int, bin_id: int) -> float:
+        """Raw-value threshold for 'bin <= bin_id' splits (for raw predict)."""
+        return float(self.upper_bounds[feature, max(bin_id - 1, 0)])
+
+
+def fit_bin_mapper(features: np.ndarray, max_bin: int = 255,
+                   sample_count: int = 200_000,
+                   seed: int = 0) -> BinMapper:
+    """Compute quantile bin boundaries from a row sample.
+
+    Mirrors the reference's sampled dataset creation
+    (LGBM_DatasetCreateFromSampledColumn, StreamingPartitionTask.scala:374):
+    sample rows, per-feature quantiles as boundaries, dedup to distinct
+    values when a feature has few uniques.
+    """
+    n, f = features.shape
+    if n > sample_count:
+        rng = np.random.default_rng(seed)
+        sample = features[rng.choice(n, sample_count, replace=False)]
+    else:
+        sample = features
+    upper = np.full((f, max_bin), np.inf, np.float32)
+    nbins = np.zeros(f, np.int32)
+    for j in range(f):
+        col = sample[:, j]
+        col = col[~np.isnan(col)]
+        if col.size == 0:
+            nbins[j] = 1
+            continue
+        uniq = np.unique(col)
+        if len(uniq) <= max_bin:
+            # one bin per distinct value; boundary midway to the next value
+            bounds = (uniq[:-1] + uniq[1:]) / 2 if len(uniq) > 1 else np.array([], np.float64)
+            k = len(bounds)
+            upper[j, :k] = bounds
+            nbins[j] = k + 1
+        else:
+            qs = np.quantile(col, np.linspace(0, 1, max_bin + 1)[1:-1])
+            bounds = np.unique(qs.astype(np.float32))
+            k = len(bounds)
+            upper[j, :k] = bounds
+            nbins[j] = k + 1
+    return BinMapper(upper_bounds=upper, num_bins=nbins, max_bin=max_bin)

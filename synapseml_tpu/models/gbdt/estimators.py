@@ -1,0 +1,398 @@
+"""GBDT pipeline estimators: classifier / regressor / ranker.
+
+The user-facing API of the boosting engine, mirroring the reference's
+``LightGBMClassifier/LightGBMRegressor/LightGBMRanker`` estimator/model
+pairs and their param surface (reference: lightgbm/.../LightGBMClassifier.scala:27-211,
+LightGBMRegressor.scala, LightGBMRanker.scala, params/LightGBMParams.scala:1-621).
+
+Key re-designs for TPU:
+- ``fit`` trains via the jitted histogram grower over a device mesh
+  (data-parallel psum) instead of barrier-mode ``mapPartitions`` + native
+  allreduce (LightGBMBase.scala:584-599);
+- ``transform`` scores whole column batches with one XLA traversal instead
+  of one JNI call per row (LightGBMClassifier.scala:119-166 per-row UDFs);
+- ``numBatches`` folds warm-started training over row batches like
+  LightGBMBase.scala:44-59;
+- ``validationIndicatorCol`` carves the validation rows out of the input
+  frame exactly like the reference (LightGBMBase.scala:403-407).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+
+from ...core.dataset import Dataset, find_unused_column_name
+from ...core.params import (BoolParam, DictParam, FloatParam, IntParam,
+                            ListParam, Params, PyObjectParam, StringParam,
+                            UDFParam)
+from ...core.pipeline import Estimator, Model
+from ...parallel.mesh import DATA_AXIS, data_parallel_mesh
+from .booster import Booster, BoostingConfig, train
+
+
+class GBDTParams(Params):
+    """Shared boosting params (reference: params/LightGBMParams.scala)."""
+    featuresCol = StringParam(doc="features vector column", default="features")
+    labelCol = StringParam(doc="label column", default="label")
+    weightCol = StringParam(doc="sample weight column")
+    predictionCol = StringParam(doc="prediction output column", default="prediction")
+    validationIndicatorCol = StringParam(
+        doc="bool column marking validation rows (LightGBMBase.scala:403)")
+    numIterations = IntParam(doc="number of boosting iterations", default=100)
+    learningRate = FloatParam(doc="shrinkage rate", default=0.1)
+    numLeaves = IntParam(doc="max leaves per tree", default=31)
+    maxDepth = IntParam(doc="max tree depth (<=0: unlimited)", default=-1)
+    minDataInLeaf = IntParam(doc="min rows per leaf", default=20)
+    minSumHessianInLeaf = FloatParam(doc="min hessian sum per leaf", default=1e-3)
+    lambdaL1 = FloatParam(doc="L1 regularization", default=0.0)
+    lambdaL2 = FloatParam(doc="L2 regularization", default=0.0)
+    minGainToSplit = FloatParam(doc="min split gain", default=0.0)
+    maxBin = IntParam(doc="max feature bins", default=255)
+    binSampleCount = IntParam(doc="rows sampled for bin boundaries", default=200000)
+    featureFraction = FloatParam(doc="per-tree feature subsample", default=1.0)
+    baggingFraction = FloatParam(doc="row subsample fraction", default=1.0)
+    baggingFreq = IntParam(doc="resample every k iterations", default=0)
+    baggingSeed = IntParam(doc="bagging seed", default=3)
+    boostingType = StringParam(doc="gbdt|rf|dart|goss", default="gbdt",
+                               allowed=("gbdt", "rf", "dart", "goss"))
+    topRate = FloatParam(doc="goss top-gradient keep rate", default=0.2)
+    otherRate = FloatParam(doc="goss small-gradient sample rate", default=0.1)
+    dropRate = FloatParam(doc="dart tree dropout rate", default=0.1)
+    maxDrop = IntParam(doc="dart max dropped trees per iter", default=50)
+    skipDrop = FloatParam(doc="dart skip-dropout probability", default=0.5)
+    earlyStoppingRound = IntParam(doc="early stopping patience (0=off)", default=0)
+    metric = StringParam(doc="eval metric name", default="")
+    boostFromAverage = BoolParam(doc="init score from label mean", default=True)
+    seed = IntParam(doc="master seed", default=0)
+    verbosity = IntParam(doc="log verbosity", default=-1)
+    numBatches = IntParam(
+        doc="split data into k sequential warm-started batches "
+            "(LightGBMBase.scala:44-59)", default=0)
+    numShards = IntParam(
+        doc="data-parallel shards over the device mesh; 0 = all local "
+            "devices (partition→chip placement)", default=0)
+    parallelism = StringParam(doc="data_parallel|voting_parallel",
+                              default="data_parallel",
+                              allowed=("data_parallel", "voting_parallel"))
+    topK = IntParam(doc="voting-parallel top features per shard", default=20)
+    passThroughArgs = DictParam(doc="extra engine params (ParamsStringBuilder "
+                                    "pass-through analogue)")
+    predictDisableShapeCheck = BoolParam(doc="skip feature-count check at "
+                                             "predict", default=False)
+
+    def _build_config(self, objective: str, num_class: int = 1) -> BoostingConfig:
+        extra = self.passThroughArgs or {}
+        cfg = BoostingConfig(
+            objective=objective,
+            boosting_type=self.boostingType,
+            num_iterations=self.numIterations,
+            learning_rate=self.learningRate,
+            num_leaves=self.numLeaves,
+            max_depth=self.maxDepth,
+            min_data_in_leaf=self.minDataInLeaf,
+            min_sum_hessian_in_leaf=self.minSumHessianInLeaf,
+            lambda_l1=self.lambdaL1,
+            lambda_l2=self.lambdaL2,
+            min_gain_to_split=self.minGainToSplit,
+            max_bin=self.maxBin,
+            bin_sample_count=self.binSampleCount,
+            feature_fraction=self.featureFraction,
+            bagging_fraction=self.baggingFraction,
+            bagging_freq=self.baggingFreq,
+            bagging_seed=self.baggingSeed,
+            seed=self.seed,
+            num_class=num_class,
+            boost_from_average=self.boostFromAverage,
+            early_stopping_round=self.earlyStoppingRound,
+            metric=self.metric,
+            top_rate=self.topRate,
+            other_rate=self.otherRate,
+            drop_rate=self.dropRate,
+            max_drop=self.maxDrop,
+            skip_drop=self.skipDrop,
+        )
+        for k, v in extra.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+            else:
+                cfg.pass_through[k] = v
+        if self.parallelism == "voting_parallel":
+            import logging
+            logging.getLogger("synapseml_tpu").warning(
+                "voting_parallel is not yet implemented; falling back to "
+                "data_parallel (full histogram psum)")
+        return cfg
+
+    def _mesh(self, n_rows: int):
+        shards = self.numShards
+        if shards == 0:
+            shards = min(len(jax.devices()), max(1, n_rows // 1024))
+        if shards <= 1:
+            return None
+        return data_parallel_mesh(shards)
+
+    def _features_matrix(self, ds: Dataset) -> np.ndarray:
+        return ds.to_numpy([self.featuresCol])
+
+    def _split_validation(self, ds: Dataset):
+        """Carve out validation rows (LightGBMBase.scala:403-407)."""
+        vcol = self.validationIndicatorCol
+        if vcol and vcol in ds:
+            mask = ds[vcol].astype(bool)
+            return ds.filter(~mask), ds.filter(mask)
+        return ds, None
+
+
+class GBDTModelBase(Model):
+    featuresCol = StringParam(doc="features vector column", default="features")
+    predictionCol = StringParam(doc="prediction output column", default="prediction")
+    leafPredictionCol = StringParam(doc="per-tree leaf index output column")
+    featuresShapCol = StringParam(doc="per-feature contribution output column")
+    numIterationsUsed = IntParam(doc="trees used at predict (-1: all)", default=-1)
+    predictDisableShapeCheck = BoolParam(doc="skip feature-count check",
+                                         default=False)
+    boosterModel = PyObjectParam(doc="trained booster")
+
+    @property
+    def booster(self) -> Booster:
+        return self.boosterModel
+
+    def get_feature_importances(self, importance_type: str = "split") -> List[float]:
+        return list(self.booster.feature_importance(importance_type))
+
+    def get_booster_num_trees(self) -> int:
+        return self.booster.num_trees
+
+    def get_model_string(self) -> str:
+        """saveNativeModel analogue (LightGBMBooster.saveToString)."""
+        return self.booster.to_string()
+
+    def _check_features(self, X: np.ndarray):
+        expected = self.booster.bin_mapper.num_features
+        if not self.predictDisableShapeCheck and X.shape[1] != expected:
+            raise ValueError(f"feature count {X.shape[1]} != model's {expected}")
+
+    def _maybe_add_leaves(self, ds: Dataset, X: np.ndarray) -> Dataset:
+        if self.leafPredictionCol:
+            leaves = self.booster.predict_leaf(X).astype(np.float64)
+            ds = ds.with_column(self.leafPredictionCol, list(leaves))
+        if self.featuresShapCol:
+            shap = self.booster.predict_contrib(X)
+            ds = ds.with_column(self.featuresShapCol, list(shap))
+        return ds
+
+
+class GBDTClassifier(GBDTParams, Estimator):
+    """LightGBMClassifier analogue (reference: LightGBMClassifier.scala:27)."""
+    objective = StringParam(doc="binary|multiclass|multiclassova", default="binary",
+                            allowed=("binary", "multiclass", "multiclassova"))
+    probabilityCol = StringParam(doc="probability vector column", default="probability")
+    rawPredictionCol = StringParam(doc="margin vector column", default="rawPrediction")
+    isUnbalance = BoolParam(doc="auto-reweight positive class", default=False)
+    scalePosWeight = FloatParam(doc="positive class weight", default=1.0)
+    thresholds = ListParam(doc="per-class prediction thresholds")
+
+    def _fit(self, ds: Dataset) -> "GBDTClassificationModel":
+        train_ds, valid_ds = self._split_validation(ds)
+        X = self._features_matrix(train_ds)
+        y_raw = np.asarray(train_ds[self.labelCol], np.float64)
+        w = train_ds[self.weightCol].astype(np.float32) if self.weightCol else None
+        classes = np.unique(y_raw[~np.isnan(y_raw)])
+        num_class = len(classes)
+        # remap arbitrary label values to contiguous 0..K-1 class indices
+        y = np.searchsorted(classes, y_raw).astype(np.float64)
+        objective = self.objective
+        if objective == "binary" and num_class > 2:
+            objective = "multiclass"
+        K = num_class if objective in ("multiclass", "multiclassova") else 1
+        cfg = self._build_config(objective, max(K, 1))
+        cfg.is_unbalance = self.isUnbalance
+        cfg.scale_pos_weight = self.scalePosWeight
+
+        valid = None
+        if valid_ds is not None and valid_ds.num_rows > 0:
+            yv_raw = np.asarray(valid_ds[self.labelCol], np.float64)
+            valid = (self._features_matrix(valid_ds),
+                     np.searchsorted(classes, yv_raw).astype(np.float64),
+                     valid_ds[self.weightCol].astype(np.float32)
+                     if self.weightCol else None)
+
+        booster, history = _train_batched(
+            X, y, cfg, w, valid, self.numBatches, self._mesh(len(X)),
+            seed=self.seed)
+        model = GBDTClassificationModel(
+            boosterModel=booster,
+            featuresCol=self.featuresCol,
+            predictionCol=self.predictionCol,
+            probabilityCol=self.probabilityCol,
+            rawPredictionCol=self.rawPredictionCol,
+            numClasses=max(num_class, 2),
+            classLabels=[float(c) for c in classes],
+        )
+        if self.is_set("thresholds"):
+            model.set("thresholds", self.thresholds)
+        model._eval_history = history
+        return model
+
+
+class GBDTClassificationModel(GBDTModelBase):
+    """LightGBMClassificationModel analogue; batched scoring."""
+    probabilityCol = StringParam(doc="probability vector column", default="probability")
+    rawPredictionCol = StringParam(doc="margin vector column", default="rawPrediction")
+    numClasses = IntParam(doc="number of classes", default=2)
+    classLabels = ListParam(doc="original label value per class index")
+    thresholds = ListParam(doc="per-class prediction thresholds")
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        X = ds.to_numpy([self.featuresCol])
+        self._check_features(X)
+        ni = self.numIterationsUsed
+        margin = self.booster.predict_margin(X, None if ni < 0 else ni)
+        proba = self.booster.to_proba(np.asarray(margin))
+        if margin.ndim == 1:
+            raw = np.stack([-margin, margin], axis=1)
+        else:
+            raw = margin
+        if self.thresholds:
+            scaled = proba / np.asarray(self.thresholds)[None, :]
+            pred = np.argmax(scaled, axis=1).astype(np.float64)
+        else:
+            pred = np.argmax(proba, axis=1).astype(np.float64)
+        if self.classLabels:
+            pred = np.asarray(self.classLabels, np.float64)[pred.astype(int)]
+        out = ds
+        if self.rawPredictionCol:
+            out = out.with_column(self.rawPredictionCol, list(raw.astype(np.float64)))
+        if self.probabilityCol:
+            out = out.with_column(self.probabilityCol, list(proba.astype(np.float64)))
+        out = out.with_column(self.predictionCol, pred)
+        return self._maybe_add_leaves(out, X)
+
+    @staticmethod
+    def load_native_model_from_string(s: str, **kw) -> "GBDTClassificationModel":
+        """loadNativeModelFromString analogue (LightGBMClassifier.scala:196)."""
+        b = Booster.from_string(s)
+        return GBDTClassificationModel(boosterModel=b,
+                                       numClasses=max(b.num_class, 2), **kw)
+
+
+class GBDTRegressor(GBDTParams, Estimator):
+    """LightGBMRegressor analogue."""
+    objective = StringParam(
+        doc="regression objective", default="regression",
+        allowed=("regression", "regression_l1", "huber", "fair", "poisson",
+                 "quantile", "mape", "gamma", "tweedie", "mse", "mae"))
+    alpha = FloatParam(doc="huber/quantile alpha", default=0.9)
+    tweedieVariancePower = FloatParam(doc="tweedie variance power", default=1.5)
+
+    def _fit(self, ds: Dataset) -> "GBDTRegressionModel":
+        train_ds, valid_ds = self._split_validation(ds)
+        X = self._features_matrix(train_ds)
+        y = np.asarray(train_ds[self.labelCol], np.float64)
+        w = train_ds[self.weightCol].astype(np.float32) if self.weightCol else None
+        cfg = self._build_config(self.objective)
+        cfg.alpha = self.alpha
+        cfg.tweedie_variance_power = self.tweedieVariancePower
+        valid = None
+        if valid_ds is not None and valid_ds.num_rows > 0:
+            valid = (self._features_matrix(valid_ds),
+                     np.asarray(valid_ds[self.labelCol], np.float64),
+                     valid_ds[self.weightCol].astype(np.float32)
+                     if self.weightCol else None)
+        booster, history = _train_batched(
+            X, y, cfg, w, valid, self.numBatches, self._mesh(len(X)),
+            seed=self.seed)
+        model = GBDTRegressionModel(
+            boosterModel=booster,
+            featuresCol=self.featuresCol,
+            predictionCol=self.predictionCol,
+        )
+        model._eval_history = history
+        return model
+
+
+class GBDTRegressionModel(GBDTModelBase):
+    def _transform(self, ds: Dataset) -> Dataset:
+        X = ds.to_numpy([self.featuresCol])
+        self._check_features(X)
+        ni = self.numIterationsUsed
+        pred = self.booster.predict_margin(X, None if ni < 0 else ni)
+        if self.booster.objective in ("poisson", "gamma", "tweedie"):
+            pred = np.exp(pred)
+        out = ds.with_column(self.predictionCol, np.asarray(pred, np.float64))
+        return self._maybe_add_leaves(out, X)
+
+    @staticmethod
+    def load_native_model_from_string(s: str, **kw) -> "GBDTRegressionModel":
+        return GBDTRegressionModel(boosterModel=Booster.from_string(s), **kw)
+
+
+class GBDTRanker(GBDTParams, Estimator):
+    """LightGBMRanker analogue (lambdarank objective + groupCol)."""
+    groupCol = StringParam(doc="query/group id column", default="query")
+    maxPosition = IntParam(doc="NDCG truncation position", default=10)
+    labelGain = ListParam(doc="relevance gain per label level")
+    evalAt = ListParam(doc="NDCG eval positions", default=[1, 3, 5, 10])
+
+    def _fit(self, ds: Dataset) -> "GBDTRankerModel":
+        train_ds, valid_ds = self._split_validation(ds)
+        # group-contiguous layout required: stable-sort by group id
+        train_ds = train_ds.sort(self.groupCol)
+        X = self._features_matrix(train_ds)
+        y = np.asarray(train_ds[self.labelCol], np.float64)
+        w = train_ds[self.weightCol].astype(np.float32) if self.weightCol else None
+        gids = train_ds[self.groupCol]
+        _, counts = np.unique(gids, return_counts=True)
+        cfg = self._build_config("lambdarank")
+        cfg.max_position = self.maxPosition
+        if self.labelGain:
+            cfg.label_gain = list(self.labelGain)
+        valid = None
+        vgroups = None
+        if valid_ds is not None and valid_ds.num_rows > 0:
+            valid_ds = valid_ds.sort(self.groupCol)
+            _, vgroups = np.unique(valid_ds[self.groupCol], return_counts=True)
+            valid = (self._features_matrix(valid_ds),
+                     np.asarray(valid_ds[self.labelCol], np.float64),
+                     valid_ds[self.weightCol].astype(np.float32)
+                     if self.weightCol else None)
+        booster, history = train(
+            X, y, cfg, sample_weight=w, valid=valid, mesh=None,
+            group=counts, valid_group=vgroups)
+        model = GBDTRankerModel(
+            boosterModel=booster,
+            featuresCol=self.featuresCol,
+            predictionCol=self.predictionCol,
+        )
+        model._eval_history = history
+        return model
+
+
+class GBDTRankerModel(GBDTModelBase):
+    def _transform(self, ds: Dataset) -> Dataset:
+        X = ds.to_numpy([self.featuresCol])
+        self._check_features(X)
+        ni = self.numIterationsUsed
+        pred = self.booster.predict_margin(X, None if ni < 0 else ni)
+        out = ds.with_column(self.predictionCol, np.asarray(pred, np.float64))
+        return self._maybe_add_leaves(out, X)
+
+
+def _train_batched(X, y, cfg, w, valid, num_batches: int, mesh, seed: int):
+    """numBatches fold-over warm start (LightGBMBase.scala:44-59)."""
+    if num_batches and num_batches > 1:
+        n = len(X)
+        idx = np.array_split(np.arange(n), num_batches)
+        booster = None
+        history = []
+        for part in idx:
+            booster, h = train(X[part], y[part], cfg,
+                               sample_weight=None if w is None else w[part],
+                               valid=valid, mesh=mesh, init_model=booster)
+            history.extend(h)
+        return booster, history
+    return train(X, y, cfg, sample_weight=w, valid=valid, mesh=mesh)

@@ -1,0 +1,137 @@
+"""Boosting objectives: gradients/hessians as jitted elementwise kernels.
+
+The reference passes an objective *name* through to native LightGBM
+(reference: lightgbm/.../params/BaseTrainParams.scala:99 objective param;
+custom objectives via FObjTrait, params/FObjTrait.scala:1-17).  Here each
+objective is a pure function ``(scores, labels, weights) -> (grad, hess)``
+fused by XLA into the training step.  Custom objectives are plain Python
+callables with the same signature (the FObj analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+ObjectiveFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def _binary(scores, labels, weights):
+    p = jax.nn.sigmoid(scores)
+    grad = (p - labels) * weights
+    hess = jnp.maximum(p * (1.0 - p), 1e-16) * weights
+    return grad, hess
+
+
+def _l2(scores, labels, weights):
+    return (scores - labels) * weights, weights
+
+
+def _l1(scores, labels, weights):
+    grad = jnp.sign(scores - labels) * weights
+    hess = weights  # LightGBM uses constant hessian for L1
+    return grad, hess
+
+
+def _huber(scores, labels, weights, alpha=0.9):
+    diff = scores - labels
+    grad = jnp.where(jnp.abs(diff) <= alpha, diff, alpha * jnp.sign(diff)) * weights
+    hess = jnp.where(jnp.abs(diff) <= alpha, 1.0, 1e-2) * weights
+    return grad, hess
+
+
+def _fair(scores, labels, weights, c=1.0):
+    diff = scores - labels
+    grad = c * diff / (jnp.abs(diff) + c) * weights
+    hess = c * c / (jnp.abs(diff) + c) ** 2 * weights
+    return grad, hess
+
+
+def _poisson(scores, labels, weights):
+    exp_s = jnp.exp(scores)
+    return (exp_s - labels) * weights, exp_s * weights
+
+
+def _quantile(scores, labels, weights, alpha=0.5):
+    diff = scores - labels
+    grad = jnp.where(diff >= 0, 1.0 - alpha, -alpha) * weights
+    return grad, weights
+
+
+def _mape(scores, labels, weights):
+    safe = jnp.maximum(jnp.abs(labels), 1.0)
+    grad = jnp.sign(scores - labels) / safe * weights
+    return grad, weights / safe
+
+
+def _gamma(scores, labels, weights):
+    exp_s = jnp.exp(-scores)
+    grad = (1.0 - labels * exp_s) * weights
+    hess = labels * exp_s * weights
+    return grad, jnp.maximum(hess, 1e-16)
+
+
+def _tweedie(scores, labels, weights, rho=1.5):
+    exp1 = jnp.exp((1.0 - rho) * scores)
+    exp2 = jnp.exp((2.0 - rho) * scores)
+    grad = (-labels * exp1 + exp2) * weights
+    hess = (-labels * (1.0 - rho) * exp1 + (2.0 - rho) * exp2) * weights
+    return grad, jnp.maximum(hess, 1e-16)
+
+
+REGRESSION_OBJECTIVES: Dict[str, ObjectiveFn] = {
+    "regression": _l2,
+    "regression_l2": _l2,
+    "mean_squared_error": _l2,
+    "mse": _l2,
+    "regression_l1": _l1,
+    "mae": _l1,
+    "huber": _huber,
+    "fair": _fair,
+    "poisson": _poisson,
+    "quantile": _quantile,
+    "mape": _mape,
+    "gamma": _gamma,
+    "tweedie": _tweedie,
+}
+
+BINARY_OBJECTIVES: Dict[str, ObjectiveFn] = {
+    "binary": _binary,
+}
+
+
+def softmax_grad_hess(scores, labels_onehot, weights):
+    """Multiclass softmax: scores (n, K) → grad/hess (n, K)
+    (LightGBM 'multiclass' objective)."""
+    p = jax.nn.softmax(scores, axis=-1)
+    grad = (p - labels_onehot) * weights[:, None]
+    hess = jnp.maximum(2.0 * p * (1.0 - p), 1e-16) * weights[:, None]
+    return grad, hess
+
+
+def get_objective(name: str) -> ObjectiveFn:
+    if name in BINARY_OBJECTIVES:
+        return BINARY_OBJECTIVES[name]
+    if name in REGRESSION_OBJECTIVES:
+        return REGRESSION_OBJECTIVES[name]
+    raise ValueError(f"unknown objective {name!r}; known: "
+                     f"{sorted(BINARY_OBJECTIVES) + sorted(REGRESSION_OBJECTIVES)}")
+
+
+# -- initial score (boost_from_average semantics) ---------------------------
+
+def initial_score(objective: str, labels, weights) -> float:
+    import numpy as np
+    labels = np.asarray(labels, np.float64)
+    weights = np.asarray(weights, np.float64)
+    mean = float((labels * weights).sum() / max(weights.sum(), 1e-12))
+    if objective == "binary":
+        mean = min(max(mean, 1e-6), 1 - 1e-6)
+        return float(np.log(mean / (1 - mean)))
+    if objective in ("poisson", "gamma", "tweedie"):
+        return float(np.log(max(mean, 1e-12)))
+    if objective in ("regression_l1", "mae", "quantile"):
+        return float(np.median(labels))
+    return mean

@@ -1,0 +1,347 @@
+"""Leaf-wise histogram tree growth, fully inside ``jit``.
+
+This replaces LightGBM's native C++ tree learner (reference: the black box
+behind LGBM_BoosterUpdateOneIter, booster/LightGBMBooster.scala:359; per-iter
+histogram build + cross-machine allreduce + split).  The TPU formulation:
+
+- **Static shapes everywhere**: exactly ``num_leaves-1`` split iterations in
+  a ``lax.fori_loop``; zero-gain iterations are no-ops guarded by
+  ``lax.cond``.  Histograms live in a slot-reused buffer of ``num_leaves+1``
+  slots (a split's left child reuses the parent's slot, the right child
+  takes a fresh one) so memory stays O(num_leaves · F · B).
+- **Histogram subtraction**: only the left child's histogram is built by
+  scatter-add; the right child's is parent − left (LightGBM's classic
+  optimization, here it also halves scatter traffic).
+- **Data-parallel = one psum**: rows are sharded over the mesh ``data``
+  axis; passing ``axis_name`` makes every histogram build and root-stat
+  reduction a ``lax.psum`` — the entire replacement for the reference's
+  driver-socket rendezvous + native allreduce ring
+  (NetworkManager.scala:55-205).  The growth loop itself is replicated and
+  deterministic on every rank.
+- **Missing values**: NaN maps to bin 0 and always routes left (a fixed
+  default-left policy).
+
+Split gain follows LightGBM: with G/H the child gradient/hessian sums,
+``score(G,H) = T(G)^2 / (H + λ2)`` where T is the L1 soft-threshold, and
+``gain = score(GL,HL) + score(GR,HR) - score(G,H)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class GrowthParams(NamedTuple):
+    """Static growth hyperparameters (hashable → part of the jit key)."""
+    num_leaves: int = 31
+    max_depth: int = -1               # <=0: unlimited (bounded by num_leaves)
+    min_data_in_leaf: float = 20.0
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    total_bins: int = 256             # B (incl. missing bin 0)
+
+
+class Tree(NamedTuple):
+    """Flat tree arrays; node 0 is the root. -1 children ⇒ leaf."""
+    split_feature: jnp.ndarray        # (MAX_NODES,) int32
+    split_bin: jnp.ndarray            # (MAX_NODES,) int32 (go left if bin<=)
+    threshold: jnp.ndarray            # (MAX_NODES,) f32 raw-value threshold
+    split_gain: jnp.ndarray           # (MAX_NODES,) f32 (0 for leaves)
+    left_child: jnp.ndarray           # (MAX_NODES,) int32
+    right_child: jnp.ndarray          # (MAX_NODES,) int32
+    leaf_value: jnp.ndarray           # (MAX_NODES,) f32 (already shrunk)
+    node_value: jnp.ndarray           # (MAX_NODES,) f32 output at every node
+    num_nodes: jnp.ndarray            # () int32
+
+
+def max_nodes(num_leaves: int) -> int:
+    return 2 * num_leaves
+
+
+def _soft_threshold(g, l1):
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+
+def _leaf_score(g, h, l1, l2):
+    t = _soft_threshold(g, l1)
+    return t * t / (h + l2 + 1e-32)
+
+
+def _leaf_output(g, h, l1, l2):
+    return -_soft_threshold(g, l1) / (h + l2 + 1e-32)
+
+
+def _build_hist(flat_bins, grad, hess, mask, F, B):
+    """Scatter-add histogram for masked rows.
+
+    flat_bins: (N, F) int32 = bins + f*B (precomputed); ``mask`` is the
+    row weight (bag/GOSS amplification); the count channel counts rows with
+    mask>0 exactly once so GOSS amplification never inflates leaf counts.
+    Returns (F*B, 3) float32 [grad, hess, count]."""
+    count = (mask > 0).astype(jnp.float32)
+    upd = jnp.stack([grad * mask, hess * mask, count], axis=-1)           # (N,3)
+    upd = jnp.broadcast_to(upd[:, None, :], flat_bins.shape + (3,))       # (N,F,3)
+    hist = jnp.zeros((F * B, 3), jnp.float32)
+    return hist.at[flat_bins].add(upd)
+
+
+def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
+                node_depth, p: GrowthParams):
+    """Best (gain, feature, bin, left-sums) from a node histogram.
+
+    hist: (F, B, 3). Split at bin b sends bins<=b left, b ∈ [0, B-2].
+    """
+    F, B, _ = hist.shape
+    cum = jnp.cumsum(hist, axis=1)                   # (F, B, 3)
+    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+    gr, hr, cr = sum_g - gl, sum_h - hl, sum_c - cl
+    gain = (_leaf_score(gl, hl, p.lambda_l1, p.lambda_l2)
+            + _leaf_score(gr, hr, p.lambda_l1, p.lambda_l2)
+            - _leaf_score(sum_g, sum_h, p.lambda_l1, p.lambda_l2))
+    bins_idx = jnp.arange(B)[None, :]
+    valid = ((cl >= p.min_data_in_leaf) & (cr >= p.min_data_in_leaf)
+             & (hl >= p.min_sum_hessian_in_leaf)
+             & (hr >= p.min_sum_hessian_in_leaf)
+             & (bins_idx < (num_bins[:, None] + 1) - 1)   # inside feature's bin range
+             & (bins_idx < B - 1)
+             & feature_mask[:, None])
+    if p.max_depth > 0:
+        valid = valid & (node_depth < p.max_depth)
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = jnp.argmax(gain)
+    bf, bb = flat // B, flat % B
+    bgain = gain[bf, bb]
+    return bgain, bf.astype(jnp.int32), bb.astype(jnp.int32), \
+        gl[bf, bb], hl[bf, bb], cl[bf, bb]
+
+
+@functools.partial(jax.jit, static_argnames=("p", "axis_name"))
+def grow_tree(binned: jnp.ndarray,          # (N, F) int32
+              grad: jnp.ndarray,            # (N,) f32 (0 for pad rows)
+              hess: jnp.ndarray,            # (N,) f32 (0 for pad rows)
+              row_valid: jnp.ndarray,       # (N,) f32 bag-weight ∈ {0,1} or GOSS weight
+              feature_mask: jnp.ndarray,    # (F,) bool — feature_fraction mask
+              upper_bounds: jnp.ndarray,    # (F, B-1) f32 raw bin bounds
+              num_bins: jnp.ndarray,        # (F,) int32
+              learning_rate: float,
+              p: GrowthParams,
+              axis_name: Optional[str] = None,
+              ) -> Tuple[Tree, jnp.ndarray]:
+    """Grow one tree; returns (tree, per-row leaf node ids).
+
+    When ``axis_name`` is set the function must run inside shard_map over
+    that axis; histograms and root stats are psum'd so every rank grows the
+    identical tree from its row shard.
+    """
+    N, F = binned.shape
+    B = p.total_bins
+    L = p.num_leaves
+    M = max_nodes(L)
+
+    def ar(x):
+        return lax.psum(x, axis_name) if axis_name else x
+
+    flat_bins = binned + (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+
+    # root
+    root_hist = ar(_build_hist(flat_bins, grad, hess,
+                               row_valid, F, B)).reshape(F, B, 3)
+    root_g = jnp.sum(root_hist[0, :, 0])
+    root_h = jnp.sum(root_hist[0, :, 1])
+    root_c = jnp.sum(root_hist[0, :, 2])
+
+    # per-node state
+    zi = jnp.zeros(M, jnp.int32)
+    zf = jnp.zeros(M, jnp.float32)
+    state = dict(
+        node_id=jnp.zeros(N, jnp.int32),
+        hist=jnp.zeros((L + 1, F * B, 3), jnp.float32).at[0].set(
+            root_hist.reshape(F * B, 3)),
+        slot=zi,                                   # node -> hist slot
+        sum_g=zf.at[0].set(root_g),
+        sum_h=zf.at[0].set(root_h),
+        sum_c=zf.at[0].set(root_c),
+        depth=zi,
+        best_gain=jnp.full(M, -jnp.inf, jnp.float32),
+        best_feat=zi, best_bin=zi,
+        best_gl=zf, best_hl=zf, best_cl=zf,
+        active=jnp.zeros(M, jnp.bool_).at[0].set(True),
+        split_feature=jnp.full(M, -1, jnp.int32),
+        split_bin=zi,
+        split_gain=zf,
+        threshold=zf,
+        left_child=jnp.full(M, -1, jnp.int32),
+        right_child=jnp.full(M, -1, jnp.int32),
+        num_nodes=jnp.ones((), jnp.int32),
+        next_slot=jnp.ones((), jnp.int32),
+    )
+
+    bg, bf_, bb, bgl, bhl, bcl = _best_split(
+        root_hist, root_g, root_h, root_c, num_bins, feature_mask,
+        jnp.zeros((), jnp.int32), p)
+    state["best_gain"] = state["best_gain"].at[0].set(bg)
+    state["best_feat"] = state["best_feat"].at[0].set(bf_)
+    state["best_bin"] = state["best_bin"].at[0].set(bb)
+    state["best_gl"] = state["best_gl"].at[0].set(bgl)
+    state["best_hl"] = state["best_hl"].at[0].set(bhl)
+    state["best_cl"] = state["best_cl"].at[0].set(bcl)
+
+    def do_split(s):
+        gains = jnp.where(s["active"], s["best_gain"], -jnp.inf)
+        leaf = jnp.argmax(gains).astype(jnp.int32)
+        feat, sbin = s["best_feat"][leaf], s["best_bin"][leaf]
+        l_id = s["num_nodes"]
+        r_id = s["num_nodes"] + 1
+
+        in_leaf = s["node_id"] == leaf
+        go_left = binned[jnp.arange(N), feat] <= sbin
+        new_node_id = jnp.where(in_leaf, jnp.where(go_left, l_id, r_id),
+                                s["node_id"])
+
+        # left child hist by scatter, right by subtraction
+        lmask = (new_node_id == l_id).astype(jnp.float32) * row_valid
+        l_hist = ar(_build_hist(flat_bins, grad, hess, lmask, F, B))
+        parent_slot = s["slot"][leaf]
+        r_hist = s["hist"][parent_slot] - l_hist
+        r_slot = s["next_slot"]
+        hist = s["hist"].at[parent_slot].set(l_hist).at[r_slot].set(r_hist)
+
+        lg, lh, lc = s["best_gl"][leaf], s["best_hl"][leaf], s["best_cl"][leaf]
+        rg, rh, rc = s["sum_g"][leaf] - lg, s["sum_h"][leaf] - lh, s["sum_c"][leaf] - lc
+        cdepth = s["depth"][leaf] + 1
+
+        lbg, lbf, lbb, lbgl, lbhl, lbcl = _best_split(
+            l_hist.reshape(F, B, 3), lg, lh, lc, num_bins, feature_mask, cdepth, p)
+        rbg, rbf, rbb, rbgl, rbhl, rbcl = _best_split(
+            r_hist.reshape(F, B, 3), rg, rh, rc, num_bins, feature_mask, cdepth, p)
+
+        thr = jnp.where(sbin >= 1, upper_bounds[feat, jnp.maximum(sbin - 1, 0)],
+                        -jnp.inf)
+
+        return dict(
+            node_id=new_node_id,
+            hist=hist,
+            slot=s["slot"].at[l_id].set(parent_slot).at[r_id].set(r_slot),
+            sum_g=s["sum_g"].at[l_id].set(lg).at[r_id].set(rg),
+            sum_h=s["sum_h"].at[l_id].set(lh).at[r_id].set(rh),
+            sum_c=s["sum_c"].at[l_id].set(lc).at[r_id].set(rc),
+            depth=s["depth"].at[l_id].set(cdepth).at[r_id].set(cdepth),
+            best_gain=s["best_gain"].at[l_id].set(lbg).at[r_id].set(rbg),
+            best_feat=s["best_feat"].at[l_id].set(lbf).at[r_id].set(rbf),
+            best_bin=s["best_bin"].at[l_id].set(lbb).at[r_id].set(rbb),
+            best_gl=s["best_gl"].at[l_id].set(lbgl).at[r_id].set(rbgl),
+            best_hl=s["best_hl"].at[l_id].set(lbhl).at[r_id].set(rbhl),
+            best_cl=s["best_cl"].at[l_id].set(lbcl).at[r_id].set(rbcl),
+            active=s["active"].at[leaf].set(False).at[l_id].set(True)
+                   .at[r_id].set(True),
+            split_feature=s["split_feature"].at[leaf].set(feat),
+            split_bin=s["split_bin"].at[leaf].set(sbin),
+            split_gain=s["split_gain"].at[leaf].set(s["best_gain"][leaf]),
+            threshold=s["threshold"].at[leaf].set(thr),
+            left_child=s["left_child"].at[leaf].set(l_id),
+            right_child=s["right_child"].at[leaf].set(r_id),
+            num_nodes=s["num_nodes"] + 2,
+            next_slot=s["next_slot"] + 1,
+        )
+
+    def body(_, s):
+        gains = jnp.where(s["active"], s["best_gain"], -jnp.inf)
+        can_split = jnp.max(gains) > p.min_gain_to_split
+        return lax.cond(can_split, do_split, lambda x: x, s)
+
+    state = lax.fori_loop(0, L - 1, body, state)
+
+    node_value = learning_rate * _leaf_output(state["sum_g"], state["sum_h"],
+                                              p.lambda_l1, p.lambda_l2)
+    leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
+
+    tree = Tree(split_feature=state["split_feature"],
+                split_bin=state["split_bin"],
+                threshold=state["threshold"],
+                split_gain=state["split_gain"],
+                left_child=state["left_child"],
+                right_child=state["right_child"],
+                leaf_value=leaf_value,
+                node_value=node_value,
+                num_nodes=state["num_nodes"])
+    return tree, state["node_id"]
+
+
+# -- prediction -------------------------------------------------------------
+
+def _traverse(binned, tree: Tree, depth_bound: int):
+    """Vectorized binned-feature traversal: (N, F) → leaf node id (N,)."""
+    N = binned.shape[0]
+    rows = jnp.arange(N)
+
+    def step(_, node):
+        feat = tree.split_feature[node]
+        is_leaf = feat < 0
+        f = jnp.maximum(feat, 0)
+        go_left = binned[rows, f] <= tree.split_bin[node]
+        child = jnp.where(go_left, tree.left_child[node], tree.right_child[node])
+        return jnp.where(is_leaf, node, child)
+
+    return lax.fori_loop(0, depth_bound, step,
+                         jnp.zeros(N, jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("depth_bound",))
+def predict_binned(binned, tree: Tree, depth_bound: int):
+    return tree.leaf_value[_traverse(binned, tree, depth_bound)]
+
+
+@functools.partial(jax.jit, static_argnames=("depth_bound",))
+def predict_raw_features(features, trees_stacked: Tree, depth_bound: int):
+    """Sum of all trees' outputs on raw float features — the batched
+    replacement for the reference's per-row JNI predict
+    (LGBM_BoosterPredictForMatSingle, LightGBMBooster.scala:551).
+
+    trees_stacked: a Tree whose arrays carry a leading tree axis (T, M).
+    """
+    N = features.shape[0]
+    rows = jnp.arange(N)
+
+    def one_tree(carry, t: Tree):
+        def step(_, node):
+            feat = t.split_feature[node]
+            is_leaf = feat < 0
+            f = jnp.maximum(feat, 0)
+            x = features[rows, f]
+            go_left = (x <= t.threshold[node]) | jnp.isnan(x)
+            child = jnp.where(go_left, t.left_child[node], t.right_child[node])
+            return jnp.where(is_leaf, node, child)
+
+        leaf = lax.fori_loop(0, depth_bound, step, jnp.zeros(N, jnp.int32))
+        return carry + t.leaf_value[leaf], leaf
+
+    total, leaves = lax.scan(one_tree, jnp.zeros(N, jnp.float32), trees_stacked)
+    return total, leaves   # leaves: (T, N) leaf indices (predict_leaf analogue)
+
+
+def stack_trees(trees) -> Tree:
+    return Tree(*[jnp.stack([getattr(t, f) for t in trees])
+                  for f in Tree._fields])
+
+
+def tree_depth(tree: Tree) -> int:
+    """Host-side actual depth (for tight traversal bounds)."""
+    lc = np.asarray(tree.left_child)
+    rc = np.asarray(tree.right_child)
+    depth = np.zeros(lc.shape, np.int32)
+    out = 0
+    for node in range(len(lc)):
+        for child in (lc[node], rc[node]):
+            if child >= 0:
+                depth[child] = depth[node] + 1
+                out = max(out, int(depth[child]))
+    return out + 1
