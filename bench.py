@@ -1,0 +1,107 @@
+"""Benchmark: flagship throughput on real TPU hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Primary metric (BASELINE.json north star): DeepTextClassifier BERT-base
+fine-tune **samples/sec/chip** (seq 128, bf16, adamw) — the path that
+replaces the reference's Horovod + pytorch_lightning DDP
+(reference: DeepTextClassifier.py:27-290).  A secondary GBDT number
+(boosting iterations/sec on 1M×28 rows — the LightGBM @1M-rows config) is
+printed to stderr for tracking.
+
+vs_baseline uses REF_SAMPLES_PER_SEC_PER_CHIP = 100.0, a nominal stand-in
+for the reference's per-GPU Horovod fine-tune throughput: the reference
+publishes no absolute numbers (BASELINE.md — "published: {}"), so this
+constant anchors cross-round comparisons.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REF_SAMPLES_PER_SEC_PER_CHIP = 100.0
+
+BERT_STEPS = 20
+BERT_BATCH = 32
+BERT_SEQ = 128
+
+GBDT_ROWS = 1_000_000
+GBDT_FEATURES = 28
+GBDT_ITERS = 20
+
+
+def bench_bert():
+    import jax
+    from synapseml_tpu.models.dl.training import DLTrainer, OptimizerConfig
+    from synapseml_tpu.models.dl.transformer import TextEncoder, TransformerConfig
+    from synapseml_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()
+    mesh = make_mesh({"data": len(devs)}, devs)
+    cfg = TransformerConfig.bert_base(num_classes=2, max_len=BERT_SEQ)
+    model = TextEncoder(cfg)
+    trainer = DLTrainer(model, OptimizerConfig(learning_rate=2e-5), mesh)
+
+    rng = np.random.default_rng(0)
+    bs = BERT_BATCH * len(devs)
+    ids = rng.integers(0, cfg.vocab_size, (bs, BERT_SEQ))
+    mask = np.ones((bs, BERT_SEQ), bool)
+    labels = rng.integers(0, 2, bs)
+
+    state = trainer.init_state(0, ids, mask)
+    step = trainer.train_step()
+    bi, bm, bl = trainer.shard_batch((ids, mask, labels))
+    key = jax.random.PRNGKey(0)
+
+    state, m = step(state, (bi, bm), bl, key)        # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(BERT_STEPS):
+        state, m = step(state, (bi, bm), bl, key)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    samples_per_sec = BERT_STEPS * bs / dt
+    return samples_per_sec / len(devs)
+
+
+def bench_gbdt():
+    from synapseml_tpu.models.gbdt import BoostingConfig, train
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(GBDT_ROWS, GBDT_FEATURES)).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] + X[:, 2] * X[:, 3]
+         + rng.normal(scale=0.5, size=GBDT_ROWS) > 0).astype(np.float64)
+    cfg = BoostingConfig(objective="binary", num_iterations=2, num_leaves=31)
+    t0 = time.perf_counter()
+    train(X, y, cfg)                                  # compile + 2 iters
+    warm = time.perf_counter() - t0
+
+    cfg = BoostingConfig(objective="binary", num_iterations=GBDT_ITERS,
+                         num_leaves=31)
+    t0 = time.perf_counter()
+    train(X, y, cfg)
+    dt = time.perf_counter() - t0
+    return GBDT_ITERS / dt, warm
+
+
+def main():
+    bert_sps = bench_bert()
+    try:
+        gbdt_ips, gbdt_warm = bench_gbdt()
+        print(f"[secondary] GBDT @1Mx{GBDT_FEATURES}: {gbdt_ips:.2f} iters/sec "
+              f"(warmup {gbdt_warm:.1f}s)", file=sys.stderr)
+    except Exception as e:  # secondary must not break the primary metric
+        print(f"[secondary] GBDT bench failed: {e}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "DeepTextClassifier BERT-base fine-tune throughput per chip",
+        "value": round(bert_sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(bert_sps / REF_SAMPLES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

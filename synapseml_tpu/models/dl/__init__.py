@@ -1,0 +1,7 @@
+from .estimators import (DeepTextClassifier, DeepTextModel,
+                         DeepVisionClassifier, DeepVisionModel)
+from .resnet import make_backbone
+from .ring_attention import ring_attention, ring_attention_inner
+from .tokenizer import WordTokenizer
+from .training import DLTrainer, OptimizerConfig, TrainState, make_dl_mesh
+from .transformer import LOGICAL_RULES, TextEncoder, TransformerConfig

@@ -1,0 +1,85 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context support the reference lacks entirely (SURVEY §5.7: no ring
+attention / sequence parallelism anywhere in SynapseML).  Standard TPU
+formulation: the sequence dim is sharded over the ``seq`` mesh axis; each
+rank holds Q for its block and streams K/V blocks around the ICI ring with
+``ppermute`` while maintaining flash-attention-style online-softmax
+accumulators (fp32).  Compute overlaps communication — each hop's partial
+attention runs while the next K/V block is in flight.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, key_mask, m, l, o, scale):
+    """One K/V block's contribution with online softmax.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D); key_mask: (B, Sk) bool;
+    m/l: (B, H, Sq) fp32 running max / normalizer; o: (B, Sq, H, D) fp32.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if key_mask is not None:
+        big_neg = jnp.finfo(jnp.float32).min
+        logits = jnp.where(key_mask[:, None, None, :], logits, big_neg)
+    block_max = jnp.max(logits, axis=-1)                      # (B,H,Sq)
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(logits - new_m[..., None])                    # (B,H,Sq,Sk)
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def ring_attention_inner(q, k, v, key_mask, axis_name: str):
+    """Per-rank body; call inside shard_map with the seq dim sharded.
+
+    q/k/v: (B, S_local, H, D) local blocks; key_mask: (B, S_local) or None.
+    Returns (B, S_local, H, D) in q.dtype.
+    """
+    B, Sq, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Sq), jnp.float32)
+    o = jnp.zeros((B, Sq, H, D), jnp.float32)
+
+    def body(i, carry):
+        m, l, o, k, v, km = carry
+        m, l, o = _block_attn(q, k, v, km, m, l, o, scale)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        if km is not None:
+            km = lax.ppermute(km, axis_name, perm)
+        return m, l, o, k, v, km
+
+    m, l, o, _, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v, key_mask))
+    out = o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, key_mask, mesh: Mesh,
+                   data_axis: str = "data", seq_axis: str = "seq"):
+    """Standalone entry: shard q/k/v (B, S, H, D) over (data, seq) and run
+    the ring. For use outside a model (tests, custom loops)."""
+    spec_qkv = P(data_axis, seq_axis, None, None)
+    spec_mask = P(data_axis, seq_axis)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask),
+                       out_specs=spec_qkv, check_vma=False)
+    def _run(q, k, v, km):
+        return ring_attention_inner(q, k, v, km, seq_axis)
+
+    return jax.jit(_run)(q, k, v, key_mask)

@@ -1,0 +1,80 @@
+"""Corpus-fitted word tokenizer for the text classifier.
+
+The reference uses HF AutoTokenizer downloads (reference:
+DeepTextClassifier.py checkpoint param, LitDeepTextModel.py:29).  This
+environment is zero-egress, so the tokenizer is fitted on the training
+corpus: top-N words by frequency + hash buckets for OOV — deterministic and
+serializable with the model.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+PAD, CLS, SEP, UNK = 0, 1, 2, 3
+_SPECIALS = 4
+_WORD_RE = re.compile(r"[\w']+|[^\w\s]")
+
+
+def _tokenize(text: str) -> List[str]:
+    return _WORD_RE.findall(str(text).lower())
+
+
+class WordTokenizer:
+    def __init__(self, vocab: Dict[str, int], vocab_size: int,
+                 num_hash_buckets: int = 0):
+        self.vocab = vocab
+        self.vocab_size = vocab_size
+        self.num_hash_buckets = num_hash_buckets
+
+    @staticmethod
+    def fit(texts: Sequence[str], vocab_size: int = 8192,
+            hash_fraction: float = 0.125) -> "WordTokenizer":
+        from collections import Counter
+        counts: Counter = Counter()
+        for t in texts:
+            counts.update(_tokenize(t))
+        n_hash = max(int(vocab_size * hash_fraction), 16) \
+            if len(counts) > vocab_size else 0
+        # hash range must never reach into special ids or shrink the word
+        # vocab below 1 entry
+        n_hash = min(n_hash, max(vocab_size - _SPECIALS - 1, 0))
+        n_vocab_words = vocab_size - _SPECIALS - n_hash
+        vocab = {w: i + _SPECIALS
+                 for i, (w, _) in enumerate(counts.most_common(n_vocab_words))}
+        return WordTokenizer(vocab, vocab_size, n_hash)
+
+    def _id(self, word: str) -> int:
+        wid = self.vocab.get(word)
+        if wid is not None:
+            return wid
+        if self.num_hash_buckets:
+            import zlib  # stable across processes (unlike builtin hash)
+            h = zlib.crc32(word.encode()) % self.num_hash_buckets
+            return self.vocab_size - self.num_hash_buckets + h
+        return UNK
+
+    def encode(self, texts: Sequence[str],
+               max_len: int = 128) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (ids (n, max_len) int32, mask (n, max_len) bool); layout
+        [CLS] tokens... [SEP] pad..."""
+        n = len(texts)
+        ids = np.zeros((n, max_len), np.int32)
+        mask = np.zeros((n, max_len), bool)
+        for i, t in enumerate(texts):
+            toks = [CLS] + [self._id(w) for w in _tokenize(t)][:max_len - 2] + [SEP]
+            ids[i, :len(toks)] = toks
+            mask[i, :len(toks)] = True
+        return ids, mask
+
+    def to_dict(self) -> dict:
+        return {"vocab": self.vocab, "vocab_size": self.vocab_size,
+                "num_hash_buckets": self.num_hash_buckets}
+
+    @staticmethod
+    def from_dict(d: dict) -> "WordTokenizer":
+        return WordTokenizer(dict(d["vocab"]), d["vocab_size"],
+                             d["num_hash_buckets"])

@@ -1,0 +1,186 @@
+"""BERT-style transformer encoder in flax, sharded for TPU meshes.
+
+Replaces the reference's HF ``AutoModelForSequenceClassification`` fine-tune
+path (reference: deep-learning/.../dl/LitDeepTextModel.py:29-120, pinned
+transformers==4.15.0 running under Horovod DDP).  TPU re-design:
+
+- pure flax linen, bfloat16 activations, fp32 params/optimizer;
+- every Dense kernel carries ``nn.with_partitioning`` logical axes so the
+  same module runs data-parallel, tensor-parallel (``model`` mesh axis) or
+  both — attention/MLP weights shard column-then-row so each block needs a
+  single psum on its output (Megatron layout);
+- optional ring attention over a ``seq`` mesh axis for long-context
+  (see synapseml_tpu/models/dl/ring_attention.py).
+
+Logical axis names: "embed" (d_model), "heads"/"kv" (attention fan-out),
+"mlp" (ffn fan-out), "vocab".  ``LOGICAL_RULES`` maps them onto mesh axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+#: logical→mesh axis mapping used by pjit sharding: fan-out dims ride the
+#: tensor-parallel axis, everything else is replicated.
+LOGICAL_RULES = (
+    ("batch", "data"),
+    ("embed", None),
+    ("heads", "model"),
+    ("kv", None),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    ("seq", None),
+    ("pos", None),
+    ("pooled", None),
+    ("classes", None),
+)
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class TransformerConfig:
+    vocab_size: int = 30522
+    max_len: int = 512
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+    use_ring_attention: bool = False
+    seq_axis: str = "seq"
+
+    @staticmethod
+    def bert_base(num_classes: int = 2, **kw) -> "TransformerConfig":
+        return TransformerConfig(num_classes=num_classes, **kw)
+
+    @staticmethod
+    def tiny(num_classes: int = 2, **kw) -> "TransformerConfig":
+        """Small config for tests/CI."""
+        return TransformerConfig(vocab_size=1024, max_len=128, num_layers=2,
+                                 num_heads=4, d_model=64, d_ff=128,
+                                 num_classes=num_classes, **kw)
+
+
+def _dense(features, kernel_axes, name, dtype, use_bias=True):
+    return nn.Dense(
+        features,
+        dtype=dtype,
+        use_bias=use_bias,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.truncated_normal(0.02), kernel_axes),
+        name=name)
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        d_head = cfg.d_model // cfg.num_heads
+        # Megatron column-parallel QKV: heads dim shards on "model"
+        q = _dense(cfg.d_model, ("embed", "heads"), "query", cfg.dtype)(x)
+        k = _dense(cfg.d_model, ("embed", "heads"), "key", cfg.dtype)(x)
+        v = _dense(cfg.d_model, ("embed", "heads"), "value", cfg.dtype)(x)
+
+        B, S, _ = x.shape
+        shape = (B, S, cfg.num_heads, d_head)
+        q = q.reshape(shape)
+        k = k.reshape(shape)
+        v = v.reshape(shape)
+
+        if cfg.use_ring_attention:
+            from .ring_attention import ring_attention_inner
+            try:
+                out = ring_attention_inner(q, k, v, mask, cfg.seq_axis)
+            except NameError as e:
+                raise ValueError(
+                    "use_ring_attention=True requires running the model "
+                    "inside shard_map with a bound "
+                    f"{cfg.seq_axis!r} mesh axis (see models/dl/"
+                    "ring_attention.py ring_attention() for the wrapper); "
+                    "for GSPMD sequence parallelism instead, shard the "
+                    "batch over (data, seq) and leave this flag off") from e
+        else:
+            scale = 1.0 / jnp.sqrt(d_head).astype(cfg.dtype)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            if mask is not None:
+                big_neg = jnp.finfo(jnp.float32).min
+                logits = jnp.where(mask[:, None, None, :], logits, big_neg)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            probs = nn.Dropout(cfg.dropout_rate)(probs, deterministic=deterministic)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+        out = out.reshape(B, S, cfg.d_model)
+        # row-parallel output projection: contraction dim sharded → one psum
+        out = _dense(cfg.d_model, ("heads", "embed"), "out", cfg.dtype)(out)
+        return out
+
+
+class EncoderBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        a = SelfAttention(cfg, name="attention")(x, mask, deterministic)
+        a = nn.Dropout(cfg.dropout_rate)(a, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_att")(x + a)
+        h = _dense(cfg.d_ff, ("embed", "mlp"), "ffn_up", cfg.dtype)(x)
+        h = nn.gelu(h)
+        h = _dense(cfg.d_model, ("mlp", "embed"), "ffn_down", cfg.dtype)(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return nn.LayerNorm(dtype=cfg.dtype, name="ln_ffn")(x + h)
+
+
+class TextEncoder(nn.Module):
+    """BERT-style encoder + [CLS] pooler + classification head."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, deterministic=True,
+                 return_embeddings=False):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.bool_)
+        else:
+            attention_mask = attention_mask.astype(jnp.bool_)
+
+        tok = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       embedding_init=nn.with_partitioning(
+                           nn.initializers.truncated_normal(0.02),
+                           ("vocab", "embed")),
+                       name="tok_embed")(input_ids)
+        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype,
+                       embedding_init=nn.with_partitioning(
+                           nn.initializers.truncated_normal(0.02),
+                           ("pos", "embed")),
+                       name="pos_embed")(jnp.arange(S)[None, :])
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_embed")(tok + pos)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, attention_mask,
+                                                     deterministic)
+        if return_embeddings:
+            return x
+
+        cls = x[:, 0, :]
+        pooled = jnp.tanh(_dense(cfg.d_model, ("embed", "pooled"), "pooler",
+                                 cfg.dtype)(cls))
+        logits = _dense(cfg.num_classes, ("embed", "classes"), "classifier",
+                        jnp.float32)(pooled)
+        return logits
+
+    def features(self, variables, input_ids, attention_mask=None):
+        """Headless (B, S, d_model) sequence embeddings for featurization."""
+        return self.apply(variables, input_ids, attention_mask,
+                          deterministic=True, return_embeddings=True)

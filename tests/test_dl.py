@@ -1,0 +1,156 @@
+"""DL module tests: transformer, resnet, ring attention, estimators."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.core.pipeline import load_stage
+from synapseml_tpu.models.dl import (DeepTextClassifier, DeepVisionClassifier,
+                                     DLTrainer, OptimizerConfig, TextEncoder,
+                                     TransformerConfig, WordTokenizer,
+                                     make_dl_mesh, ring_attention)
+from synapseml_tpu.parallel.mesh import make_mesh
+
+from fuzzing import EstimatorFuzzing, TestObject
+
+
+# -- tokenizer --------------------------------------------------------------
+
+def test_tokenizer_roundtrip():
+    texts = ["the cat sat on the mat", "dogs are great", "cats and dogs"]
+    tok = WordTokenizer.fit(texts, vocab_size=64)
+    ids, mask = tok.encode(texts, max_len=16)
+    assert ids.shape == (3, 16)
+    assert ids[0, 0] == 1                      # CLS
+    assert mask.sum(1).min() >= 3
+    tok2 = WordTokenizer.from_dict(tok.to_dict())
+    ids2, _ = tok2.encode(texts, max_len=16)
+    np.testing.assert_array_equal(ids, ids2)
+
+
+# -- ring attention ---------------------------------------------------------
+
+def test_ring_attention_matches_full():
+    mesh = make_mesh({"data": 2, "seq": 4})
+    rng = np.random.default_rng(0)
+    B, S, H, D = 4, 32, 2, 8
+    q, k, v = [rng.normal(size=(B, S, H, D)).astype(np.float32) for _ in range(3)]
+    mask = np.ones((B, S), bool)
+    mask[:, 28:] = False
+    out = np.asarray(ring_attention(q, k, v, mask, mesh))
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    logits = np.where(mask[:, None, None, :], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# -- TP training parity -----------------------------------------------------
+
+def test_tp_matches_dp_training():
+    """Tensor-parallel training must produce the same loss trajectory as
+    pure data-parallel (same seed, same data)."""
+    cfg = TransformerConfig.tiny(num_classes=2)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1024, (16, 16))
+    mask = np.ones((16, 16), bool)
+    labels = rng.integers(0, 2, 16)
+    losses = {}
+    for tp in (1, 2):
+        model = TextEncoder(cfg)
+        tr = DLTrainer(model, OptimizerConfig(learning_rate=1e-3),
+                       make_dl_mesh(tp=tp))
+        state = tr.init_state(0, ids, mask)
+        step = tr.train_step()
+        bi, bm, bl = tr.shard_batch((ids, mask, labels))
+        key = jax.random.PRNGKey(0)
+        ls = []
+        for _ in range(5):
+            state, m = step(state, (bi, bm), bl, key)
+            ls.append(float(m["loss"]))
+        losses[tp] = ls
+    np.testing.assert_allclose(losses[1], losses[2], rtol=2e-2)
+
+
+# -- estimators -------------------------------------------------------------
+
+def text_dataset(n=64):
+    rng = np.random.default_rng(0)
+    pos_words = ["good", "great", "excellent", "love", "wonderful"]
+    neg_words = ["bad", "awful", "terrible", "hate", "poor"]
+    texts, labels = [], []
+    for i in range(n):
+        y = i % 2
+        words = rng.choice(pos_words if y else neg_words, 5)
+        filler = rng.choice(["the", "a", "movie", "was", "it"], 3)
+        texts.append(" ".join(np.concatenate([words, filler])))
+        labels.append(float(y))
+    return Dataset({"text": texts, "label": np.asarray(labels)})
+
+
+def test_deep_text_classifier_learns():
+    ds = text_dataset(64)
+    clf = DeepTextClassifier(modelSize="tiny", maxEpochs=8, batchSize=16,
+                             learningRate=3e-3, maxTokenLen=16,
+                             vocabSize=128, lrSchedule="constant",
+                             numDevices=2)
+    model = clf.fit(ds)
+    out = model.transform(ds)
+    acc = (out["prediction"] == ds["label"]).mean()
+    assert acc > 0.9, acc
+    proba = np.stack(list(out["probability"]))
+    np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+
+
+def test_deep_text_nondefault_labels():
+    ds = text_dataset(32)
+    ds = ds.with_column("label", ds["label"] * 3 + 2)   # labels {2, 5}
+    clf = DeepTextClassifier(modelSize="tiny", maxEpochs=4, batchSize=16,
+                             learningRate=3e-3, maxTokenLen=16,
+                             vocabSize=128, numDevices=2)
+    out = clf.fit(ds).transform(ds)
+    assert set(np.unique(out["prediction"])) <= {2.0, 5.0}
+
+
+def test_deep_vision_classifier_learns():
+    rng = np.random.default_rng(0)
+    n = 32
+    imgs = rng.normal(size=(n, 16, 16, 3)).astype(np.float32) * 0.1
+    labels = np.arange(n) % 2
+    imgs[labels == 1, :8] += 1.0          # class-1 marker
+    ds = Dataset({"image": list(imgs), "label": labels.astype(np.float64)})
+    clf = DeepVisionClassifier(backbone="resnet18", maxEpochs=6, batchSize=16,
+                               learningRate=1e-2, optimizer="sgd",
+                               lrSchedule="constant", numDevices=2)
+    model = clf.fit(ds)
+    out = model.transform(ds)
+    acc = (out["prediction"] == ds["label"]).mean()
+    assert acc > 0.9, acc
+
+
+def test_text_model_save_load(tmp_path):
+    ds = text_dataset(32)
+    model = DeepTextClassifier(modelSize="tiny", maxEpochs=2, batchSize=16,
+                               maxTokenLen=16, vocabSize=128,
+                               numDevices=2).fit(ds)
+    model.save(str(tmp_path / "m"))
+    m2 = load_stage(str(tmp_path / "m"))
+    a = model.transform(ds)
+    b = m2.transform(ds)
+    np.testing.assert_allclose(np.stack(list(a["probability"])),
+                               np.stack(list(b["probability"])), atol=1e-5)
+
+
+class TestDeepTextFuzzing(EstimatorFuzzing):
+    rtol = 1e-3
+    atol = 1e-4
+
+    def fuzzing_objects(self):
+        return [TestObject(
+            DeepTextClassifier(modelSize="tiny", maxEpochs=1, batchSize=16,
+                               maxTokenLen=16, vocabSize=128, numDevices=2),
+            text_dataset(32))]
