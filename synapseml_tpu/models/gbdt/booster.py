@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...parallel.mesh import DATA_AXIS, batch_sharding, replicated
 from . import metrics as metrics_mod
@@ -295,7 +295,8 @@ class Booster:
 
 def _make_step(p: GrowthParams, objective_fn, num_class: int,
                learning_rate: float, mesh: Optional[Mesh], use_goss: bool,
-               top_rate: float, other_rate: float, ova: bool = False):
+               top_rate: float, other_rate: float, ova: bool = False,
+               use_pallas: bool = False):
     """Build the jitted one-iteration step.
 
     step(binned, scores, labels, weights, bag_mask, feature_mask, key,
@@ -308,16 +309,20 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
 
     def goss_weights(g_abs, bag, key):
         """Gradient one-side sampling: keep top_rate by |grad|, sample
-        other_rate of the rest with amplification (1-a)/b."""
+        other_rate of the rest with amplification (1-a)/b.  k is computed
+        from the REAL (bag>0) row count so pallas pad rows don't distort
+        the top-k threshold."""
         n = g_abs.shape[0]
-        k = jnp.maximum(1, jnp.int32(n * top_rate))
-        thresh = -jnp.sort(-g_abs)[k - 1]
+        n_real = jnp.sum((bag > 0).astype(jnp.int32))
+        k = jnp.maximum(1, (n_real.astype(jnp.float32) * top_rate).astype(jnp.int32))
+        sorted_desc = -jnp.sort(-(g_abs * (bag > 0)))
+        thresh = sorted_desc[jnp.minimum(k - 1, n - 1)]
         topset = g_abs >= thresh
         rest_keep = jax.random.uniform(key, (n,)) < other_rate
         amp = (1.0 - top_rate) / jnp.maximum(other_rate, 1e-6)
         return jnp.where(topset, 1.0, jnp.where(rest_keep, amp, 0.0)) * bag
 
-    def one_step(binned, scores, labels, weights, bag_mask, feature_mask,
+    def one_step(bins_t, scores, labels, weights, bag_mask, feature_mask,
                  key, upper_bounds, num_bins):
         trees = []
         if num_class == 1:
@@ -325,9 +330,9 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
             rv = bag_mask
             if use_goss:
                 rv = goss_weights(jnp.abs(grad), bag_mask, key)
-            tree, node_id = grow_tree(binned, grad, hess, rv, feature_mask,
+            tree, node_id = grow_tree(bins_t, grad, hess, rv, feature_mask,
                                       upper_bounds, num_bins, learning_rate,
-                                      p, axis)
+                                      p, axis, use_pallas)
             new_scores = scores + tree.leaf_value[node_id]
             trees.append(tree)
         else:
@@ -345,9 +350,9 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                 if use_goss:
                     rv = goss_weights(jnp.abs(grad[:, k]), bag_mask,
                                       jax.random.fold_in(key, k))
-                tree, node_id = grow_tree(binned, grad[:, k], hess[:, k], rv,
+                tree, node_id = grow_tree(bins_t, grad[:, k], hess[:, k], rv,
                                           feature_mask, upper_bounds, num_bins,
-                                          learning_rate, p, axis)
+                                          learning_rate, p, axis, use_pallas)
                 new_scores = new_scores.at[:, k].add(tree.leaf_value[node_id])
                 trees.append(tree)
         return stack_trees(trees), new_scores
@@ -356,7 +361,7 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
         return jax.jit(one_step)
 
     ndim_scores = 1 if num_class == 1 else 2
-    in_specs = (P(DATA_AXIS, None),                       # binned
+    in_specs = (P(None, DATA_AXIS),                       # bins_t (F, N)
                 P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None),
                 P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # labels/weights/bag
                 P(), P(), P(), P())                        # fmask/key/bounds/nbins
@@ -451,8 +456,17 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         base_margin = np.zeros((n, K) if K > 1 else n, np.float32)
 
     # -- padding + device placement ---------------------------------------
+    # pallas kernel constraints: VMEM one-hot scratch 8*B*CHUNK*2 bytes must
+    # fit (B<=512) and B must be sublane-aligned; otherwise scatter fallback
+    B_total = config.max_bin + 1
+    use_pallas = (jax.default_backend() == "tpu"
+                  and B_total <= 512 and B_total % 8 == 0)
     shards = mesh.shape[DATA_AXIS] if mesh is not None else 1
-    pad = (-n) % shards
+    pad_unit = shards
+    if use_pallas:
+        from .pallas_hist import hist_pad_multiple
+        pad_unit = shards * hist_pad_multiple()
+    pad = (-n) % pad_unit
     if pad:
         binned_np = np.concatenate([binned_np, np.zeros((pad, F), np.int32)])
         labels_np = np.concatenate([labels_np, np.zeros(pad, labels_np.dtype)])
@@ -468,7 +482,15 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             return jnp.asarray(xx)
         return jax.device_put(xx, batch_sharding(mesh, ndim))
 
-    binned = put(binned_np, 2)
+    # transpose ONCE on host: every boosting iteration reads the (F, N)
+    # layout; re-transposing in-step would copy ~N*F*4B per iteration
+    bins_t_np = np.ascontiguousarray(binned_np.T)
+    if mesh is None:
+        bins_t = jnp.asarray(bins_t_np)
+    else:
+        bins_t = jax.device_put(
+            bins_t_np, NamedSharding(mesh, P(None, DATA_AXIS)))
+    binned = put(binned_np, 2) if config.boosting_type == "dart" else None
     labels = put(labels_np, 1)
     weights = put(w, 1)
     scores = put(base_margin.astype(np.float32), base_margin.ndim)
@@ -516,7 +538,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     p = config.growth_params()
     step = _make_step(p, objective_fn, K, lr, mesh, use_goss,
                       config.top_rate, config.other_rate,
-                      ova=(config.objective == "multiclassova"))
+                      ova=(config.objective == "multiclassova"),
+                      use_pallas=use_pallas)
 
     # -- validation setup (validationIndicatorCol analogue) ----------------
     have_valid = valid is not None
@@ -586,7 +609,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 scores = _sub_scores(scores, contrib, tree_class[d], K)
 
         key = jax.random.PRNGKey(config.seed * 100003 + it)
-        tstack, new_scores = step(binned, scores, labels, weights,
+        tstack, new_scores = step(bins_t, scores, labels, weights,
                                   jnp.asarray(bag), jnp.asarray(feature_mask),
                                   key, upper_bounds, num_bins)
         new_trees = [Tree(*[np.asarray(a[k]) for a in tstack]) for k in range(K)]

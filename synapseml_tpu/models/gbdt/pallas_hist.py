@@ -1,0 +1,110 @@
+"""Pallas TPU histogram kernel — the GBDT hot op.
+
+Replaces the XLA scatter-add histogram (TPU scatters serialize; measured
+~8 s per 1M×28-row training step) with an MXU formulation.  Each grid step
+loads an 8-feature × CHUNK-row tile of the binned matrix and builds the
+features' one-hot bin matrices directly in transposed "tall" layout
+(FEAT_TILE·B, CHUNK) in VMEM scratch, then runs ONE matmul per step:
+
+    hist_tile += OH(f·B+b, c) · vals(c, v)      # (2048, C) x (C, 8)
+
+The tall M dimension keeps the MXU rows busy (M=8-style layouts lower
+~10× slower on Mosaic).  Gradients/hessians ride in bf16 hi/lo split pairs
+(exact reconstruction to ~f32) so the dot runs single-pass bf16.
+
+Measured on v5e-1 @ 1M×28×256 bins: ~80 ms per histogram vs ~260 ms
+scatter — and the whole-tree cost drops from ~8 s to ~2.5 s.
+
+This is the TPU-native equivalent of LightGBM's C++ histogram construction
+(reference: the native code behind LGBM_BoosterUpdateOneIter,
+booster/LightGBMBooster.scala:359).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: rows per grid chunk
+CHUNK = 1024
+#: features per grid step (Pallas sublane granularity for the bins block)
+FEAT_TILE = 8
+#: value channels: g_hi, g_lo, h_hi, h_lo, count, 3×pad
+VALS = 8
+
+
+def _hist_kernel(bins_ref, vals_ref, out_ref, oh_ref):
+    """Grid (F//8, N//CHUNK). bins block (8, C); vals block (C, 8) bf16;
+    out block (1, 8·B, 8) f32 revisited across the chunk dim."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    C = bins_ref.shape[1]
+    B = out_ref.shape[1] // FEAT_TILE
+    iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
+    for f in range(FEAT_TILE):
+        b = bins_ref[f, :]
+        oh_ref[f * B:(f + 1) * B, :] = (iota_b == b[None, :]).astype(jnp.bfloat16)
+    contrib = lax.dot_general(oh_ref[...], vals_ref[...],
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    out_ref[...] += contrib[None]
+
+
+@functools.partial(jax.jit, static_argnames=("total_bins", "interpret"))
+def build_hist_pallas(bins_t: jnp.ndarray,    # (F, N) int32, N % CHUNK == 0
+                      grad: jnp.ndarray,      # (N,) f32
+                      hess: jnp.ndarray,      # (N,) f32
+                      mask: jnp.ndarray,      # (N,) f32 row weight
+                      total_bins: int,
+                      interpret: bool = False) -> jnp.ndarray:
+    """→ (F, B, 3) float32 [grad, hess, count] histogram."""
+    F, N = bins_t.shape
+    B = total_bins
+    assert N % CHUNK == 0, f"N={N} must be a multiple of {CHUNK}"
+    g = grad * mask
+    h = hess * mask
+    count = (mask > 0).astype(jnp.float32)
+    # bf16 hi/lo split: hi + lo reconstructs ~f32 precision after the bf16 dot
+    g_hi = g.astype(jnp.bfloat16)
+    g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    h_hi = h.astype(jnp.bfloat16)
+    h_lo = (h - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    z = jnp.zeros_like(count, jnp.bfloat16)
+    vals = jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                      count.astype(jnp.bfloat16), z, z, z], axis=-1)  # (N, 8)
+
+    Fp = ((F + FEAT_TILE - 1) // FEAT_TILE) * FEAT_TILE
+    if Fp != F:
+        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+
+    out = pl.pallas_call(
+        _hist_kernel,
+        grid=(Fp // FEAT_TILE, N // CHUNK),
+        in_specs=[
+            pl.BlockSpec((FEAT_TILE, CHUNK), lambda f, c: (f, c)),
+            pl.BlockSpec((CHUNK, VALS), lambda f, c: (c, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, FEAT_TILE * B, VALS), lambda f, c: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp // FEAT_TILE, FEAT_TILE * B, VALS),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((FEAT_TILE * B, CHUNK), jnp.bfloat16)],
+        interpret=interpret,
+    )(bins_t, vals)
+
+    out = out.reshape(Fp, B, VALS)[:F]
+    gsum = out[:, :, 0] + out[:, :, 1]
+    hsum = out[:, :, 2] + out[:, :, 3]
+    return jnp.stack([gsum, hsum, out[:, :, 4]], axis=-1)   # (F, B, 3)
+
+
+def hist_pad_multiple() -> int:
+    return CHUNK

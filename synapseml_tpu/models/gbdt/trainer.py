@@ -80,16 +80,20 @@ def _leaf_output(g, h, l1, l2):
     return -_soft_threshold(g, l1) / (h + l2 + 1e-32)
 
 
-def _build_hist(flat_bins, grad, hess, mask, F, B):
-    """Scatter-add histogram for masked rows.
+def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas):
+    """Histogram for masked rows → (F*B, 3) f32 [grad, hess, count].
 
-    flat_bins: (N, F) int32 = bins + f*B (precomputed); ``mask`` is the
-    row weight (bag/GOSS amplification); the count channel counts rows with
-    mask>0 exactly once so GOSS amplification never inflates leaf counts.
-    Returns (F*B, 3) float32 [grad, hess, count]."""
+    ``mask`` is the row weight (bag/GOSS amplification); the count channel
+    counts rows with mask>0 exactly once so GOSS amplification never
+    inflates leaf counts.  On TPU the Pallas MXU kernel builds it
+    (pallas_hist.py); elsewhere an XLA scatter-add over the precomputed
+    flattened bin ids ``flat_bins`` (F, N)."""
+    if use_pallas:
+        from .pallas_hist import build_hist_pallas
+        return build_hist_pallas(bins_t, grad, hess, mask, B).reshape(F * B, 3)
     count = (mask > 0).astype(jnp.float32)
     upd = jnp.stack([grad * mask, hess * mask, count], axis=-1)           # (N,3)
-    upd = jnp.broadcast_to(upd[:, None, :], flat_bins.shape + (3,))       # (N,F,3)
+    upd = jnp.broadcast_to(upd[None, :, :], (F,) + upd.shape)             # (F,N,3)
     hist = jnp.zeros((F * B, 3), jnp.float32)
     return hist.at[flat_bins].add(upd)
 
@@ -124,8 +128,8 @@ def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
         gl[bf, bb], hl[bf, bb], cl[bf, bb]
 
 
-@functools.partial(jax.jit, static_argnames=("p", "axis_name"))
-def grow_tree(binned: jnp.ndarray,          # (N, F) int32
+@functools.partial(jax.jit, static_argnames=("p", "axis_name", "use_pallas"))
+def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
               grad: jnp.ndarray,            # (N,) f32 (0 for pad rows)
               hess: jnp.ndarray,            # (N,) f32 (0 for pad rows)
               row_valid: jnp.ndarray,       # (N,) f32 bag-weight ∈ {0,1} or GOSS weight
@@ -135,6 +139,7 @@ def grow_tree(binned: jnp.ndarray,          # (N, F) int32
               learning_rate: float,
               p: GrowthParams,
               axis_name: Optional[str] = None,
+              use_pallas: bool = False,
               ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one tree; returns (tree, per-row leaf node ids).
 
@@ -142,7 +147,7 @@ def grow_tree(binned: jnp.ndarray,          # (N, F) int32
     that axis; histograms and root stats are psum'd so every rank grows the
     identical tree from its row shard.
     """
-    N, F = binned.shape
+    F, N = bins_t.shape
     B = p.total_bins
     L = p.num_leaves
     M = max_nodes(L)
@@ -150,11 +155,13 @@ def grow_tree(binned: jnp.ndarray,          # (N, F) int32
     def ar(x):
         return lax.psum(x, axis_name) if axis_name else x
 
-    flat_bins = binned + (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    flat_bins = None
+    if not use_pallas:
+        flat_bins = bins_t + (jnp.arange(F, dtype=jnp.int32) * B)[:, None]
 
     # root
-    root_hist = ar(_build_hist(flat_bins, grad, hess,
-                               row_valid, F, B)).reshape(F, B, 3)
+    root_hist = ar(_build_hist(bins_t, flat_bins, grad, hess,
+                               row_valid, F, B, use_pallas)).reshape(F, B, 3)
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
     root_c = jnp.sum(root_hist[0, :, 2])
@@ -203,13 +210,14 @@ def grow_tree(binned: jnp.ndarray,          # (N, F) int32
         r_id = s["num_nodes"] + 1
 
         in_leaf = s["node_id"] == leaf
-        go_left = binned[jnp.arange(N), feat] <= sbin
+        go_left = bins_t[feat, :] <= sbin
         new_node_id = jnp.where(in_leaf, jnp.where(go_left, l_id, r_id),
                                 s["node_id"])
 
-        # left child hist by scatter, right by subtraction
+        # left child hist by one device pass, right by subtraction
         lmask = (new_node_id == l_id).astype(jnp.float32) * row_valid
-        l_hist = ar(_build_hist(flat_bins, grad, hess, lmask, F, B))
+        l_hist = ar(_build_hist(bins_t, flat_bins, grad, hess, lmask, F, B,
+                                use_pallas))
         parent_slot = s["slot"][leaf]
         r_hist = s["hist"][parent_slot] - l_hist
         r_slot = s["next_slot"]

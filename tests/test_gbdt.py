@@ -279,3 +279,27 @@ class TestGBDTRegressorFuzzing(EstimatorFuzzing):
             GBDTRegressor(numIterations=3, numLeaves=7, minDataInLeaf=5,
                           numShards=1),
             vec_dataset(X, y))]
+
+
+def test_pallas_hist_matches_scatter():
+    """Pallas kernel (interpret mode) vs the scatter path — same histograms."""
+    import jax.numpy as jnp
+    from synapseml_tpu.models.gbdt.pallas_hist import build_hist_pallas
+    from synapseml_tpu.models.gbdt.trainer import _build_hist
+
+    rng = np.random.default_rng(0)
+    N, F, B = 2048, 11, 64
+    bins_t = rng.integers(0, B, (F, N)).astype(np.int32)
+    grad = rng.normal(size=N).astype(np.float32)
+    hess = (np.abs(grad) + 0.1).astype(np.float32)
+    mask = (rng.random(N) < 0.7).astype(np.float32) * 1.5   # weighted rows
+
+    out_p = np.asarray(build_hist_pallas(
+        jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), B, interpret=True))
+    flat = bins_t + (np.arange(F, dtype=np.int32) * B)[:, None]
+    out_s = np.asarray(_build_hist(
+        jnp.asarray(bins_t), jnp.asarray(flat), jnp.asarray(grad),
+        jnp.asarray(hess), jnp.asarray(mask), F, B,
+        use_pallas=False)).reshape(F, B, 3)
+    np.testing.assert_allclose(out_p, out_s, rtol=1e-4, atol=1e-4)
