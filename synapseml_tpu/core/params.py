@@ -269,6 +269,14 @@ class Params:
                 new.set(k, v)
         return new
 
+    def _copy_values_from(self, other: "Params") -> "Params":
+        """Copy explicitly-set values of shared params from ``other``
+        (estimator -> model param transfer)."""
+        for name, value in other._paramMap.items():
+            if self.has_param(name):
+                self.set(name, value)
+        return self
+
     def explain_params(self) -> str:
         lines = []
         for p in self.params:

@@ -372,16 +372,16 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
 
 
 @functools.partial(jax.jit, static_argnames=("depth_bound",))
-def _predict_binned_tree(binned, tree: Tree, depth_bound: int):
-    """Leaf values of one tree on binned features (for dart/valid eval)."""
-    N = binned.shape[0]
+def _predict_binned_tree(bins_t, tree: Tree, depth_bound: int):
+    """Leaf values of one tree on (F, N) binned features (dart/valid eval)."""
+    N = bins_t.shape[1]
     rows = jnp.arange(N)
 
     def step(_, node):
         feat = tree.split_feature[node]
         is_leaf = feat < 0
         f = jnp.maximum(feat, 0)
-        go_left = binned[rows, f] <= tree.split_bin[node]
+        go_left = bins_t[f, rows] <= tree.split_bin[node]
         child = jnp.where(go_left, tree.left_child[node], tree.right_child[node])
         return jnp.where(is_leaf, node, child)
 
@@ -490,7 +490,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     else:
         bins_t = jax.device_put(
             bins_t_np, NamedSharding(mesh, P(None, DATA_AXIS)))
-    binned = put(binned_np, 2) if config.boosting_type == "dart" else None
     labels = put(labels_np, 1)
     weights = put(w, 1)
     scores = put(base_margin.astype(np.float32), base_margin.ndim)
@@ -546,7 +545,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if have_valid:
         Xv, yv, wv = valid
         Xv = np.ascontiguousarray(Xv, np.float32)
-        binned_v = jnp.asarray(mapper.transform(Xv))
+        binned_v = jnp.asarray(np.ascontiguousarray(mapper.transform(Xv).T))
         yv = (np.asarray(yv) > 0).astype(np.float32) if config.objective == "binary" \
             else np.asarray(yv, np.float32)
         # contributions accumulate separately from the init margin so rf can
@@ -604,7 +603,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             drop_mask = rng.random(len(trees)) < config.drop_rate
             dropped = list(np.nonzero(drop_mask)[0][:config.max_drop])
             for d in dropped:
-                contrib = _predict_binned_tree(binned, _to_device_tree(trees[d]),
+                contrib = _predict_binned_tree(bins_t, _to_device_tree(trees[d]),
                                                depth_hint) * tree_weights[d]
                 scores = _sub_scores(scores, contrib, tree_class[d], K)
 
@@ -621,14 +620,14 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             new_w = 1.0 / (ndrop + 1)
             factor = ndrop / (ndrop + 1)
             for k in range(K):
-                contrib = _predict_binned_tree(binned, _to_device_tree(new_trees[k]),
+                contrib = _predict_binned_tree(bins_t, _to_device_tree(new_trees[k]),
                                                depth_hint) * new_w
                 scores = _add_scores(scores, contrib, k, K)
             for d in dropped:
                 old_w = tree_weights[d]
                 tree_weights[d] = old_w * factor
                 dropped_weight_changes.append((d, old_w))
-                contrib = _predict_binned_tree(binned, _to_device_tree(trees[d]),
+                contrib = _predict_binned_tree(bins_t, _to_device_tree(trees[d]),
                                                depth_hint) * tree_weights[d]
                 scores = _add_scores(scores, contrib, tree_class[d], K)
             weights_new = [new_w] * K
