@@ -3,9 +3,10 @@
 The reference's VW featurizer hashes feature names/values with murmur3,
 with a pre-hashed-prefix optimization for column names
 (reference: vw/src/main/scala/.../VowpalWabbitMurmurWithPrefix.scala:80,
-VowpalWabbitFeaturizer.scala:150-165).  This is a NumPy re-implementation
-with the same algorithm (public domain algorithm, Austin Appleby) and a
-vectorized batch variant for hashing whole columns at once.
+VowpalWabbitFeaturizer.scala:150-165).  This implements the same algorithm
+(public domain, Austin Appleby) in masked Python-int arithmetic — an order
+of magnitude faster than numpy-scalar boxing in the per-token inner loop —
+plus a column-level helper that hashes a whole token iterable at once.
 """
 
 from __future__ import annotations
@@ -14,58 +15,57 @@ from typing import Iterable, List, Union
 
 import numpy as np
 
-_C1 = np.uint32(0xCC9E2D51)
-_C2 = np.uint32(0x1B873593)
-
-
-def _rotl32(x: np.uint32, r: int) -> np.uint32:
-    x = np.uint32(x)
-    return np.uint32((np.uint64(x) << np.uint64(r) | np.uint64(x) >> np.uint64(32 - r)) & np.uint64(0xFFFFFFFF))
+_MASK = 0xFFFFFFFF
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
 
 
 def murmurhash3_32(data: Union[bytes, str], seed: int = 0) -> int:
-    """Scalar murmur3_x86_32 of a byte string."""
+    """murmur3_x86_32 of a byte/str payload; returns an unsigned 32-bit int."""
     if isinstance(data, str):
         data = data.encode("utf-8")
-    with np.errstate(over="ignore"):
-        h = np.uint32(seed)
-        n = len(data)
-        nblocks = n // 4
-        for i in range(nblocks):
-            k = np.uint32(int.from_bytes(data[4 * i:4 * i + 4], "little"))
-            k = np.uint32(k * _C1)
-            k = _rotl32(k, 15)
-            k = np.uint32(k * _C2)
-            h = np.uint32(h ^ k)
-            h = _rotl32(h, 13)
-            h = np.uint32(h * np.uint32(5) + np.uint32(0xE6546B64))
-        tail = data[nblocks * 4:]
-        k = np.uint32(0)
-        if len(tail) >= 3:
-            k = np.uint32(k ^ np.uint32(tail[2]) << np.uint32(16))
-        if len(tail) >= 2:
-            k = np.uint32(k ^ np.uint32(tail[1]) << np.uint32(8))
-        if len(tail) >= 1:
-            k = np.uint32(k ^ np.uint32(tail[0]))
-            k = np.uint32(k * _C1)
-            k = _rotl32(k, 15)
-            k = np.uint32(k * _C2)
-            h = np.uint32(h ^ k)
-        h = np.uint32(h ^ np.uint32(n))
-        h = np.uint32(h ^ (h >> np.uint32(16)))
-        h = np.uint32(h * np.uint32(0x85EBCA6B))
-        h = np.uint32(h ^ (h >> np.uint32(13)))
-        h = np.uint32(h * np.uint32(0xC2B2AE35))
-        h = np.uint32(h ^ (h >> np.uint32(16)))
-    return int(h)
+    h = seed & _MASK
+    n = len(data)
+    nblocks = n >> 2
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i:4 * i + 4], "little")
+        k = (k * _C1) & _MASK
+        k = ((k << 15) | (k >> 17)) & _MASK
+        k = (k * _C2) & _MASK
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _MASK
+        h = (h * 5 + 0xE6546B64) & _MASK
+    tail = data[nblocks * 4:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * _C1) & _MASK
+        k = ((k << 15) | (k >> 17)) & _MASK
+        k = (k * _C2) & _MASK
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _MASK
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _MASK
+    h ^= h >> 16
+    return h
+
+
+def murmurhash3_column(tokens: Iterable[str], seed: int = 0) -> np.ndarray:
+    """Hash every token of a column in one call -> uint32 array."""
+    return np.fromiter((murmurhash3_32(t, seed) for t in tokens),
+                       dtype=np.uint32)
 
 
 class MurmurWithPrefix:
-    """Hash ``prefix + value`` cheaply by pre-hashing the prefix blocks —
+    """Hash ``prefix + value`` with the prefix pre-encoded once —
     the reference's trick for 'column-name + feature-value' hashes
-    (VowpalWabbitMurmurWithPrefix.scala).  Correctness over cleverness:
-    we cache the encoded prefix and concatenate; profiling shows the
-    dominant cost on TPU pipelines is elsewhere."""
+    (VowpalWabbitMurmurWithPrefix.scala)."""
 
     def __init__(self, prefix: str):
         self.prefix = prefix.encode("utf-8")

@@ -1,0 +1,23 @@
+"""Model interpretability — LIME, Kernel SHAP, ICE.
+
+Re-designs the reference's ``explainers`` package (reference:
+core/src/main/scala/com/microsoft/azure/synapse/ml/explainers/
+LocalExplainer.scala:13, LIMEBase.scala:137, KernelSHAPBase.scala:37,
+ICEExplainer.scala:130).  All explainers only need ``model.transform``
+over perturbed copies of a row — perturbation batches are built host-side
+and scored in a few large batched calls so the model's jitted path sees
+MXU-sized blocks, then per-row weighted regressions are solved with one
+vmapped jnp solve.
+"""
+
+from .solvers import lasso_regression, least_squares_regression
+from .lime import TabularLIME, TextLIME, VectorLIME, ImageLIME
+from .shap import TabularSHAP, TextSHAP, VectorSHAP, ImageSHAP
+from .ice import ICETransformer
+
+__all__ = [
+    "lasso_regression", "least_squares_regression",
+    "TabularLIME", "VectorLIME", "TextLIME", "ImageLIME",
+    "TabularSHAP", "VectorSHAP", "TextSHAP", "ImageSHAP",
+    "ICETransformer",
+]

@@ -1,0 +1,21 @@
+"""Image ops — the OpenCV-module and core-image equivalents.
+
+The reference ships two image layers: JNI OpenCV stages
+(opencv/.../ImageTransformer.scala:68-283 — Resize/Crop/ColorFormat/Blur/
+Threshold/GaussianKernel/Flip applied per row) and pure-Scala helpers
+(image/UnrollImage.scala:169, image/SuperpixelTransformer.scala:37).
+Here every pixel op is a jnp/XLA kernel over a stacked (N, H, W, C)
+batch — no per-row JNI, one fused program per pipeline.
+"""
+
+from .ops import (gaussian_kernel, gaussian_blur, resize_bilinear,
+                  center_crop, flip, threshold, color_convert)
+from .stages import ImageTransformer, UnrollImage, UnrollBinaryImage
+from .superpixel import SuperpixelTransformer, slic_segments
+
+__all__ = [
+    "gaussian_kernel", "gaussian_blur", "resize_bilinear", "center_crop",
+    "flip", "threshold", "color_convert",
+    "ImageTransformer", "UnrollImage", "UnrollBinaryImage",
+    "SuperpixelTransformer", "slic_segments",
+]

@@ -1,0 +1,105 @@
+"""SLIC-style superpixel clustering.
+
+Re-designs the reference's superpixel support (reference:
+image/Superpixel.scala:147 — SLIC-ish cluster growth used by image
+explainers; image/SuperpixelTransformer.scala:37).  The clustering is a
+fixed-iteration-count SLIC: k-means in (color, position) space with
+centers initialized on a grid — all distance updates are batched jnp so
+the per-image cost is a handful of fused XLA ops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dataset import Dataset
+from ..core.params import FloatParam, IntParam, StringParam
+from ..core.pipeline import Transformer
+
+
+@partial(jax.jit, static_argnames=("gh", "gw", "iters"))
+def _slic(img, yy, xx, gh: int, gw: int, iters: int, spatial_weight):
+    """img (H,W,C) float32; returns (H,W) int32 segment labels."""
+    h, w, c = img.shape
+    # grid-initialized centers: color mean at grid point + position
+    cy = (jnp.arange(gh) + 0.5) * (h / gh)
+    cx = (jnp.arange(gw) + 0.5) * (w / gw)
+    centers_pos = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"),
+                            -1).reshape(-1, 2)                  # (K, 2)
+    ci = jnp.clip(centers_pos[:, 0].astype(jnp.int32), 0, h - 1)
+    cj = jnp.clip(centers_pos[:, 1].astype(jnp.int32), 0, w - 1)
+    centers_col = img[ci, cj]                                   # (K, C)
+
+    pix_col = img.reshape(-1, c)                                # (P, C)
+    pix_pos = jnp.stack([yy.ravel(), xx.ravel()], -1)           # (P, 2)
+
+    def step(_, carry):
+        centers_col, centers_pos = carry
+        d_col = ((pix_col[:, None, :] - centers_col[None]) ** 2).sum(-1)
+        d_pos = ((pix_pos[:, None, :] - centers_pos[None]) ** 2).sum(-1)
+        d = d_col + spatial_weight * d_pos                      # (P, K)
+        assign = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(assign, centers_col.shape[0],
+                                dtype=jnp.float32)              # (P, K)
+        counts = onehot.sum(0)[:, None] + 1e-6
+        new_col = (onehot.T @ pix_col) / counts
+        new_pos = (onehot.T @ pix_pos) / counts
+        return (new_col, new_pos)
+
+    centers_col, centers_pos = jax.lax.fori_loop(
+        0, iters, step, (centers_col, centers_pos))
+    d_col = ((pix_col[:, None, :] - centers_col[None]) ** 2).sum(-1)
+    d_pos = ((pix_pos[:, None, :] - centers_pos[None]) ** 2).sum(-1)
+    assign = jnp.argmin(d_col + spatial_weight * d_pos, axis=1)
+    return assign.reshape(h, w).astype(jnp.int32)
+
+
+def slic_segments(img: np.ndarray, cell_size: float = 16.0,
+                  modifier: float = 130.0, iters: int = 5) -> np.ndarray:
+    """(H, W, C) image -> (H, W) int32 superpixel labels, contiguous from 0.
+
+    ``cell_size`` and ``modifier`` mirror the reference's Superpixel params
+    (cellSize ≈ target superpixel side; modifier ≈ compactness: larger =
+    more color-driven boundaries)."""
+    img = np.asarray(img, np.float32)
+    if img.ndim == 2:
+        img = img[..., None]
+    h, w = img.shape[:2]
+    gh = max(1, int(round(h / cell_size)))
+    gw = max(1, int(round(w / cell_size)))
+    yy, xx = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    # compactness: color range / modifier scales the spatial term
+    spatial_weight = np.float32((max(modifier, 1e-3) / cell_size) ** 2) / 255.0
+    seg = np.asarray(_slic(jnp.asarray(img), jnp.asarray(yy), jnp.asarray(xx),
+                           gh, gw, iters, jnp.float32(spatial_weight)))
+    # relabel contiguous (empty clusters removed)
+    uniq, inv = np.unique(seg, return_inverse=True)
+    return inv.reshape(h, w).astype(np.int32)
+
+
+class SuperpixelTransformer(Transformer):
+    """Attach superpixel assignments to an image column
+    (reference: image/SuperpixelTransformer.scala:37)."""
+
+    inputCol = StringParam(doc="image column", default="image")
+    outputCol = StringParam(doc="segment-label output", default="superpixels")
+    cellSize = FloatParam(doc="target superpixel side length", default=16.0)
+    modifier = FloatParam(doc="compactness", default=130.0)
+
+    def __init__(self, inputCol: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        if inputCol is not None:
+            self.set("inputCol", inputCol)
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        col = ds[self.inputCol]
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col):
+            out[i] = slic_segments(np.asarray(v), self.cellSize, self.modifier)
+        return ds.with_column(self.outputCol, out)

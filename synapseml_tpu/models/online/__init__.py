@@ -1,0 +1,28 @@
+"""Online linear learners — the Vowpal-Wabbit-equivalent engine.
+
+The reference wraps the VW C++ core over JNI (reference:
+vw/src/main/scala/.../VowpalWabbitBaseLearner.scala:123-260,
+build.sbt:436 vw-jni 9.3.0).  Here the learn loop is a jit-compiled
+``lax.scan`` over minibatches with AdaGrad-normalized updates — per-row
+JNI calls become on-device vectorized steps — and VW's spanning-tree
+AllReduce (VowpalWabbitClusterUtil.scala:16-40) becomes parameter
+averaging with ``psum`` over the device mesh.
+"""
+
+from .sgd import SGDConfig, SGDState, train_sgd, predict_margin
+from .estimators import (OnlineSGDClassifier, OnlineSGDClassificationModel,
+                         OnlineSGDRegressor, OnlineSGDRegressionModel)
+from .featurizer import FeatureInteractions, HashingFeaturizer
+from .bandit import (ContextualBandit, ContextualBanditModel)
+from .policyeval import (CressieReadInterval, PolicyEvalTransformer,
+                         bernstein_bound, cressie_read, ips, snips)
+
+__all__ = [
+    "SGDConfig", "SGDState", "train_sgd", "predict_margin",
+    "OnlineSGDClassifier", "OnlineSGDClassificationModel",
+    "OnlineSGDRegressor", "OnlineSGDRegressionModel",
+    "HashingFeaturizer", "FeatureInteractions",
+    "ContextualBandit", "ContextualBanditModel",
+    "PolicyEvalTransformer", "CressieReadInterval",
+    "ips", "snips", "cressie_read", "bernstein_bound",
+]

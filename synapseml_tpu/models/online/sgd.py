@@ -1,0 +1,298 @@
+"""Jitted online-SGD core.
+
+Re-designs VW's learn loop (reference: vw JNI ``VowpalWabbitNative.learn``
+called per row, VowpalWabbitBaseLearner.scala:123-160) as a ``lax.scan``
+over minibatches: each step consumes a (B, D) dense block, computes
+margins on the MXU, and applies an AdaGrad-normalized update — VW's
+``--adaptive --normalized --invariant`` default triple, restated for
+batched hardware:
+
+- *adaptive*: per-coordinate learning rate eta / sqrt(sum g^2)
+- *normalized*: gradients scaled by the running max |x_d| so feature
+  scales don't skew the step size
+- the per-example t-schedule ``eta * (t0 / (t0 + t))^power_t``
+
+Multipass + distributed: each shard scans its rows locally; at pass end
+weights are parameter-averaged over the mesh (`pmean`), the TPU analogue
+of VW's spanning-tree AllReduce at pass boundaries
+(VowpalWabbitSyncSchedule.scala:16-72, VowpalWabbitClusterUtil.scala:35-40).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.mesh import DATA_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class SGDConfig:
+    """VW arg-surface analogue (reference: VowpalWabbitBase.scala params
+    learningRate/powerT/l1/l2/numPasses + passThroughArgs)."""
+    loss: str = "squared"          # squared | logistic | hinge | quantile | poisson
+    learning_rate: float = 0.5
+    power_t: float = 0.5
+    initial_t: float = 1.0
+    l1: float = 0.0
+    l2: float = 0.0
+    num_passes: int = 1
+    batch_size: int = 32
+    adaptive: bool = True
+    normalized: bool = True
+    quantile_tau: float = 0.5
+    link: str = "identity"         # identity | logistic
+    #: average weights across shards every k batches (0 = only at pass end)
+    sync_every_batches: int = 0
+
+
+class SGDState(NamedTuple):
+    w: jnp.ndarray          # (D,) weights
+    bias: jnp.ndarray       # () bias
+    g2: jnp.ndarray         # (D,) adagrad accumulator
+    g2_bias: jnp.ndarray    # ()
+    x_max: jnp.ndarray      # (D,) running max |x| for normalization
+    t: jnp.ndarray          # () example counter
+
+
+def init_state(dim: int) -> SGDState:
+    return SGDState(
+        w=jnp.zeros(dim, jnp.float32), bias=jnp.zeros((), jnp.float32),
+        g2=jnp.full(dim, 1e-6, jnp.float32), g2_bias=jnp.asarray(1e-6, jnp.float32),
+        x_max=jnp.full(dim, 1e-6, jnp.float32), t=jnp.zeros((), jnp.float32))
+
+
+def _loss_grad(loss: str, margin, y, tau: float):
+    """d loss / d margin, elementwise.  Labels: logistic/hinge use ±1."""
+    if loss == "squared":
+        return margin - y
+    if loss == "logistic":
+        return -y / (1.0 + jnp.exp(y * margin))
+    if loss == "hinge":
+        return jnp.where(y * margin < 1.0, -y, 0.0)
+    if loss == "quantile":
+        return jnp.where(margin > y, 1.0 - tau, -tau)
+    if loss == "poisson":
+        return jnp.exp(margin) - y
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+def _loss_value(loss: str, margin, y, tau: float):
+    if loss == "squared":
+        return 0.5 * (margin - y) ** 2
+    if loss == "logistic":
+        return jnp.log1p(jnp.exp(-y * margin))
+    if loss == "hinge":
+        return jnp.maximum(0.0, 1.0 - y * margin)
+    if loss == "quantile":
+        e = y - margin
+        return jnp.where(e >= 0, tau * e, (tau - 1.0) * e)
+    if loss == "poisson":
+        return jnp.exp(margin) - y * margin
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+def make_scan_step(cfg: SGDConfig, axis: Optional[str] = None):
+    """One minibatch update, suitable for lax.scan.
+
+    carry = (state, loss_sum, weight_sum); block = (x (B,D), y (B,),
+    sample_weight (B,), valid-mask (B,)).
+    """
+
+    def step(carry, block):
+        state, loss_sum, weight_sum = carry
+        x, y, sw, mask = block
+        eff_w = sw * mask
+        margin = x @ state.w + state.bias                       # MXU
+        g_m = _loss_grad(cfg.loss, margin, y, cfg.quantile_tau) * eff_w
+        B = x.shape[0]
+        denom = jnp.maximum(eff_w.sum(), 1.0)
+        grad_w = (x * g_m[:, None]).sum(0) / denom + cfg.l2 * state.w
+        grad_b = g_m.sum() / denom
+        if axis is not None and cfg.sync_every_batches == 1:
+            grad_w = lax.pmean(grad_w, axis)
+            grad_b = lax.pmean(grad_b, axis)
+        x_max = jnp.maximum(state.x_max, jnp.abs(x).max(0))
+        if cfg.normalized:
+            grad_w = grad_w / x_max
+        g2 = state.g2 + grad_w ** 2
+        g2_b = state.g2_bias + grad_b ** 2
+        t = state.t + eff_w.sum()
+        if cfg.adaptive:
+            # VW --adaptive: the accumulator IS the schedule — per-coordinate
+            # rate lr / (sum g^2)^power_t, no extra t-decay on top
+            denom_w = g2 ** cfg.power_t
+            denom_b = g2_b ** cfg.power_t
+            step_w = cfg.learning_rate * grad_w / denom_w
+            step_b = cfg.learning_rate * grad_b / denom_b
+            shrink = cfg.learning_rate * cfg.l1 / jnp.maximum(denom_w, 1e-12)
+        else:
+            eta = cfg.learning_rate * (cfg.initial_t /
+                                       (cfg.initial_t + t)) ** cfg.power_t
+            step_w = eta * grad_w
+            step_b = eta * grad_b
+            shrink = eta * cfg.l1
+        w = state.w - step_w
+        if cfg.l1 > 0:
+            # truncated-gradient L1 (VW --l1): shrink toward zero
+            w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - shrink, 0.0)
+        new_state = SGDState(w=w, bias=state.bias - step_b, g2=g2,
+                             g2_bias=g2_b, x_max=x_max, t=t)
+        loss_sum = loss_sum + (_loss_value(cfg.loss, margin, y,
+                                           cfg.quantile_tau) * eff_w).sum()
+        weight_sum = weight_sum + eff_w.sum()
+        return (new_state, loss_sum, weight_sum), None
+
+    return step
+
+
+def _pad_blocks(x: np.ndarray, y: np.ndarray, sw: np.ndarray,
+                batch: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    n, d = x.shape
+    n_blocks = max(1, -(-n // batch))
+    pad = n_blocks * batch - n
+    if pad:
+        x = np.concatenate([x, np.zeros((pad, d), x.dtype)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+        sw = np.concatenate([sw, np.zeros(pad, sw.dtype)])
+    mask = np.ones(n_blocks * batch, np.float32)
+    if pad:
+        mask[-pad:] = 0.0
+    return (x.reshape(n_blocks, batch, d), y.reshape(n_blocks, batch),
+            sw.reshape(n_blocks, batch), mask.reshape(n_blocks, batch))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _run_pass(cfg: SGDConfig, state: SGDState, xb, yb, swb, maskb):
+    step = make_scan_step(cfg)
+    (state, loss_sum, w_sum), _ = lax.scan(
+        step, (state, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xb, yb, swb, maskb))
+    return state, loss_sum, w_sum
+
+
+def _sync_state(state: SGDState) -> SGDState:
+    """Cross-shard parameter averaging weighted by examples seen
+    (mergeModels analogue, VowpalWabbitBaseLearner.scala:228-260)."""
+    seen = jnp.maximum(state.t, 1e-6)
+    total = lax.psum(seen, DATA_AXIS)
+    return state._replace(
+        w=lax.psum(state.w * seen, DATA_AXIS) / total,
+        bias=lax.psum(state.bias * seen, DATA_AXIS) / total,
+        g2=lax.psum(state.g2 * seen, DATA_AXIS) / total,
+        g2_bias=lax.psum(state.g2_bias * seen, DATA_AXIS) / total,
+        x_max=lax.pmax(state.x_max, DATA_AXIS),
+        t=total)
+
+
+def _make_sharded_pass(cfg: SGDConfig, mesh: Mesh):
+    k = cfg.sync_every_batches
+
+    def local_pass(state, xb, yb, swb, maskb):
+        step = make_scan_step(cfg, axis=DATA_AXIS)
+        init = (state, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        if k > 1:
+            # mid-pass sync schedule: average weights after every chunk of
+            # k batches (caller pads the block count to a multiple of k)
+            nb = xb.shape[0]
+
+            def chunk(carry, blocks):
+                carry, _ = lax.scan(step, carry, blocks)
+                st, ls, ws = carry
+                return (_sync_state(st), ls, ws), None
+
+            reshape = lambda a: a.reshape(nb // k, k, *a.shape[1:])  # noqa: E731
+            (state, loss_sum, w_sum), _ = lax.scan(
+                chunk, init, (reshape(xb), reshape(yb),
+                              reshape(swb), reshape(maskb)))
+        else:
+            (state, loss_sum, w_sum), _ = lax.scan(
+                step, init, (xb, yb, swb, maskb))
+            state = _sync_state(state)  # pass-end allreduce
+        return state, lax.psum(loss_sum, DATA_AXIS), lax.psum(w_sum, DATA_AXIS)
+
+    shards = mesh.devices.size
+    return jax.jit(jax.shard_map(
+        local_pass, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()), check_vma=False)), shards
+
+
+def train_sgd(x: np.ndarray, y: np.ndarray, cfg: SGDConfig,
+              sample_weight: Optional[np.ndarray] = None,
+              mesh: Optional[Mesh] = None,
+              init: Optional[SGDState] = None):
+    """Run ``cfg.num_passes`` passes; returns (state, stats dict).
+
+    With a mesh, rows are sharded over ``DATA_AXIS`` and weights are
+    parameter-averaged at every pass end (``trainInternalDistributed``
+    analogue, VowpalWabbitBaseLearner.scala:197-211).
+    """
+    x = np.ascontiguousarray(x, np.float32)
+    y = np.asarray(y, np.float32)
+    sw = (np.asarray(sample_weight, np.float32) if sample_weight is not None
+          else np.ones(len(y), np.float32))
+    n, d = x.shape
+    state = init if init is not None else init_state(d)
+
+    if mesh is not None:
+        run, shards = _make_sharded_pass(cfg, mesh)
+        # pad rows so each shard gets whole blocks of cfg.batch_size — and,
+        # with a mid-pass sync schedule, whole chunks of k blocks
+        unit = cfg.batch_size * max(1, cfg.sync_every_batches)
+        per = -(-n // shards)
+        per = -(-per // unit) * unit
+        tot = per * shards
+        pad = tot - n
+        if pad:
+            x = np.concatenate([x, np.zeros((pad, d), np.float32)])
+            y = np.concatenate([y, np.zeros(pad, np.float32)])
+            sw = np.concatenate([sw, np.zeros(pad, np.float32)])
+        mask = np.ones(tot, np.float32)
+        if pad:
+            mask[-pad:] = 0.0
+        blocks = tot // cfg.batch_size
+        xb = x.reshape(blocks, cfg.batch_size, d)
+        yb = y.reshape(blocks, cfg.batch_size)
+        swb = sw.reshape(blocks, cfg.batch_size)
+        maskb = mask.reshape(blocks, cfg.batch_size)
+    else:
+        xb, yb, swb, maskb = _pad_blocks(x, y, sw, cfg.batch_size)
+
+    loss_sum = w_sum = 0.0
+    for _ in range(cfg.num_passes):
+        if mesh is not None:
+            state, ls, ws = run(state, xb, yb, swb, maskb)
+        else:
+            state, ls, ws = _run_pass(cfg, state, xb, yb, swb, maskb)
+        loss_sum, w_sum = float(ls), float(ws)
+    stats = {"average_loss": loss_sum / max(w_sum, 1e-12),
+             "examples": float(state.t)}
+    return state, stats
+
+
+def predict_margin(state: SGDState, x: np.ndarray) -> np.ndarray:
+    x = np.ascontiguousarray(x, np.float32)
+    return np.asarray(jnp.asarray(x) @ state.w + state.bias)
+
+
+def merge_states(states, weights=None) -> SGDState:
+    """Parameter-average independently trained states
+    (VowpalWabbitNative.mergeModels analogue)."""
+    ws = np.asarray(weights if weights is not None
+                    else [float(s.t) for s in states], np.float64)
+    ws = ws / max(ws.sum(), 1e-12)
+    def avg(field):
+        return jnp.asarray(sum(np.asarray(getattr(s, field)) * wi
+                               for s, wi in zip(states, ws)), jnp.float32)
+    return SGDState(w=avg("w"), bias=avg("bias"), g2=avg("g2"),
+                    g2_bias=avg("g2_bias"),
+                    x_max=jnp.asarray(np.max([np.asarray(s.x_max) for s in states], 0)),
+                    t=jnp.asarray(sum(float(s.t) for s in states), jnp.float32))

@@ -198,8 +198,10 @@ class Featurize(Estimator):
     inputCols = ListParam(doc="columns to featurize")
     outputCol = StringParam(doc="assembled vector column", default="features")
     oneHotEncodeCategoricals = BoolParam(doc="one-hot strings", default=True)
-    numFeatures = IntParam(doc="hash dim for high-cardinality/text columns",
-                           default=262144)
+    # the reference defaults to 2^18 sparse; our assembled vectors are dense
+    # (they feed XLA matmuls), so the default hash dimension is MXU-sized
+    numFeatures = IntParam(doc="hash dim for high-cardinality/text columns "
+                           "(dense)", default=4096)
     imputeMissing = BoolParam(doc="impute NaN with mean", default=True)
 
     #: one-hot cardinality cutoff; beyond this a string column is hashed
@@ -230,10 +232,12 @@ class Featurize(Estimator):
                 if self.oneHotEncodeCategoricals and len(uniq) <= self._MAX_ONE_HOT:
                     plan.append({"col": c, "kind": "onehot", "levels": uniq})
                 else:
-                    # hashing trick for high-cardinality strings; dimension
-                    # kept small relative to numFeatures for dense output
-                    dim = min(self.numFeatures, 1024)
-                    plan.append({"col": c, "kind": "hash", "dim": dim})
+                    # hashing trick for high-cardinality strings; the full
+                    # numFeatures dimension is honored — output vectors are
+                    # dense, so users trading memory for fewer collisions
+                    # get exactly what they asked for
+                    plan.append({"col": c, "kind": "hash",
+                                 "dim": self.numFeatures})
         return FeaturizeModel(outputCol=self.outputCol, plan=plan,
                               imputeMissing=self.imputeMissing)
 

@@ -512,11 +512,14 @@ class TextPreprocessor(Transformer):
             self.set("map", dict(map))
 
     def _transform(self, ds: Dataset) -> Dataset:
-        table = self.get_or_default("map") or {}
+        norm = (lambda s: s.lower()) if self.normFunc == "lowerCase" else (lambda s: s)
+        # keys go through the same normalization as the text, else an
+        # uppercase key could never match normalized input
+        table = {norm(k): v for k, v in
+                 (self.get_or_default("map") or {}).items()}
         # longest-first replacement reproduces the reference trie's
         # longest-match-wins behavior
         keys = sorted(table, key=len, reverse=True)
-        norm = (lambda s: s.lower()) if self.normFunc == "lowerCase" else (lambda s: s)
 
         def clean(s: str) -> str:
             s = norm(str(s))

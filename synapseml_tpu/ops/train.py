@@ -120,6 +120,16 @@ class TrainedClassifierModel(Model):
     def _transform(self, ds: Dataset) -> Dataset:
         cur = self.featurizer.transform(ds)
         out = self.innerModel.transform(cur)
+        if out.num_rows and self.get("levels"):
+            # inverse-map class indices back to the original label values
+            levels = self.levels
+            pred_col = (self.innerModel.predictionCol
+                        if self.innerModel.has_param("predictionCol")
+                        else "prediction")
+            if pred_col in out:
+                idx = out[pred_col].astype(np.int64)
+                vals = [levels[i] for i in idx]
+                out = out.with_column(pred_col, vals)
         return out.drop(self.featuresCol) if self.featuresCol in out else out
 
 
@@ -255,6 +265,10 @@ class ComputeModelStatistics(Transformer):
         else:
             raise ValueError(f"unknown evaluationMetric {metric!r}")
         if metric in MetricConstants.CLASSIFICATION_METRICS + MetricConstants.REGRESSION_METRICS:
+            if metric not in stats:
+                raise ValueError(
+                    f"metric {metric!r} unavailable: AUC requires scoresCol "
+                    "to be set and binary labels")
             stats = {metric: stats[metric]}
         return Dataset({k: np.asarray([v]) for k, v in stats.items()},
                        num_partitions=1)

@@ -1,0 +1,296 @@
+"""Tests for explainers (LIME/SHAP/ICE) and the image module.
+
+Mirrors the reference's explainer suites (reference:
+core/src/test/.../explainers/split1/TabularLIMEExplainerSuite.scala,
+TabularSHAPExplainerSuite.scala, ICEExplainerSuite.scala): train a simple
+model with a KNOWN structure, explain it, and assert the attributions
+recover that structure.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset, Transformer
+from synapseml_tpu.core.params import StringParam
+from synapseml_tpu.explainers import (ICETransformer, ImageLIME, ImageSHAP,
+                                      TabularLIME, TabularSHAP, TextLIME,
+                                      TextSHAP, VectorLIME, VectorSHAP,
+                                      lasso_regression,
+                                      least_squares_regression)
+from synapseml_tpu.image import (ImageTransformer, SuperpixelTransformer,
+                                 UnrollImage, gaussian_blur, resize_bilinear,
+                                 slic_segments)
+
+
+class LinearProbModel(Transformer):
+    """Deterministic test model: P(1) = sigmoid(2*a - 3*b); c ignored."""
+
+    probabilityCol = StringParam(default="probability")
+
+    def _transform(self, ds):
+        a = ds["a"].astype(np.float64)
+        b = ds["b"].astype(np.float64)
+        p = 1.0 / (1.0 + np.exp(-(2 * a - 3 * b)))
+        return ds.with_column("probability",
+                              [np.array([1 - x, x]) for x in p])
+
+
+class VectorSumModel(Transformer):
+    """score = x[0] + 2*x[2]; outputs scalar column."""
+
+    def _transform(self, ds):
+        mat = np.stack([np.asarray(v, np.float64) for v in ds["features"]])
+        return ds.with_column("score", mat[:, 0] + 2 * mat[:, 2])
+
+
+class TokenCountModel(Transformer):
+    """score = 1 if 'good' in text else 0 (plus small length term)."""
+
+    def _transform(self, ds):
+        s = [str(t) for t in ds["text"]]
+        score = np.array([1.0 * ("good" in t.split()) + 0.01 * len(t.split())
+                          for t in s])
+        return ds.with_column("score", score)
+
+
+class BrightQuadrantModel(Transformer):
+    """score = mean brightness of the top-left quadrant."""
+
+    def _transform(self, ds):
+        out = []
+        for v in ds["image"]:
+            img = np.asarray(v, np.float64)
+            h, w = img.shape[:2]
+            out.append(img[: h // 2, : w // 2].mean())
+        return ds.with_column("score", np.asarray(out))
+
+
+def background(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return Dataset({"a": rng.normal(size=n), "b": rng.normal(size=n),
+                    "c": rng.normal(size=n)})
+
+
+class TestSolvers:
+    def test_least_squares_exact(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(100, 3)).astype(np.float32)
+        y = 2 * x[:, 0] - x[:, 1] + 0.5
+        res = least_squares_regression(x, y)
+        np.testing.assert_allclose(np.asarray(res.coefficients),
+                                   [2, -1, 0], atol=1e-3)
+        assert float(res.intercept) == pytest.approx(0.5, abs=1e-3)
+        assert float(res.r_squared) > 0.999
+
+    def test_weighted(self):
+        x = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+        y = np.array([1.0, 2.0, 10.0, 20.0], np.float32)
+        w = np.array([1.0, 1.0, 0.0, 0.0], np.float32)
+        res = least_squares_regression(x, y, w)
+        assert float(res.coefficients[0]) == pytest.approx(1.0, abs=1e-3)
+
+    def test_lasso_sparsity(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(200, 5)).astype(np.float32)
+        y = 3 * x[:, 0]
+        res = lasso_regression(x, y, alpha=0.1)
+        coefs = np.asarray(res.coefficients)
+        assert abs(coefs[0]) > 1.0
+        assert np.abs(coefs[1:]).max() < 0.2
+
+
+class TestTabularLIME:
+    def test_recovers_signs(self):
+        ds = Dataset({"a": np.array([1.0, -0.5]), "b": np.array([0.2, 1.0]),
+                      "c": np.array([0.0, 0.3])})
+        lime = TabularLIME(model=LinearProbModel(),
+                           inputCols=["a", "b", "c"],
+                           backgroundData=background(),
+                           numSamples=400, targetCol="probability")
+        out = lime.transform(ds)
+        for i in range(2):
+            coef = np.asarray(out["explanation"][i])[0]  # target class 1
+            assert coef[0] > 0          # a increases P(1)
+            assert coef[1] < 0          # b decreases P(1)
+            assert abs(coef[2]) < abs(coef[0]) / 3  # c irrelevant
+            assert np.asarray(out["r2"][i])[0] > 0.5
+
+
+class TestVectorLIME:
+    def test_recovers_weights(self):
+        rng = np.random.default_rng(3)
+        ds = Dataset({"features": [rng.normal(size=4) for _ in range(3)]})
+        lime = VectorLIME(model=VectorSumModel(), inputCol="features",
+                          numSamples=400, targetCol="score")
+        out = lime.transform(ds)
+        for i in range(3):
+            coef = np.asarray(out["explanation"][i])[0]
+            # score = x0 + 2 x2: relative magnitudes must match
+            assert coef[2] > coef[0] > 0.1
+            assert abs(coef[1]) < 0.2 and abs(coef[3]) < 0.2
+
+
+class TestTextLIME:
+    def test_keyword_attribution(self):
+        ds = Dataset({"text": ["this movie is good indeed",
+                               "terrible plot no thanks"]})
+        lime = TextLIME(model=TokenCountModel(), inputCol="text",
+                        numSamples=200, targetCol="score")
+        out = lime.transform(ds)
+        toks0 = out["tokens"][0]
+        coef0 = np.asarray(out["explanation"][0])[0]
+        good_idx = toks0.index("good")
+        assert coef0[good_idx] == max(coef0)
+
+
+class TestTabularSHAP:
+    def test_additivity_and_ranking(self):
+        ds = Dataset({"a": np.array([1.5]), "b": np.array([-1.0]),
+                      "c": np.array([0.1])})
+        shap = TabularSHAP(model=LinearProbModel(),
+                           inputCols=["a", "b", "c"],
+                           backgroundData=background(),
+                           numSamples=256, targetCol="probability")
+        out = shap.transform(ds)
+        exp = np.asarray(out["explanation"][0])[0]  # [base, phi_a, phi_b, phi_c]
+        base, phis = exp[0], exp[1:]
+        # additivity: base + sum(phi) ~= f(x)
+        fx = 1.0 / (1.0 + np.exp(-(2 * 1.5 - 3 * -1.0)))
+        assert base + phis.sum() == pytest.approx(fx, abs=0.05)
+        assert phis[0] > 0 and phis[1] > 0  # both push P(1) up here
+        assert abs(phis[2]) < 0.1
+
+    def test_vector_shap(self):
+        rng = np.random.default_rng(5)
+        inst = np.array([1.0, 0.0, 1.0, 0.0])  # phi0 ~= 1, phi2 ~= 2
+        ds = Dataset({"features": [inst]})
+        bg = Dataset({"features": [rng.normal(size=4) * 0.1 for _ in range(50)]})
+        shap = VectorSHAP(model=VectorSumModel(), inputCol="features",
+                          backgroundData=bg, numSamples=256,
+                          targetCol="score")
+        out = shap.transform(ds)
+        exp = np.asarray(out["explanation"][0])[0]
+        base, phis = exp[0], exp[1:]
+        fx = inst[0] + 2 * inst[2]
+        assert base + phis.sum() == pytest.approx(fx, abs=0.1)
+        assert phis[2] > phis[0] > 0.5
+
+
+class TestTextSHAP:
+    def test_keyword(self):
+        ds = Dataset({"text": ["a good day"]})
+        shap = TextSHAP(model=TokenCountModel(), inputCol="text",
+                        numSamples=64, targetCol="score")
+        out = shap.transform(ds)
+        toks = out["tokens"][0]
+        exp = np.asarray(out["explanation"][0])[0][1:]
+        assert exp[toks.index("good")] == max(exp)
+
+
+class TestICE:
+    def test_individual_curves(self):
+        ds = Dataset({"a": np.array([0.0, 1.0]), "b": np.array([0.0, 0.0]),
+                      "c": np.array([0.0, 0.0])})
+        ice = ICETransformer(model=LinearProbModel(),
+                             numericFeatures=["a"], numSplits=5,
+                             targetCol="probability")
+        out = ice.transform(ds)
+        curve = np.asarray(out["a_dependence"][0])  # (G, 1)
+        assert curve.shape[0] == 5
+        assert (np.diff(curve[:, 0]) > 0).all()  # P(1) increases with a
+
+    def test_pdp_average(self):
+        ds = background(50, seed=7)
+        ice = ICETransformer(model=LinearProbModel(),
+                             numericFeatures=["a", "b"], numSplits=4,
+                             kind="average", targetCol="probability")
+        out = ice.transform(ds)
+        assert out.num_rows == 2
+        assert list(out["feature"]) == ["a", "b"]
+        dep_a = np.asarray(out["dependence"][0])
+        assert (np.diff(dep_a[:, 0]) > 0).all()
+
+
+class TestImageOps:
+    def test_resize_and_blur_shapes(self):
+        imgs = np.random.default_rng(0).uniform(
+            0, 255, (2, 32, 48, 3)).astype(np.float32)
+        assert resize_bilinear(imgs, 16, 24).shape == (2, 16, 24, 3)
+        assert gaussian_blur(imgs, 5, 1.5).shape == imgs.shape
+
+    def test_blur_smooths(self):
+        rng = np.random.default_rng(1)
+        imgs = rng.uniform(0, 255, (1, 16, 16, 1)).astype(np.float32)
+        out = np.asarray(gaussian_blur(imgs, 5, 2.0))
+        assert out.std() < imgs.std()
+
+    def test_transformer_chain(self):
+        rng = np.random.default_rng(2)
+        ds = Dataset({"image": [rng.uniform(0, 255, (32, 32, 3))
+                                for _ in range(3)]})
+        t = (ImageTransformer(inputCol="image", outputCol="out")
+             .resize(16, 16).blur(3, 1.0).flip(1))
+        out = t.transform(ds)
+        assert out["out"][0].shape == (16, 16, 3)
+
+    def test_tensor_normalize(self):
+        ds = Dataset({"image": [np.full((8, 8, 3), 255.0)]})
+        t = (ImageTransformer(inputCol="image", outputCol="out")
+             .normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5],
+                        color_scale_factor=1 / 255.0))
+        out = t.transform(ds)["out"][0]
+        assert out.shape == (3, 8, 8)  # CHW
+        np.testing.assert_allclose(out, 1.0, atol=1e-6)
+
+    def test_unroll(self):
+        ds = Dataset({"image": [np.ones((4, 4, 3))]})
+        out = UnrollImage(inputCol="image", outputCol="v").transform(ds)
+        assert len(out["v"][0]) == 48
+
+
+class TestSuperpixel:
+    def test_segments_contiguous_and_spatial(self):
+        img = np.zeros((32, 32, 3), np.float32)
+        img[:, 16:] = 255.0  # two halves
+        seg = slic_segments(img, cell_size=8.0)
+        assert seg.shape == (32, 32)
+        labels = np.unique(seg)
+        assert labels.min() == 0 and len(labels) >= 4
+        # left/right halves should not share most labels
+        left, right = set(np.unique(seg[:, :8])), set(np.unique(seg[:, 24:]))
+        assert len(left & right) == 0
+
+    def test_transformer(self):
+        ds = Dataset({"image": [np.random.default_rng(0)
+                                .uniform(0, 255, (24, 24, 3))]})
+        out = SuperpixelTransformer(inputCol="image").transform(ds)
+        assert out["superpixels"][0].shape == (24, 24)
+
+
+class TestImageExplainers:
+    def test_image_lime_quadrant(self):
+        rng = np.random.default_rng(9)
+        img = rng.uniform(100, 155, (32, 32, 3)).astype(np.float32)
+        ds = Dataset({"image": [img]})
+        lime = ImageLIME(model=BrightQuadrantModel(), inputCol="image",
+                         numSamples=100, cellSize=16.0, targetCol="score")
+        out = lime.transform(ds)
+        seg = out["superpixels"][0]
+        coef = np.asarray(out["explanation"][0])[0]
+        # superpixels overlapping the top-left quadrant must get the largest
+        # attributions
+        tl_labels = set(np.unique(seg[:16, :16]))
+        other = [coef[l] for l in np.unique(seg) if l not in tl_labels]
+        top = max(coef[l] for l in tl_labels)
+        assert top > max(other) if other else True
+
+    def test_image_shap_runs(self):
+        rng = np.random.default_rng(10)
+        img = rng.uniform(0, 255, (16, 16, 3)).astype(np.float32)
+        ds = Dataset({"image": [img]})
+        shap = ImageSHAP(model=BrightQuadrantModel(), inputCol="image",
+                         numSamples=64, cellSize=8.0, targetCol="score")
+        out = shap.transform(ds)
+        exp = np.asarray(out["explanation"][0])[0]
+        fx = BrightQuadrantModel().transform(ds)["score"][0]
+        assert exp[0] + exp[1:].sum() == pytest.approx(fx, rel=0.1)

@@ -452,3 +452,38 @@ class TestComputeModelStatistics:
                       "prediction": np.array([1.0, 2.0, 3.0])})
         out = ComputeModelStatistics(evaluationMetric="regression").transform(ds)
         assert out["rmse"][0] == 0.0 and out["r2"][0] == 1.0
+
+
+class TestReviewRegressions:
+    """Regressions for review findings on the ops layer."""
+
+    def test_train_classifier_inverse_maps_labels(self):
+        from synapseml_tpu.models.gbdt import GBDTClassifier
+        rng = np.random.default_rng(0)
+        n = 100
+        x = rng.normal(size=n)
+        ds = Dataset({"f1": x, "label": np.where(x > 0, 7, 2)})
+        model = TrainClassifier(model=GBDTClassifier(numIterations=10),
+                                labelCol="label").fit(ds)
+        preds = model.transform(ds)["prediction"]
+        assert set(np.unique(preds)) <= {2, 7}
+
+    def test_featurize_honors_num_features(self):
+        cats = [f"id{i}" for i in range(300)]
+        ds = Dataset({"c": cats, "label": np.zeros(300)})
+        model = Featurize(inputCols=["c"], numFeatures=2048).fit(ds)
+        dim = len(model.transform(ds)["features"][0])
+        assert dim == 2048
+
+    def test_text_preprocessor_normalized_keys(self):
+        ds = Dataset({"t": ["Hello world"]})
+        out = TextPreprocessor(inputCol="t", outputCol="o",
+                               map={"Hello": "hi"},
+                               normFunc="lowerCase").transform(ds)
+        assert out["o"][0] == "hi world"
+
+    def test_auc_without_scores_raises_cleanly(self):
+        ds = Dataset({"label": np.array([0, 1]),
+                      "prediction": np.array([0, 1])})
+        with pytest.raises(ValueError, match="AUC requires"):
+            ComputeModelStatistics(evaluationMetric="AUC").transform(ds)
