@@ -65,7 +65,12 @@ def _kernel_weights(states01: np.ndarray, width: float) -> np.ndarray:
 class _LIMEBase(_LIMEParams, Transformer):
     """Shared transform loop: subclasses implement ``_perturb_row``."""
 
-    def _perturb_row(self, ds: Dataset, i: int, rng) -> Dict:
+    def _prepare(self, ds: Dataset) -> Dict:
+        """Row-independent context (background stats etc.), computed ONCE
+        per transform instead of per explained row."""
+        return {}
+
+    def _perturb_row(self, ds: Dataset, i: int, rng, ctx: Dict) -> Dict:
         """Returns dict(perturbed=column dict, states=(S, D) regression
         features, states01=(S, D) similarity space in [0,1])."""
         raise NotImplementedError
@@ -73,9 +78,10 @@ class _LIMEBase(_LIMEParams, Transformer):
     def _transform(self, ds: Dataset) -> Dataset:
         rng = np.random.default_rng(self.seed)
         n = ds.num_rows
+        ctx = self._prepare(ds)
         blocks, states, states01 = [], [], []
         for i in range(n):
-            p = self._perturb_row(ds, i, rng)
+            p = self._perturb_row(ds, i, rng, ctx)
             blocks.append(p["perturbed"])
             states.append(p["states"])
             states01.append(p["states01"])
@@ -135,10 +141,20 @@ class TabularLIME(_LIMEBase):
             raise ValueError("TabularLIME requires backgroundData")
         return bg
 
-    def _perturb_row(self, ds: Dataset, i: int, rng) -> Dict:
+    def _prepare(self, ds: Dataset) -> Dict:
         bg = self._background()
-        cols = self.inputCols
         cats = set(self.get_or_default("categoricalFeatures") or [])
+        stats = {}
+        for c in self.inputCols:
+            if c not in cats:
+                vals = bg[c].astype(np.float64)
+                stats[c] = (float(np.nanmean(vals)),
+                            float(np.nanstd(vals)) or 1.0)
+        return {"bg": bg, "cats": cats, "stats": stats}
+
+    def _perturb_row(self, ds: Dataset, i: int, rng, ctx: Dict) -> Dict:
+        bg, cats, stats = ctx["bg"], ctx["cats"], ctx["stats"]
+        cols = self.inputCols
         S = self.numSamples
         perturbed = replicate_row(ds, i, S)
         states = np.zeros((S, len(cols)), np.float32)
@@ -162,11 +178,15 @@ class TabularLIME(_LIMEBase):
                 states[:, j] = ind
                 states01[:, j] = ind
             else:
-                mu = float(np.nanmean(bg[c].astype(np.float64)))
-                sd = float(np.nanstd(bg[c].astype(np.float64))) or 1.0
+                mu, sd = stats[c]
                 orig = float(ds[c][i])
                 z = orig + rng.normal(0.0, sd, S)
-                perturbed[c] = z.astype(ds[c].dtype)
+                if np.issubdtype(ds[c].dtype, np.integer):
+                    z = np.round(z)
+                # regress on the values the model actually sees
+                fed = z.astype(ds[c].dtype)
+                perturbed[c] = fed
+                z = fed.astype(np.float64)
                 states[:, j] = (z - mu) / sd
                 # similarity in [0,1]: 1 at the original value
                 states01[:, j] = np.exp(-0.5 * ((z - orig) / sd) ** 2)
@@ -187,12 +207,16 @@ class VectorLIME(_LIMEBase):
         if inputCol is not None:
             self.set("inputCol", inputCol)
 
-    def _perturb_row(self, ds: Dataset, i: int, rng) -> Dict:
+    def _prepare(self, ds: Dataset) -> Dict:
         bg = self.get("backgroundData")
         mat = (np.stack([np.asarray(v, np.float64) for v in bg[self.inputCol]])
                if bg is not None else
                np.stack([np.asarray(v, np.float64) for v in ds[self.inputCol]]))
-        mu, sd = mat.mean(0), np.where(mat.std(0) > 0, mat.std(0), 1.0)
+        return {"mu": mat.mean(0),
+                "sd": np.where(mat.std(0) > 0, mat.std(0), 1.0)}
+
+    def _perturb_row(self, ds: Dataset, i: int, rng, ctx: Dict) -> Dict:
+        mu, sd = ctx["mu"], ctx["sd"]
         orig = np.asarray(ds[self.inputCol][i], np.float64)
         S = self.numSamples
         z = orig + rng.normal(0.0, 1.0, (S, len(orig))) * sd

@@ -64,10 +64,18 @@ class _SHAPBase(_SHAPParams, Transformer):
     """Shared solve: subclasses build coalitions + perturbed inputs."""
 
     def _weights(self, coalitions: np.ndarray) -> np.ndarray:
+        """Regression weights per sampled coalition.
+
+        ``sample_coalitions`` already draws each coalition with probability
+        proportional to its Shapley kernel weight (size ∝ kernel mass, then
+        a uniform subset of that size), so the importance-sampled least
+        squares must weight interior samples UNIFORMLY — re-applying the
+        kernel here would square the size weighting.  Only the pinned
+        empty/full constraint rows carry ``infWeight``."""
         d = coalitions.shape[1]
         sizes = coalitions.sum(1).astype(int)
-        return np.array([min(shapley_kernel_weight(d, s), self.infWeight)
-                         for s in sizes], np.float64)
+        return np.where((sizes == 0) | (sizes == d),
+                        float(self.infWeight), 1.0).astype(np.float64)
 
 
 class TabularSHAP(_SHAPBase):

@@ -109,5 +109,9 @@ class FeatureInteractions(Transformer):
         idx = np.array([murmurhash3_32(p.to_bytes(4, "little")) % dim
                         for p in range(d_in)], np.int64)
         out = np.zeros((cross.shape[0], dim), np.float32)
-        np.add.at(out, (slice(None), idx), cross)
+        if self.sumCollisions:
+            np.add.at(out, (slice(None), idx), cross)
+        else:
+            # overwrite-on-collision: last position hashing to a slot wins
+            out[:, idx] = cross
         return ds.with_column(self.outputCol, [row for row in out])

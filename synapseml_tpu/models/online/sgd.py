@@ -272,7 +272,8 @@ def train_sgd(x: np.ndarray, y: np.ndarray, cfg: SGDConfig,
             state, ls, ws = run(state, xb, yb, swb, maskb)
         else:
             state, ls, ws = _run_pass(cfg, state, xb, yb, swb, maskb)
-        loss_sum, w_sum = float(ls), float(ws)
+        loss_sum += float(ls)
+        w_sum += float(ws)
     stats = {"average_loss": loss_sum / max(w_sum, 1e-12),
              "examples": float(state.t)}
     return state, stats

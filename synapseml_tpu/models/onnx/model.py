@@ -1,0 +1,260 @@
+"""ONNXModel — batch-inference pipeline Transformer.
+
+Re-designs the reference's ONNX Runtime transformer (reference:
+deep-learning/.../onnx/ONNXModel.scala:145-423 — miniBatch → broadcast
+model bytes → mapPartitions → OrtSession.run per batch → FlattenBatch →
+softmax/argmax UDFs) for XLA: the model protobuf lowers to ONE jitted
+program; rows are processed in fixed-size minibatches padded to a static
+shape so `jit` compiles exactly once per shape, and the softmax/argmax
+post-ops are fused into the same program instead of per-row UDFs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dataset import Dataset
+from ...core.params import (BoolParam, DictParam, IntParam, Param, Params,
+                            PyObjectParam, StringParam)
+from ...core.pipeline import Model, Transformer
+from .graph import Graph, load_graph, slice_at_outputs, to_model
+from .runner import evaluate
+
+
+class ONNXModel(Model):
+    """Run an ONNX model over Dataset columns on TPU via XLA.
+
+    Parameters mirror the reference (ONNXModel.scala:60-140):
+    ``modelPayload`` (protobuf bytes), ``feedDict`` {onnx input → column},
+    ``fetchDict`` {output column → onnx output}, ``miniBatchSize``,
+    ``softMaxDict`` / ``argMaxDict`` {input column → output column}.
+    """
+
+    modelPayload = PyObjectParam(doc="ONNX model protobuf bytes")
+    feedDict = DictParam(doc="map: onnx graph input name -> dataset column")
+    fetchDict = DictParam(doc="map: output column -> onnx graph output name")
+    miniBatchSize = IntParam(doc="rows per device batch", default=128)
+    softMaxDict = DictParam(doc="map: input col -> output col to soft-max")
+    argMaxDict = DictParam(doc="map: input col -> output col to arg-max")
+    dtype = StringParam(doc="compute dtype for float inputs",
+                        default="float32", allowed=("float32", "bfloat16"))
+
+    def __init__(self, model: Union[bytes, str, None] = None, **kw):
+        super().__init__(**kw)
+        if model is not None:
+            self.set_model(model)
+        self._fn_cache: Dict[Any, Any] = {}
+
+    def _get_cache(self) -> Dict[Any, Any]:
+        # instances deserialized via load_stage skip __init__
+        if not hasattr(self, "_fn_cache"):
+            self._fn_cache = {}
+        return self._fn_cache
+
+    # -- model loading -----------------------------------------------------
+    def set_model(self, model: Union[bytes, str]) -> "ONNXModel":
+        if isinstance(model, str):
+            with open(model, "rb") as f:
+                model = f.read()
+        self.set("modelPayload", bytes(model))
+        self._fn_cache = {}
+        self._graph_cache = None
+        return self
+
+    def set_feed_dict(self, feed: Dict[str, str]) -> "ONNXModel":
+        return self.set("feedDict", feed)
+
+    def set_fetch_dict(self, fetch: Dict[str, str]) -> "ONNXModel":
+        return self.set("fetchDict", fetch)
+
+    def set_mini_batch_size(self, n: int) -> "ONNXModel":
+        return self.set("miniBatchSize", n)
+
+    def set_softmax_dict(self, d: Dict[str, str]) -> "ONNXModel":
+        return self.set("softMaxDict", d)
+
+    def set_argmax_dict(self, d: Dict[str, str]) -> "ONNXModel":
+        return self.set("argMaxDict", d)
+
+    def _graph(self) -> Graph:
+        payload = self.get_or_default("modelPayload")
+        if payload is None:
+            raise ValueError("ONNXModel: modelPayload not set")
+        # parse once per payload: explainers call transform per-row, and a
+        # fresh Graph each call would defeat the jit cache below
+        cached = getattr(self, "_graph_cache", None)
+        if cached is not None and cached[0] is payload:
+            return cached[1]
+        graph = load_graph(payload)
+        self._graph_cache = (payload, graph)
+        return graph
+
+    # -- introspection (reference ONNXModel modelInput/modelOutput) --------
+    def model_inputs(self) -> List[str]:
+        return self._graph().input_names
+
+    def model_outputs(self) -> List[str]:
+        return self._graph().output_names
+
+    def slice_at_output(self, *output_names: str) -> "ONNXModel":
+        """Model surgery (reference: ONNXModel.sliceAtOutput,
+        ONNXModel.scala:203-209): re-point the graph at intermediate
+        outputs, dropping unreachable nodes."""
+        sliced = slice_at_outputs(self._graph(), list(output_names))
+        clone = self.copy()
+        clone.set("modelPayload", to_model(sliced).serialize())
+        clone.set("fetchDict", {n: n for n in output_names})
+        clone._fn_cache = {}
+        return clone
+
+    # -- execution ---------------------------------------------------------
+    def _build_fn(self, graph: Graph, fetch_names: List[str],
+                  softmax_of: Dict[str, str], argmax_of: Dict[str, str]):
+        """One jitted program: graph eval + fused softmax/argmax post-ops.
+
+        dtype="float32" pins matmul/conv to full-precision MXU passes
+        (TPU default is bf16 inputs); dtype="bfloat16" keeps the fast path.
+        """
+        precision = ("float32" if self.get_or_default("dtype") == "float32"
+                     else "bfloat16")
+
+        def run(inputs: Dict[str, Any]) -> Dict[str, Any]:
+            with jax.default_matmul_precision(precision):
+                out = evaluate(graph, inputs, fetch_names)
+            post: Dict[str, Any] = {k: jnp.asarray(v) for k, v in out.items()}
+            for src, dst in softmax_of.items():
+                post[dst] = jax.nn.softmax(jnp.asarray(out[src]), axis=-1)
+            for src, dst in argmax_of.items():
+                post[dst] = jnp.argmax(jnp.asarray(out[src]), axis=-1)
+            return post
+
+        return jax.jit(run)
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        graph = self._graph()
+        feed: Dict[str, str] = dict(self.get_or_default("feedDict")
+                                    or {n: n for n in graph.input_names})
+        fetch: Dict[str, str] = dict(self.get_or_default("fetchDict")
+                                     or {n: n for n in graph.output_names})
+        batch = int(self.get_or_default("miniBatchSize"))
+        dtype = jnp.bfloat16 if self.get_or_default("dtype") == "bfloat16" \
+            else jnp.float32
+
+        # fetch cols whose source feeds softmax/argmax post-ops
+        softmax_d = dict(self.get_or_default("softMaxDict") or {})
+        argmax_d = dict(self.get_or_default("argMaxDict") or {})
+        fetch_names = list(dict.fromkeys(fetch.values()))
+        out_to_col = {v: k for k, v in fetch.items()}
+
+        # columns referenced by post-op dicts must exist among fetch outputs
+        softmax_of = {fetch[src]: dst for src, dst in softmax_d.items()
+                      if src in fetch}
+        argmax_of = {fetch[src]: dst for src, dst in argmax_d.items()
+                     if src in fetch}
+
+        key = (id(graph), tuple(fetch_names), tuple(sorted(softmax_of.items())),
+               tuple(sorted(argmax_of.items())))
+        cache = self._get_cache()
+        if key not in cache:
+            cache[key] = self._build_fn(graph, fetch_names,
+                                        softmax_of, argmax_of)
+        fn = cache[key]
+
+        n = ds.num_rows
+        # stack each fed column to (n, ...) once
+        feeds_np: Dict[str, np.ndarray] = {}
+        for onnx_name, col in feed.items():
+            column = ds[col]
+            if column.dtype == object:
+                arr = np.stack([np.asarray(v) for v in column])
+            else:
+                arr = np.asarray(column)
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = arr.astype(np.dtype(dtype))
+            feeds_np[onnx_name] = arr
+
+        chunks: Dict[str, List[np.ndarray]] = {}
+        for start in range(0, n, batch):
+            stop = min(start + batch, n)
+            pad = batch - (stop - start)
+            ins = {}
+            for k, arr in feeds_np.items():
+                piece = arr[start:stop]
+                if pad:  # pad to static batch so jit compiles once
+                    piece = np.concatenate(
+                        [piece, np.repeat(piece[-1:], pad, axis=0)], axis=0)
+                ins[k] = piece
+            outs = fn(ins)
+            for name, val in outs.items():
+                val = np.asarray(val)[:stop - start]
+                chunks.setdefault(name, []).append(val)
+
+        new_cols: Dict[str, Any] = {}
+        for name, pieces in chunks.items():
+            # fetch outputs map back to their dataset column; post-op dict
+            # values are already the destination column names
+            col_name = out_to_col.get(name, name)
+            stacked = np.concatenate(pieces, axis=0)
+            if stacked.ndim == 1:
+                new_cols[col_name] = stacked
+            else:
+                obj = np.empty(len(stacked), dtype=object)
+                for i in range(len(stacked)):
+                    obj[i] = stacked[i]
+                new_cols[col_name] = obj
+        return ds.with_columns(new_cols)
+
+
+class ImageFeaturizer(Transformer):
+    """Headless-CNN embeddings (reference: deep-learning/.../onnx/
+    ImageFeaturizer.scala:34-270 — ImageTransformer preprocessing feeding a
+    sliced ONNXModel).  ``headless=True`` slices the network at
+    ``featureTensorName`` so the output column holds flat embeddings; with
+    ``headless=False`` the final network outputs (logits) are emitted.
+    """
+
+    inputCol = StringParam(doc="image column", default="image")
+    outputCol = StringParam(doc="feature column", default="features")
+    headless = BoolParam(doc="cut at feature tensor instead of logits",
+                         default=True)
+    featureTensorName = StringParam(doc="onnx value name of the feature tensor")
+    onnxModel = PyObjectParam(doc="the wrapped ONNXModel")
+    miniBatchSize = IntParam(doc="rows per device batch", default=128)
+
+    def __init__(self, onnx_model: Optional[ONNXModel] = None, **kw):
+        super().__init__(**kw)
+        if onnx_model is not None:
+            self.set("onnxModel", onnx_model)
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        base: ONNXModel = self.get_or_default("onnxModel")
+        if base is None:
+            raise ValueError("ImageFeaturizer: onnxModel not set")
+        graph = base._graph()
+        in_name = graph.input_names[0]
+        if self.get_or_default("headless"):
+            feat = self.get_or_default("featureTensorName")
+            if not feat:
+                raise ValueError("headless=True requires featureTensorName")
+            model = base.slice_at_output(feat)
+            out_name = feat
+        else:
+            model = base.copy()
+            out_name = graph.output_names[0]
+        model.set("feedDict", {in_name: self.get_or_default("inputCol")})
+        model.set("fetchDict", {"_imgfeat": out_name})
+        model.set("miniBatchSize", self.get_or_default("miniBatchSize"))
+        model._fn_cache = {}
+        out = model.transform(ds)
+        col = out["_imgfeat"]
+        # flatten per-row feature maps to vectors
+        if col.dtype == object:
+            flat = np.empty(len(col), dtype=object)
+            for i, v in enumerate(col):
+                flat[i] = np.asarray(v).reshape(-1)
+            col = flat
+        return ds.with_column(self.get_or_default("outputCol"), col)

@@ -1,0 +1,980 @@
+"""ONNX op → JAX/XLA lowerings.
+
+Replaces the reference's ONNX Runtime execution (reference:
+deep-learning/.../onnx/ONNXRuntime.scala:24-108 — a CUDA OrtSession per
+Spark partition) with tracing each op into ONE XLA program: the whole
+graph jit-compiles, XLA fuses elementwise chains into the convolutions /
+matmuls, and the MXU sees large batched GEMMs instead of per-op kernel
+launches.
+
+Static-vs-traced dispatch: shape-producing subgraphs (``Shape`` →
+``Gather`` → ``Concat`` → ``Reshape`` is the classic exporter pattern)
+must stay concrete so reshapes get static ints under ``jit``.  Every
+value in the evaluator is either a ``np.ndarray`` (static) or a traced
+jax array; ops compute with numpy whenever all inputs are static.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+OP_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(*names: str):
+    def deco(fn):
+        for n in names:
+            OP_REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+class OpCall:
+    """One node application: resolved inputs + attributes."""
+
+    def __init__(self, op_type: str, inputs: List[Any], attrs: Dict[str, Any],
+                 opset: int, n_outputs: int):
+        self.op_type = op_type
+        self.inputs = inputs          # None for omitted optional inputs
+        self.attrs = attrs
+        self.opset = opset
+        self.n_outputs = n_outputs
+
+    def inp(self, i: int, default=None):
+        if i < len(self.inputs) and self.inputs[i] is not None:
+            return self.inputs[i]
+        return default
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def static(self, i: int, default=None) -> Optional[np.ndarray]:
+        v = self.inp(i)
+        if v is None:
+            return default
+        if not isinstance(v, np.ndarray):
+            raise ValueError(
+                f"{self.op_type}: input #{i} must be static (shape-like) "
+                f"under jit, got traced value")
+        return v
+
+
+def is_static(v) -> bool:
+    return isinstance(v, (np.ndarray, np.generic))
+
+
+def xp(*vals):
+    """numpy when every operand is static, jnp otherwise."""
+    return np if all(is_static(v) for v in vals if v is not None) else jnp
+
+
+# ============================================================================
+# elementwise / arithmetic
+# ============================================================================
+
+def _binop(fn_name):
+    def f(call: OpCall):
+        a, b = call.inp(0), call.inp(1)
+        return [getattr(xp(a, b), fn_name)(a, b)]
+    return f
+
+
+register("Add")(_binop("add"))
+register("Sub")(_binop("subtract"))
+register("Mul")(_binop("multiply"))
+register("Pow")(_binop("power"))
+register("Greater")(_binop("greater"))
+register("GreaterOrEqual")(_binop("greater_equal"))
+register("Less")(_binop("less"))
+register("LessOrEqual")(_binop("less_equal"))
+register("Equal")(_binop("equal"))
+register("And")(_binop("logical_and"))
+register("Or")(_binop("logical_or"))
+register("Xor")(_binop("logical_xor"))
+register("BitwiseAnd")(_binop("bitwise_and"))
+register("BitwiseOr")(_binop("bitwise_or"))
+register("Mod")(_binop("mod"))
+
+
+@register("Div")
+def _div(c: OpCall):
+    a, b = c.inp(0), c.inp(1)
+    m = xp(a, b)
+    dtype = a.dtype
+    if np.issubdtype(dtype, np.integer):
+        # ONNX integer Div truncates toward zero; numpy floor-divides.
+        return [m.trunc(m.divide(a, b)).astype(dtype)]
+    return [m.divide(a, b)]
+
+
+def _unary(fn_name):
+    def f(call: OpCall):
+        a = call.inp(0)
+        return [getattr(xp(a), fn_name)(a)]
+    return f
+
+
+for onnx_name, np_name in [
+        ("Neg", "negative"), ("Abs", "abs"), ("Exp", "exp"), ("Log", "log"),
+        ("Sqrt", "sqrt"), ("Floor", "floor"), ("Ceil", "ceil"),
+        ("Round", "round"), ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+        ("Asin", "arcsin"), ("Acos", "arccos"), ("Atan", "arctan"),
+        ("Sinh", "sinh"), ("Cosh", "cosh"), ("Tanh", "tanh"),
+        ("Sign", "sign"), ("Not", "logical_not"), ("IsNaN", "isnan"),
+        ("IsInf", "isinf")]:
+    register(onnx_name)(_unary(np_name))
+
+
+@register("Reciprocal")
+def _reciprocal(c: OpCall):
+    return [1.0 / c.inp(0)]
+
+
+@register("Erf")
+def _erf(c: OpCall):
+    a = c.inp(0)
+    if is_static(a):
+        return [np.vectorize(math.erf, otypes=[np.asarray(a).dtype])(a)]
+    return [jax.scipy.special.erf(a)]
+
+
+@register("Relu")
+def _relu(c: OpCall):
+    a = c.inp(0)
+    return [xp(a).maximum(a, 0)]
+
+
+@register("LeakyRelu")
+def _leaky_relu(c: OpCall):
+    a, alpha = c.inp(0), c.attr("alpha", 0.01)
+    return [xp(a).where(a >= 0, a, alpha * a)]
+
+
+@register("PRelu")
+def _prelu(c: OpCall):
+    a, slope = c.inp(0), c.inp(1)
+    return [xp(a, slope).where(a >= 0, a, slope * a)]
+
+
+@register("Elu")
+def _elu(c: OpCall):
+    a, alpha = c.inp(0), c.attr("alpha", 1.0)
+    m = xp(a)
+    return [m.where(a >= 0, a, alpha * (m.exp(m.minimum(a, 0)) - 1))]
+
+
+@register("Selu")
+def _selu(c: OpCall):
+    a = c.inp(0)
+    alpha = c.attr("alpha", 1.6732632423543772)
+    gamma = c.attr("gamma", 1.0507009873554805)
+    m = xp(a)
+    return [gamma * m.where(a >= 0, a, alpha * (m.exp(m.minimum(a, 0)) - 1))]
+
+
+@register("Sigmoid")
+def _sigmoid(c: OpCall):
+    a = c.inp(0)
+    if is_static(a):
+        return [1.0 / (1.0 + np.exp(-a))]
+    return [jax.nn.sigmoid(a)]
+
+
+@register("HardSigmoid")
+def _hard_sigmoid(c: OpCall):
+    a = c.inp(0)
+    alpha, beta = c.attr("alpha", 0.2), c.attr("beta", 0.5)
+    return [xp(a).clip(alpha * a + beta, 0, 1)]
+
+
+@register("HardSwish")
+def _hard_swish(c: OpCall):
+    a = c.inp(0)
+    return [a * xp(a).clip(a / 6.0 + 0.5, 0, 1)]
+
+
+@register("Softplus")
+def _softplus(c: OpCall):
+    a = c.inp(0)
+    if is_static(a):
+        return [np.log1p(np.exp(-np.abs(a))) + np.maximum(a, 0)]
+    return [jax.nn.softplus(a)]
+
+
+@register("Softsign")
+def _softsign(c: OpCall):
+    a = c.inp(0)
+    return [a / (1 + xp(a).abs(a))]
+
+
+@register("Gelu")
+def _gelu(c: OpCall):
+    a = c.inp(0)
+    approx = c.attr("approximate", "none")
+    return [jax.nn.gelu(a, approximate=(approx == "tanh"))]
+
+
+@register("Mish")
+def _mish(c: OpCall):
+    a = c.inp(0)
+    return [a * jnp.tanh(jax.nn.softplus(a))]
+
+
+@register("Clip")
+def _clip(c: OpCall):
+    a = c.inp(0)
+    if c.opset >= 11:
+        lo, hi = c.inp(1), c.inp(2)
+    else:
+        lo, hi = c.attr("min"), c.attr("max")
+    m = xp(a)
+    if lo is not None:
+        a = m.maximum(a, lo)
+    if hi is not None:
+        a = m.minimum(a, hi)
+    return [a]
+
+
+@register("Softmax")
+def _softmax(c: OpCall):
+    a = c.inp(0)
+    axis = c.attr("axis", -1 if c.opset >= 13 else 1)
+    if c.opset < 13:
+        # legacy: flatten to 2D at `axis`, softmax rows, reshape back
+        shp = a.shape
+        lead = int(np.prod(shp[:axis])) if axis > 0 else 1
+        flat = a.reshape(lead, -1)
+        out = jax.nn.softmax(jnp.asarray(flat), axis=-1)
+        return [out.reshape(shp)]
+    return [jax.nn.softmax(jnp.asarray(a), axis=axis)]
+
+
+@register("LogSoftmax")
+def _log_softmax(c: OpCall):
+    a = c.inp(0)
+    axis = c.attr("axis", -1 if c.opset >= 13 else 1)
+    return [jax.nn.log_softmax(jnp.asarray(a), axis=axis)]
+
+
+@register("Min", "Max", "Sum", "Mean")
+def _variadic(c: OpCall):
+    vals = [v for v in c.inputs if v is not None]
+    m = xp(*vals)
+    if c.op_type == "Min":
+        out = vals[0]
+        for v in vals[1:]:
+            out = m.minimum(out, v)
+    elif c.op_type == "Max":
+        out = vals[0]
+        for v in vals[1:]:
+            out = m.maximum(out, v)
+    else:
+        out = vals[0]
+        for v in vals[1:]:
+            out = m.add(out, v)
+        if c.op_type == "Mean":
+            out = out / len(vals)
+    return [out]
+
+
+@register("Where")
+def _where(c: OpCall):
+    cond, a, b = c.inp(0), c.inp(1), c.inp(2)
+    return [xp(cond, a, b).where(cond, a, b)]
+
+
+# ============================================================================
+# shape / indexing
+# ============================================================================
+
+@register("Shape")
+def _shape(c: OpCall):
+    a = c.inp(0)
+    shp = np.asarray(a.shape if hasattr(a, "shape") else np.shape(a),
+                     dtype=np.int64)
+    start = c.attr("start", 0)
+    end = c.attr("end")
+    return [shp[start:end]]
+
+
+@register("Size")
+def _size(c: OpCall):
+    a = c.inp(0)
+    return [np.asarray(int(np.prod(a.shape)), dtype=np.int64)]
+
+
+@register("Reshape")
+def _reshape(c: OpCall):
+    a = c.inp(0)
+    if c.opset >= 5:
+        shape = c.static(1).astype(np.int64).tolist()
+    else:
+        shape = list(c.attr("shape"))
+    allowzero = c.attr("allowzero", 0)
+    out_shape = []
+    for i, d in enumerate(shape):
+        if d == 0 and not allowzero:
+            out_shape.append(a.shape[i])
+        else:
+            out_shape.append(int(d))
+    return [a.reshape(out_shape)]
+
+
+@register("Flatten")
+def _flatten(c: OpCall):
+    a = c.inp(0)
+    axis = c.attr("axis", 1)
+    lead = int(np.prod(a.shape[:axis])) if axis > 0 else 1
+    return [a.reshape(lead, -1)]
+
+
+@register("Transpose")
+def _transpose(c: OpCall):
+    a = c.inp(0)
+    perm = c.attr("perm")
+    return [xp(a).transpose(a, perm)]
+
+
+@register("Squeeze")
+def _squeeze(c: OpCall):
+    a = c.inp(0)
+    if c.opset >= 13:
+        axes = c.static(1)
+        axes = None if axes is None else tuple(int(x) for x in axes)
+    else:
+        axes = c.attr("axes")
+        axes = None if axes is None else tuple(axes)
+    if axes is None:
+        axes = tuple(i for i, d in enumerate(a.shape) if d == 1)
+    return [xp(a).squeeze(a, axis=axes)]
+
+
+@register("Unsqueeze")
+def _unsqueeze(c: OpCall):
+    a = c.inp(0)
+    if c.opset >= 13:
+        axes = [int(x) for x in c.static(1)]
+    else:
+        axes = list(c.attr("axes"))
+    out_rank = len(a.shape) + len(axes)
+    axes = sorted(ax % out_rank for ax in axes)
+    m = xp(a)
+    for ax in axes:
+        a = m.expand_dims(a, ax)
+    return [a]
+
+
+@register("Concat")
+def _concat(c: OpCall):
+    vals = [v for v in c.inputs if v is not None]
+    return [xp(*vals).concatenate(vals, axis=c.attr("axis", 0))]
+
+
+@register("Split")
+def _split(c: OpCall):
+    a = c.inp(0)
+    axis = c.attr("axis", 0)
+    if c.opset >= 13:
+        split = c.inp(1)
+        split = None if split is None else np.asarray(split).tolist()
+    else:
+        split = c.attr("split")
+    n = c.n_outputs
+    if split is None:
+        size = a.shape[axis]
+        base = -(-size // n)  # ONNX: last chunk may be smaller
+        split = [base] * (n - 1) + [size - base * (n - 1)]
+    idx = np.cumsum(split)[:-1].tolist()
+    m = xp(a)
+    return list(m.split(a, idx, axis=axis))
+
+
+@register("Slice")
+def _slice(c: OpCall):
+    a = c.inp(0)
+    if c.opset >= 10:
+        starts = c.static(1).tolist()
+        ends = c.static(2).tolist()
+        axes = c.static(3)
+        steps = c.static(4)
+        axes = list(range(len(starts))) if axes is None else axes.tolist()
+        steps = [1] * len(starts) if steps is None else steps.tolist()
+    else:
+        starts = list(c.attr("starts"))
+        ends = list(c.attr("ends"))
+        axes = list(c.attr("axes", range(len(starts))))
+        steps = [1] * len(starts)
+    slices = [slice(None)] * len(a.shape)
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        ax = int(ax) % len(a.shape)
+        INT_MAX = np.iinfo(np.int64).max
+        en = None if en >= INT_MAX else int(en)
+        en2 = None if (sp < 0 and en is not None and en < -a.shape[ax]) else en
+        slices[ax] = slice(int(st), en2, int(sp))
+    return [a[tuple(slices)]]
+
+
+@register("Gather")
+def _gather(c: OpCall):
+    a, idx = c.inp(0), c.inp(1)
+    axis = c.attr("axis", 0)
+    return [xp(a, idx).take(a, idx, axis=axis)]
+
+
+@register("GatherElements")
+def _gather_elements(c: OpCall):
+    a, idx = jnp.asarray(c.inp(0)), jnp.asarray(c.inp(1))
+    axis = c.attr("axis", 0)
+    return [jnp.take_along_axis(a, idx, axis=axis)]
+
+
+@register("GatherND")
+def _gather_nd(c: OpCall):
+    data, indices = jnp.asarray(c.inp(0)), np.asarray(c.static(1))
+    if c.attr("batch_dims", 0):
+        raise NotImplementedError("GatherND batch_dims > 0")
+    idx = tuple(indices[..., i] for i in range(indices.shape[-1]))
+    return [data[idx]]
+
+
+@register("ScatterND")
+def _scatter_nd(c: OpCall):
+    data, indices, updates = (jnp.asarray(c.inp(0)), c.static(1),
+                              jnp.asarray(c.inp(2)))
+    idx = tuple(indices[..., i] for i in range(indices.shape[-1]))
+    return [data.at[idx].set(updates)]
+
+
+@register("Expand")
+def _expand(c: OpCall):
+    a = c.inp(0)
+    shape = [int(s) for s in c.static(1)]
+    # ONNX Expand uses multidirectional broadcasting
+    target = np.broadcast_shapes(tuple(a.shape), tuple(shape))
+    return [xp(a).broadcast_to(a, target)]
+
+
+@register("Tile")
+def _tile(c: OpCall):
+    a = c.inp(0)
+    reps = [int(r) for r in c.static(1)]
+    return [xp(a).tile(a, reps)]
+
+
+@register("Pad")
+def _pad(c: OpCall):
+    a = c.inp(0)
+    if c.opset >= 11:
+        pads = c.static(1).astype(np.int64)
+        cval = c.inp(2)
+        cval = 0.0 if cval is None else float(np.asarray(cval))
+        axes = c.static(3)
+    else:
+        pads = np.asarray(c.attr("pads"), dtype=np.int64)
+        cval = c.attr("value", 0.0)
+        axes = None
+    mode = c.attr("mode", "constant")
+    rank = len(a.shape)
+    pad_width = [(0, 0)] * rank
+    if axes is None:
+        axes = list(range(rank))
+    half = len(pads) // 2
+    for j, ax in enumerate(axes):
+        pad_width[int(ax) % rank] = (int(pads[j]), int(pads[j + half]))
+    m = xp(a)
+    if mode == "constant":
+        return [m.pad(a, pad_width, mode="constant", constant_values=cval)]
+    return [m.pad(a, pad_width, mode={"reflect": "reflect",
+                                      "edge": "edge", "wrap": "wrap"}[mode])]
+
+
+@register("Cast")
+def _cast(c: OpCall):
+    from .protoparse import DTYPE_TO_NUMPY
+    a = c.inp(0)
+    to = DTYPE_TO_NUMPY[c.attr("to")]
+    return [a.astype(to)]
+
+
+@register("CastLike")
+def _cast_like(c: OpCall):
+    a, b = c.inp(0), c.inp(1)
+    return [a.astype(b.dtype)]
+
+
+@register("Identity")
+def _identity(c: OpCall):
+    return [c.inp(0)]
+
+
+@register("Dropout")
+def _dropout(c: OpCall):
+    a = c.inp(0)
+    outs = [a]
+    if c.n_outputs > 1:
+        outs.append(xp(a).ones(a.shape, dtype=bool))
+    return outs
+
+
+@register("Constant")
+def _constant(c: OpCall):
+    for key in ("value", "value_float", "value_int", "value_floats",
+                "value_ints", "value_string"):
+        v = c.attr(key)
+        if v is not None:
+            if key == "value_int":
+                return [np.asarray(v, dtype=np.int64)]
+            if key == "value_ints":
+                return [np.asarray(v, dtype=np.int64)]
+            if key == "value_float":
+                return [np.asarray(v, dtype=np.float32)]
+            if key == "value_floats":
+                return [np.asarray(v, dtype=np.float32)]
+            return [np.asarray(v)]
+    raise ValueError("Constant node with no value attribute")
+
+
+@register("ConstantOfShape")
+def _constant_of_shape(c: OpCall):
+    shape = [int(s) for s in c.static(0)]
+    value = c.attr("value")
+    if value is None:
+        value = np.zeros(1, dtype=np.float32)
+    value = np.asarray(value)
+    return [np.full(shape, value.reshape(-1)[0], dtype=value.dtype)]
+
+
+@register("Range")
+def _range(c: OpCall):
+    start, limit, delta = (np.asarray(c.static(0)), np.asarray(c.static(1)),
+                           np.asarray(c.static(2)))
+    return [np.arange(start.item(), limit.item(), delta.item(),
+                      dtype=start.dtype)]
+
+
+@register("OneHot")
+def _onehot(c: OpCall):
+    indices, depth, values = c.inp(0), int(np.asarray(c.static(1)).item()), c.inp(2)
+    axis = c.attr("axis", -1)
+    off, on = values[0], values[1]
+    oh = jax.nn.one_hot(jnp.asarray(indices) % depth, depth, axis=axis)
+    return [oh * (on - off) + off]
+
+
+@register("TopK")
+def _topk(c: OpCall):
+    a = c.inp(0)
+    k = int(np.asarray(c.static(1)).item())
+    axis = c.attr("axis", -1)
+    largest = c.attr("largest", 1)
+    a = jnp.asarray(a)
+    a_m = jnp.moveaxis(a, axis, -1)
+    vals, idx = lax.top_k(a_m if largest else -a_m, k)
+    if not largest:
+        vals = -vals
+    return [jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx.astype(jnp.int64), -1, axis)]
+
+
+@register("ArgMax", "ArgMin")
+def _argmax(c: OpCall):
+    a = c.inp(0)
+    axis = c.attr("axis", 0)
+    keepdims = c.attr("keepdims", 1)
+    fn = "argmax" if c.op_type == "ArgMax" else "argmin"
+    out = getattr(xp(a), fn)(a, axis=axis)
+    out = out.astype(np.int64)
+    if keepdims:
+        out = xp(a).expand_dims(out, axis)
+    return [out]
+
+
+@register("CumSum")
+def _cumsum(c: OpCall):
+    a = c.inp(0)
+    axis = int(np.asarray(c.static(1)).item())
+    if c.attr("exclusive", 0) or c.attr("reverse", 0):
+        raise NotImplementedError("CumSum exclusive/reverse")
+    return [xp(a).cumsum(a, axis=axis)]
+
+
+@register("Trilu")
+def _trilu(c: OpCall):
+    a = c.inp(0)
+    k = c.inp(1)
+    k = 0 if k is None else int(np.asarray(k).item())
+    upper = c.attr("upper", 1)
+    m = xp(a)
+    return [m.triu(a, k) if upper else m.tril(a, k)]
+
+
+@register("NonZero")
+def _nonzero(c: OpCall):
+    a = c.static(0)  # data-dependent shape: only legal on static values
+    return [np.stack(np.nonzero(a)).astype(np.int64)]
+
+
+@register("Einsum")
+def _einsum(c: OpCall):
+    eq = c.attr("equation")
+    vals = [jnp.asarray(v) for v in c.inputs if v is not None]
+    return [jnp.einsum(eq, *vals)]
+
+
+# ============================================================================
+# reductions
+# ============================================================================
+
+def _reduce(np_name):
+    def f(c: OpCall):
+        a = c.inp(0)
+        if c.opset >= 18 or (c.op_type == "ReduceSum" and c.opset >= 13):
+            axes = c.inp(1)
+            axes = None if axes is None else tuple(int(x) for x in np.asarray(axes))
+        else:
+            axes = c.attr("axes")
+            axes = None if axes is None else tuple(axes)
+        keepdims = bool(c.attr("keepdims", 1))
+        if axes is None and c.attr("noop_with_empty_axes", 0):
+            return [a]
+        m = xp(a)
+        return [getattr(m, np_name)(a, axis=axes, keepdims=keepdims)]
+    return f
+
+
+register("ReduceSum")(_reduce("sum"))
+register("ReduceMean")(_reduce("mean"))
+register("ReduceMax")(_reduce("max"))
+register("ReduceMin")(_reduce("min"))
+register("ReduceProd")(_reduce("prod"))
+
+
+@register("ReduceL2")
+def _reduce_l2(c: OpCall):
+    a = c.inp(0)
+    if c.opset >= 18:
+        axes = c.inp(1)
+        axes = None if axes is None else tuple(int(x) for x in np.asarray(axes))
+    else:
+        axes = c.attr("axes")
+        axes = None if axes is None else tuple(axes)
+    keepdims = bool(c.attr("keepdims", 1))
+    m = xp(a)
+    return [m.sqrt(m.sum(m.square(a), axis=axes, keepdims=keepdims))]
+
+
+@register("ReduceLogSumExp")
+def _reduce_lse(c: OpCall):
+    a = jnp.asarray(c.inp(0))
+    axes = c.attr("axes")
+    axes = None if axes is None else tuple(axes)
+    keepdims = bool(c.attr("keepdims", 1))
+    return [jax.scipy.special.logsumexp(a, axis=axes, keepdims=keepdims)]
+
+
+# ============================================================================
+# linear algebra
+# ============================================================================
+
+@register("MatMul")
+def _matmul(c: OpCall):
+    a, b = c.inp(0), c.inp(1)
+    return [jnp.matmul(jnp.asarray(a), jnp.asarray(b),
+                       preferred_element_type=jnp.float32)
+            if not (is_static(a) and is_static(b)) else np.matmul(a, b)]
+
+
+@register("Gemm")
+def _gemm(c: OpCall):
+    a, b, bias = c.inp(0), c.inp(1), c.inp(2)
+    alpha, beta = c.attr("alpha", 1.0), c.attr("beta", 1.0)
+    if c.attr("transA", 0):
+        a = a.T
+    if c.attr("transB", 0):
+        b = b.T
+    out = alpha * jnp.matmul(jnp.asarray(a), jnp.asarray(b),
+                             preferred_element_type=jnp.float32)
+    if bias is not None:
+        out = out + beta * bias
+    return [out]
+
+
+# ============================================================================
+# convolutions / pooling / normalization
+# ============================================================================
+
+def _conv_pads(call: OpCall, a_shape, k_shape, strides, dilations):
+    """Resolve ONNX pads/auto_pad to lax padding list [(lo,hi), ...]."""
+    spatial = len(k_shape)
+    auto = call.attr("auto_pad", "NOTSET")
+    if auto in ("NOTSET", ""):
+        pads = call.attr("pads", [0] * 2 * spatial)
+        return [(int(pads[i]), int(pads[i + spatial])) for i in range(spatial)]
+    if auto == "VALID":
+        return [(0, 0)] * spatial
+    out = []
+    for i in range(spatial):
+        eff_k = (k_shape[i] - 1) * dilations[i] + 1
+        out_dim = -(-a_shape[i] // strides[i])
+        total = max(0, (out_dim - 1) * strides[i] + eff_k - a_shape[i])
+        lo = total // 2 if auto == "SAME_UPPER" else total - total // 2
+        out.append((lo, total - lo))
+    return out
+
+
+@register("Conv")
+def _conv(c: OpCall):
+    x, w, b = jnp.asarray(c.inp(0)), jnp.asarray(c.inp(1)), c.inp(2)
+    spatial = x.ndim - 2
+    strides = list(c.attr("strides", [1] * spatial))
+    dilations = list(c.attr("dilations", [1] * spatial))
+    group = c.attr("group", 1)
+    pads = _conv_pads(c, x.shape[2:], w.shape[2:], strides, dilations)
+    spec = "NCHW"[:x.ndim] if spatial == 2 else None
+    if spatial == 1:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCH", "OIH", "NCH"))
+    elif spatial == 2:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    elif spatial == 3:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    else:
+        raise NotImplementedError(f"Conv with {spatial} spatial dims")
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=group,
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        out = out + jnp.asarray(b).reshape((1, -1) + (1,) * spatial)
+    return [out]
+
+
+@register("ConvTranspose")
+def _conv_transpose(c: OpCall):
+    x, w, b = jnp.asarray(c.inp(0)), jnp.asarray(c.inp(1)), c.inp(2)
+    spatial = x.ndim - 2
+    strides = list(c.attr("strides", [1] * spatial))
+    dilations = list(c.attr("dilations", [1] * spatial))
+    group = c.attr("group", 1)
+    if group != 1:
+        raise NotImplementedError("ConvTranspose group > 1")
+    pads = c.attr("pads", [0] * 2 * spatial)
+    out_pads = c.attr("output_padding", [0] * spatial)
+    # ONNX kernel layout is (C_in, C_out/group, *k); lax wants IOHW via dims
+    lax_pads = []
+    for i in range(spatial):
+        eff_k = (w.shape[2 + i] - 1) * dilations[i] + 1
+        lo = eff_k - 1 - int(pads[i])
+        hi = eff_k - 1 - int(pads[i + spatial]) + int(out_pads[i])
+        lax_pads.append((lo, hi))
+    x_dil = lax.conv_general_dilated(
+        x, jnp.flip(w, axis=tuple(range(2, 2 + spatial))).swapaxes(0, 1),
+        window_strides=[1] * spatial, padding=lax_pads,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, w.shape[:2][::-1] + w.shape[2:],
+            ("NCHW"[:x.ndim], "OIHW"[:x.ndim], "NCHW"[:x.ndim])
+            if spatial == 2 else
+            (("NCH", "OIH", "NCH") if spatial == 1 else
+             ("NCDHW", "OIDHW", "NCDHW"))),
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        x_dil = x_dil + jnp.asarray(b).reshape((1, -1) + (1,) * spatial)
+    return [x_dil]
+
+
+def _pool(c: OpCall, reducer, init, is_avg=False):
+    x = jnp.asarray(c.inp(0))
+    spatial = x.ndim - 2
+    kernel = list(c.attr("kernel_shape"))
+    strides = list(c.attr("strides", [1] * spatial))
+    dilations = list(c.attr("dilations", [1] * spatial))
+    pads = _conv_pads(c, x.shape[2:], kernel, strides, dilations)
+    window = (1, 1) + tuple(kernel)
+    strd = (1, 1) + tuple(strides)
+    dil = (1, 1) + tuple(dilations)
+    padding = ((0, 0), (0, 0)) + tuple(pads)
+    out = lax.reduce_window(x, init, reducer, window, strd, padding,
+                            window_dilation=dil)
+    if is_avg:
+        if c.attr("count_include_pad", 0):
+            denom = float(np.prod(kernel))
+            out = out / denom
+        else:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strd,
+                                       padding, window_dilation=dil)
+            out = out / counts
+    return [out]
+
+
+@register("MaxPool")
+def _maxpool(c: OpCall):
+    return _pool(c, lax.max, -jnp.inf)
+
+
+@register("AveragePool")
+def _avgpool(c: OpCall):
+    return _pool(c, lax.add, 0.0, is_avg=True)
+
+
+@register("GlobalAveragePool")
+def _global_avgpool(c: OpCall):
+    x = c.inp(0)
+    axes = tuple(range(2, len(x.shape)))
+    return [xp(x).mean(x, axis=axes, keepdims=True)]
+
+
+@register("GlobalMaxPool")
+def _global_maxpool(c: OpCall):
+    x = c.inp(0)
+    axes = tuple(range(2, len(x.shape)))
+    return [xp(x).max(x, axis=axes, keepdims=True)]
+
+
+@register("BatchNormalization")
+def _batchnorm(c: OpCall):
+    x, scale, bias, mean, var = (c.inp(0), c.inp(1), c.inp(2), c.inp(3),
+                                 c.inp(4))
+    eps = c.attr("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (len(x.shape) - 2)
+    m = xp(x, scale, bias, mean, var)
+    inv = scale / m.sqrt(var + eps)
+    return [x * inv.reshape(shape) + (bias - mean * inv).reshape(shape)]
+
+
+@register("InstanceNormalization")
+def _instancenorm(c: OpCall):
+    x, scale, bias = jnp.asarray(c.inp(0)), c.inp(1), c.inp(2)
+    eps = c.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return [(x - mean) / jnp.sqrt(var + eps) * scale.reshape(shape)
+            + bias.reshape(shape)]
+
+
+@register("LayerNormalization")
+def _layernorm(c: OpCall):
+    x, scale, bias = jnp.asarray(c.inp(0)), c.inp(1), c.inp(2)
+    axis = c.attr("axis", -1)
+    eps = c.attr("epsilon", 1e-5)
+    axes = tuple(range(axis % x.ndim, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    out = (x - mean) * inv * scale
+    if bias is not None:
+        out = out + bias
+    outs = [out]
+    if c.n_outputs > 1:
+        outs.append(mean)
+    if c.n_outputs > 2:
+        outs.append(inv)
+    return outs
+
+
+@register("GroupNormalization")
+def _groupnorm(c: OpCall):
+    x, scale, bias = jnp.asarray(c.inp(0)), c.inp(1), c.inp(2)
+    ngroups = c.attr("num_groups")
+    eps = c.attr("epsilon", 1e-5)
+    n, ch = x.shape[0], x.shape[1]
+    grouped = x.reshape((n, ngroups, ch // ngroups) + x.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = grouped.mean(axis=axes, keepdims=True)
+    var = grouped.var(axis=axes, keepdims=True)
+    normed = ((grouped - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return [normed * scale.reshape(shape) + bias.reshape(shape)]
+
+
+@register("LRN")
+def _lrn(c: OpCall):
+    x = jnp.asarray(c.inp(0))
+    size = c.attr("size")
+    alpha, beta, bias = (c.attr("alpha", 1e-4), c.attr("beta", 0.75),
+                         c.attr("bias", 1.0))
+    sq = jnp.square(x)
+    half_lo = (size - 1) // 2
+    half_hi = size - 1 - half_lo
+    window = (1, size) + (1,) * (x.ndim - 2)
+    padding = ((0, 0), (half_lo, half_hi)) + ((0, 0),) * (x.ndim - 2)
+    sums = lax.reduce_window(sq, 0.0, lax.add, window, (1,) * x.ndim, padding)
+    return [x / jnp.power(bias + alpha / size * sums, beta)]
+
+
+@register("Resize")
+def _resize(c: OpCall):
+    x = jnp.asarray(c.inp(0))
+    scales = c.inp(2)
+    sizes = c.inp(3)
+    mode = c.attr("mode", "nearest")
+    if sizes is not None:
+        out_shape = [int(s) for s in np.asarray(sizes)]
+    elif scales is not None and len(np.asarray(scales)):
+        sc = np.asarray(scales, dtype=np.float64)
+        out_shape = [int(math.floor(d * s)) for d, s in zip(x.shape, sc)]
+    else:
+        raise ValueError("Resize needs scales or sizes")
+    method = {"nearest": "nearest", "linear": "linear",
+              "cubic": "cubic"}[mode]
+    return [jax.image.resize(x, out_shape, method=method)]
+
+
+@register("Upsample")
+def _upsample(c: OpCall):
+    x = jnp.asarray(c.inp(0))
+    scales = c.inp(1)
+    sc = np.asarray(scales if scales is not None else c.attr("scales"),
+                    dtype=np.float64)
+    out_shape = [int(math.floor(d * s)) for d, s in zip(x.shape, sc)]
+    mode = c.attr("mode", "nearest")
+    return [jax.image.resize(x, out_shape,
+                             method="nearest" if mode == "nearest" else "linear")]
+
+
+@register("DepthToSpace")
+def _depth_to_space(c: OpCall):
+    x = jnp.asarray(c.inp(0))
+    bs = c.attr("blocksize")
+    n, ch, h, w = x.shape
+    if c.attr("mode", "DCR") == "DCR":
+        t = x.reshape(n, bs, bs, ch // (bs * bs), h, w)
+        t = t.transpose(0, 3, 4, 1, 5, 2)
+    else:
+        t = x.reshape(n, ch // (bs * bs), bs, bs, h, w)
+        t = t.transpose(0, 1, 4, 2, 5, 3)
+    return [t.reshape(n, ch // (bs * bs), h * bs, w * bs)]
+
+
+@register("SpaceToDepth")
+def _space_to_depth(c: OpCall):
+    x = jnp.asarray(c.inp(0))
+    bs = c.attr("blocksize")
+    n, ch, h, w = x.shape
+    t = x.reshape(n, ch, h // bs, bs, w // bs, bs)
+    t = t.transpose(0, 3, 5, 1, 2, 4)
+    return [t.reshape(n, ch * bs * bs, h // bs, w // bs)]
+
+
+def lower(call: OpCall) -> List[Any]:
+    fn = OP_REGISTRY.get(call.op_type)
+    if fn is None:
+        raise NotImplementedError(
+            f"ONNX op {call.op_type!r} has no XLA lowering "
+            f"({len(OP_REGISTRY)} ops supported)")
+    return fn(call)
+
+
+def supported_ops() -> List[str]:
+    return sorted(OP_REGISTRY)
