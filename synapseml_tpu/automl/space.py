@@ -1,0 +1,98 @@
+"""Hyperparameter spaces (reference: core/.../automl/HyperparamBuilder.scala,
+DefaultHyperparams.scala): discrete / range distributions per param, swept
+as a full grid or random draws."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DiscreteHyperParam:
+    """Finite set of values (reference: DiscreteHyperParam)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def grid_values(self) -> List[Any]:
+        return list(self.values)
+
+    def sample(self, rng) -> Any:
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+
+class RangeHyperParam:
+    """Closed numeric range (reference: RangeHyperParam); ``log=True``
+    samples log-uniformly; int ranges produce ints."""
+
+    def __init__(self, low, high, log: bool = False, n_grid: int = 5):
+        if high <= low:
+            raise ValueError("high must exceed low")
+        self.low, self.high = low, high
+        self.log = log
+        self.n_grid = n_grid
+        self.is_int = isinstance(low, int) and isinstance(high, int)
+
+    def grid_values(self) -> List[Any]:
+        if self.log:
+            pts = np.exp(np.linspace(np.log(self.low), np.log(self.high),
+                                     self.n_grid))
+        else:
+            pts = np.linspace(self.low, self.high, self.n_grid)
+        if self.is_int:
+            return sorted({int(round(p)) for p in pts})
+        return [float(p) for p in pts]
+
+    def sample(self, rng) -> Any:
+        if self.log:
+            v = float(np.exp(rng.uniform(np.log(self.low),
+                                         np.log(self.high))))
+        else:
+            v = float(rng.uniform(self.low, self.high))
+        return int(round(v)) if self.is_int else v
+
+
+class HyperparamBuilder:
+    """Accumulates (estimator, paramName) -> distribution entries
+    (reference: HyperparamBuilder.addHyperparam)."""
+
+    def __init__(self):
+        self._entries: List[Tuple[Any, str, Any]] = []
+
+    def add_hyperparam(self, stage, param_name: str, dist) -> "HyperparamBuilder":
+        stage.get_param(param_name)  # validate existence early
+        self._entries.append((stage, param_name, dist))
+        return self
+
+    def build(self) -> List[Tuple[Any, str, Any]]:
+        return list(self._entries)
+
+
+class GridSpace:
+    """Cartesian product of every distribution's grid values
+    (reference: GridSpace)."""
+
+    def __init__(self, entries: List[Tuple[Any, str, Any]]):
+        self.entries = entries
+
+    def param_maps(self) -> Iterator[List[Tuple[Any, str, Any]]]:
+        grids = [d.grid_values() for _, _, d in self.entries]
+        for combo in itertools.product(*grids):
+            yield [(stage, name, val) for (stage, name, _), val
+                   in zip(self.entries, combo)]
+
+
+class RandomSpace:
+    """Random draws from each distribution (reference: RandomSpace)."""
+
+    def __init__(self, entries: List[Tuple[Any, str, Any]], seed: int = 0):
+        self.entries = entries
+        self.seed = seed
+
+    def param_maps(self, n: int) -> Iterator[List[Tuple[Any, str, Any]]]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(n):
+            yield [(stage, name, d.sample(rng))
+                   for stage, name, d in self.entries]

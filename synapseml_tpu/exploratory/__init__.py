@@ -1,0 +1,7 @@
+"""Data-balance analysis (reference: core/.../exploratory/)."""
+
+from .balance import (AggregateBalanceMeasure, DistributionBalanceMeasure,
+                      FeatureBalanceMeasure)
+
+__all__ = ["AggregateBalanceMeasure", "DistributionBalanceMeasure",
+           "FeatureBalanceMeasure"]

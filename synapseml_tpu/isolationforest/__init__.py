@@ -1,0 +1,5 @@
+"""Isolation-forest outlier detection (reference: isolationforest/)."""
+
+from .forest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

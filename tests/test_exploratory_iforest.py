@@ -1,0 +1,112 @@
+"""Balance measures + isolation forest tests (reference test model:
+core/src/test/.../exploratory/, isolationforest/)."""
+
+import numpy as np
+import pytest
+
+from fuzzing import EstimatorFuzzing, TestObject
+from synapseml_tpu import Dataset
+from synapseml_tpu.exploratory import (AggregateBalanceMeasure,
+                                       DistributionBalanceMeasure,
+                                       FeatureBalanceMeasure)
+from synapseml_tpu.isolationforest import IsolationForest
+
+
+def _vec(mat):
+    col = np.empty(len(mat), dtype=object)
+    for i, row in enumerate(mat):
+        col[i] = np.asarray(row, np.float32)
+    return col
+
+
+class TestFeatureBalance:
+    def test_parity_gap(self):
+        # group A: 75% positive, group B: 25% positive
+        ds = Dataset({
+            "gender": np.array(["A"] * 4 + ["B"] * 4),
+            "label": np.array([1, 1, 1, 0, 1, 0, 0, 0], np.float64),
+        })
+        out = FeatureBalanceMeasure(sensitiveCols=["gender"]).transform(ds)
+        row = out.collect()[0]
+        m = row["FeatureBalanceMeasure"]
+        np.testing.assert_allclose(m["dp"], 0.5, atol=1e-9)
+        assert m["pmi"] > 0
+
+    def test_balanced_is_zero(self):
+        ds = Dataset({
+            "g": np.array(["A", "A", "B", "B"]),
+            "label": np.array([1, 0, 1, 0], np.float64),
+        })
+        out = FeatureBalanceMeasure(sensitiveCols=["g"]).transform(ds)
+        m = out.collect()[0]["FeatureBalanceMeasure"]
+        assert abs(m["dp"]) < 1e-9
+        assert abs(m["pmi"]) < 1e-9
+
+
+class TestDistributionBalance:
+    def test_uniform_is_zero(self):
+        ds = Dataset({"c": np.array(["x", "y", "z", "x", "y", "z"])})
+        out = DistributionBalanceMeasure(sensitiveCols=["c"]).transform(ds)
+        m = out.collect()[0]["DistributionBalanceMeasure"]
+        assert abs(m["kl_divergence"]) < 1e-9
+        assert abs(m["total_variation_dist"]) < 1e-9
+
+    def test_skew_increases_divergence(self):
+        near = Dataset({"c": np.array(["x"] * 5 + ["y"] * 5 + ["z"] * 2)})
+        far = Dataset({"c": np.array(["x"] * 10 + ["y", "z"])})
+        m_near = DistributionBalanceMeasure(sensitiveCols=["c"]) \
+            .transform(near).collect()[0]["DistributionBalanceMeasure"]
+        m_far = DistributionBalanceMeasure(sensitiveCols=["c"]) \
+            .transform(far).collect()[0]["DistributionBalanceMeasure"]
+        assert m_far["kl_divergence"] > m_near["kl_divergence"]
+        assert m_far["js_dist"] > m_near["js_dist"]
+
+
+class TestAggregateBalance:
+    def test_equal_groups_zero_inequality(self):
+        ds = Dataset({"a": np.array(["x", "x", "y", "y"]),
+                      "b": np.array(["p", "q", "p", "q"])})
+        out = AggregateBalanceMeasure(sensitiveCols=["a", "b"]).transform(ds)
+        m = out.collect()[0]["AggregateBalanceMeasure"]
+        assert abs(m["atkinson_index"]) < 1e-9
+        assert abs(m["theil_t_index"]) < 1e-9
+
+    def test_imbalance_positive(self):
+        ds = Dataset({"a": np.array(["x"] * 9 + ["y"])})
+        out = AggregateBalanceMeasure(sensitiveCols=["a"]).transform(ds)
+        m = out.collect()[0]["AggregateBalanceMeasure"]
+        assert m["theil_t_index"] > 0.1
+
+
+class TestIsolationForest:
+    def test_detects_planted_outliers(self, rng):
+        inliers = rng.normal(0, 1, size=(300, 4))
+        outliers = rng.normal(0, 1, size=(8, 4)) + 7.0
+        x = np.vstack([inliers, outliers]).astype(np.float32)
+        ds = Dataset({"features": _vec(x)})
+        model = IsolationForest(numEstimators=64, maxSamples=128,
+                                contamination=8 / 308, seed=0).fit(ds)
+        out = model.transform(ds)
+        scores = out["outlierScore"]
+        # planted outliers must clearly out-score inliers on average
+        assert scores[300:].mean() > scores[:300].mean() + 0.1
+        # most planted outliers flagged
+        assert out["predictedLabel"][300:].sum() >= 6
+        # few false positives
+        assert out["predictedLabel"][:300].sum() <= 15
+
+    def test_score_range(self, rng):
+        x = rng.normal(size=(100, 3)).astype(np.float32)
+        ds = Dataset({"features": _vec(x)})
+        model = IsolationForest(numEstimators=16, maxSamples=64).fit(ds)
+        s = model.transform(ds)["outlierScore"]
+        assert (s > 0).all() and (s < 1).all()
+
+
+class TestIsolationForestFuzzing(EstimatorFuzzing):
+    def fuzzing_objects(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(60, 3)).astype(np.float32)
+        ds = Dataset({"features": _vec(x)})
+        return [TestObject(IsolationForest(numEstimators=8, maxSamples=32),
+                           ds)]

@@ -1,0 +1,116 @@
+"""KNN / ConditionalKNN / BallTree tests (reference test model:
+core/src/test/.../nn/ — exact-match against brute force)."""
+
+import numpy as np
+import pytest
+
+from fuzzing import EstimatorFuzzing, TestObject
+from synapseml_tpu import Dataset
+from synapseml_tpu.nn import BallTree, ConditionalKNN, KNN
+
+
+def _vec_col(mat):
+    col = np.empty(len(mat), dtype=object)
+    for i, row in enumerate(mat):
+        col[i] = np.asarray(row, np.float32)
+    return col
+
+
+@pytest.fixture(scope="module")
+def index_data():
+    rng = np.random.default_rng(1)
+    mat = rng.normal(size=(533, 8)).astype(np.float32)  # non-multiple of tile
+    return mat
+
+
+def brute_force_knn(index, queries, k):
+    d = np.linalg.norm(index[None] - queries[:, None], axis=2)
+    idx = np.argsort(d, axis=1)[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+class TestKNN:
+    def test_matches_brute_force(self, index_data, rng):
+        queries = rng.normal(size=(17, 8)).astype(np.float32)
+        ds_fit = Dataset({"features": _vec_col(index_data),
+                          "values": np.arange(len(index_data))})
+        model = KNN(k=7, leafSize=128).fit(ds_fit)
+        out = model.transform(Dataset({"features": _vec_col(queries)}))
+        want_d, want_i = brute_force_knn(index_data, queries, 7)
+        for i, matches in enumerate(out["output"]):
+            got_vals = [m["value"] for m in matches]
+            got_d = [m["distance"] for m in matches]
+            assert got_vals == want_i[i].tolist()
+            np.testing.assert_allclose(got_d, want_d[i], rtol=1e-3, atol=1e-4)
+
+    def test_k_larger_than_index(self):
+        mat = np.eye(3, dtype=np.float32)
+        ds = Dataset({"features": _vec_col(mat), "values": [10, 11, 12]})
+        model = KNN(k=9).fit(ds)
+        out = model.transform(Dataset({"features": _vec_col(mat[:1])}))
+        assert len(out["output"][0]) == 3
+        assert out["output"][0][0]["value"] == 10  # self-match first
+
+
+class TestConditionalKNN:
+    def test_label_filtering(self, index_data, rng):
+        labels = np.array(["a", "b", "c"])[
+            rng.integers(0, 3, len(index_data))]
+        queries = rng.normal(size=(9, 8)).astype(np.float32)
+        conds = np.empty(9, dtype=object)
+        for i in range(9):
+            conds[i] = ["a"] if i % 2 == 0 else ["b", "c"]
+        ds_fit = Dataset({"features": _vec_col(index_data),
+                          "values": np.arange(len(index_data)),
+                          "labels": labels})
+        model = ConditionalKNN(k=5, leafSize=64).fit(ds_fit)
+        out = model.transform(Dataset({"features": _vec_col(queries),
+                                       "conditioner": conds}))
+        for i, matches in enumerate(out["output"]):
+            allowed = set(conds[i])
+            assert len(matches) == 5
+            for m in matches:
+                assert m["label"] in allowed
+        # distances must match label-masked brute force
+        for i, matches in enumerate(out["output"]):
+            mask = np.isin(labels, list(conds[i]))
+            sub = index_data[mask]
+            d = np.linalg.norm(sub - queries[i], axis=1)
+            want = np.sort(d)[:5]
+            got = [m["distance"] for m in matches]
+            np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestBallTree:
+    def test_query_point(self, index_data):
+        bt = BallTree(index_data, values=[f"v{i}" for i in
+                                          range(len(index_data))])
+        res = bt.query_point(index_data[42], k=3)
+        assert res[0][0] == "v42"
+        assert res[0][1] < 1e-3
+        dist, idx = bt.query(index_data[:5], k=1)
+        assert idx[:, 0].tolist() == [0, 1, 2, 3, 4]
+
+
+class TestKNNFuzzing(EstimatorFuzzing):
+    def fuzzing_objects(self):
+        rng = np.random.default_rng(9)
+        mat = rng.normal(size=(40, 4)).astype(np.float32)
+        ds = Dataset({"features": _vec_col(mat),
+                      "values": np.arange(40)})
+        return [TestObject(KNN(k=3), ds)]
+
+
+class TestConditionalKNNFuzzing(EstimatorFuzzing):
+    def fuzzing_objects(self):
+        rng = np.random.default_rng(9)
+        mat = rng.normal(size=(30, 4)).astype(np.float32)
+        conds = np.empty(30, dtype=object)
+        for i in range(30):
+            conds[i] = ["x", "y"]
+        ds = Dataset({"features": _vec_col(mat),
+                      "values": np.arange(30),
+                      "labels": np.array(["x", "y"])[
+                          rng.integers(0, 2, 30)],
+                      "conditioner": conds})
+        return [TestObject(ConditionalKNN(k=2), ds)]

@@ -1,0 +1,156 @@
+"""SAR + ranking evaluator tests (reference test model:
+core/src/test/.../recommendation/ — SAR spec tests check similarity
+matrices and top-k recommendations on small hand-computable data)."""
+
+import numpy as np
+import pytest
+
+from fuzzing import EstimatorFuzzing, TestObject
+from synapseml_tpu import Dataset
+from synapseml_tpu.recommendation import (RankingEvaluator,
+                                          RankingTrainValidationSplit,
+                                          RecommendationIndexer, SAR,
+                                          mean_average_precision, ndcg_at_k,
+                                          precision_at_k, recall_at_k)
+
+
+@pytest.fixture()
+def interactions():
+    # users 0,1 share items a,b; user 2 only item c
+    return Dataset({
+        "user": np.array(["u0", "u0", "u1", "u1", "u1", "u2"]),
+        "item": np.array(["a", "b", "a", "b", "c", "c"]),
+        "rating": np.ones(6, np.float32),
+    })
+
+
+class TestSAR:
+    def test_cooccurrence_matrix(self, interactions):
+        model = SAR(supportThreshold=1,
+                    similarityFunction="cooccurrence").fit(interactions)
+        sim = np.asarray(model.get("itemSimilarity"))
+        items = list(model.get("itemVocabulary"))
+        ia, ib, ic = items.index("a"), items.index("b"), items.index("c")
+        assert sim[ia, ia] == 2       # a seen by u0,u1
+        assert sim[ia, ib] == 2       # a&b co-occur for u0,u1
+        assert sim[ia, ic] == 1       # a&c co-occur only for u1
+        assert sim[ic, ic] == 2
+
+    def test_jaccard_similarity(self, interactions):
+        model = SAR(supportThreshold=1).fit(interactions)
+        sim = np.asarray(model.get("itemSimilarity"))
+        items = list(model.get("itemVocabulary"))
+        ia, ib = items.index("a"), items.index("b")
+        # jaccard(a,b) = 2 / (2 + 2 - 2) = 1.0
+        np.testing.assert_allclose(sim[ia, ib], 1.0)
+        ic = items.index("c")
+        # jaccard(a,c) = 1 / (2 + 2 - 1) = 1/3
+        np.testing.assert_allclose(sim[ia, ic], 1 / 3, rtol=1e-6)
+
+    def test_support_threshold_zeroes(self, interactions):
+        model = SAR(supportThreshold=2,
+                    similarityFunction="cooccurrence").fit(interactions)
+        sim = np.asarray(model.get("itemSimilarity"))
+        items = list(model.get("itemVocabulary"))
+        assert sim[items.index("a"), items.index("c")] == 0  # support 1 < 2
+
+    def test_recommendations_exclude_seen(self, interactions):
+        model = SAR(supportThreshold=1).fit(interactions)
+        recs = model.recommend_for_all_users(3)
+        by_user = {r["user"]: r["recommendations"]
+                   for r in recs.collect()}
+        u0_items = [m["item"] for m in by_user["u0"]]
+        assert "a" not in u0_items and "b" not in u0_items
+        assert "c" in u0_items  # via co-occurrence with a,b through u1
+
+    def test_transform_scores_pairs(self, interactions):
+        model = SAR(supportThreshold=1).fit(interactions)
+        pairs = Dataset({"user": np.array(["u0", "u2"]),
+                         "item": np.array(["c", "a"])})
+        out = model.transform(pairs)
+        assert out["prediction"].shape == (2,)
+        assert out["prediction"][0] > 0
+
+    def test_time_decay_downweights_old(self):
+        day = 86400.0
+        ds = Dataset({
+            "user": np.array(["u", "u", "v", "v"]),
+            "item": np.array(["old", "new", "old", "new"]),
+            "rating": np.ones(4, np.float32),
+            "ts": np.array([0.0, 300 * day, 300 * day, 300 * day]),
+        })
+        model = SAR(supportThreshold=1, timeCol="ts",
+                    timeDecayCoeff=30).fit(ds)
+        aff = np.asarray(model.get("userAffinity"))
+        users = list(model.get("userVocabulary"))
+        items = list(model.get("itemVocabulary"))
+        u = users.index("u")
+        assert aff[u, items.index("old")] < 0.01  # 10 half-lives old
+        np.testing.assert_allclose(aff[u, items.index("new")], 1.0)
+
+
+class TestRankingMetrics:
+    def test_known_values(self):
+        pred = [[1, 2, 3], [4, 5, 6]]
+        actual = [[1, 3], [7]]
+        assert precision_at_k(pred, actual, 3) == pytest.approx(
+            (2 / 3 + 0) / 2)
+        assert recall_at_k(pred, actual, 3) == pytest.approx((1.0 + 0) / 2)
+        # user1 dcg = 1 + 1/log2(4); idcg = 1 + 1/log2(3)
+        want = ((1 + 1 / np.log2(4)) / (1 + 1 / np.log2(3)) + 0) / 2
+        assert ndcg_at_k(pred, actual, 3) == pytest.approx(want)
+        assert mean_average_precision(pred, actual) == pytest.approx(
+            ((1 / 1 + 2 / 3) / 2 + 0) / 2)
+
+    def test_evaluator_stage(self):
+        ds = Dataset({"prediction": [[1, 2], [3, 4]],
+                      "label": [[1], [9]]})
+        ev = RankingEvaluator(metricName="precisionAtk", k=2)
+        assert ev.evaluate(ds) == pytest.approx((1 / 2 + 0) / 2)
+
+    def test_evaluator_accepts_sar_rec_dicts(self, interactions):
+        # SAR recommendation dicts must unwrap to item ids, not crash
+        model = SAR(supportThreshold=1).fit(interactions)
+        recs = model.recommend_for_all_users(2)
+        ds = Dataset({"prediction": recs["recommendations"],
+                      "label": [["c"], ["c"], ["a"]]})
+        ev = RankingEvaluator(metricName="recallAtK", k=2)
+        assert 0.0 <= ev.evaluate(ds) <= 1.0
+
+
+class TestIndexerAndSplit:
+    def test_indexer_roundtrip(self, interactions):
+        model = RecommendationIndexer().fit(interactions)
+        out = model.transform(interactions)
+        assert out["userIdx"].max() == 2
+        back = model.recover_item(out["itemIdx"][:3])
+        np.testing.assert_array_equal(back, interactions["item"][:3])
+
+    def test_train_validation_split(self, rng):
+        n_u, n_i = 12, 8
+        rows = {"user": [], "item": [], "rating": []}
+        for u in range(n_u):
+            for i in rng.choice(n_i, size=5, replace=False):
+                rows["user"].append(f"u{u}")
+                rows["item"].append(f"i{i}")
+                rows["rating"].append(1.0)
+        ds = Dataset({k: np.asarray(v) for k, v in rows.items()})
+        tvs = RankingTrainValidationSplit(
+            estimator=SAR(supportThreshold=1),
+            evaluator=RankingEvaluator(metricName="recallAtK", k=4),
+            trainRatio=0.6, seed=3)
+        model = tvs.fit(ds)
+        metric = model.get("validationMetric")
+        assert 0.0 <= metric <= 1.0
+        out = model.transform(ds.take(4))
+        assert "prediction" in out
+
+
+class TestSARFuzzing(EstimatorFuzzing):
+    def fuzzing_objects(self):
+        ds = Dataset({
+            "user": np.array(["a", "a", "b", "b", "c"]),
+            "item": np.array(["x", "y", "x", "z", "y"]),
+            "rating": np.ones(5, np.float32),
+        })
+        return [TestObject(SAR(supportThreshold=1), ds)]
