@@ -66,6 +66,48 @@ class Dataset:
     def from_pandas(df, num_partitions: int = 1) -> "Dataset":
         return Dataset({c: df[c].to_numpy() for c in df.columns}, num_partitions)
 
+    @staticmethod
+    def from_csv(path: str, delim: str = ",",
+                 num_partitions: int = 1) -> "Dataset":
+        """Numeric CSV via the native C++ parser (multithreaded mmap parse;
+        see synapseml_tpu/native/loader.cpp), numpy fallback."""
+        from ..native import read_csv_matrix
+        mat, names = read_csv_matrix(path, delim)
+        # dict-keyed columns would silently drop duplicate header names
+        uniq: List[str] = []
+        for n in names:
+            if n in uniq:
+                base, k = n, 1
+                while f"{base}_{k}" in uniq or f"{base}_{k}" in names:
+                    k += 1
+                n = f"{base}_{k}"
+            uniq.append(n)
+        return Dataset({n: mat[:, i].copy() for i, n in enumerate(uniq)},
+                       num_partitions)
+
+    @staticmethod
+    def from_colstore(path: str, columns: Optional[Sequence[str]] = None,
+                      num_partitions: int = 1) -> "Dataset":
+        """Binary SMLC column store (native fast path)."""
+        from ..native import read_colstore
+        mat = read_colstore(path)
+        if columns is not None and len(columns) != mat.shape[1]:
+            raise ValueError(f"column store {path} holds {mat.shape[1]} "
+                             f"columns but {len(columns)} names were given")
+        names = (list(columns) if columns
+                 else [f"f{i}" for i in range(mat.shape[1])])
+        return Dataset({n: mat[:, i].copy() for i, n in enumerate(names)},
+                       num_partitions)
+
+    def to_colstore(self, path: str, cols: Optional[Sequence[str]] = None) -> None:
+        from ..native import write_colstore
+        use = (list(cols) if cols is not None
+               else [c for c in self.columns
+                     if self._cols[c].dtype != object])
+        if not use:
+            raise ValueError("to_colstore: no numeric columns to write")
+        write_colstore(path, self.to_numpy(use))
+
     def to_pandas(self):
         import pandas as pd
         return pd.DataFrame({k: list(v) if v.dtype == object else v

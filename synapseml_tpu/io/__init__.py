@@ -1,0 +1,10 @@
+"""IO: HTTP client stages + serving (reference: core/.../io/)."""
+
+from .http import (HTTPClient, HTTPRequestData, HTTPResponseData,
+                   HTTPTransformer, JSONInputParser, JSONOutputParser,
+                   SimpleHTTPTransformer)
+
+__all__ = [
+    "HTTPClient", "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
+    "JSONInputParser", "JSONOutputParser", "SimpleHTTPTransformer",
+]

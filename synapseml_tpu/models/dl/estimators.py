@@ -61,6 +61,14 @@ class _DLParamsBase(Params):
                                     "axis", default=1)
     validationFraction = FloatParam(doc="fraction held out for eval logging",
                                     default=0.0)
+    checkpointDir = StringParam(doc="step-checkpoint directory (resume "
+                                "automatically if it holds checkpoints)")
+    checkpointInterval = IntParam(doc="save every N optimizer steps "
+                                  "(0 = off)", default=0)
+
+    def _checkpoint_loop(self, trainer: "DLTrainer",
+                         state: "TrainState") -> "_CheckpointLoop":
+        return _CheckpointLoop(self, trainer, state)
 
     def _opt_config(self, total_steps: int) -> OptimizerConfig:
         return OptimizerConfig(
@@ -68,6 +76,66 @@ class _DLParamsBase(Params):
             weight_decay=self.weightDecay, schedule=self.lrSchedule,
             warmup_steps=int(total_steps * self.warmupRatio),
             total_steps=total_steps, grad_clip_norm=self.gradClipNorm)
+
+
+class _CheckpointLoop:
+    """Shared resume scaffolding for the DL fit loops (SURVEY §5.4 — the
+    reference cannot resume mid-training; this build can).
+
+    Responsibilities: restore the latest step into the initialized state's
+    structure, RE-SHARD the restored host arrays onto the trainer's mesh
+    (restore_state_dict hands back uncommitted numpy — without device_put
+    the tensor-parallel layout would silently degrade to replication),
+    validate that the data-order-determining config matches the run that
+    wrote the checkpoint, and save every ``checkpointInterval`` steps.
+    """
+
+    # keys that determine the deterministic data order being replayed —
+    # maxEpochs is deliberately absent (resuming with MORE epochs is the
+    # normal continue-training pattern)
+    _CONFIG_KEYS = ("batchSize", "seed", "validationFraction")
+
+    def __init__(self, est: "_DLParamsBase", trainer, state):
+        from ...core.checkpoint import CheckpointManager
+        self.manager = None
+        self.start_step = 0
+        self.interval = int(est.checkpointInterval)
+        self.state = state
+        self._config = {k: float(est.get_or_default(k))
+                        for k in self._CONFIG_KEYS}
+        self._config["shards"] = float(trainer.mesh.shape["data"])
+        ckpt_dir = est.get("checkpointDir")
+        if not ckpt_dir:
+            return
+        self.manager = CheckpointManager(ckpt_dir)
+        latest = self.manager.latest_step()
+        if latest is None:
+            return
+        saved_cfg = {k: v for k, v in self.manager.metrics(latest).items()
+                     if k in self._config}
+        mismatch = {k: (saved_cfg[k], self._config[k]) for k in saved_cfg
+                    if saved_cfg[k] != self._config[k]}
+        if mismatch:
+            raise ValueError(
+                f"checkpoint at {ckpt_dir} step {latest} was written with a "
+                f"different data-order config {mismatch}; resuming would "
+                f"silently train on wrong batches — use a fresh "
+                f"checkpointDir or restore manually")
+        restored = self.manager.restore_state_dict(state)
+        if trainer.state_shardings is not None:
+            restored = jax.device_put(restored, trainer.state_shardings)
+        self.state = restored
+        self.start_step = int(np.asarray(restored.step))
+
+    def skips(self, gstep: int) -> bool:
+        """True while replaying already-trained steps (data order is
+        re-derived deterministically; no compute runs)."""
+        return gstep <= self.start_step
+
+    def after_step(self, gstep: int, state) -> None:
+        if self.manager and self.interval and gstep % self.interval == 0:
+            self.manager.save(gstep, jax.device_get(state),
+                              metrics=self._config)
 
 
 class DeepTextClassifier(_DLParamsBase, Estimator):
@@ -126,12 +194,22 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         rng = np.random.default_rng(self.seed)
         key = jax.random.PRNGKey(self.seed)
 
+        ckpt = self._checkpoint_loop(trainer, state)
+        state = ckpt.state
+        gstep = 0
         history = []
+        metrics = {}
         for epoch in range(self.maxEpochs):
             for idx in iterate_minibatches(n, self.batchSize, shards, rng):
+                gstep += 1
+                if ckpt.skips(gstep):
+                    continue
                 bi, bm, bl = trainer.shard_batch(
                     (ids[idx], mask[idx], labels[idx]))
                 state, metrics = step(state, (bi, bm), bl, key)
+                ckpt.after_step(gstep, state)
+            if ckpt.skips(gstep):
+                continue  # whole epoch already covered by the checkpoint
             record = {k: float(v) for k, v in metrics.items()}
             if n_val:
                 vlogits = np.asarray(eval_step(state, (val_ids, val_mask)))
@@ -231,11 +309,21 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
         rng = np.random.default_rng(self.seed)
         key = jax.random.PRNGKey(self.seed)
 
+        ckpt = self._checkpoint_loop(trainer, state)
+        state = ckpt.state
+        gstep = 0
         history = []
+        metrics = {}
         for epoch in range(self.maxEpochs):
             for idx in iterate_minibatches(n, self.batchSize, shards, rng):
+                gstep += 1
+                if ckpt.skips(gstep):
+                    continue
                 bi, bl = trainer.shard_batch((imgs[idx], labels[idx]))
                 state, metrics = step(state, (bi,), bl, key)
+                ckpt.after_step(gstep, state)
+            if ckpt.skips(gstep):
+                continue
             history.append({k: float(v) for k, v in metrics.items()})
 
         return DeepVisionModel(

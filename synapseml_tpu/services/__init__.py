@@ -1,0 +1,18 @@
+"""Remote-service pipeline stages (reference: cognitive/).
+
+Proof that the pipeline algebra supports async remote-call stages
+(SURVEY §2.9): the ServiceParam pattern, a retrying/concurrent service
+base, and representative families (text analytics + OpenAI-style
+completion/embedding/prompt).  Endpoints are configurable URLs — this
+build has no egress, so tests exercise them against local servers.
+"""
+
+from .base import (HasServiceParams, RemoteServiceTransformer, ServiceParam)
+from .openai import (OpenAICompletion, OpenAIEmbedding, OpenAIPrompt)
+from .text import KeyPhraseExtractor, TextSentiment
+
+__all__ = [
+    "HasServiceParams", "RemoteServiceTransformer", "ServiceParam",
+    "OpenAICompletion", "OpenAIEmbedding", "OpenAIPrompt",
+    "KeyPhraseExtractor", "TextSentiment",
+]

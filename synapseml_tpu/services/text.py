@@ -1,0 +1,43 @@
+"""Text-analytics service stages (reference: cognitive/.../text/
+TextAnalytics.scala — TextSentiment, KeyPhraseExtractor families: batch
+documents into {documents: [{id, text, language}]} requests, unpack the
+per-document results)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from ..io.http import HTTPRequestData
+from .base import RemoteServiceTransformer, ServiceParam
+from ..core.params import StringParam
+
+
+class _TextServiceBase(RemoteServiceTransformer):
+    textCol = StringParam(doc="input text column", default="text")
+    language = ServiceParam(doc="document language (value or column)")
+
+    def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
+        doc = {"id": "0", "text": str(row[self.textCol])}
+        lang = self.resolve_service_param("language", row)
+        if lang:
+            doc["language"] = lang
+        body = json.dumps({"documents": [doc]}).encode()
+        return HTTPRequestData(url=self.url, method="POST",
+                               headers={"Content-Type": "application/json"},
+                               entity=body)
+
+    def parse_response(self, value: Any) -> Any:
+        if isinstance(value, dict) and "documents" in value:
+            docs = value["documents"]
+            return docs[0] if docs else None
+        return value
+
+
+class TextSentiment(_TextServiceBase):
+    """Sentiment per row (reference: TextAnalytics.scala TextSentiment)."""
+
+
+class KeyPhraseExtractor(_TextServiceBase):
+    """Key phrases per row (reference: TextAnalytics.scala
+    KeyPhraseExtractor)."""

@@ -1,0 +1,6 @@
+"""Model serving (reference: core Spark Serving layer)."""
+
+from .server import PipelineServer, ServingReply, ServingRequest, ServingServer
+
+__all__ = ["PipelineServer", "ServingReply", "ServingRequest",
+           "ServingServer"]

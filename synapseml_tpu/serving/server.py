@@ -1,0 +1,221 @@
+"""HTTP ⇄ Dataset serving.
+
+Re-designs Spark Serving (reference: core/src/main/scala/org/apache/spark/
+sql/execution/streaming/HTTPSourceV2.scala:56-90 — an HttpServer hosted in
+a partition task turning requests into rows {id, request}; ServingUDFs.
+scala:40-53 — ``sendReplyUDF`` routing response bytes back to the open
+exchange by request id; DistributedHTTPSource.scala:88,203 — one server
+per JVM).  Here the source/sink pair is explicit: :class:`ServingServer`
+accepts requests into a micro-batch queue and parks each exchange on an
+event until :meth:`reply` lands; :class:`PipelineServer` is the
+continuous-serving loop — batch → ``model.transform`` → reply — so the
+jitted model sees fixed-size batches instead of per-request calls.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Empty, Queue
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.dataset import Dataset
+from ..core.pipeline import Transformer
+
+
+@dataclass
+class ServingRequest:
+    """One pending request row (reference: HTTPSourceV2 row schema
+    {id, request})."""
+    id: str
+    method: str
+    path: str
+    headers: Dict[str, str]
+    body: bytes
+
+    def json(self) -> Any:
+        return json.loads(self.body.decode("utf-8"))
+
+
+@dataclass
+class ServingReply:
+    status: int = 200
+    body: bytes = b""
+    headers: Dict[str, str] = field(default_factory=dict)
+
+
+class _Exchange:
+    __slots__ = ("request", "event", "reply")
+
+    def __init__(self, request: ServingRequest):
+        self.request = request
+        self.event = threading.Event()
+        self.reply: Optional[ServingReply] = None
+
+
+class ServingServer:
+    """HTTP source + reply sink (one server per host — the
+    DistributedHTTPSource model; multi-host serving runs one per TPU-VM
+    worker behind an external balancer)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout_s: float = 30.0):
+        self.api_path = api_path.rstrip("/") or "/"
+        self.reply_timeout_s = reply_timeout_s
+        self._queue: "Queue[_Exchange]" = Queue()
+        self._pending: Dict[str, _Exchange] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _serve(self):
+                if outer.api_path != "/" and \
+                        not self.path.startswith(outer.api_path):
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                req = ServingRequest(
+                    id=uuid.uuid4().hex, method=self.command,
+                    path=self.path, headers=dict(self.headers), body=body)
+                ex = _Exchange(req)
+                with outer._lock:
+                    outer._pending[req.id] = ex
+                outer._queue.put(ex)
+                ok = ex.event.wait(outer.reply_timeout_s)
+                with outer._lock:
+                    outer._pending.pop(req.id, None)
+                if not ok or ex.reply is None:
+                    self.send_error(504, "serving pipeline timeout")
+                    return
+                rep = ex.reply
+                self.send_response(rep.status)
+                for k, v in rep.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(rep.body)))
+                self.end_headers()
+                self.wfile.write(rep.body)
+
+            do_GET = do_POST = do_PUT = _serve
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        h, p = self.address
+        return f"http://{h}:{p}{'' if self.api_path == '/' else self.api_path}"
+
+    # -- source side (micro-batch pull; HTTPSourceV2 getBatch analogue) ----
+    def get_batch(self, max_rows: int = 64,
+                  timeout_s: float = 0.05) -> List[ServingRequest]:
+        out: List[_Exchange] = []
+        deadline = time.monotonic() + timeout_s
+        while len(out) < max_rows:
+            left = deadline - time.monotonic()
+            if left <= 0 and out:
+                break
+            try:
+                out.append(self._queue.get(timeout=max(left, 0.001)))
+            except Empty:
+                if out:
+                    break
+                if left <= 0:
+                    break
+        return [e.request for e in out]
+
+    # -- sink side (ServingUDFs.sendReplyUDF analogue) ---------------------
+    def reply(self, request_id: str, reply: ServingReply) -> bool:
+        with self._lock:
+            ex = self._pending.get(request_id)
+        if ex is None:
+            return False
+        ex.reply = reply
+        ex.event.set()
+        return True
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+class PipelineServer:
+    """Continuous serving loop: requests → Dataset → ``model.transform`` →
+    replies (the ``readStream.continuousServer()`` pipeline of reference
+    §3.5 collapsed into one object).
+
+    ``input_parser(request) -> dict`` produces one row; the transformed
+    column ``output_col`` is JSON-encoded back (override with
+    ``output_formatter``).
+    """
+
+    def __init__(self, model: Transformer,
+                 input_parser: Callable[[ServingRequest], Dict[str, Any]],
+                 output_col: str = "prediction",
+                 output_formatter: Optional[Callable[[Any], bytes]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", batch_size: int = 64,
+                 batch_timeout_s: float = 0.01):
+        self.model = model
+        self.input_parser = input_parser
+        self.output_col = output_col
+        self.output_formatter = output_formatter or self._default_format
+        self.batch_size = batch_size
+        self.batch_timeout_s = batch_timeout_s
+        self.server = ServingServer(host, port, api_path)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _default_format(value: Any) -> bytes:
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        elif isinstance(value, (np.generic,)):
+            value = value.item()
+        return json.dumps({"prediction": value}).encode()
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.server.get_batch(self.batch_size,
+                                          self.batch_timeout_s)
+            if not batch:
+                continue
+            try:
+                rows = [self.input_parser(r) for r in batch]
+                ds = Dataset.from_rows(rows)
+                out = self.model.transform(ds)
+                col = out[self.output_col]
+                for req, val in zip(batch, col):
+                    self.server.reply(req.id, ServingReply(
+                        200, self.output_formatter(val),
+                        {"Content-Type": "application/json"}))
+            except Exception as e:  # noqa: BLE001 — serving must not die
+                body = json.dumps({"error": str(e)}).encode()
+                for req in batch:
+                    self.server.reply(req.id, ServingReply(500, body))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.server.close()

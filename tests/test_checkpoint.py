@@ -1,0 +1,96 @@
+"""Step-checkpoint tests — the aux subsystem the reference lacks
+(SURVEY §5.4: model persistence only, stage retry on failure; this build
+adds resumable step checkpoints)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.core.checkpoint import CheckpointManager
+from synapseml_tpu.models.dl import DeepVisionClassifier
+
+
+class TestCheckpointManager:
+    def test_roundtrip_pytree(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        tree = {"a": np.arange(5), "nested": {"b": np.eye(3, dtype=np.float32)},
+                "scalar": np.float32(2.5)}
+        mgr.save(10, tree, metrics={"loss": 0.5})
+        got = mgr.restore()
+        np.testing.assert_array_equal(got["a"], tree["a"])
+        np.testing.assert_array_equal(got["nested"]["b"], tree["nested"]["b"])
+        assert mgr.metrics(10)["loss"] == 0.5
+
+    def test_latest_and_prune(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+        for s in (1, 2, 3, 4):
+            mgr.save(s, {"x": np.full(3, s)})
+        assert mgr.all_steps() == [3, 4]
+        assert mgr.latest_step() == 4
+        np.testing.assert_array_equal(mgr.restore()["x"], np.full(3, 4))
+
+    def test_restore_specific_step(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), max_to_keep=0)
+        mgr.save(1, {"x": np.ones(2)})
+        mgr.save(2, {"x": np.ones(2) * 2})
+        np.testing.assert_array_equal(mgr.restore(1)["x"], np.ones(2))
+
+    def test_no_checkpoint_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            CheckpointManager(str(tmp_path)).restore()
+
+    def test_atomic_no_partial_dirs(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(7, {"x": np.ones(4)})
+        entries = [e for e in os.listdir(tmp_path)
+                   if e.startswith(".tmp_ckpt_")]
+        assert entries == []
+
+    def test_positional_restore_with_template(self, tmp_path):
+        # simulate a state whose treedef can't pickle: save raw, restore
+        # into a template of the same structure
+        mgr = CheckpointManager(str(tmp_path))
+        state = {"w": np.arange(4, dtype=np.float32), "step": np.int32(3)}
+        mgr.save(3, state)
+        template = {"w": np.zeros(4, np.float32), "step": np.int32(0)}
+        got = mgr.restore_state_dict(template)
+        np.testing.assert_array_equal(got["w"], state["w"])
+        assert got["step"] == 3
+
+
+def _vision_ds(rng, n=48):
+    imgs = np.empty(n, dtype=object)
+    for i in range(n):
+        imgs[i] = rng.normal(size=(16, 16, 3)).astype(np.float32)
+    labels = rng.integers(0, 2, n).astype(np.float64)
+    return Dataset({"image": imgs, "label": labels})
+
+
+class TestDLResume:
+    def test_resume_matches_uninterrupted(self, rng, tmp_path):
+        ds = _vision_ds(rng)
+        kw = dict(backbone="resnet18", batchSize=16, learningRate=1e-3,
+                  seed=7, numDevices=2, lrSchedule="constant",
+                  validationFraction=0.0)
+
+        # uninterrupted run
+        m_full = DeepVisionClassifier(maxEpochs=3, **kw).fit(ds)
+
+        # interrupted run: checkpoint every step, stop after 1 epoch
+        ck = str(tmp_path / "ck")
+        DeepVisionClassifier(maxEpochs=1, **kw, checkpointDir=ck,
+                             checkpointInterval=1).fit(ds)
+        mgr = CheckpointManager(ck)
+        assert mgr.latest_step() == 3  # 48 rows / 16 batch = 3 steps/epoch
+
+        # resume: same config, full epochs, same checkpoint dir
+        m_res = DeepVisionClassifier(maxEpochs=3, **kw, checkpointDir=ck,
+                                     checkpointInterval=1).fit(ds)
+
+        a = m_full.transform(ds)
+        b = m_res.transform(ds)
+        np.testing.assert_allclose(
+            np.stack(list(a["probability"])),
+            np.stack(list(b["probability"])), rtol=1e-4, atol=1e-5)

@@ -1,0 +1,221 @@
+"""HTTP stages + serving tests, against in-process local servers
+(the reference tests cognitive/HTTP stages against live endpoints —
+SURVEY §4; with zero egress we host the endpoint ourselves)."""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.io import (HTTPClient, HTTPRequestData, HTTPTransformer,
+                              SimpleHTTPTransformer)
+from synapseml_tpu.models.gbdt import GBDTClassifier
+from synapseml_tpu.serving import PipelineServer, ServingReply, ServingServer
+from synapseml_tpu.services import (OpenAICompletion, OpenAIPrompt,
+                                    TextSentiment)
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    """Echoes JSON bodies; /flaky fails twice per path then succeeds;
+    /sentiment mimics the text-analytics shape; /completions the OpenAI
+    shape."""
+
+    fail_counts = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = json.loads(self.rfile.read(length) or b"{}")
+        if self.path.startswith("/flaky"):
+            with _EchoHandler.lock:
+                n = _EchoHandler.fail_counts.get(self.path, 0)
+                _EchoHandler.fail_counts[self.path] = n + 1
+            if n < 2:
+                self.send_error(503)
+                return
+            payload = {"ok": True, "attempts": n + 1}
+        elif self.path.startswith("/sentiment"):
+            text = body["documents"][0]["text"]
+            payload = {"documents": [{
+                "id": "0",
+                "sentiment": "positive" if "good" in text else "negative"}]}
+        elif self.path.startswith("/completions"):
+            payload = {"choices": [{"text": "echo: " + body["prompt"]}]}
+        else:
+            payload = {"echo": body}
+        data = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    do_GET = do_POST
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _EchoHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestHTTPClient:
+    def test_retry_on_503(self, echo_server):
+        client = HTTPClient(retries=3, backoffs_ms=[10, 10, 10])
+        resp = client.send(HTTPRequestData(
+            url=echo_server + "/flaky/a", method="POST",
+            headers={"Content-Type": "application/json"}, entity=b"{}"))
+        assert resp.status_code == 200
+        assert resp.json()["attempts"] == 3
+
+    def test_connection_refused_reported(self):
+        client = HTTPClient(retries=0)
+        resp = client.send(HTTPRequestData(url="http://127.0.0.1:1/nope"))
+        assert resp.status_code == 0
+        assert resp.reason
+
+
+class TestHTTPTransformer:
+    def test_concurrent_requests(self, echo_server):
+        n = 12
+        reqs = np.empty(n, dtype=object)
+        for i in range(n):
+            reqs[i] = {"url": echo_server + "/echo", "method": "POST",
+                       "headers": {"Content-Type": "application/json"},
+                       "entity": json.dumps({"i": i}).encode()}
+        ds = Dataset({"request": reqs})
+        out = HTTPTransformer(concurrency=4).transform(ds)
+        for i, resp in enumerate(out["response"]):
+            assert resp.status_code == 200
+            assert resp.json()["echo"]["i"] == i
+
+
+class TestSimpleHTTPTransformer:
+    def test_json_round_trip(self, echo_server):
+        ds = Dataset({"a": np.arange(3), "b": np.array(["x", "y", "z"])})
+        stage = SimpleHTTPTransformer(
+            inputCols=["a", "b"], url=echo_server + "/echo", concurrency=2)
+        out = stage.transform(ds)
+        assert out["output"][1]["echo"] == {"a": 1, "b": "y"}
+        assert all(e is None for e in out["errors"])
+
+
+class TestServices:
+    def test_text_sentiment(self, echo_server):
+        ds = Dataset({"text": np.array(["good day", "awful day"])})
+        stage = TextSentiment(url=echo_server + "/sentiment")
+        out = stage.transform(ds)
+        assert out["output"][0]["sentiment"] == "positive"
+        assert out["output"][1]["sentiment"] == "negative"
+
+    def test_openai_prompt_templating(self, echo_server):
+        ds = Dataset({"text": np.array(["cats", "dogs"])})
+        stage = OpenAIPrompt(url=echo_server + "/completions",
+                             promptTemplate="say {text}!")
+        out = stage.transform(ds)
+        assert out["output"][0] == "echo: say cats!"
+        assert out["output"][1] == "echo: say dogs!"
+
+    def test_openai_completion_error_col(self):
+        ds = Dataset({"prompt": np.array(["hi"])})
+        stage = OpenAICompletion(url="http://127.0.0.1:1/x", retries=0)
+        out = stage.transform(ds)
+        assert out["output"][0] is None
+        assert out["errors"][0] is not None
+
+
+class TestServingServer:
+    def test_request_reply_roundtrip(self):
+        server = ServingServer()
+        try:
+            results = {}
+
+            def client():
+                req = urllib.request.Request(
+                    server.url, data=b'{"x": 1}', method="POST")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    results["body"] = r.read()
+
+            t = threading.Thread(target=client)
+            t.start()
+            batch = server.get_batch(max_rows=1, timeout_s=5.0)
+            assert len(batch) == 1
+            assert batch[0].json() == {"x": 1}
+            assert server.reply(batch[0].id, ServingReply(200, b"pong"))
+            t.join(timeout=10)
+            assert results["body"] == b"pong"
+        finally:
+            server.close()
+
+    def test_timeout_504(self):
+        server = ServingServer(reply_timeout_s=0.2)
+        try:
+            req = urllib.request.Request(server.url, data=b"{}",
+                                         method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 504
+        finally:
+            server.close()
+
+
+class TestPipelineServer:
+    def test_model_serving_end_to_end(self, rng):
+        # train a tiny model, serve it, score over HTTP
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        feats = np.empty(200, dtype=object)
+        for i in range(200):
+            feats[i] = x[i]
+        model = GBDTClassifier(numIterations=8).fit(
+            Dataset({"features": feats, "label": y}))
+
+        def parse(req):
+            vec = np.asarray(req.json()["features"], np.float32)
+            return {"features": vec}
+
+        ps = PipelineServer(model, parse, output_col="prediction",
+                            batch_timeout_s=0.05)
+        try:
+            for i in range(4):
+                probe = x[i].tolist()
+                req = urllib.request.Request(
+                    ps.url, data=json.dumps({"features": probe}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    got = json.loads(r.read())
+                want = model.transform(
+                    Dataset({"features": feats[i:i + 1]}))["prediction"][0]
+                assert got["prediction"] == pytest.approx(float(want))
+        finally:
+            ps.close()
+
+    def test_serving_error_returns_500(self):
+        class Boom:
+            def transform(self, ds):
+                raise RuntimeError("kaboom")
+
+        ps = PipelineServer(Boom(), lambda r: {"x": 1.0},
+                            batch_timeout_s=0.05)
+        try:
+            req = urllib.request.Request(ps.url, data=b"{}", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 500
+            assert b"kaboom" in exc.value.read()
+        finally:
+            ps.close()
