@@ -1,0 +1,239 @@
+"""Decoder-only causal LLM (Llama-3 family architecture), TP-sharded.
+
+The reference has no LLM training/serving of its own — its OpenAI stages
+call out to a remote service (reference: cognitive/.../openai/OpenAI.scala
+:246).  This module is the TPU-native counterpart the stretch config
+needs: RMSNorm, rotary embeddings, grouped-query attention, SwiGLU MLP —
+with Megatron-style tensor-parallel layout expressed as flax logical
+axes: QKV/gate/up shard column-wise on the ``model`` mesh axis, the
+output/down projections row-wise, so each block incurs exactly one psum
+(inserted by XLA from the shardings, not hand-written).
+
+KV caches are explicit function state (a pytree threaded through
+``apply``), shaped (B, max_len, n_kv_heads, d_head) and sharded on the
+heads axis, so the whole decode loop stays inside one jitted program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: logical→mesh rules for the decoder (kv heads shard with tp too)
+LLM_LOGICAL_RULES = (
+    ("batch", "data"),
+    ("embed", None),
+    ("heads", "model"),
+    ("kv", "model"),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    ("seq", None),
+)
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    d_model: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    d_ff: int = 14_336
+    max_len: int = 8192
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.num_heads
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_1b(**kw) -> "LlamaConfig":
+        return LlamaConfig(d_model=2048, num_layers=16, num_heads=32,
+                           num_kv_heads=8, d_ff=8192, tie_embeddings=True,
+                           **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test config: byte vocab, 4 layers."""
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("d_model", 128)
+        kw.setdefault("num_layers", 4)
+        kw.setdefault("num_heads", 8)
+        kw.setdefault("num_kv_heads", 4)
+        kw.setdefault("d_ff", 256)
+        kw.setdefault("max_len", 256)
+        return LlamaConfig(**kw)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.with_partitioning(
+            nn.initializers.ones, ("embed",)), (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        normed = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def rope_frequencies(d_head: int, theta: float) -> np.ndarray:
+    return 1.0 / (theta ** (np.arange(0, d_head, 2, np.float32) / d_head))
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               theta: float) -> jnp.ndarray:
+    """x: (B, S, H, D); positions: (B, S) absolute token positions."""
+    d = x.shape[-1]
+    inv = jnp.asarray(rope_frequencies(d, theta))          # (D/2,)
+    ang = positions[..., None].astype(jnp.float32) * inv   # (B, S, D/2)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _dense(features, axes, name, dtype):
+    return nn.Dense(features, use_bias=False, dtype=dtype, name=name,
+                    kernel_init=nn.with_partitioning(
+                        nn.initializers.truncated_normal(0.02), axes))
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> List[Dict]:
+    """Per-layer KV cache pytree."""
+    shape = (batch, max_len, cfg.num_kv_heads, cfg.d_head)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.num_layers)]
+
+
+class CausalAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache: Optional[Dict],
+                 cache_index: Optional[jnp.ndarray]):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.d_head
+        q = _dense(H * D, ("embed", "heads"), "q_proj", cfg.dtype)(x)
+        k = _dense(KV * D, ("embed", "kv"), "k_proj", cfg.dtype)(x)
+        v = _dense(KV * D, ("embed", "kv"), "v_proj", cfg.dtype)(x)
+        q = apply_rope(q.reshape(B, S, H, D), positions, cfg.rope_theta)
+        k = apply_rope(k.reshape(B, S, KV, D), positions, cfg.rope_theta)
+        v = v.reshape(B, S, KV, D)
+
+        new_cache = None
+        if cache is not None:
+            # write this step's K/V at cache_index, attend over the prefix
+            k_all = jax.lax.dynamic_update_slice(
+                cache["k"], k, (0, cache_index, 0, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                cache["v"], v, (0, cache_index, 0, 0))
+            new_cache = {"k": k_all, "v": v_all}
+            k_att, v_att = k_all, v_all
+            T = k_all.shape[1]
+            key_pos = jnp.arange(T)[None, :]                    # (1, T)
+            qpos = positions[:, :, None]                        # (B, S, 1)
+            causal = key_pos[:, None, :] <= qpos                # (B, S, T)
+        else:
+            k_att, v_att = k, v
+            T = S
+            causal = jnp.tril(jnp.ones((S, S), bool))[None]     # (1, S, S)
+
+        group = H // KV
+        qg = q.reshape(B, S, KV, group, D)
+        logits = jnp.einsum("bskgd,btkd->bkgst", qg, k_att,
+                            preferred_element_type=jnp.float32)
+        logits = logits / np.sqrt(D)
+        mask = jnp.broadcast_to(causal[:, None, None, :, :] if causal.ndim == 3
+                                else causal, logits.shape)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bkgst,btkd->bskgd", probs, v_att)
+        out = out.reshape(B, S, H * D)
+        out = _dense(cfg.d_model, ("heads", "embed"), "o_proj",
+                     cfg.dtype)(out)
+        return out, new_cache
+
+
+class DecoderBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, cache, cache_index):
+        cfg = self.cfg
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_attn")(x)
+        a, new_cache = CausalAttention(cfg, name="attn")(
+            h, positions, cache, cache_index)
+        x = x + a
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_mlp")(x)
+        gate = _dense(cfg.d_ff, ("embed", "mlp"), "gate_proj", cfg.dtype)(h)
+        up = _dense(cfg.d_ff, ("embed", "mlp"), "up_proj", cfg.dtype)(h)
+        h = nn.silu(gate) * up                                  # SwiGLU
+        h = _dense(cfg.d_model, ("mlp", "embed"), "down_proj", cfg.dtype)(h)
+        return x + h, new_cache
+
+
+class LlamaModel(nn.Module):
+    """Causal LM: ``__call__`` returns logits (B, S, vocab); pass a cache
+    pytree + cache_index for incremental decode."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, cache=None,
+                 cache_index=None, deterministic: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         embedding_init=nn.with_partitioning(
+                             nn.initializers.truncated_normal(0.02),
+                             ("vocab", "embed")),
+                         name="tok_embed")
+        x = embed(input_ids)
+        new_caches = []
+        for i in range(cfg.num_layers):
+            layer_cache = cache[i] if cache is not None else None
+            x, nc = DecoderBlock(cfg, name=f"layer_{i}")(
+                x, positions, layer_cache, cache_index)
+            new_caches.append(nc)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_final")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = _dense(cfg.vocab_size, ("embed", "vocab"), "lm_head",
+                            jnp.float32)(x)
+        logits = logits.astype(jnp.float32)
+        if cache is not None:
+            return logits, new_caches
+        return logits
+
+
+def causal_lm_loss(logits: jnp.ndarray, input_ids: jnp.ndarray,
+                   mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Next-token cross entropy over shifted targets."""
+    import optax
+    targets = input_ids[:, 1:]
+    pred = logits[:, :-1]
+    losses = optax.softmax_cross_entropy_with_integer_labels(pred, targets)
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return (losses * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return losses.mean()
