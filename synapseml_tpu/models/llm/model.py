@@ -161,8 +161,7 @@ class CausalAttention(nn.Module):
         logits = jnp.einsum("bskgd,btkd->bkgst", qg, k_att,
                             preferred_element_type=jnp.float32)
         logits = logits / np.sqrt(D)
-        mask = jnp.broadcast_to(causal[:, None, None, :, :] if causal.ndim == 3
-                                else causal, logits.shape)
+        mask = jnp.broadcast_to(causal[:, None, None, :, :], logits.shape)
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v_att)

@@ -2,17 +2,52 @@
 
 Proof that the pipeline algebra supports async remote-call stages
 (SURVEY §2.9): the ServiceParam pattern, a retrying/concurrent service
-base, and representative families (text analytics + OpenAI-style
-completion/embedding/prompt).  Endpoints are configurable URLs — this
-build has no egress, so tests exercise them against local servers.
+base, and the service families — text analytics, OpenAI-style
+completion/embedding/prompt, vision, face, form recognizer, translator,
+speech, anomaly detection (incl. multivariate), search sink, bing image
+search, and geospatial.  Endpoints are configurable URLs — this build
+has no egress, so tests exercise them against local servers.
 """
 
 from .base import (HasServiceParams, RemoteServiceTransformer, ServiceParam)
 from .openai import (OpenAICompletion, OpenAIEmbedding, OpenAIPrompt)
-from .text import KeyPhraseExtractor, TextSentiment
+from .text import (AnalyzeHealthText, EntityDetector, KeyPhraseExtractor,
+                   LanguageDetector, NER, PII, TextAnalyze, TextSentiment)
+from .vision import (AnalyzeImage, DescribeImage, GenerateThumbnails, OCR,
+                     ReadImage, RecognizeDomainSpecificContent, TagImage)
+from .face import (DetectFace, FindSimilarFace, GroupFaces, IdentifyFaces,
+                   VerifyFaces)
+from .form import (AnalyzeBusinessCards, AnalyzeCustomModel,
+                   AnalyzeIDDocuments, AnalyzeInvoices, AnalyzeLayout,
+                   AnalyzeReceipts, FormOntologyLearner, FormOntologyModel)
+from .translate import (BreakSentence, Detect, DictionaryExamples,
+                        DictionaryLookup, Translate, Transliterate)
+from .speech import ConversationTranscription, SpeechToText, TextToSpeech
+from .anomaly import (DetectAnomalies, DetectLastAnomaly,
+                      DetectMultivariateAnomaly, FitMultivariateAnomaly,
+                      SimpleDetectAnomalies)
+from .search import AddDocuments, AzureSearchWriter
+from .bing import BingImageSearch
+from .geospatial import (AddressGeocoder, CheckPointInPolygon,
+                         ReverseAddressGeocoder)
 
 __all__ = [
     "HasServiceParams", "RemoteServiceTransformer", "ServiceParam",
     "OpenAICompletion", "OpenAIEmbedding", "OpenAIPrompt",
-    "KeyPhraseExtractor", "TextSentiment",
+    "KeyPhraseExtractor", "TextSentiment", "LanguageDetector",
+    "EntityDetector", "NER", "PII", "AnalyzeHealthText", "TextAnalyze",
+    "AnalyzeImage", "DescribeImage", "OCR", "ReadImage", "TagImage",
+    "GenerateThumbnails", "RecognizeDomainSpecificContent",
+    "DetectFace", "FindSimilarFace", "GroupFaces", "IdentifyFaces",
+    "VerifyFaces",
+    "AnalyzeLayout", "AnalyzeReceipts", "AnalyzeBusinessCards",
+    "AnalyzeInvoices", "AnalyzeIDDocuments", "AnalyzeCustomModel",
+    "FormOntologyLearner", "FormOntologyModel",
+    "Translate", "Transliterate", "Detect", "BreakSentence",
+    "DictionaryLookup", "DictionaryExamples",
+    "SpeechToText", "TextToSpeech", "ConversationTranscription",
+    "DetectLastAnomaly", "DetectAnomalies", "SimpleDetectAnomalies",
+    "FitMultivariateAnomaly", "DetectMultivariateAnomaly",
+    "AddDocuments", "AzureSearchWriter", "BingImageSearch",
+    "AddressGeocoder", "ReverseAddressGeocoder", "CheckPointInPolygon",
 ]

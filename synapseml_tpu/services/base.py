@@ -49,6 +49,15 @@ class ServiceParam(Param):
         return v["value"]
 
 
+def with_query(url: str, q: Dict[str, Any]) -> str:
+    """Append query params to a URL that may already carry some."""
+    if not q:
+        return url
+    from urllib.parse import urlencode
+    sep = "&" if "?" in url else "?"
+    return url + sep + urlencode(q, doseq=True)
+
+
 class HasServiceParams:
     """Mixin helpers for stages with ServiceParams."""
 
@@ -82,6 +91,10 @@ class RemoteServiceTransformer(HasServiceParams, Transformer):
     concurrency = IntParam(doc="concurrent requests", default=1)
     retries = IntParam(doc="retry count on 429/5xx", default=3)
 
+    #: subclasses whose response entity is not JSON (audio, thumbnails)
+    #: set this True to surface raw bytes in ``outputCol``
+    binary_output = False
+
     def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
         raise NotImplementedError
 
@@ -109,7 +122,8 @@ class RemoteServiceTransformer(HasServiceParams, Transformer):
         errors = np.empty(ds.num_rows, dtype=object)
         for i, resp in enumerate(scored["_resp"]):
             if 200 <= resp.status_code < 300:
-                out[i] = self.parse_response(parse_json(resp))
+                out[i] = resp.entity if self.binary_output \
+                    else self.parse_response(parse_json(resp))
                 errors[i] = None
             else:
                 out[i] = None

@@ -1,0 +1,68 @@
+"""Geospatial service stages (reference: cognitive/.../geospatial/ —
+AddressGeocoder, ReverseAddressGeocoder, CheckPointInPolygon)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from ..core.params import StringParam
+from ..io.http import HTTPRequestData
+from .base import RemoteServiceTransformer, ServiceParam, with_query
+
+
+class AddressGeocoder(RemoteServiceTransformer):
+    """Address → lat/lon (reference: geospatial/AddressGeocoder.scala —
+    batch geocode POST)."""
+
+    addressCol = StringParam(doc="address column", default="address")
+
+    def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
+        body = {"batchItems": [{"query": str(row[self.addressCol])}]}
+        return HTTPRequestData(url=self.url, method="POST",
+                               headers={"Content-Type": "application/json"},
+                               entity=json.dumps(body).encode())
+
+    def parse_response(self, value: Any) -> Any:
+        if isinstance(value, dict) and "batchItems" in value:
+            items = value["batchItems"]
+            return items[0] if items else None
+        return value
+
+
+class ReverseAddressGeocoder(RemoteServiceTransformer):
+    """Lat/lon → address (reference: geospatial/
+    ReverseAddressGeocoder.scala)."""
+
+    latitudeCol = StringParam(doc="latitude column", default="lat")
+    longitudeCol = StringParam(doc="longitude column", default="lon")
+
+    def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
+        body = {"batchItems": [
+            {"query": f"{float(row[self.latitudeCol])},"
+                      f"{float(row[self.longitudeCol])}"}]}
+        return HTTPRequestData(url=self.url, method="POST",
+                               headers={"Content-Type": "application/json"},
+                               entity=json.dumps(body).encode())
+
+
+class CheckPointInPolygon(RemoteServiceTransformer):
+    """Point-in-polygon membership (reference: geospatial/
+    CheckPointInPolygon.scala — GET with lat/lon + user data id)."""
+
+    latitudeCol = StringParam(doc="latitude column", default="lat")
+    longitudeCol = StringParam(doc="longitude column", default="lon")
+    userDataIdentifier = StringParam(doc="uploaded polygon set id",
+                                     default="")
+
+    def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
+        q = {"lat": float(row[self.latitudeCol]),
+             "lon": float(row[self.longitudeCol])}
+        if self.userDataIdentifier:
+            q["udid"] = self.userDataIdentifier
+        return HTTPRequestData(url=with_query(self.url, q), method="GET")
+
+    def parse_response(self, value: Any) -> Any:
+        if isinstance(value, dict) and "result" in value:
+            return value["result"]
+        return value

@@ -10,7 +10,7 @@ from typing import Any, Dict
 
 from ..io.http import HTTPRequestData
 from .base import RemoteServiceTransformer, ServiceParam
-from ..core.params import StringParam
+from ..core.params import ListParam, StringParam
 
 
 class _TextServiceBase(RemoteServiceTransformer):
@@ -41,3 +41,42 @@ class TextSentiment(_TextServiceBase):
 class KeyPhraseExtractor(_TextServiceBase):
     """Key phrases per row (reference: TextAnalytics.scala
     KeyPhraseExtractor)."""
+
+
+class LanguageDetector(_TextServiceBase):
+    """Language detection per row (reference: TextAnalytics.scala
+    LanguageDetector — the base omits the language hint when unset)."""
+
+
+class EntityDetector(_TextServiceBase):
+    """Linked-entity detection (reference: TextAnalytics.scala
+    EntityDetector)."""
+
+
+class NER(_TextServiceBase):
+    """Named-entity recognition (reference: TextAnalytics.scala NER)."""
+
+
+class PII(_TextServiceBase):
+    """PII redaction (reference: TextAnalytics.scala PII — response also
+    carries ``redactedText`` per document)."""
+
+
+class AnalyzeHealthText(_TextServiceBase):
+    """Healthcare entity extraction (reference: TextAnalytics.scala
+    AnalyzeHealthText)."""
+
+
+class TextAnalyze(_TextServiceBase):
+    """Multi-task text analysis (reference: TextAnalytics.scala
+    TextAnalyze — bundles several analyses in one request; ``tasks``
+    lists the analysis kinds to run)."""
+
+    tasks = ListParam(doc="analysis task names", default=None)
+
+    def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
+        req = super().prepare_request(row)
+        body = json.loads(req.entity.decode())
+        body["tasks"] = self.get("tasks") or []
+        req.entity = json.dumps(body).encode()
+        return req

@@ -1,0 +1,307 @@
+"""Cognitive-service family stages against a local mock server
+(reference tests hit live Azure endpoints — SURVEY §4; zero egress here,
+so the endpoint shapes are mimicked in-process)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.services import (
+    AddDocuments, AnalyzeImage, BingImageSearch, CheckPointInPolygon,
+    DescribeImage, DetectFace, DetectMultivariateAnomaly,
+    FitMultivariateAnomaly, FormOntologyLearner, GenerateThumbnails,
+    LanguageDetector, NER, SimpleDetectAnomalies, SpeechToText,
+    TextToSpeech, Translate, VerifyFaces)
+
+
+class _MockHandler(BaseHTTPRequestHandler):
+    search_batches = []
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _reply_json(self, payload, status=200):
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_bytes(self, data, ctype="application/octet-stream"):
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        path = url.path
+        ctype = self.headers.get("Content-Type", "")
+        body = json.loads(raw) if ctype.startswith("application/json") \
+            and raw else None
+
+        if path.startswith("/vision/analyze"):
+            self._reply_json({"url": (body or {}).get("url"),
+                              "nbytes": 0 if body else len(raw),
+                              "features":
+                                  q.get("visualFeatures", [""])[0]})
+        elif path.startswith("/vision/describe"):
+            self._reply_json({"description": {"captions": [
+                {"text": "a mock caption", "confidence": 0.9}]}})
+        elif path.startswith("/vision/thumb"):
+            self._reply_bytes(b"THUMB" + q["width"][0].encode(),
+                              "image/jpeg")
+        elif path.startswith("/face/detect"):
+            self._reply_json([{"faceId": "f1", "faceRectangle":
+                               {"top": 1, "left": 2}}])
+        elif path.startswith("/face/verify"):
+            same = body["faceId1"] == body["faceId2"]
+            self._reply_json({"isIdentical": same,
+                              "confidence": 1.0 if same else 0.1})
+        elif path.startswith("/translate"):
+            texts = [d["Text"] for d in body]
+            to = q.get("to", ["en"])
+            self._reply_json([{"translations": [
+                {"text": f"[{lang}] {t}", "to": lang}
+                for lang in to]} for t in texts])
+        elif path.startswith("/anomaly/series"):
+            vals = [p["value"] for p in body["series"]]
+            self._reply_json({"isAnomaly": [v > 50 for v in vals]})
+        elif path.startswith("/mvad/train"):
+            self._reply_json({"modelId": "model-42"})
+        elif path.startswith("/mvad"):
+            self._reply_json({"modelId": body["modelId"],
+                              "isAnomaly":
+                                  abs(sum(body["variables"].values())) > 10})
+        elif path.startswith("/search/index"):
+            with _MockHandler.lock:
+                _MockHandler.search_batches.append(body["value"])
+            self._reply_json({"value": [
+                {"status": True} for _ in body["value"]]})
+        elif path.startswith("/speech/stt"):
+            self._reply_json({"DisplayText": f"heard {len(raw)} bytes"})
+        elif path.startswith("/speech/tts"):
+            self._reply_bytes(b"RIFFaudio", "audio/wav")
+        elif path.startswith("/geo/geocode"):
+            self._reply_json({"batchItems": [
+                {"lat": 47.6, "lon": -122.3,
+                 "query": body["batchItems"][0]["query"]}]})
+        elif path.startswith("/text/language"):
+            text = body["documents"][0]["text"]
+            lang = "fr" if "bonjour" in text else "en"
+            self._reply_json({"documents": [
+                {"id": "0", "detectedLanguage": {"iso6391Name": lang}}]})
+        elif path.startswith("/text/ner"):
+            self._reply_json({"documents": [
+                {"id": "0", "entities": [{"text": "Seattle",
+                                          "category": "Location"}]}]})
+        else:
+            self._reply_json({"error": "unknown path " + path}, 404)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        if url.path.startswith("/bing/images"):
+            n = int(q["count"][0])
+            self._reply_json({"value": [
+                {"contentUrl": f"http://x/{q['q'][0]}/{i}"}
+                for i in range(n)]})
+        elif url.path.startswith("/geo/pip"):
+            inside = float(q["lat"][0]) > 0
+            self._reply_json({"result": {"pointInPolygons": inside}})
+        else:
+            self._reply_json({"error": "unknown"}, 404)
+
+
+@pytest.fixture(scope="module")
+def mock_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _MockHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestVision:
+    def test_analyze_image_url_column(self, mock_server):
+        ds = Dataset({"img": np.array(["http://a/1.jpg", "http://a/2.jpg"])})
+        stage = AnalyzeImage(url=mock_server + "/vision/analyze",
+                             visualFeatures=["Categories", "Tags"])
+        stage.set_col("imageUrl", "img")
+        out = stage.transform(ds)
+        assert out["output"][0]["url"] == "http://a/1.jpg"
+        assert out["output"][0]["features"] == "Categories,Tags"
+
+    def test_analyze_image_bytes(self, mock_server):
+        imgs = np.empty(1, dtype=object)
+        imgs[0] = b"\x89PNGfake"
+        ds = Dataset({"img": imgs})
+        stage = AnalyzeImage(url=mock_server + "/vision/analyze")
+        stage.set_col("imageBytes", "img")
+        out = stage.transform(ds)
+        assert out["output"][0]["nbytes"] == 8
+
+    def test_describe_parses_description(self, mock_server):
+        ds = Dataset({"img": np.array(["http://a/1.jpg"])})
+        stage = DescribeImage(url=mock_server + "/vision/describe")
+        stage.set_col("imageUrl", "img")
+        out = stage.transform(ds)
+        assert out["output"][0]["captions"][0]["text"] == "a mock caption"
+
+    def test_thumbnails_binary_output(self, mock_server):
+        ds = Dataset({"img": np.array(["http://a/1.jpg"])})
+        stage = GenerateThumbnails(url=mock_server + "/vision/thumb",
+                                   width=48, height=48)
+        stage.set_col("imageUrl", "img")
+        out = stage.transform(ds)
+        assert out["output"][0] == b"THUMB48"
+
+
+class TestFace:
+    def test_detect(self, mock_server):
+        ds = Dataset({"img": np.array(["http://a/f.jpg"])})
+        stage = DetectFace(url=mock_server + "/face/detect",
+                           returnFaceAttributes=["age"])
+        stage.set_col("imageUrl", "img")
+        out = stage.transform(ds)
+        assert out["output"][0][0]["faceId"] == "f1"
+
+    def test_verify_columns(self, mock_server):
+        ds = Dataset({"a": np.array(["f1", "f1"]),
+                      "b": np.array(["f1", "f2"])})
+        stage = VerifyFaces(url=mock_server + "/face/verify")
+        stage.set_col("faceId1", "a")
+        stage.set_col("faceId2", "b")
+        out = stage.transform(ds)
+        assert out["output"][0]["isIdentical"] is True
+        assert out["output"][1]["isIdentical"] is False
+
+
+class TestFormOntology:
+    def test_learn_and_project(self):
+        forms = np.empty(2, dtype=object)
+        forms[0] = {"documentResults": [{"fields": {
+            "Total": {"type": "number", "valueNumber": 3.5},
+            "Vendor": {"type": "string", "valueString": "acme"}}}]}
+        forms[1] = {"documentResults": [{"fields": {
+            "Date": {"type": "string", "valueString": "2020-01-01"}}}]}
+        ds = Dataset({"form": forms})
+        model = FormOntologyLearner(inputCol="form",
+                                    outputCol="fields").fit(ds)
+        assert set(model.get("ontology")) == {"Total", "Vendor", "Date"}
+        out = model.transform(ds)
+        assert out["fields"][0]["Vendor"] == "acme"
+        assert out["fields"][1]["Date"] == "2020-01-01"
+
+
+class TestTranslate:
+    def test_multi_target(self, mock_server):
+        ds = Dataset({"text": np.array(["hello"])})
+        stage = Translate(url=mock_server + "/translate",
+                          toLanguage=["fr", "de"])
+        out = stage.transform(ds)
+        langs = [t["to"] for t in out["output"][0]]
+        assert langs == ["fr", "de"]
+        assert out["output"][0][0]["text"] == "[fr] hello"
+
+
+class TestAnomaly:
+    def test_simple_detect_groups_and_redistributes(self, mock_server):
+        ds = Dataset({
+            "group": np.array(["a", "a", "a", "b", "b", "b"]),
+            "timestamp": np.array(["t0", "t1", "t2"] * 2),
+            "value": np.array([1.0, 2.0, 99.0, 5.0, 5.0, 5.0])})
+        stage = SimpleDetectAnomalies(url=mock_server + "/anomaly/series",
+                                      groupbyCol="group")
+        out = stage.transform(ds)
+        assert out["output"][2]["isAnomaly"] is True
+        assert out["output"][0]["isAnomaly"] is False
+        assert all(v["isAnomaly"] is False for v in out["output"][3:])
+
+    def test_multivariate_fit_then_detect(self, mock_server):
+        ds = Dataset({"timestamp": np.array(["t0", "t1"]),
+                      "x": np.array([1.0, 20.0]),
+                      "y": np.array([2.0, 30.0])})
+        est = FitMultivariateAnomaly(url=mock_server + "/mvad/train",
+                                     inputCols="x,y")
+        model = est.fit(ds)
+        assert isinstance(model, DetectMultivariateAnomaly)
+        assert model.modelId == "model-42"
+        model.set("url", mock_server + "/mvad/detect")
+        out = model.transform(ds)
+        assert out["output"][0]["isAnomaly"] is False
+        assert out["output"][1]["isAnomaly"] is True
+
+
+class TestSearch:
+    def test_add_documents_batches(self, mock_server):
+        _MockHandler.search_batches.clear()
+        ds = Dataset({"id": np.array(["1", "2", "3"]),
+                      "body": np.array(["a", "b", "c"])})
+        stage = AddDocuments(url=mock_server + "/search/index", batchSize=2)
+        out = stage.transform(ds)
+        assert list(out["output"]) == ["ok", "ok", "ok"]
+        assert [len(b) for b in _MockHandler.search_batches] == [2, 1]
+        assert _MockHandler.search_batches[0][0]["@search.action"] == \
+            "upload"
+
+
+class TestBingGeo:
+    def test_bing_image_search(self, mock_server):
+        ds = Dataset({"query": np.array(["cats"])})
+        stage = BingImageSearch(url=mock_server + "/bing/images", count=3)
+        out = stage.transform(ds)
+        assert len(out["output"][0]) == 3
+        assert out["output"][0][0]["contentUrl"].startswith("http://x/cats")
+
+    def test_point_in_polygon(self, mock_server):
+        ds = Dataset({"lat": np.array([10.0, -10.0]),
+                      "lon": np.array([0.0, 0.0])})
+        stage = CheckPointInPolygon(url=mock_server + "/geo/pip")
+        out = stage.transform(ds)
+        assert out["output"][0]["pointInPolygons"] is True
+        assert out["output"][1]["pointInPolygons"] is False
+
+
+class TestSpeech:
+    def test_stt_parses_display_text(self, mock_server):
+        audio = np.empty(1, dtype=object)
+        audio[0] = b"\x00" * 16
+        ds = Dataset({"audio": audio})
+        stage = SpeechToText(url=mock_server + "/speech/stt")
+        out = stage.transform(ds)
+        assert out["output"][0] == "heard 16 bytes"
+
+    def test_tts_binary(self, mock_server):
+        ds = Dataset({"text": np.array(["hi there"])})
+        stage = TextToSpeech(url=mock_server + "/speech/tts")
+        out = stage.transform(ds)
+        assert out["output"][0].startswith(b"RIFF")
+
+
+class TestTextFamilies:
+    def test_language_detector(self, mock_server):
+        ds = Dataset({"text": np.array(["bonjour le monde", "hello"])})
+        stage = LanguageDetector(url=mock_server + "/text/language")
+        out = stage.transform(ds)
+        assert out["output"][0]["detectedLanguage"]["iso6391Name"] == "fr"
+        assert out["output"][1]["detectedLanguage"]["iso6391Name"] == "en"
+
+    def test_ner(self, mock_server):
+        ds = Dataset({"text": np.array(["I live in Seattle"])})
+        stage = NER(url=mock_server + "/text/ner")
+        out = stage.transform(ds)
+        assert out["output"][0]["entities"][0]["category"] == "Location"
