@@ -1,0 +1,60 @@
+"""Stage discovery: import every package module, read the registry.
+
+The reference reflects over the jar for all ``Wrappable`` classes
+(reference: core/utils/JarLoadingUtils.scala — ``instantiateServices``);
+here we walk ``synapseml_tpu``'s module tree, import everything, and
+collect the stage registry that ``PipelineStage.__init_subclass__``
+populates (core/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+from typing import Dict, List, Type
+
+#: modules that require optional/native context and are skipped in codegen
+_SKIP_PREFIXES = ("synapseml_tpu.native",)
+
+
+def load_all_modules() -> List[str]:
+    """Import every synapseml_tpu submodule; return imported names."""
+    import synapseml_tpu
+    loaded = []
+    for info in pkgutil.walk_packages(synapseml_tpu.__path__,
+                                      prefix="synapseml_tpu."):
+        if info.name.startswith(_SKIP_PREFIXES):
+            continue
+        importlib.import_module(info.name)
+        loaded.append(info.name)
+    return loaded
+
+
+def discover_stages() -> Dict[str, type]:
+    """qualified-name → stage class for every public, concrete stage."""
+    from ..core.pipeline import (_STAGE_REGISTRY, Estimator, Model,
+                                 Pipeline, PipelineModel, PipelineStage,
+                                 Transformer)
+    load_all_modules()
+    base = {Transformer, Estimator, Model, PipelineStage,
+            Pipeline, PipelineModel}
+    out: Dict[str, type] = {}
+    for qual, cls in sorted(_STAGE_REGISTRY.items()):
+        if cls in base:
+            continue
+        if cls.__name__.startswith("_"):
+            continue  # private helper bases
+        out[qual] = cls
+    return out
+
+
+def stage_kind(cls: type) -> str:
+    """'estimator' | 'model' | 'transformer' (drives wrapper shape)."""
+    from ..core.pipeline import Estimator, Model, Transformer
+    if issubclass(cls, Estimator):
+        return "estimator"
+    if issubclass(cls, Model):
+        return "model"
+    if issubclass(cls, Transformer):
+        return "transformer"
+    return "stage"

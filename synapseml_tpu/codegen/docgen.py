@@ -1,0 +1,56 @@
+"""Markdown API-doc generation (reference: the website docs are built
+from the same Wrappable metadata — codegen/DocGen parts of
+CodegenPlugin.scala).  One page per module: class, first doc line,
+param table with types and defaults."""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from typing import Dict, List
+
+from .common import lang_types, public_params, py_default_repr
+from .discovery import stage_kind
+
+
+def _page(module: str, classes: List[type]) -> str:
+    lines = [f"# `{module}`", ""]
+    for cls in sorted(classes, key=lambda c: c.__name__):
+        lines.append(f"## {cls.__name__} ({stage_kind(cls)})")
+        doc = (cls.__doc__ or "").strip()
+        if doc:
+            lines.append("")
+            lines.append(doc.splitlines()[0])
+        params = public_params(cls)
+        if params:
+            lines += ["", "| param | type | default | doc |",
+                      "|---|---|---|---|"]
+            for p in params:
+                pytype, _, _ = lang_types(p)
+                doc_text = (p.doc or "").replace("|", "\\|")
+                lines.append(f"| `{p.name}` | `{pytype}` | "
+                             f"`{py_default_repr(p)}` | {doc_text} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def generate_docs(stages: Dict[str, type], out_dir: str) -> List[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    by_module = defaultdict(list)
+    for qual, cls in stages.items():
+        by_module[cls.__module__].append(cls)
+    paths = []
+    index = ["# synapseml_tpu API reference", ""]
+    for module, classes in sorted(by_module.items()):
+        fname = module.replace("synapseml_tpu.", "").replace(".", "_") + ".md"
+        path = os.path.join(out_dir, fname)
+        with open(path, "w") as f:
+            f.write(_page(module, classes))
+        index.append(f"- [`{module}`]({fname}) — "
+                     f"{len(classes)} stages")
+        paths.append(path)
+    index_path = os.path.join(out_dir, "index.md")
+    with open(index_path, "w") as f:
+        f.write("\n".join(index) + "\n")
+    paths.append(index_path)
+    return paths

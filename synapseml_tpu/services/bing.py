@@ -10,7 +10,7 @@ import numpy as np
 
 from ..core.dataset import Dataset
 from ..core.params import IntParam, StringParam
-from ..io.http import HTTPClient, HTTPRequestData
+from ..io.http import HTTPRequestData
 from .base import RemoteServiceTransformer, with_query
 
 
@@ -40,14 +40,17 @@ class BingImageSearch(RemoteServiceTransformer):
                            concurrency: int = 4,
                            retries: int = 1) -> Dataset:
         """Fetch each URL's bytes (reference: BingImageSearch.scala
-        downloadFromUrls — a companion helper, not a stage)."""
-        from concurrent.futures import ThreadPoolExecutor
-        http = HTTPClient(retries=retries)
-        reqs = [HTTPRequestData(url=str(u), method="GET")
-                for u in ds[url_col]]
+        downloadFromUrls — a companion helper, not a stage).  Dispatch
+        rides HTTPTransformer's concurrent machinery."""
+        from ..io.http import HTTPTransformer
+        reqs = np.empty(ds.num_rows, dtype=object)
+        for i, u in enumerate(ds[url_col]):
+            reqs[i] = HTTPRequestData(url=str(u), method="GET")
+        scored = HTTPTransformer(
+            inputCol="_req", outputCol="_resp",
+            concurrency=concurrency, retries=retries,
+        ).transform(ds.with_column("_req", reqs))
         out = np.empty(ds.num_rows, dtype=object)
-        with ThreadPoolExecutor(max_workers=max(1, concurrency)) as pool:
-            for i, resp in enumerate(pool.map(http.send, reqs)):
-                out[i] = resp.entity \
-                    if 200 <= resp.status_code < 300 else None
+        for i, resp in enumerate(scored["_resp"]):
+            out[i] = resp.entity if 200 <= resp.status_code < 300 else None
         return ds.with_column(output_col, out)

@@ -121,6 +121,20 @@ class FormOntologyModel(Model):
     outputCol = StringParam(doc="projected fields column", default="fields")
     ontology = DictParam(doc="field-name → type tree", default=None)
 
+    @staticmethod
+    def _extract(spec: Any) -> Any:
+        """Pull the value out of a field spec; recurse into objects."""
+        if not isinstance(spec, dict):
+            return spec
+        if spec.get("type") == "object":
+            return {k: FormOntologyModel._extract(v)
+                    for k, v in (spec.get("valueObject") or {}).items()}
+        for key in ("valueString", "valueNumber", "valueDate",
+                    "valueInteger", "text"):
+            if key in spec:
+                return spec[key]
+        return None
+
     def _transform(self, ds: Dataset) -> Dataset:
         onto = self.get("ontology") or {}
         out = np.empty(ds.num_rows, dtype=object)
@@ -129,8 +143,6 @@ class FormOntologyModel(Model):
             for doc in (v or {}).get("documentResults", []):
                 for name, spec in (doc.get("fields") or {}).items():
                     if name in onto:
-                        val = spec.get("valueString", spec.get("valueNumber"))\
-                            if isinstance(spec, dict) else spec
-                        fields[name] = val
+                        fields[name] = self._extract(spec)
             out[i] = fields
         return ds.with_column(self.outputCol, out)

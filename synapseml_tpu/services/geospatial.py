@@ -11,14 +11,16 @@ from ..io.http import HTTPRequestData
 from .base import RemoteServiceTransformer, ServiceParam, with_query
 
 
-class AddressGeocoder(RemoteServiceTransformer):
-    """Address → lat/lon (reference: geospatial/AddressGeocoder.scala —
-    batch geocode POST)."""
+class _BatchGeocodeBase(RemoteServiceTransformer):
+    """Shared one-item batchItems POST + unwrap (reference: geospatial/
+    AddressGeocoder.scala / ReverseAddressGeocoder.scala share the batch
+    request/response shape)."""
 
-    addressCol = StringParam(doc="address column", default="address")
+    def _geocode_query(self, row: Dict[str, Any]) -> str:
+        raise NotImplementedError
 
     def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
-        body = {"batchItems": [{"query": str(row[self.addressCol])}]}
+        body = {"batchItems": [{"query": self._geocode_query(row)}]}
         return HTTPRequestData(url=self.url, method="POST",
                                headers={"Content-Type": "application/json"},
                                entity=json.dumps(body).encode())
@@ -30,20 +32,26 @@ class AddressGeocoder(RemoteServiceTransformer):
         return value
 
 
-class ReverseAddressGeocoder(RemoteServiceTransformer):
+class AddressGeocoder(_BatchGeocodeBase):
+    """Address → lat/lon (reference: geospatial/AddressGeocoder.scala —
+    batch geocode POST)."""
+
+    addressCol = StringParam(doc="address column", default="address")
+
+    def _geocode_query(self, row):
+        return str(row[self.addressCol])
+
+
+class ReverseAddressGeocoder(_BatchGeocodeBase):
     """Lat/lon → address (reference: geospatial/
     ReverseAddressGeocoder.scala)."""
 
     latitudeCol = StringParam(doc="latitude column", default="lat")
     longitudeCol = StringParam(doc="longitude column", default="lon")
 
-    def prepare_request(self, row: Dict[str, Any]) -> HTTPRequestData:
-        body = {"batchItems": [
-            {"query": f"{float(row[self.latitudeCol])},"
-                      f"{float(row[self.longitudeCol])}"}]}
-        return HTTPRequestData(url=self.url, method="POST",
-                               headers={"Content-Type": "application/json"},
-                               entity=json.dumps(body).encode())
+    def _geocode_query(self, row):
+        return (f"{float(row[self.latitudeCol])},"
+                f"{float(row[self.longitudeCol])}")
 
 
 class CheckPointInPolygon(RemoteServiceTransformer):

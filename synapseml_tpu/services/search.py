@@ -29,7 +29,8 @@ class AddDocuments(RemoteServiceTransformer):
         action_col = self.actionCol
         bs = max(1, int(self.batchSize))
         status = np.empty(ds.num_rows, dtype=object)
-        for start in range(0, ds.num_rows, bs):
+
+        def run_batch(start: int):
             idx = range(start, min(start + bs, ds.num_rows))
             docs: List[Dict[str, Any]] = []
             for i in idx:
@@ -46,7 +47,14 @@ class AddDocuments(RemoteServiceTransformer):
                 headers={"Content-Type": "application/json",
                          **self._auth_headers(row0)},
                 entity=json.dumps({"value": docs}).encode())
-            resp = http.send(req)
+            return idx, http.send(req)
+
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=max(1, int(self.concurrency))) as pool:
+            results = list(pool.map(run_batch,
+                                    range(0, ds.num_rows, bs)))
+        for idx, resp in results:
             ok = 200 <= resp.status_code < 300
             for i in idx:
                 status[i] = "ok" if ok \

@@ -1,0 +1,118 @@
+"""Codegen layer tests (reference: the sbt codegen task emits Py/R/.NET
+wrappers from param metadata — CodegenPlugin.scala:62-66, Wrappable.scala;
+here the same metadata drives .pyi/R/C#/markdown generators)."""
+
+import ast
+import os
+import re
+import tempfile
+
+import pytest
+
+from synapseml_tpu.codegen import (discover_stages, generate_docs,
+                                   generate_dotnet, generate_pyi, generate_r)
+from synapseml_tpu.codegen.discovery import stage_kind
+
+
+@pytest.fixture(scope="module")
+def stages():
+    return discover_stages()
+
+
+@pytest.fixture(scope="module")
+def outputs(stages):
+    d = tempfile.mkdtemp(prefix="codegen_test_")
+    return {
+        "pyi": generate_pyi(stages, os.path.join(d, "python")),
+        "r": generate_r(stages, os.path.join(d, "R")),
+        "cs": generate_dotnet(stages, os.path.join(d, "dotnet")),
+        "docs": generate_docs(stages, os.path.join(d, "docs")),
+    }
+
+
+class TestDiscovery:
+    def test_finds_the_main_stage_families(self, stages):
+        names = {cls.__name__ for cls in stages.values()}
+        # representative coverage across layers (SURVEY §2 inventory)
+        for expected in ["GBDTClassifier", "OnlineSGDClassifier",
+                         "ONNXModel", "DeepTextClassifier", "KNN", "SAR",
+                         "TabularLIME", "ICETransformer", "HTTPTransformer",
+                         "TextSentiment", "AnalyzeImage", "ImageTransformer",
+                         "DoubleMLEstimator", "IsolationForest",
+                         "FixedMiniBatchTransformer", "TuneHyperparameters"]:
+            assert expected in names, f"{expected} not discovered"
+        assert len(stages) > 120
+
+    def test_kinds(self, stages):
+        by_name = {c.__name__: c for c in stages.values()}
+        assert stage_kind(by_name["GBDTClassifier"]) == "estimator"
+        assert stage_kind(by_name["GBDTClassificationModel"]) == "model"
+        assert stage_kind(by_name["HTTPTransformer"]) == "transformer"
+
+    def test_private_bases_excluded(self, stages):
+        assert all(not c.__name__.startswith("_")
+                   for c in stages.values())
+
+
+class TestPyi:
+    def test_stubs_parse_as_python(self, outputs):
+        for path in outputs["pyi"]:
+            ast.parse(open(path).read(), filename=path)
+
+    def test_estimator_has_fit_model_has_transform(self, outputs):
+        path = [p for p in outputs["pyi"]
+                if p.endswith("gbdt" + os.sep + "estimators.pyi")][0]
+        src = open(path).read()
+        tree = ast.parse(src)
+        classes = {n.name: n for n in tree.body
+                   if isinstance(n, ast.ClassDef)}
+        clf_methods = {m.name for m in classes["GBDTClassifier"].body
+                       if isinstance(m, ast.FunctionDef)}
+        assert "fit" in clf_methods and "transform" not in clf_methods
+        mdl_methods = {m.name
+                       for m in classes["GBDTClassificationModel"].body
+                       if isinstance(m, ast.FunctionDef)}
+        assert "transform" in mdl_methods
+
+    def test_param_defaults_rendered(self, outputs):
+        path = [p for p in outputs["pyi"]
+                if p.endswith("gbdt" + os.sep + "estimators.pyi")][0]
+        src = open(path).read()
+        assert "featuresCol: str = 'features'" in src
+
+
+class TestR:
+    def test_snake_cased_constructors_with_roxygen(self, outputs):
+        joined = "\n".join(open(p).read() for p in outputs["r"])
+        assert "sml_gbdt_classifier <- function(" in joined
+        assert "#' @export" in joined
+        assert "reticulate::import" in joined
+
+    def test_r_defaults(self, outputs):
+        joined = "\n".join(open(p).read() for p in outputs["r"])
+        assert re.search(r"featuresCol = \"features\"", joined)
+        assert "NULL" in joined
+
+
+class TestDotnet:
+    def test_classes_and_setters(self, outputs):
+        joined = "\n".join(open(p).read() for p in outputs["cs"])
+        assert "public class GBDTClassifier : PythonStage" in joined
+        assert re.search(
+            r"public GBDTClassifier SetFeaturesCol\(string value\)", joined)
+        assert "namespace SynapseMLTpu." in joined
+
+
+class TestDocs:
+    def test_index_links_every_page(self, outputs):
+        index = [p for p in outputs["docs"] if p.endswith("index.md")][0]
+        content = open(index).read()
+        pages = [p for p in outputs["docs"] if not p.endswith("index.md")]
+        assert len(re.findall(r"\]\(", content)) == len(pages)
+
+    def test_param_table(self, outputs):
+        page = [p for p in outputs["docs"]
+                if p.endswith("models_gbdt_estimators.md")][0]
+        content = open(page).read()
+        assert "| param | type | default | doc |" in content
+        assert "`featuresCol`" in content

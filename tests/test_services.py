@@ -191,6 +191,19 @@ class TestFace:
 
 
 class TestFormOntology:
+    def test_nested_object_fields_projected(self):
+        forms = np.empty(1, dtype=object)
+        forms[0] = {"documentResults": [{"fields": {
+            "Address": {"type": "object", "valueObject": {
+                "City": {"type": "string", "valueString": "Redmond"},
+                "Zip": {"type": "string", "valueString": "98052"}}}}}]}
+        ds = Dataset({"form": forms})
+        model = FormOntologyLearner(inputCol="form",
+                                    outputCol="fields").fit(ds)
+        out = model.transform(ds)
+        assert out["fields"][0]["Address"] == {"City": "Redmond",
+                                               "Zip": "98052"}
+
     def test_learn_and_project(self):
         forms = np.empty(2, dtype=object)
         forms[0] = {"documentResults": [{"fields": {
