@@ -1,0 +1,23 @@
+"""Cyber-ML: access-anomaly detection via collaborative filtering
+(reference: core/src/main/python/synapse/ml/cyber/ — indexers, per-group
+scalers, complement-set sampling, and the AccessAnomaly estimator built
+on ALS, anomaly/collaborative_filtering.py:1-1229).
+
+TPU re-design: the ALS solves are jit-compiled dense normal-equation
+updates (vmapped per-user/per-resource ridge solves on the MXU) instead
+of Spark's blocked ALS."""
+
+from .indexers import IdIndexer, IdIndexerModel, MultiIndexer, MultiIndexerModel
+from .scalers import (LinearScalarScaler, LinearScalarScalerModel,
+                      StandardScalarScaler, StandardScalarScalerModel)
+from .complement_access import ComplementAccessTransformer
+from .access_anomaly import (AccessAnomaly, AccessAnomalyConfig,
+                             AccessAnomalyModel)
+
+__all__ = [
+    "IdIndexer", "IdIndexerModel", "MultiIndexer", "MultiIndexerModel",
+    "StandardScalarScaler", "StandardScalarScalerModel",
+    "LinearScalarScaler", "LinearScalarScalerModel",
+    "ComplementAccessTransformer",
+    "AccessAnomaly", "AccessAnomalyConfig", "AccessAnomalyModel",
+]

@@ -1,10 +1,16 @@
-"""IO: HTTP client stages + serving (reference: core/.../io/)."""
+"""IO: HTTP client stages, binary/image file formats, PowerBI sink
+(reference: core/.../io/)."""
 
 from .http import (HTTPClient, HTTPRequestData, HTTPResponseData,
                    HTTPTransformer, JSONInputParser, JSONOutputParser,
                    SimpleHTTPTransformer)
+from .binary import BinaryFileReader, read_binary_files
+from .image import decode_image, read_images
+from .powerbi import PowerBIResponseError, PowerBIWriter
 
 __all__ = [
     "HTTPClient", "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
     "JSONInputParser", "JSONOutputParser", "SimpleHTTPTransformer",
+    "BinaryFileReader", "read_binary_files", "decode_image", "read_images",
+    "PowerBIWriter", "PowerBIResponseError",
 ]

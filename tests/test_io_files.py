@@ -1,0 +1,210 @@
+"""Binary/image file formats, PowerBI sink, model downloader
+(reference: io/binary/BinaryFileFormat.scala, PatchedImageFileFormat,
+io/powerbi/PowerBIWriter.scala, downloader/ModelDownloader.py)."""
+
+import hashlib
+import io
+import json
+import os
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.downloader import ModelDownloader, ModelSchema
+from synapseml_tpu.io import (BinaryFileReader, PowerBIResponseError,
+                              PowerBIWriter, read_images)
+
+
+@pytest.fixture()
+def file_tree(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"alpha")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "b.bin").write_bytes(b"beta")
+    with zipfile.ZipFile(tmp_path / "c.zip", "w") as zf:
+        zf.writestr("inner/x.txt", b"xray")
+        zf.writestr("y.txt", b"yankee")
+    return tmp_path
+
+
+class TestBinaryFileReader:
+    def test_flat_read(self, file_tree):
+        ds = BinaryFileReader.read(str(file_tree), inspect_zip=False)
+        by_path = {os.path.basename(p): b
+                   for p, b in zip(ds["path"], ds["bytes"])}
+        assert by_path["a.bin"] == b"alpha"
+        assert "b.bin" not in by_path  # not recursive
+
+    def test_recursive_and_zip_inspection(self, file_tree):
+        ds = BinaryFileReader.read(str(file_tree), recursive=True)
+        paths = [str(p) for p in ds["path"]]
+        assert any(p.endswith("sub/b.bin") or p.endswith("sub\\b.bin")
+                   for p in paths)
+        assert any(p.endswith("c.zip/inner/x.txt") for p in paths)
+        blob = dict(zip(paths, ds["bytes"]))
+        zp = [p for p in paths if p.endswith("c.zip/y.txt")][0]
+        assert blob[zp] == b"yankee"
+
+    def test_subsample_deterministic(self, file_tree):
+        a = BinaryFileReader.read(str(file_tree), recursive=True,
+                                  sample_ratio=0.5, seed=7)
+        b = BinaryFileReader.read(str(file_tree), recursive=True,
+                                  sample_ratio=0.5, seed=7)
+        assert list(a["path"]) == list(b["path"])
+        full = BinaryFileReader.read(str(file_tree), recursive=True)
+        assert a.num_rows <= full.num_rows
+
+
+class TestReadImages:
+    def test_decode_shapes_and_bgr(self, tmp_path):
+        from PIL import Image
+        rgb = np.zeros((4, 6, 3), np.uint8)
+        rgb[..., 0] = 255  # pure red
+        Image.fromarray(rgb).save(tmp_path / "red.png")
+        Image.fromarray(np.uint8(np.arange(16).reshape(4, 4) * 15),
+                        mode="L").save(tmp_path / "gray.png")
+        (tmp_path / "junk.jpg").write_bytes(b"not an image")
+
+        ds = read_images(str(tmp_path))
+        assert ds.num_rows == 2  # junk dropped
+        rows = {os.path.basename(str(p)): i
+                for i, p in enumerate(ds["path"])}
+        i = rows["red.png"]
+        assert (ds["height"][i], ds["width"][i],
+                ds["nChannels"][i]) == (4, 6, 3)
+        # BGR order: red lands in channel 2
+        assert ds["data"][i][0, 0, 2] == 255
+        assert ds["data"][i][0, 0, 0] == 0
+        g = rows["gray.png"]
+        assert ds["nChannels"][g] == 1
+        assert ds["mode"][g] == 0
+
+    def test_keep_failures(self, tmp_path):
+        (tmp_path / "junk.jpg").write_bytes(b"not an image")
+        ds = read_images(str(tmp_path), drop_image_failures=False)
+        assert ds.num_rows == 1
+        assert ds["mode"][0] == -1
+        assert ds["data"][0] is None
+
+
+class _PBIHandler(BaseHTTPRequestHandler):
+    batches = []
+    fail = False
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        body = json.loads(self.rfile.read(n))
+        if _PBIHandler.fail:
+            self.send_error(400, "Bad payload")
+            return
+        with _PBIHandler.lock:
+            _PBIHandler.batches.append(body)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def pbi_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _PBIHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}/push"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestPowerBIWriter:
+    def test_fixed_batches(self, pbi_server):
+        _PBIHandler.batches.clear()
+        _PBIHandler.fail = False
+        ds = Dataset({"x": np.arange(5), "label": np.array(list("abcde"))})
+        PowerBIWriter.write(ds, pbi_server, {"batchSize": "2"})
+        sizes = sorted(len(b) for b in _PBIHandler.batches)
+        assert sizes == [1, 2, 2]
+        all_rows = [r for b in _PBIHandler.batches for r in b]
+        assert {r["label"] for r in all_rows} == set("abcde")
+        assert all(isinstance(r["x"], int) for r in all_rows)
+
+    def test_error_raises(self, pbi_server):
+        _PBIHandler.fail = True
+        ds = Dataset({"x": np.arange(2)})
+        with pytest.raises(PowerBIResponseError) as ei:
+            PowerBIWriter.write(ds, pbi_server)
+        assert ei.value.status_code == 400
+        _PBIHandler.fail = False
+
+    def test_unknown_option_rejected(self, pbi_server):
+        ds = Dataset({"x": np.arange(2)})
+        with pytest.raises(ValueError, match="not applicable"):
+            PowerBIWriter.write(ds, pbi_server, {"bogus": "1"})
+
+
+class TestModelDownloader:
+    def _serve_dir(self, d):
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                p = os.path.join(d, self.path.lstrip("/"))
+                if not os.path.exists(p):
+                    self.send_error(404)
+                    return
+                data = open(p, "rb").read()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def test_download_verify_and_cache(self, tmp_path):
+        server_dir = tmp_path / "server"
+        server_dir.mkdir()
+        blob = b"MODELBYTES" * 100
+        (server_dir / "resnet.onnx").write_bytes(blob)
+        manifest = [{"name": "ResNet50", "uri": "resnet.onnx",
+                     "hash": hashlib.sha256(blob).hexdigest(),
+                     "size": len(blob)}]
+        (server_dir / "manifest.json").write_text(json.dumps(manifest))
+        httpd, url = self._serve_dir(str(server_dir))
+        try:
+            cache = tmp_path / "cache"
+            dl = ModelDownloader(str(cache), url)
+            remote = list(dl.remoteModels())
+            assert [m.name for m in remote] == ["ResNet50"]
+            got = dl.downloadByName("ResNet50")
+            assert os.path.exists(got.uri)
+            assert open(got.uri, "rb").read() == blob
+            # now visible locally without the server
+            local = list(ModelDownloader(str(cache)).localModels())
+            assert [m.name for m in local] == ["ResNet50"]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_hash_mismatch_rejected(self, tmp_path):
+        server_dir = tmp_path / "server"
+        server_dir.mkdir()
+        (server_dir / "m.bin").write_bytes(b"evil")
+        (server_dir / "manifest.json").write_text(json.dumps(
+            [{"name": "m", "uri": "m.bin", "hash": "0" * 64}]))
+        httpd, url = self._serve_dir(str(server_dir))
+        try:
+            dl = ModelDownloader(str(tmp_path / "cache2"), url)
+            with pytest.raises(ValueError, match="hash mismatch"):
+                dl.downloadByName("m")
+            assert not os.path.exists(tmp_path / "cache2" / "m.bin")
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
