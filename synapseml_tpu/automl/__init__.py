@@ -1,12 +1,12 @@
 """Hyperparameter search (reference: core/.../automl/)."""
 
-from .space import (DiscreteHyperParam, GridSpace, HyperparamBuilder,
-                    RandomSpace, RangeHyperParam)
+from .space import (DefaultHyperparams, DiscreteHyperParam, GridSpace,
+                    HyperparamBuilder, RandomSpace, RangeHyperParam)
 from .tune import (BestModel, FindBestModel, TuneHyperparameters,
                    TuneHyperparametersModel)
 
 __all__ = [
-    "DiscreteHyperParam", "GridSpace", "HyperparamBuilder", "RandomSpace",
-    "RangeHyperParam", "BestModel", "FindBestModel", "TuneHyperparameters",
-    "TuneHyperparametersModel",
+    "DefaultHyperparams", "DiscreteHyperParam", "GridSpace",
+    "HyperparamBuilder", "RandomSpace", "RangeHyperParam", "BestModel",
+    "FindBestModel", "TuneHyperparameters", "TuneHyperparametersModel",
 ]

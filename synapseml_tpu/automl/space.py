@@ -96,3 +96,47 @@ class RandomSpace:
         for _ in range(n):
             yield [(stage, name, d.sample(rng))
                    for stage, name, d in self.entries]
+
+
+class DefaultHyperparams:
+    """Sensible default search ranges per estimator family (reference:
+    automl/DefaultHyperparams.scala:18-60 — per-learner
+    ``defaultRange`` tables consumed by TuneHyperparameters)."""
+
+    @staticmethod
+    def gbdt(stage) -> List[Tuple[Any, str, Any]]:
+        return (HyperparamBuilder()
+                .add_hyperparam(stage, "numIterations",
+                                RangeHyperParam(20, 100, n_grid=3))
+                .add_hyperparam(stage, "learningRate",
+                                RangeHyperParam(0.01, 0.3, log=True,
+                                                n_grid=3))
+                .add_hyperparam(stage, "numLeaves",
+                                DiscreteHyperParam([15, 31, 63]))
+                .add_hyperparam(stage, "lambdaL2",
+                                RangeHyperParam(0.0, 1.0, n_grid=3))
+                .build())
+
+    @staticmethod
+    def online_sgd(stage) -> List[Tuple[Any, str, Any]]:
+        return (HyperparamBuilder()
+                .add_hyperparam(stage, "learningRate",
+                                RangeHyperParam(0.05, 2.0, log=True,
+                                                n_grid=4))
+                .add_hyperparam(stage, "l2",
+                                DiscreteHyperParam([0.0, 1e-6, 1e-4]))
+                .add_hyperparam(stage, "numPasses",
+                                DiscreteHyperParam([1, 3, 5]))
+                .build())
+
+    @staticmethod
+    def for_stage(stage) -> List[Tuple[Any, str, Any]]:
+        """Dispatch by available params, mirroring the reference's
+        per-learner overloads."""
+        names = {p.name for p in stage.params}
+        if "numLeaves" in names:
+            return DefaultHyperparams.gbdt(stage)
+        if "numPasses" in names:
+            return DefaultHyperparams.online_sgd(stage)
+        raise ValueError(
+            f"no default hyperparam table for {type(stage).__name__}")

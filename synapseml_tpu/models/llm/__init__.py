@@ -1,0 +1,13 @@
+"""TP-sharded decoder-only LLM (the Llama-3 stretch config; the
+reference's only LLM surface is remote OpenAI calls,
+cognitive/.../openai/OpenAI.scala:246)."""
+
+from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
+                    LlamaConfig, LlamaModel, RMSNorm, apply_rope,
+                    causal_lm_loss, init_cache, rope_frequencies)
+
+__all__ = [
+    "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LlamaConfig",
+    "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss", "init_cache",
+    "rope_frequencies",
+]

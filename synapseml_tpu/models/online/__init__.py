@@ -14,6 +14,9 @@ from .estimators import (OnlineSGDClassifier, OnlineSGDClassificationModel,
                          OnlineSGDRegressor, OnlineSGDRegressionModel)
 from .featurizer import FeatureInteractions, HashingFeaturizer
 from .bandit import (ContextualBandit, ContextualBanditModel)
+from .generic import (OnlineGeneric, OnlineGenericModel,
+                      OnlineGenericProgressive, parse_vw_line,
+                      vectorize_vw_lines)
 from .policyeval import (CressieReadInterval, PolicyEvalTransformer,
                          bernstein_bound, cressie_read, ips, snips)
 
@@ -23,6 +26,8 @@ __all__ = [
     "OnlineSGDRegressor", "OnlineSGDRegressionModel",
     "HashingFeaturizer", "FeatureInteractions",
     "ContextualBandit", "ContextualBanditModel",
+    "OnlineGeneric", "OnlineGenericModel", "OnlineGenericProgressive",
+    "parse_vw_line", "vectorize_vw_lines",
     "PolicyEvalTransformer", "CressieReadInterval",
     "ips", "snips", "cressie_read", "bernstein_bound",
 ]

@@ -13,6 +13,8 @@ from .stages import (Cacher, ClassBalancer, ClassBalancerModel, DropColumns,
                      SummarizeData, TextPreprocessor, Timer, TimerModel,
                      TimeIntervalMiniBatchTransformer, UDFTransformer,
                      UnicodeNormalize)
+from .batchers import (DynamicBufferedBatcher, FixedBufferedBatcher,
+                       TimeIntervalBatcher)
 from .featurize import (CleanMissingData, CleanMissingDataModel, CountSelector,
                         CountSelectorModel, DataConversion, Featurize,
                         IndexToValue, ValueIndexer, ValueIndexerModel)
@@ -29,6 +31,7 @@ __all__ = [
     "Repartition", "SelectColumns", "StratifiedRepartition", "SummarizeData",
     "TextPreprocessor", "Timer", "TimerModel",
     "TimeIntervalMiniBatchTransformer", "UDFTransformer", "UnicodeNormalize",
+    "DynamicBufferedBatcher", "FixedBufferedBatcher", "TimeIntervalBatcher",
     "CleanMissingData", "CleanMissingDataModel", "CountSelector",
     "CountSelectorModel", "DataConversion", "Featurize", "IndexToValue",
     "ValueIndexer", "ValueIndexerModel",

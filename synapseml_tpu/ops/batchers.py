@@ -1,0 +1,163 @@
+"""Background-thread buffered batch iterators (reference:
+core/.../stages/Batchers.scala:11-130 — DynamicBufferedBatcher drains
+whatever accumulated while downstream was busy, FixedBufferedBatcher
+prefetches fixed-size batches, TimeIntervalBatcher flushes on a clock).
+
+These are the host-side input-pipeline primitives behind the mini-batch
+transformer stages and the serving source: a producer thread keeps the
+queue full so device steps never wait on ingestion — the TPU analogue of
+keeping the infeed ahead of the MXU."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterable, Iterator, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+_SENTINEL = object()
+
+
+class _BufferedBatcherBase(Iterator[List[T]]):
+    def __init__(self, it: Iterable[T], max_buffer_size: int):
+        self._source = iter(it)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffer_size)
+        self._started = False
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+
+    def _produce(self) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def close(self) -> None:
+        self._done.set()
+
+    def __iter__(self) -> "Iterator[List[T]]":
+        return self
+
+
+class DynamicBufferedBatcher(_BufferedBatcherBase):
+    """Yield lists sized by whatever the producer buffered since the last
+    ``next()`` — slow consumers get bigger batches (amortizing fixed
+    per-batch cost), fast consumers get small low-latency ones."""
+
+    def __init__(self, it: Iterable[T], max_buffer_size: int = 2 ** 30):
+        super().__init__(it, max_buffer_size)
+
+    def _produce(self) -> None:
+        try:
+            for item in self._source:
+                if self._done.is_set():
+                    return
+                self._queue.put(item)
+        finally:
+            self._queue.put(_SENTINEL)
+
+    def __next__(self) -> List[T]:
+        self.start()
+        first = self._queue.get()
+        if first is _SENTINEL:
+            self._queue.put(_SENTINEL)  # stay exhausted on repeat next()
+            raise StopIteration
+        batch = [first]
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return batch
+            if item is _SENTINEL:
+                # re-plant so a subsequent __next__ terminates
+                self._queue.put(_SENTINEL)
+                return batch
+            batch.append(item)
+
+
+class FixedBufferedBatcher(_BufferedBatcherBase):
+    """Prefetch fixed-size batches on a producer thread (reference:
+    FixedBufferedBatcher, Batchers.scala:65)."""
+
+    def __init__(self, it: Iterable[T], batch_size: int,
+                 max_buffer_size: int = 2 ** 30):
+        super().__init__(it, max_buffer_size)
+        self.batch_size = int(batch_size)
+
+    def _produce(self) -> None:
+        try:
+            batch: List[T] = []
+            for item in self._source:
+                if self._done.is_set():
+                    return
+                batch.append(item)
+                if len(batch) >= self.batch_size:
+                    self._queue.put(batch)
+                    batch = []
+            if batch:
+                self._queue.put(batch)
+        finally:
+            self._queue.put(_SENTINEL)
+
+    def __next__(self) -> List[T]:
+        self.start()
+        item = self._queue.get()
+        if item is _SENTINEL:
+            self._queue.put(_SENTINEL)  # stay exhausted on repeat next()
+            raise StopIteration
+        return item
+
+
+class TimeIntervalBatcher(_BufferedBatcherBase):
+    """Flush accumulated rows every ``interval_ms`` wall-clock
+    milliseconds (reference: TimeIntervalBatcher, Batchers.scala:96 —
+    used by TimeIntervalMiniBatchTransformer).
+
+    The first row of a batch is awaited indefinitely; once one row is in
+    hand the flush deadline is hard — a stalled producer yields a small
+    on-time batch rather than a late big one."""
+
+    def __init__(self, it: Iterable[T], interval_ms: int,
+                 max_batch_size: Optional[int] = None,
+                 max_buffer_size: int = 2 ** 30):
+        super().__init__(it, max_buffer_size)
+        self.interval_s = interval_ms / 1000.0
+        self.max_batch_size = max_batch_size
+
+    def _produce(self) -> None:
+        try:
+            for item in self._source:
+                if self._done.is_set():
+                    return
+                self._queue.put(item)
+        finally:
+            self._queue.put(_SENTINEL)
+
+    def __next__(self) -> List[T]:
+        self.start()
+        first = self._queue.get()
+        if first is _SENTINEL:
+            self._queue.put(_SENTINEL)  # stay exhausted on repeat next()
+            raise StopIteration
+        batch = [first]
+        deadline = time.monotonic() + self.interval_s
+        while True:
+            if (self.max_batch_size is not None
+                    and len(batch) >= self.max_batch_size):
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                item = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                self._queue.put(_SENTINEL)
+                break
+            batch.append(item)
+        return batch

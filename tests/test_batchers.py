@@ -1,0 +1,65 @@
+"""Buffered-batcher tests (reference test model:
+core/src/test/.../stages/MiniBatchTransformerSuite.scala exercises the
+batchers through slow/fast consumer patterns)."""
+
+import time
+
+import numpy as np
+
+from synapseml_tpu.automl import DefaultHyperparams
+from synapseml_tpu.models.gbdt import GBDTClassifier
+from synapseml_tpu.models.online import OnlineSGDRegressor
+from synapseml_tpu.ops import (DynamicBufferedBatcher, FixedBufferedBatcher,
+                               TimeIntervalBatcher)
+
+
+class TestDynamicBufferedBatcher:
+    def test_all_items_delivered_once(self):
+        items = list(range(1000))
+        got = [x for batch in DynamicBufferedBatcher(iter(items))
+               for x in batch]
+        assert got == items
+
+    def test_slow_consumer_gets_larger_batches(self):
+        def trickle():
+            for i in range(50):
+                time.sleep(0.001)
+                yield i
+
+        b = DynamicBufferedBatcher(trickle())
+        first = b.__next__()
+        time.sleep(0.02)            # let the producer run ahead
+        second = b.__next__()
+        rest = [x for batch in b for x in batch]
+        assert len(second) > 1      # accumulated while we slept
+        assert sorted(first + second + rest) == list(range(50))
+
+    def test_empty_source(self):
+        assert list(DynamicBufferedBatcher(iter([]))) == []
+
+
+class TestFixedBufferedBatcher:
+    def test_fixed_sizes_with_remainder(self):
+        batches = list(FixedBufferedBatcher(iter(range(10)), batch_size=4))
+        assert [len(b) for b in batches] == [4, 4, 2]
+        assert [x for b in batches for x in b] == list(range(10))
+
+
+class TestTimeIntervalBatcher:
+    def test_flushes_and_caps_batch_size(self):
+        b = TimeIntervalBatcher(iter(range(100)), interval_ms=5,
+                                max_batch_size=30)
+        batches = list(b)
+        assert all(len(x) <= 30 for x in batches)
+        assert sorted(x for bt in batches for x in bt) == list(range(100))
+
+
+class TestDefaultHyperparams:
+    def test_gbdt_table(self):
+        entries = DefaultHyperparams.for_stage(GBDTClassifier())
+        assert {e[1] for e in entries} >= {"numIterations", "learningRate",
+                                           "numLeaves"}
+
+    def test_online_table(self):
+        entries = DefaultHyperparams.for_stage(OnlineSGDRegressor())
+        assert {e[1] for e in entries} >= {"learningRate", "numPasses"}

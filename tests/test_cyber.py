@@ -1,0 +1,170 @@
+"""Cyber-ML tests (reference test model: core/src/test/python — the
+reference exercises AccessAnomaly on synthetic per-tenant access data
+and checks standardized score statistics, indexers, and scalers)."""
+
+import numpy as np
+import pytest
+
+from fuzzing import EstimatorFuzzing, TestObject, TransformerFuzzing
+from synapseml_tpu import Dataset
+from synapseml_tpu.cyber import (AccessAnomaly, AccessAnomalyModel,
+                                 ComplementAccessTransformer, IdIndexer,
+                                 LinearScalarScaler, MultiIndexer,
+                                 StandardScalarScaler)
+
+
+def _access_dataset(seed=0, n=400):
+    """Two tenants; users mostly hit a small in-group resource set."""
+    rng = np.random.default_rng(seed)
+    tenants, users, ress, likes = [], [], [], []
+    for t in ("t0", "t1"):
+        for _ in range(n // 2):
+            g = rng.integers(0, 2)            # two user/resource cliques
+            u = f"u{g}_{rng.integers(0, 8)}"
+            r = f"r{g}_{rng.integers(0, 6)}"
+            tenants.append(t)
+            users.append(u)
+            ress.append(r)
+            likes.append(float(rng.integers(1, 20)))
+    return Dataset({"tenant": np.asarray(tenants),
+                    "user": np.asarray(users),
+                    "res": np.asarray(ress),
+                    "likelihood": np.asarray(likes, np.float64)})
+
+
+class TestIndexers:
+    def test_id_indexer_roundtrip(self):
+        ds = Dataset({"tenant": np.array(["a", "a", "b", "b"]),
+                      "user": np.array(["x", "y", "x", "z"])})
+        model = IdIndexer(inputCol="user", partitionKey="tenant",
+                          outputCol="idx", resetPerPartition=True).fit(ds)
+        out = model.transform(ds)
+        # per-partition numbering restarts at 1
+        assert out["idx"].min() == 1
+        assert set(out["idx"][:2]) == {1, 2}
+        assert out["idx"][2] == 1
+        undone = model.undo_transform(out)
+        assert list(undone["user"]) == ["x", "y", "x", "z"]
+
+    def test_multi_indexer_lookup(self):
+        ds = Dataset({"tenant": np.array(["a", "a"]),
+                      "user": np.array(["x", "y"]),
+                      "res": np.array(["p", "q"])})
+        mi = MultiIndexer(indexers=[
+            IdIndexer(inputCol="user", partitionKey="tenant",
+                      outputCol="ui"),
+            IdIndexer(inputCol="res", partitionKey="tenant",
+                      outputCol="ri")])
+        mm = mi.fit(ds)
+        assert mm.get_model_by_input_col("res").outputCol == "ri"
+        out = mm.transform(ds)
+        assert "ui" in out.columns and "ri" in out.columns
+
+
+class TestScalers:
+    def test_standard_scaler_per_group(self):
+        ds = Dataset({"k": np.array(["a"] * 4 + ["b"] * 4),
+                      "v": np.array([1., 2., 3., 4., 10., 20., 30., 40.])})
+        out = StandardScalarScaler(inputCol="v", partitionKey="k",
+                                   outputCol="s").fit(ds).transform(ds)
+        for key in ("a", "b"):
+            grp = out["s"][out["k"] == key]
+            assert abs(grp.mean()) < 1e-9 and abs(grp.std() - 1.0) < 1e-9
+
+    def test_linear_scaler_range(self):
+        ds = Dataset({"k": np.array(["a"] * 3),
+                      "v": np.array([2., 4., 6.])})
+        out = LinearScalarScaler(inputCol="v", partitionKey="k",
+                                 outputCol="s", minRequiredValue=5.0,
+                                 maxRequiredValue=10.0).fit(ds).transform(ds)
+        assert out["s"].min() == 5.0 and out["s"].max() == 10.0
+
+
+class TestComplementAccess:
+    def test_complement_disjoint_from_observed(self):
+        ds = Dataset({"tenant": np.array(["a"] * 6),
+                      "ui": np.array([0, 0, 1, 1, 2, 2]),
+                      "ri": np.array([0, 1, 0, 1, 0, 1])})
+        comp = ComplementAccessTransformer(
+            partitionKey="tenant", indexedColNamesArr=["ui", "ri"],
+            complementsetFactor=3, seed=1).transform(ds)
+        observed = set(zip(ds["ui"], ds["ri"]))
+        drawn = set(zip(comp["ui"], comp["ri"]))
+        assert drawn.isdisjoint(observed)
+
+
+class TestAccessAnomaly:
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        ds = _access_dataset()
+        model = AccessAnomaly(maxIter=8, rankParam=6).fit(ds)
+        return ds, model
+
+    def test_training_scores_standardized(self, fitted):
+        ds, model = fitted
+        scores = model.transform(ds)["anomaly_score"]
+        finite = scores[np.isfinite(scores)]
+        assert abs(finite.mean()) < 0.3
+        assert 0.5 < finite.std() < 1.5
+
+    def test_cross_clique_access_is_anomalous(self, fitted):
+        ds, model = fitted
+        # in-clique pair vs cross-clique pair for tenant t0
+        probe = Dataset({"tenant": np.array(["t0", "t0"]),
+                         "user": np.array(["u0_0", "u0_0"]),
+                         "res": np.array(["r0_0", "r1_0"])})
+        s = model.transform(probe)["anomaly_score"]
+        assert s[1] > s[0]
+
+    def test_unseen_user_scores_nan(self, fitted):
+        _, model = fitted
+        probe = Dataset({"tenant": np.array(["t0"]),
+                         "user": np.array(["nobody"]),
+                         "res": np.array(["r0_0"])})
+        assert np.isnan(model.transform(probe)["anomaly_score"][0])
+
+    def test_disconnected_components_score_inf(self):
+        ds = Dataset({"tenant": np.array(["t"] * 4),
+                      "user": np.array(["a", "a", "b", "b"]),
+                      "res": np.array(["x", "x", "y", "y"]),
+                      "likelihood": np.array([3., 2., 4., 5.])})
+        model = AccessAnomaly(maxIter=4, rankParam=2).fit(ds)
+        probe = Dataset({"tenant": np.array(["t"]),
+                         "user": np.array(["a"]),
+                         "res": np.array(["y"])})
+        assert np.isposinf(model.transform(probe)["anomaly_score"][0])
+
+    def test_history_pairs_score_zero(self):
+        ds = _access_dataset(seed=2, n=120)
+        hist = Dataset({"tenant": np.array(["t0"]),
+                        "user": np.array([str(ds["user"][0])]),
+                        "res": np.array([str(ds["res"][0])])})
+        model = AccessAnomaly(maxIter=4, rankParam=4,
+                              historyAccessDs=hist).fit(ds)
+        probe = Dataset({"tenant": np.array(["t0"]),
+                         "user": np.array([str(ds["user"][0])]),
+                         "res": np.array([str(ds["res"][0])])})
+        assert model.transform(probe)["anomaly_score"][0] == 0.0
+
+    def test_explicit_cf_path(self):
+        ds = _access_dataset(seed=3, n=120)
+        model = AccessAnomaly(maxIter=4, rankParam=4,
+                              applyImplicitCf=False).fit(ds)
+        scores = model.transform(ds)["anomaly_score"]
+        assert np.isfinite(scores).any()
+
+
+class TestAccessAnomalyFuzzing(EstimatorFuzzing):
+    def fuzzing_objects(self):
+        return [TestObject(AccessAnomaly(maxIter=3, rankParam=3),
+                           _access_dataset(seed=4, n=80))]
+
+
+class TestComplementFuzzing(TransformerFuzzing):
+    def fuzzing_objects(self):
+        ds = Dataset({"tenant": np.array(["a"] * 4),
+                      "ui": np.array([0, 0, 1, 2]),
+                      "ri": np.array([0, 1, 1, 0])})
+        return [TestObject(ComplementAccessTransformer(
+            partitionKey="tenant", indexedColNamesArr=["ui", "ri"],
+            complementsetFactor=2, seed=1), ds)]
