@@ -6,5 +6,5 @@ from .params import (ArrayParam, BoolParam, ComplexParam, DatasetParam,
 from .pipeline import (Estimator, Evaluator, Model, Pipeline, PipelineModel,
                        PipelineStage, Transformer, load_dataset, load_stage,
                        save_dataset)
-from .utils import (KahanSum, SharedVariable, StopWatch, retry,
-                    retry_with_timeout, using)
+from .utils import (KahanSum, SharedVariable, StopWatch,
+                    assert_models_equal, retry, retry_with_timeout, using)

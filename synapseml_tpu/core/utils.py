@@ -158,3 +158,49 @@ class KahanSum:
 
     def __iadd__(self, x: float) -> "KahanSum":
         return self.add(x)
+
+
+def assert_models_equal(m1, m2, loose_params: Iterable[str] = ()) -> None:
+    """Assert two pipeline stages have the same class and param values.
+
+    TPU-build analogue of the reference's save/load equality check
+    (core/utils/ModelEquality.scala:15-50): identical class, identical
+    param-name sets, and equal values — except params named in
+    ``loose_params`` (the reference hard-codes uid-bearing column names
+    and randomly assigned ports), which only need matching presence.
+    Numpy-array values compare with allclose.
+    """
+    import numpy as np
+
+    if type(m1) is not type(m2):
+        raise AssertionError(f"{type(m1)} != {type(m2)}")
+    names1 = {p.name for p in m1.params}
+    names2 = {p.name for p in m2.params}
+    if names1 != names2:
+        raise AssertionError(f"param sets differ: {names1 ^ names2}")
+    loose = set(loose_params)
+    for name in sorted(names1):
+        if name in loose:
+            continue
+        v1, v2 = m1.get(name), m2.get(name)
+        if isinstance(v1, np.ndarray) or isinstance(v2, np.ndarray):
+            a1, a2 = np.asarray(v1), np.asarray(v2)
+            if a1.shape != a2.shape:
+                raise AssertionError(f"param {name}: shape {a1.shape} != {a2.shape}")
+            if a1.dtype.kind in "fc":
+                ok = np.allclose(a1, a2, equal_nan=True)
+            else:
+                ok = bool(np.array_equal(a1, a2))
+            if not ok:
+                raise AssertionError(f"param {name}: arrays differ")
+        elif callable(v1) and callable(v2):
+            continue  # UDFs compare by presence only, like ComplexParam
+        elif (v1 is not None and v2 is not None
+              and type(v1) is type(v2)
+              and type(v1).__eq__ is object.__eq__):
+            continue  # complex values with identity equality: presence only
+        elif (isinstance(v1, float) and isinstance(v2, float)
+              and np.isnan(v1) and np.isnan(v2)):
+            continue  # NaN scalars match, like equal_nan for arrays
+        elif v1 != v2:
+            raise AssertionError(f"param {name}: {v1!r} != {v2!r}")
