@@ -78,6 +78,8 @@ class BoostingConfig:
     bin_sample_count: int = 200_000
     bagging_seed: int = 3
     verbosity: int = -1
+    parallelism: str = "data_parallel"     # data_parallel | voting_parallel
+    top_k: int = 20                        # voting-parallel votes per rank
     pass_through: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def growth_params(self) -> GrowthParams:
@@ -90,6 +92,7 @@ class BoostingConfig:
             lambda_l2=self.lambda_l2,
             min_gain_to_split=self.min_gain_to_split,
             total_bins=self.max_bin + 1,
+            voting_k=self.top_k if self.parallelism == "voting_parallel" else 0,
         )
 
 

@@ -114,17 +114,14 @@ class GBDTParams(Params):
             drop_rate=self.dropRate,
             max_drop=self.maxDrop,
             skip_drop=self.skipDrop,
+            parallelism=self.parallelism,
+            top_k=self.topK,
         )
         for k, v in extra.items():
             if hasattr(cfg, k):
                 setattr(cfg, k, v)
             else:
                 cfg.pass_through[k] = v
-        if self.parallelism == "voting_parallel":
-            import logging
-            logging.getLogger("synapseml_tpu").warning(
-                "voting_parallel is not yet implemented; falling back to "
-                "data_parallel (full histogram psum)")
         return cfg
 
     def _mesh(self, n_rows: int):

@@ -48,6 +48,7 @@ class GrowthParams(NamedTuple):
     lambda_l2: float = 0.0
     min_gain_to_split: float = 0.0
     total_bins: int = 256             # B (incl. missing bin 0)
+    voting_k: int = 0                 # >0: voting-parallel with this top-k
 
 
 class Tree(NamedTuple):
@@ -98,11 +99,12 @@ def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas):
     return hist.at[flat_bins].add(upd)
 
 
-def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
-                node_depth, p: GrowthParams):
-    """Best (gain, feature, bin, left-sums) from a node histogram.
+def _gain_matrix(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
+                 node_depth, p: GrowthParams):
+    """Split-gain matrix (F, B) with invalid candidates at -inf, plus the
+    cumulative left sums (F, B, 3) the winner's child stats read from.
 
-    hist: (F, B, 3). Split at bin b sends bins<=b left, b ∈ [0, B-2].
+    Split at bin b sends bins<=b left, b ∈ [0, B-2].
     """
     F, B, _ = hist.shape
     cum = jnp.cumsum(hist, axis=1)                   # (F, B, 3)
@@ -120,12 +122,66 @@ def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
              & feature_mask[:, None])
     if p.max_depth > 0:
         valid = valid & (node_depth < p.max_depth)
-    gain = jnp.where(valid, gain, -jnp.inf)
+    return jnp.where(valid, gain, -jnp.inf), cum
+
+
+def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
+                node_depth, p: GrowthParams):
+    """Best (gain, feature, bin, left-sums) from a node histogram (F, B, 3)."""
+    F, B, _ = hist.shape
+    gain, cum = _gain_matrix(hist, sum_g, sum_h, sum_c, num_bins,
+                             feature_mask, node_depth, p)
     flat = jnp.argmax(gain)
     bf, bb = flat // B, flat % B
     bgain = gain[bf, bb]
     return bgain, bf.astype(jnp.int32), bb.astype(jnp.int32), \
-        gl[bf, bb], hl[bf, bb], cl[bf, bb]
+        cum[bf, bb, 0], cum[bf, bb, 1], cum[bf, bb, 2]
+
+
+def _best_split_voting(local_hist, sum_g, sum_h, sum_c, num_bins,
+                       feature_mask, node_depth, p: GrowthParams,
+                       axis_name: str):
+    """Voting-parallel split selection (LightGBM ``voting_parallel`` / the
+    PV-Tree algorithm; reference surfaces it as the ``parallelism`` param,
+    params/LightGBMParams.scala:25, topK LightGBMBase.scala:251).
+
+    Each rank keeps its histograms LOCAL and: (1) ranks features by local
+    best gain and votes for its top-k; (2) votes ride one tiny psum and the
+    global top-2k features are selected identically on every rank; (3) only
+    those 2k features' histograms are psum'd — O(2k·B) instead of O(F·B)
+    ICI traffic — and the true global best split is chosen among them.
+    ``sum_g/h/c`` must be the node's GLOBAL stats.
+    """
+    F, B, _ = local_hist.shape
+    k = min(p.voting_k, F)
+    sel_n = min(2 * k, F)
+
+    # (1) local view: gains against local node stats (the local root/leaf
+    # sums live in every feature's bins; feature 0 spans all rows)
+    lsum = jnp.sum(local_hist[0], axis=0)            # (3,)
+    lgain, _ = _gain_matrix(local_hist, lsum[0], lsum[1], lsum[2],
+                            num_bins, feature_mask, node_depth, p)
+    per_feat = jnp.max(lgain, axis=1)                # (F,)
+    _, local_top = lax.top_k(per_feat, k)
+    votes = jnp.zeros(F, jnp.float32).at[local_top].add(
+        jnp.where(per_feat[local_top] > -jnp.inf, 1.0, 0.0))
+    votes = lax.psum(votes, axis_name)
+
+    # (2) deterministic global top-2k: votes desc, feature index asc
+    # (exact in f32 while votes·(F+1)+F < 2^24)
+    score = votes * jnp.float32(F + 1) + jnp.arange(F - 1, -1, -1,
+                                                    dtype=jnp.float32)
+    _, sel = lax.top_k(score, sel_n)
+    sel = sel.astype(jnp.int32)
+
+    # (3) aggregate only the voted features; pick the global best among them
+    glob = lax.psum(local_hist[sel], axis_name)      # (sel_n, B, 3)
+    ggain, cum = _gain_matrix(glob, sum_g, sum_h, sum_c, num_bins[sel],
+                              feature_mask[sel], node_depth, p)
+    flat = jnp.argmax(ggain)
+    bi, bb = flat // B, flat % B
+    return ggain[bi, bb], sel[bi], bb.astype(jnp.int32), \
+        cum[bi, bb, 0], cum[bi, bb, 1], cum[bi, bb, 2]
 
 
 @functools.partial(jax.jit, static_argnames=("p", "axis_name", "use_pallas"))
@@ -152,8 +208,22 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     L = p.num_leaves
     M = max_nodes(L)
 
+    # voting-parallel keeps histograms local and aggregates only the voted
+    # features inside _best_split_voting; full data-parallel psums every
+    # histogram as it is built
+    voting = p.voting_k > 0 and axis_name is not None
+
     def ar(x):
-        return lax.psum(x, axis_name) if axis_name else x
+        return lax.psum(x, axis_name) if (axis_name and not voting) else x
+
+    if voting:
+        def pick(hist3, g, h, c, depth):
+            return _best_split_voting(hist3, g, h, c, num_bins, feature_mask,
+                                      depth, p, axis_name)
+    else:
+        def pick(hist3, g, h, c, depth):
+            return _best_split(hist3, g, h, c, num_bins, feature_mask,
+                               depth, p)
 
     flat_bins = None
     if not use_pallas:
@@ -162,9 +232,10 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     # root
     root_hist = ar(_build_hist(bins_t, flat_bins, grad, hess,
                                row_valid, F, B, use_pallas)).reshape(F, B, 3)
-    root_g = jnp.sum(root_hist[0, :, 0])
-    root_h = jnp.sum(root_hist[0, :, 1])
-    root_c = jnp.sum(root_hist[0, :, 2])
+    root_stats = jnp.sum(root_hist[0], axis=0)
+    if voting:
+        root_stats = lax.psum(root_stats, axis_name)
+    root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
 
     # per-node state
     zi = jnp.zeros(M, jnp.int32)
@@ -192,9 +263,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         next_slot=jnp.ones((), jnp.int32),
     )
 
-    bg, bf_, bb, bgl, bhl, bcl = _best_split(
-        root_hist, root_g, root_h, root_c, num_bins, feature_mask,
-        jnp.zeros((), jnp.int32), p)
+    bg, bf_, bb, bgl, bhl, bcl = pick(root_hist, root_g, root_h, root_c,
+                                      jnp.zeros((), jnp.int32))
     state["best_gain"] = state["best_gain"].at[0].set(bg)
     state["best_feat"] = state["best_feat"].at[0].set(bf_)
     state["best_bin"] = state["best_bin"].at[0].set(bb)
@@ -227,10 +297,10 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         rg, rh, rc = s["sum_g"][leaf] - lg, s["sum_h"][leaf] - lh, s["sum_c"][leaf] - lc
         cdepth = s["depth"][leaf] + 1
 
-        lbg, lbf, lbb, lbgl, lbhl, lbcl = _best_split(
-            l_hist.reshape(F, B, 3), lg, lh, lc, num_bins, feature_mask, cdepth, p)
-        rbg, rbf, rbb, rbgl, rbhl, rbcl = _best_split(
-            r_hist.reshape(F, B, 3), rg, rh, rc, num_bins, feature_mask, cdepth, p)
+        lbg, lbf, lbb, lbgl, lbhl, lbcl = pick(
+            l_hist.reshape(F, B, 3), lg, lh, lc, cdepth)
+        rbg, rbf, rbb, rbgl, rbhl, rbcl = pick(
+            r_hist.reshape(F, B, 3), rg, rh, rc, cdepth)
 
         thr = jnp.where(sbin >= 1, upper_bounds[feat, jnp.maximum(sbin - 1, 0)],
                         -jnp.inf)

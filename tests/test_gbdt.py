@@ -125,6 +125,46 @@ def test_distributed_matches_single_device():
                                atol=1e-4)
 
 
+def test_voting_parallel_close_to_data_parallel():
+    """Voting parallel (PV-Tree) aggregates only voted features; with
+    top_k >= the number of informative features it should find essentially
+    the same trees (reference param: params/LightGBMParams.scala:25)."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = binary_data(n=4000)
+    mesh = data_parallel_mesh(8)
+    full = BoostingConfig(objective="binary", num_iterations=10,
+                          num_leaves=15, min_data_in_leaf=5)
+    vote = BoostingConfig(objective="binary", num_iterations=10,
+                          num_leaves=15, min_data_in_leaf=5,
+                          parallelism="voting_parallel", top_k=6)
+    bf, _ = train(X, y, full, mesh=mesh)
+    bv, _ = train(X, y, vote, mesh=mesh)
+    auc_f = auc(y, 1 / (1 + np.exp(-bf.predict_margin(X))))
+    auc_v = auc(y, 1 / (1 + np.exp(-bv.predict_margin(X))))
+    assert auc_v > auc_f - 0.01
+    # with top_k = F every feature is aggregated → exactly data-parallel
+    exact = BoostingConfig(objective="binary", num_iterations=4,
+                           num_leaves=7, min_data_in_leaf=5,
+                           parallelism="voting_parallel", top_k=X.shape[1])
+    be, _ = train(X, y, exact, mesh=mesh)
+    ref = BoostingConfig(objective="binary", num_iterations=4,
+                         num_leaves=7, min_data_in_leaf=5)
+    br, _ = train(X, y, ref, mesh=mesh)
+    np.testing.assert_allclose(be.predict_margin(X), br.predict_margin(X),
+                               atol=1e-4)
+
+
+def test_voting_parallel_estimator():
+    X, y = binary_data(n=2000)
+    ds = vec_dataset(X, y)
+    clf = GBDTClassifier(featuresCol="features", labelCol="label",
+                         numIterations=8, numLeaves=15, minDataInLeaf=5,
+                         parallelism="voting_parallel", topK=6, numShards=8)
+    model = clf.fit(ds)
+    out = model.transform(ds)
+    assert auc(y, np.stack(out["probability"])[:, 1]) > 0.85
+
+
 def test_model_string_roundtrip():
     X, y = binary_data(n=1000)
     cfg = BoostingConfig(objective="binary", num_iterations=5,
