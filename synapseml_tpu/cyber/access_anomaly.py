@@ -162,27 +162,55 @@ class AccessAnomalyModel(Model):
         rcomp = self.get("resComponents") or {}
         history = {tuple(t) for t in (self.get("historyPairs") or [])}
 
-        tenants = ds[self.tenantCol]
-        users = ds[self.userCol]
-        ress = ds[self.resCol]
+        tenants = np.asarray([str(t) for t in ds[self.tenantCol]], object)
+        users = np.asarray([str(u) for u in ds[self.userCol]], object)
+        ress = np.asarray([str(r) for r in ds[self.resCol]], object)
         out = np.full(ds.num_rows, np.nan, np.float64)
-        for i in range(ds.num_rows):
-            t, u, r = str(tenants[i]), str(users[i]), str(ress[i])
-            if (t, u, r) in history:
-                out[i] = 0.0
-                continue
-            uv = uvecs.get(t, {}).get(u)
-            rv = rvecs.get(t, {}).get(r)
-            if uv is None or rv is None:
-                continue                       # reference emits null
-            cu = ucomp.get(t, {}).get(u)
-            cr = rcomp.get(t, {}).get(r)
-            if cu is not None and cr is not None and cu != cr:
-                out[i] = np.inf
-                continue
+
+        # batch per tenant: dict lookups once per unique entity, all dot
+        # products in one einsum per tenant (scoring is the volume path)
+        for t in dict.fromkeys(tenants):
+            rows = np.nonzero(tenants == t)[0]
+            uv_map, rv_map = uvecs.get(t, {}), rvecs.get(t, {})
             s = stats.get(t, {"mean": 0.0, "std": 1.0})
             std = s["std"] if s["std"] != 0.0 else 1.0
-            out[i] = (s["mean"] - float(np.dot(uv, rv))) / std
+
+            uniq_u = list(dict.fromkeys(users[rows]))
+            uniq_r = list(dict.fromkeys(ress[rows]))
+            u_idx = {u: i for i, u in enumerate(uniq_u)}
+            r_idx = {r: i for i, r in enumerate(uniq_r)}
+            rank = len(next(iter(uv_map.values()))) if uv_map else 1
+            u_mat = np.zeros((len(uniq_u), rank))
+            u_known = np.zeros(len(uniq_u), bool)
+            for i, u in enumerate(uniq_u):
+                v = uv_map.get(u)
+                if v is not None:
+                    u_mat[i], u_known[i] = v, True
+            r_mat = np.zeros((len(uniq_r), rank))
+            r_known = np.zeros(len(uniq_r), bool)
+            for i, r in enumerate(uniq_r):
+                v = rv_map.get(r)
+                if v is not None:
+                    r_mat[i], r_known[i] = v, True
+
+            ui = np.array([u_idx[u] for u in users[rows]])
+            ri = np.array([r_idx[r] for r in ress[rows]])
+            dots = np.einsum("ik,ik->i", u_mat[ui], r_mat[ri])
+            scores = (s["mean"] - dots) / std
+            scores[~(u_known[ui] & r_known[ri])] = np.nan  # reference: null
+
+            uc, rc = ucomp.get(t, {}), rcomp.get(t, {})
+            if uc and rc:
+                cu = np.array([uc.get(u, -1) for u in uniq_u])[ui]
+                cr = np.array([rc.get(r, -2) for r in uniq_r])[ri]
+                cross = (cu >= 0) & (cr >= 0) & (cu != cr)
+                scores[cross & (u_known[ui] & r_known[ri])] = np.inf
+
+            if history:
+                in_hist = np.array([(t, u, r) in history
+                                    for u, r in zip(users[rows], ress[rows])])
+                scores[in_hist] = 0.0
+            out[rows] = scores
         return ds.with_column(self.outputCol, out)
 
 
@@ -276,9 +304,13 @@ class AccessAnomaly(Estimator):
             ri = np.array([uniq_r[r] for r in t_ress])
             scaled = self._scale_likelihood(likes[idx])
 
+            # duplicate (user, res) rows aggregate (every access counts,
+            # matching ALS-over-rows semantics); mask from the index pairs
+            # so zero/negative scaled likelihoods still count as observed
             dense = np.zeros((nu, nr), np.float32)
-            dense[ui, ri] = scaled
-            observed = dense > 0
+            np.add.at(dense, (ui, ri), scaled)
+            observed = np.zeros((nu, nr), bool)
+            observed[ui, ri] = True
             if bool(self.applyImplicitCf):
                 # Hu-Koren: confidence 1 + alpha·r everywhere, binary
                 # preference target (reference builds the implicit ALS at

@@ -90,7 +90,11 @@ def vectorize_vw_lines(lines, num_bits: int, seed: int
         label, imp, feats = parse_vw_line(str(line))
         if label is not None:
             y[i] = label
-        w[i] = imp
+            w[i] = imp
+        else:
+            # VW treats label-less lines as predict-only examples; zero
+            # importance keeps them out of the loss without reindexing
+            w[i] = 0.0
         for ns, name, value in feats:
             idx = murmurhash3_32(ns + name, seed) % dim
             x[i, idx] += value

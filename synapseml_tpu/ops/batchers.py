@@ -26,10 +26,40 @@ class _BufferedBatcherBase(Iterator[List[T]]):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_buffer_size)
         self._started = False
         self._done = threading.Event()
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._produce, daemon=True)
 
     def _produce(self) -> None:
+        try:
+            self._fill()
+        except BaseException as e:  # re-raised on the consumer thread
+            self._error = e
+        finally:
+            try:  # a closed-and-full pipeline has no consumer to signal
+                self._queue.put(_SENTINEL, timeout=0.1)
+            except queue.Full:
+                pass
+
+    def _fill(self) -> None:
         raise NotImplementedError
+
+    def _put(self, item) -> bool:
+        """Enqueue, waking periodically so close() can unblock a producer
+        parked on a full queue; False once closed (stop producing)."""
+        while not self._done.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _exhausted(self) -> None:
+        """Sentinel seen: stay exhausted, surface any producer error."""
+        self._queue.put(_SENTINEL)
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
     def start(self) -> None:
         if not self._started:
@@ -38,6 +68,8 @@ class _BufferedBatcherBase(Iterator[List[T]]):
 
     def close(self) -> None:
         self._done.set()
+        if self._started:
+            self._thread.join(timeout=1.0)
 
     def __iter__(self) -> "Iterator[List[T]]":
         return self
@@ -51,20 +83,16 @@ class DynamicBufferedBatcher(_BufferedBatcherBase):
     def __init__(self, it: Iterable[T], max_buffer_size: int = 2 ** 30):
         super().__init__(it, max_buffer_size)
 
-    def _produce(self) -> None:
-        try:
-            for item in self._source:
-                if self._done.is_set():
-                    return
-                self._queue.put(item)
-        finally:
-            self._queue.put(_SENTINEL)
+    def _fill(self) -> None:
+        for item in self._source:
+            if not self._put(item):
+                return
 
     def __next__(self) -> List[T]:
         self.start()
         first = self._queue.get()
         if first is _SENTINEL:
-            self._queue.put(_SENTINEL)  # stay exhausted on repeat next()
+            self._exhausted()
             raise StopIteration
         batch = [first]
         while True:
@@ -88,26 +116,24 @@ class FixedBufferedBatcher(_BufferedBatcherBase):
         super().__init__(it, max_buffer_size)
         self.batch_size = int(batch_size)
 
-    def _produce(self) -> None:
-        try:
-            batch: List[T] = []
-            for item in self._source:
-                if self._done.is_set():
+    def _fill(self) -> None:
+        batch: List[T] = []
+        for item in self._source:
+            if self._done.is_set():
+                return
+            batch.append(item)
+            if len(batch) >= self.batch_size:
+                if not self._put(batch):
                     return
-                batch.append(item)
-                if len(batch) >= self.batch_size:
-                    self._queue.put(batch)
-                    batch = []
-            if batch:
-                self._queue.put(batch)
-        finally:
-            self._queue.put(_SENTINEL)
+                batch = []
+        if batch:
+            self._put(batch)
 
     def __next__(self) -> List[T]:
         self.start()
         item = self._queue.get()
         if item is _SENTINEL:
-            self._queue.put(_SENTINEL)  # stay exhausted on repeat next()
+            self._exhausted()
             raise StopIteration
         return item
 
@@ -128,20 +154,16 @@ class TimeIntervalBatcher(_BufferedBatcherBase):
         self.interval_s = interval_ms / 1000.0
         self.max_batch_size = max_batch_size
 
-    def _produce(self) -> None:
-        try:
-            for item in self._source:
-                if self._done.is_set():
-                    return
-                self._queue.put(item)
-        finally:
-            self._queue.put(_SENTINEL)
+    def _fill(self) -> None:
+        for item in self._source:
+            if not self._put(item):
+                return
 
     def __next__(self) -> List[T]:
         self.start()
         first = self._queue.get()
         if first is _SENTINEL:
-            self._queue.put(_SENTINEL)  # stay exhausted on repeat next()
+            self._exhausted()
             raise StopIteration
         batch = [first]
         deadline = time.monotonic() + self.interval_s

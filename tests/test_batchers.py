@@ -63,3 +63,40 @@ class TestDefaultHyperparams:
     def test_online_table(self):
         entries = DefaultHyperparams.for_stage(OnlineSGDRegressor())
         assert {e[1] for e in entries} >= {"learningRate", "numPasses"}
+
+
+class TestProducerErrorPropagation:
+    def test_fixed_batcher_reraises_source_error(self):
+        import pytest
+        from synapseml_tpu.ops.batchers import FixedBufferedBatcher
+
+        def flaky():
+            yield 1
+            yield 2
+            raise RuntimeError("source died")
+
+        b = FixedBufferedBatcher(flaky(), batch_size=2)
+        assert next(b) == [1, 2]
+        with pytest.raises(RuntimeError, match="source died"):
+            next(b)
+
+    def test_dynamic_batcher_reraises_source_error(self):
+        import pytest
+        from synapseml_tpu.ops.batchers import DynamicBufferedBatcher
+
+        def flaky():
+            raise RuntimeError("immediate")
+            yield  # pragma: no cover
+
+        with pytest.raises(RuntimeError, match="immediate"):
+            next(DynamicBufferedBatcher(flaky()))
+
+    def test_close_unblocks_full_queue_producer(self):
+        import itertools
+        from synapseml_tpu.ops.batchers import FixedBufferedBatcher
+
+        b = FixedBufferedBatcher(itertools.count(), batch_size=1,
+                                 max_buffer_size=2)
+        assert next(b) == [0]
+        b.close()                      # producer parked on full queue
+        assert not b._thread.is_alive()

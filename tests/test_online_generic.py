@@ -83,3 +83,15 @@ class TestOnlineGeneric:
     def test_training_stats_attached(self):
         model = OnlineGeneric(numBits=8).fit(_vw_corpus(n=40))
         assert "average_loss" in model.training_stats
+
+
+def test_unlabeled_lines_do_not_train():
+    """Label-less VW lines are predict-only: zero importance weight
+    (matches VW's handling of unlabeled examples)."""
+    import numpy as np
+    from synapseml_tpu.models.online.generic import vectorize_vw_lines
+
+    x, y, w = vectorize_vw_lines(["1 |f a", "|f b", "-1 2.0 |f c"],
+                                 num_bits=8, seed=0)
+    assert w.tolist() == [1.0, 0.0, 2.0]
+    assert x[1].sum() > 0          # features still hashed for prediction
