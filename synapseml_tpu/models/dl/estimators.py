@@ -147,6 +147,13 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
     modelSize = StringParam(doc="tiny|small|base", default="small",
                             allowed=("tiny", "small", "base"))
     dropoutRate = FloatParam(doc="dropout rate", default=0.1)
+    numExperts = IntParam(doc="0 = dense FFN; >0 = MoE FFN with this many "
+                              "experts, sharded over the mesh expert axis",
+                          default=0)
+    moeTopK = IntParam(doc="MoE router top-k", default=2)
+    expertParallelism = IntParam(doc="expert-axis mesh size (>1 shards "
+                                     "experts over chips; requires "
+                                     "numExperts > 0)", default=1)
 
     def _model_config(self, num_classes: int) -> TransformerConfig:
         sizes = {
@@ -156,7 +163,8 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         }[self.modelSize]
         return TransformerConfig(
             vocab_size=self.vocabSize, max_len=self.maxTokenLen,
-            num_classes=num_classes, dropout_rate=self.dropoutRate, **sizes)
+            num_classes=num_classes, dropout_rate=self.dropoutRate,
+            num_experts=self.numExperts, moe_top_k=self.moeTopK, **sizes)
 
     def _fit(self, ds: Dataset) -> "DeepTextModel":
         texts = list(ds[self.textCol])
@@ -168,8 +176,25 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         tokenizer = WordTokenizer.fit(texts, self.vocabSize)
         ids, mask = tokenizer.encode(texts, self.maxTokenLen)
 
-        mesh = make_dl_mesh(self.modelParallelism,
-                            self.numDevices or None)
+        ep = int(self.expertParallelism)
+        if ep > 1:
+            if self.numExperts <= 0:
+                raise ValueError("expertParallelism > 1 requires "
+                                 "numExperts > 0 (MoE FFN)")
+            if self.numExperts % ep:
+                raise ValueError(
+                    f"numExperts={self.numExperts} must be divisible by "
+                    f"expertParallelism={ep} to shard experts evenly")
+            from ...parallel.mesh import dp_ep_mesh
+            devs = jax.devices()[:self.numDevices or None]
+            if len(devs) % ep:
+                raise ValueError(
+                    f"expertParallelism={ep} does not divide the "
+                    f"{len(devs)} available devices")
+            mesh = dp_ep_mesh(ep, devs)
+        else:
+            mesh = make_dl_mesh(self.modelParallelism,
+                                self.numDevices or None)
         shards = mesh.shape["data"]
 
         # validationFraction: hold out rows for per-epoch eval logging

@@ -86,12 +86,16 @@ def make_dl_mesh(tp: int = 1, num_devices: Optional[int] = None) -> Mesh:
     return dp_tp_mesh(tp, devs)
 
 
+def usable_rules(mesh: Mesh, rules=LOGICAL_RULES):
+    """Logical→mesh rules restricted to axes this mesh actually has
+    (tp=1 ⇒ no "model" axis, dense model ⇒ no "expert" axis, ...)."""
+    return [(log, phys if phys in mesh.axis_names else None)
+            for log, phys in rules]
+
+
 def _state_shardings(abs_state, mesh: Mesh, rules=LOGICAL_RULES):
-    # drop rules whose mesh axis doesn't exist (e.g. tp=1 ⇒ no "model" axis)
-    usable = [(log, phys if phys in mesh.axis_names else None)
-              for log, phys in rules]
     specs = nn.get_partition_spec(abs_state)
-    return nn.logical_to_mesh_sharding(specs, mesh, usable)
+    return nn.logical_to_mesh_sharding(specs, mesh, usable_rules(mesh, rules))
 
 
 class DLTrainer:
@@ -113,6 +117,7 @@ class DLTrainer:
         self._step_fn = None
         self._eval_fn = None
         self.state_shardings = None
+        self._rules = usable_rules(mesh)
 
     # -- init --------------------------------------------------------------
     def _make_state(self, rng, *sample_inputs) -> TrainState:
@@ -120,7 +125,9 @@ class DLTrainer:
                                           else True)}
         variables = self.model.init(rng, *sample_inputs, **call_kwargs)
         params = variables["params"]
-        extra = {k: v for k, v in variables.items() if k != "params"}
+        # "losses" is per-step scratch (sown aux objectives), not state
+        extra = {k: v for k, v in variables.items()
+                 if k not in ("params", "losses")}
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           extra_vars=extra, opt_state=self.tx.init(params),
                           tx=self.tx, apply_fn=self.model.apply)
@@ -146,15 +153,23 @@ class DLTrainer:
                 variables = {"params": params, **state.extra_vars}
                 kwargs = dict(train_flag)
                 rngs = {"dropout": jax.random.fold_in(dropout_key, state.step)}
-                if self.has_batch_stats:
+                # "losses" collects auxiliary objectives sown by layers
+                # (e.g. the MoE load-balance loss) — always mutable so the
+                # sows land; empty for models that sow nothing.  The bound
+                # logical rules make nn.with_logical_constraint on
+                # activations effective inside this mesh's jit.
+                with self.mesh, nn.logical_axis_rules(self._rules):
                     logits, updates = state.apply_fn(
                         variables, *inputs, **kwargs,
-                        mutable=["batch_stats"], rngs=rngs)
-                else:
-                    logits = state.apply_fn(variables, *inputs, **kwargs,
-                                            rngs=rngs)
-                    updates = {}
-                return self.loss_fn(logits, labels), (logits, updates)
+                        mutable=["batch_stats", "losses"], rngs=rngs)
+                updates = dict(updates)
+                aux = sum((jnp.sum(leaf) for leaf in
+                           jax.tree_util.tree_leaves(updates.pop("losses", {}))),
+                          jnp.zeros((), jnp.float32))
+                if not self.has_batch_stats:
+                    updates.pop("batch_stats", None)
+                loss = self.loss_fn(logits, labels) + aux
+                return loss, (logits, updates)
 
             (loss, (logits, updates)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(state.params)
@@ -185,7 +200,8 @@ class DLTrainer:
 
             def ev(state: TrainState, inputs: Tuple):
                 variables = {"params": state.params, **state.extra_vars}
-                return state.apply_fn(variables, *inputs, **eval_flag)
+                with self.mesh, nn.logical_axis_rules(self._rules):
+                    return state.apply_fn(variables, *inputs, **eval_flag)
 
             self._eval_fn = jax.jit(ev)
         return self._eval_fn

@@ -38,6 +38,7 @@ LOGICAL_RULES = (
     ("pos", None),
     ("pooled", None),
     ("classes", None),
+    ("expert", "expert"),
 )
 
 
@@ -55,6 +56,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
     seq_axis: str = "seq"
+    num_experts: int = 0              # >0: MoE FFN on every moe_layer_freq-th block
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_layer_freq: int = 2
 
     @staticmethod
     def bert_base(num_classes: int = 2, **kw) -> "TransformerConfig":
@@ -126,6 +131,7 @@ class SelfAttention(nn.Module):
 
 class EncoderBlock(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
@@ -133,9 +139,16 @@ class EncoderBlock(nn.Module):
         a = SelfAttention(cfg, name="attention")(x, mask, deterministic)
         a = nn.Dropout(cfg.dropout_rate)(a, deterministic=deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_att")(x + a)
-        h = _dense(cfg.d_ff, ("embed", "mlp"), "ffn_up", cfg.dtype)(x)
-        h = nn.gelu(h)
-        h = _dense(cfg.d_model, ("mlp", "embed"), "ffn_down", cfg.dtype)(h)
+        if self.use_moe:
+            from .moe import MoEFFN
+            h = MoEFFN(num_experts=cfg.num_experts, d_ff=cfg.d_ff,
+                       top_k=cfg.moe_top_k,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       dtype=cfg.dtype, name="moe_ffn")(x, deterministic)
+        else:
+            h = _dense(cfg.d_ff, ("embed", "mlp"), "ffn_up", cfg.dtype)(x)
+            h = nn.gelu(h)
+            h = _dense(cfg.d_model, ("mlp", "embed"), "ffn_down", cfg.dtype)(h)
         h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         return nn.LayerNorm(dtype=cfg.dtype, name="ln_ffn")(x + h)
 
@@ -168,8 +181,10 @@ class TextEncoder(nn.Module):
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
 
         for i in range(cfg.num_layers):
-            x = EncoderBlock(cfg, name=f"layer_{i}")(x, attention_mask,
-                                                     deterministic)
+            moe = (cfg.num_experts > 0
+                   and i % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
+            x = EncoderBlock(cfg, use_moe=moe, name=f"layer_{i}")(
+                x, attention_mask, deterministic)
         if return_embeddings:
             return x
 

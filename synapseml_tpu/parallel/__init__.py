@@ -3,7 +3,7 @@ from .collectives import (all_gather, allreduce_fn, axis_index, barrier,
                           ring_shift, shard_map_over)
 from .distributed import ClusterConfig, initialize_cluster, shutdown_cluster
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
-                   batch_sharding, data_parallel_mesh, dp_sp_tp_mesh,
+                   batch_sharding, data_parallel_mesh, dp_ep_mesh, dp_sp_tp_mesh,
                    dp_tp_mesh, local_mesh_devices, make_mesh, pad_to_multiple,
                    replicated, shard_batch)
 from .placement import PlacementMap, place_partitions, rows_for_rank

@@ -75,6 +75,11 @@ def dp_sp_tp_mesh(sp: int, tp: int,
     return make_mesh({DATA_AXIS: -1, SEQ_AXIS: sp, MODEL_AXIS: tp}, devices)
 
 
+def dp_ep_mesh(ep: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """(data, expert) mesh: MoE dispatch all_to_alls ride the expert axis."""
+    return make_mesh({DATA_AXIS: -1, EXPERT_AXIS: ep}, devices)
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 2, axis: str = DATA_AXIS) -> NamedSharding:
     """Shard dim 0 along the data axis, replicate the rest."""
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
