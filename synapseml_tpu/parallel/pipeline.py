@@ -1,0 +1,115 @@
+"""GPipe-style pipeline parallelism over a ``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.3: PP — "No"); this is
+TPU-native capability.  The formulation is SPMD pipelining under
+``shard_map``: every stage runs the SAME program, holding its own slice of
+a stage-stacked parameter pytree (leading dim = ``pipe`` axis), and
+activations rotate one hop per tick with ``lax.ppermute`` — the collective
+rides ICI neighbors, which is exactly the physical topology a pipeline
+wants.  A microbatch enters at stage 0 each tick; after ``S-1`` fill ticks
+the pipe is full and every tick retires one microbatch at the last stage.
+Total ticks ``T = M + S - 1`` for M microbatches over S stages; bubble
+fraction ``(S-1)/T`` shrinks as M grows, as in GPipe.
+
+Everything is differentiable (``ppermute`` transposes to the reverse
+permutation), so ``jax.grad`` through :func:`pipeline_apply` yields the
+1F1B-equivalent backward schedule automatically from XLA's scheduling of
+the transposed loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import PIPE_AXIS
+
+__all__ = ["pipeline_apply", "pipeline_loss", "stack_stage_params",
+           "PIPE_AXIS"]
+
+
+def stack_stage_params(per_stage_params: Sequence[Any]):
+    """Stack S per-stage pytrees into one pytree with a leading stage dim
+    (shard it over the ``pipe`` axis; each rank then sees its own slice)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def _run_schedule(stage_fn, stacked_params, microbatches, axis_name):
+    """The tick loop; returns (outputs valid on last stage, stage, S)."""
+    params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    M = microbatches.shape[0]
+    stage = lax.axis_index(axis_name)
+    S = lax.psum(1, axis_name)
+    T = M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped gather keeps shapes static;
+        # ingested garbage for t >= M never reaches an output slot)
+        inp = lax.dynamic_index_in_dim(microbatches, jnp.minimum(t, M - 1),
+                                       axis=0, keepdims=False)
+        state = jnp.where(stage == 0, inp, state)
+        out = stage_fn(params, state)
+        # last stage retires microbatch t-(S-1) at tick t
+        retire = t - (S - 1)
+        outputs = jnp.where(
+            (stage == S - 1) & (retire >= 0),
+            lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.maximum(retire, 0), axis=0),
+            outputs)
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(T))
+    return outputs, stage, S
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stacked_params: Any,
+                   microbatches: jnp.ndarray,
+                   axis_name: str = PIPE_AXIS) -> jnp.ndarray:
+    """Run microbatches through the S-stage pipeline.  MUST be called
+    inside ``shard_map`` with ``axis_name`` bound and ``stacked_params``
+    sharded so each rank's slice has leading dim 1.
+
+    stage_fn: (params_of_one_stage, activation (mb, ...)) -> activation.
+    microbatches: (M, mb, ...) — the same array on every stage (stage 0 is
+    the only consumer; replicating it avoids a scatter).
+    Returns (M, mb, ...) outputs, valid on every stage (broadcast from the
+    last stage by the closing psum).
+
+    For TRAINING use :func:`pipeline_loss`: differentiating through this
+    broadcast with an identical per-rank loss inflates gradients by S
+    (every rank seeds the same cotangent into the psum transpose).
+    """
+    outputs, stage, S = _run_schedule(stage_fn, stacked_params,
+                                      microbatches, axis_name)
+    return lax.psum(jnp.where(stage == S - 1, outputs, 0.0), axis_name)
+
+
+def pipeline_loss(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                  stacked_params: Any,
+                  microbatches: jnp.ndarray,
+                  loss_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                  axis_name: str = PIPE_AXIS) -> jnp.ndarray:
+    """Pipeline forward + scalar loss: ``loss_fn`` (outputs (M, mb, ...) →
+    scalar) is evaluated on the broadcast outputs, identically on every
+    rank — never on another stage's zero-filled buffer, so losses with
+    singular derivatives at 0 (log, sqrt, 1/x) stay NaN-free.
+
+    Differentiate by taking ``jax.grad`` OUTSIDE the shard_map wrapping
+    this function (out_specs ``P()``) — that seeds one cotangent for the
+    replicated scalar and the transposed ppermute schedule delivers exact
+    per-stage gradients.  ``jax.grad`` INSIDE the shard_map would seed once
+    per rank and inflate every gradient by the stage count.
+    """
+    return loss_fn(pipeline_apply(stage_fn, stacked_params, microbatches,
+                                  axis_name))
