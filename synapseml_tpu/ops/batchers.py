@@ -35,10 +35,10 @@ class _BufferedBatcherBase(Iterator[List[T]]):
         except BaseException as e:  # re-raised on the consumer thread
             self._error = e
         finally:
-            try:  # a closed-and-full pipeline has no consumer to signal
-                self._queue.put(_SENTINEL, timeout=0.1)
-            except queue.Full:
-                pass
+            # keep trying while the batcher is live — a busy consumer may
+            # hold the queue full for a while; _put gives up only after
+            # close(), when there is no consumer left to signal
+            self._put(_SENTINEL)
 
     def _fill(self) -> None:
         raise NotImplementedError

@@ -100,3 +100,16 @@ class TestProducerErrorPropagation:
         assert next(b) == [0]
         b.close()                      # producer parked on full queue
         assert not b._thread.is_alive()
+
+    def test_sentinel_survives_busy_consumer(self):
+        """Producer finishing while the queue is full must still deliver
+        end-of-stream once the consumer catches up (no dropped sentinel)."""
+        import time
+        from synapseml_tpu.ops.batchers import FixedBufferedBatcher
+
+        b = FixedBufferedBatcher(iter(range(6)), batch_size=2,
+                                 max_buffer_size=2)
+        assert next(b) == [0, 1]
+        time.sleep(0.3)            # producer hits full queue + exhausts src
+        rest = list(b)             # must terminate, not hang
+        assert rest == [[2, 3], [4, 5]]
