@@ -57,8 +57,16 @@ def murmurhash3_32(data: Union[bytes, str], seed: int = 0) -> int:
 
 
 def murmurhash3_column(tokens: Iterable[str], seed: int = 0) -> np.ndarray:
-    """Hash every token of a column in one call -> uint32 array."""
-    return np.fromiter((murmurhash3_32(t, seed) for t in tokens),
+    """Hash every token of a column in one call -> uint32 array.
+
+    Uses the native batch hasher (synapseml_tpu/native/textproc.cpp) when
+    the toolchain is available; Python murmur otherwise."""
+    toks = tokens if isinstance(tokens, (list, tuple)) else list(tokens)
+    from ..native import murmur3_batch
+    hashed = murmur3_batch(toks, seed)
+    if hashed is not None:
+        return hashed
+    return np.fromiter((murmurhash3_32(t, seed) for t in toks),
                        dtype=np.uint32)
 
 

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ...core.dataset import Dataset
-from ...core.hashing import murmurhash3_32
+from ...core.hashing import murmurhash3_32, murmurhash3_column
 from ...core.params import (BoolParam, IntParam, ListParam, StringParam)
 from ...core.pipeline import Transformer
 
@@ -62,18 +62,26 @@ class HashingFeaturizer(Transformer):
                 else:
                     out[:, idx] = vals
             else:
-                prefix = c.encode("utf-8")
+                # flatten (row, token) pairs and hash the whole column in
+                # one native batch call (textproc.cpp), then scatter
+                rows: List[int] = []
+                flat: List[str] = []
                 for i, x in enumerate(v):
                     tokens = x if isinstance(x, (list, tuple, np.ndarray)) else [x]
                     for t in tokens:
-                        h = murmurhash3_32(prefix + str(t).encode("utf-8"), seed)
-                        val = 1.0
-                        if self.signedMode and (h >> 31) & 1:
-                            val = -1.0
-                        if self.sumCollisions:
-                            out[i, h % dim] += val
-                        else:
-                            out[i, h % dim] = val
+                        rows.append(i)
+                        flat.append(c + str(t))
+                if not flat:
+                    continue
+                hashes = murmurhash3_column(flat, seed).astype(np.int64)
+                ridx = np.asarray(rows, np.int64)
+                vals = np.ones(len(flat), np.float32)
+                if self.signedMode:
+                    vals = np.where((hashes >> 31) & 1, -1.0, 1.0).astype(np.float32)
+                if self.sumCollisions:
+                    np.add.at(out, (ridx, hashes % dim), vals)
+                else:
+                    out[ridx, hashes % dim] = vals
         return ds.with_column(self.outputCol, [row for row in out])
 
 

@@ -83,6 +83,16 @@ def vectorize_vw_lines(lines, num_bits: int, seed: int
     semantics: feature index = murmur(ns + name))."""
     dim = 1 << num_bits
     n = len(lines)
+    # native C++ parser+hasher when the toolchain is up (the reference's
+    # VW parse path is native C++ behind JNI; ours is ctypes)
+    from ...native import coo_densify, vw_parse_batch
+    parsed = vw_parse_batch(lines, num_bits, seed)
+    if parsed is not None:
+        rows, idxs, vals, y, w, _has = parsed
+        x = np.zeros((n, dim), np.float32)
+        if not coo_densify(rows, idxs, vals, x):
+            np.add.at(x, (rows, idxs), vals)
+        return x, y, w
     x = np.zeros((n, dim), np.float32)
     y = np.zeros(n, np.float32)
     w = np.ones(n, np.float32)

@@ -22,9 +22,12 @@ from typing import Optional, Tuple
 import numpy as np
 
 _SRC = os.path.join(os.path.dirname(__file__), "loader.cpp")
+_TEXT_SRC = os.path.join(os.path.dirname(__file__), "textproc.cpp")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
+_TEXTLIB: Optional[ctypes.CDLL] = None
+_TEXTLIB_FAILED = False
 
 
 def _cache_dir() -> str:
@@ -34,16 +37,16 @@ def _cache_dir() -> str:
     return root
 
 
-def _build_library() -> Optional[str]:
-    with open(_SRC, "rb") as f:
+def _compile_source(src_path: str, stem: str) -> Optional[str]:
+    with open(src_path, "rb") as f:
         src = f.read()
     tag = hashlib.sha256(src).hexdigest()[:16]
-    out = os.path.join(_cache_dir(), f"libsmlloader_{tag}.so")
+    out = os.path.join(_cache_dir(), f"lib{stem}_{tag}.so")
     if os.path.exists(out):
         return out
     tmp = out + f".tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", tmp]
+           src_path, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=180)
     except (OSError, subprocess.SubprocessError):
@@ -59,7 +62,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
     with _LOCK:
         if _LIB is not None or _LIB_FAILED:
             return _LIB
-        path = _build_library()
+        path = _compile_source(_SRC, "smlloader")
         if path is None:
             _LIB_FAILED = True
             return None
@@ -175,5 +178,115 @@ def read_colstore(path: str) -> np.ndarray:
     return data.reshape(cols, rows).T
 
 
-__all__ = ["native_available", "read_csv_matrix", "read_colstore",
+def _get_textlib() -> Optional[ctypes.CDLL]:
+    global _TEXTLIB, _TEXTLIB_FAILED
+    if _TEXTLIB is not None or _TEXTLIB_FAILED:
+        return _TEXTLIB
+    with _LOCK:
+        if _TEXTLIB is not None or _TEXTLIB_FAILED:
+            return _TEXTLIB
+        path = _compile_source(_TEXT_SRC, "smltextproc")
+        if path is None:
+            _TEXTLIB_FAILED = True
+            return None
+        lib = ctypes.CDLL(path)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.sml_murmur3_batch.argtypes = [ctypes.c_char_p, i64p,
+                                          ctypes.c_int64, ctypes.c_uint32,
+                                          u32p, ctypes.c_int]
+        lib.sml_murmur3_batch.restype = None
+        lib.sml_vw_count.argtypes = [ctypes.c_char_p, i64p, ctypes.c_int64,
+                                     ctypes.c_uint32, i64p, ctypes.c_int]
+        lib.sml_vw_count.restype = None
+        lib.sml_vw_parse.argtypes = [ctypes.c_char_p, i64p, ctypes.c_int64,
+                                     ctypes.c_uint32, ctypes.c_int, i64p,
+                                     i32p, i32p, f32p, f32p, f32p, u8p,
+                                     ctypes.c_int]
+        lib.sml_vw_parse.restype = None
+        lib.sml_coo_densify.argtypes = [i32p, i32p, f32p, ctypes.c_int64,
+                                        f32p, ctypes.c_int64, ctypes.c_int]
+        lib.sml_coo_densify.restype = None
+        _TEXTLIB = lib
+        return _TEXTLIB
+
+
+def _concat_utf8(strings) -> Tuple[bytes, np.ndarray]:
+    enc = [s.encode("utf-8") if isinstance(s, str) else bytes(s)
+           for s in strings]
+    offsets = np.zeros(len(enc) + 1, np.int64)
+    if enc:
+        np.cumsum([len(b) for b in enc], out=offsets[1:])
+    return b"".join(enc), offsets
+
+
+def _p(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def murmur3_batch(strings, seed: int = 0,
+                  n_threads: int = 0) -> Optional[np.ndarray]:
+    """Hash a batch of strings natively -> uint32 array; None if the
+    toolchain is unavailable (callers fall back to the Python hasher)."""
+    lib = _get_textlib()
+    if lib is None:
+        return None
+    buf, offsets = _concat_utf8(strings)
+    n = len(offsets) - 1
+    out = np.empty(n, np.uint32)
+    lib.sml_murmur3_batch(buf, _p(offsets, ctypes.c_int64), n,
+                          ctypes.c_uint32(seed & 0xFFFFFFFF),
+                          _p(out, ctypes.c_uint32), n_threads)
+    return out
+
+
+def vw_parse_batch(lines, num_bits: int, seed: int = 0, n_threads: int = 0):
+    """Parse VW-format lines natively.  Returns (rows, idxs, vals, labels,
+    weights, has_label) COO arrays, or None without a toolchain."""
+    lib = _get_textlib()
+    if lib is None:
+        return None
+    buf, offsets = _concat_utf8(str(l) for l in lines)
+    n = len(offsets) - 1
+    counts = np.zeros(n, np.int64)
+    seed32 = ctypes.c_uint32(seed & 0xFFFFFFFF)
+    lib.sml_vw_count(buf, _p(offsets, ctypes.c_int64), n, seed32,
+                     _p(counts, ctypes.c_int64), n_threads)
+    starts = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    total = int(starts[-1])
+    rows = np.empty(total, np.int32)
+    idxs = np.empty(total, np.int32)
+    vals = np.empty(total, np.float32)
+    labels = np.empty(n, np.float32)
+    weights = np.empty(n, np.float32)
+    has = np.empty(n, np.uint8)
+    lib.sml_vw_parse(buf, _p(offsets, ctypes.c_int64), n, seed32,
+                     int(num_bits), _p(starts, ctypes.c_int64),
+                     _p(rows, ctypes.c_int32), _p(idxs, ctypes.c_int32),
+                     _p(vals, ctypes.c_float), _p(labels, ctypes.c_float),
+                     _p(weights, ctypes.c_float), _p(has, ctypes.c_uint8),
+                     n_threads)
+    return rows, idxs, vals, labels, weights, has
+
+
+def coo_densify(rows: np.ndarray, idxs: np.ndarray, vals: np.ndarray,
+                out: np.ndarray) -> bool:
+    """out[row, idx] += val natively (rows must be sorted, as the VW
+    parser emits them).  Returns False without a toolchain."""
+    lib = _get_textlib()
+    if lib is None:
+        return False
+    assert out.dtype == np.float32 and out.flags.c_contiguous
+    lib.sml_coo_densify(_p(rows, ctypes.c_int32), _p(idxs, ctypes.c_int32),
+                        _p(vals, ctypes.c_float), len(rows),
+                        _p(out, ctypes.c_float), out.shape[1], 0)
+    return True
+
+
+__all__ = ["coo_densify", "murmur3_batch", "native_available",
+           "read_csv_matrix", "read_colstore", "vw_parse_batch",
            "write_colstore"]

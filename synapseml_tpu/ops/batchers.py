@@ -27,6 +27,7 @@ class _BufferedBatcherBase(Iterator[List[T]]):
         self._started = False
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
+        self._consumed = 0          # bumped by every __next__ (liveness)
         self._thread = threading.Thread(target=self._produce, daemon=True)
 
     def _produce(self) -> None:
@@ -35,10 +36,7 @@ class _BufferedBatcherBase(Iterator[List[T]]):
         except BaseException as e:  # re-raised on the consumer thread
             self._error = e
         finally:
-            # keep trying while the batcher is live — a busy consumer may
-            # hold the queue full for a while; _put gives up only after
-            # close(), when there is no consumer left to signal
-            self._put(_SENTINEL)
+            self._put_sentinel()
 
     def _fill(self) -> None:
         raise NotImplementedError
@@ -53,6 +51,27 @@ class _BufferedBatcherBase(Iterator[List[T]]):
             except queue.Full:
                 continue
         return False
+
+    def _put_sentinel(self) -> None:
+        """Deliver end-of-stream even if the queue is momentarily full.
+
+        Retries while the consumer shows signs of life (any __next__ since
+        the last Full timeout) and gives up after 30s of zero consumer
+        progress — so an abandoned batcher doesn't pin a spinning producer
+        thread forever, while a merely busy consumer still gets its
+        sentinel."""
+        stalled_ticks = 0
+        last_seen = self._consumed
+        while not self._done.is_set() and stalled_ticks < 300:
+            try:
+                self._queue.put(_SENTINEL, timeout=0.1)
+                return
+            except queue.Full:
+                if self._consumed != last_seen:
+                    last_seen = self._consumed
+                    stalled_ticks = 0
+                else:
+                    stalled_ticks += 1
 
     def _exhausted(self) -> None:
         """Sentinel seen: stay exhausted, surface any producer error."""
@@ -90,6 +109,7 @@ class DynamicBufferedBatcher(_BufferedBatcherBase):
 
     def __next__(self) -> List[T]:
         self.start()
+        self._consumed += 1
         first = self._queue.get()
         if first is _SENTINEL:
             self._exhausted()
@@ -131,6 +151,7 @@ class FixedBufferedBatcher(_BufferedBatcherBase):
 
     def __next__(self) -> List[T]:
         self.start()
+        self._consumed += 1
         item = self._queue.get()
         if item is _SENTINEL:
             self._exhausted()
@@ -161,6 +182,7 @@ class TimeIntervalBatcher(_BufferedBatcherBase):
 
     def __next__(self) -> List[T]:
         self.start()
+        self._consumed += 1
         first = self._queue.get()
         if first is _SENTINEL:
             self._exhausted()

@@ -82,3 +82,116 @@ def test_dataset_colstore_roundtrip(tmp_path, csv_file):
     ds.to_colstore(p)
     back = Dataset.from_colstore(p, columns=ds.columns)
     np.testing.assert_allclose(back["col0"], ds["col0"])
+
+
+# -- textproc: native murmur + VW parse ------------------------------------
+
+def test_murmur_batch_matches_python():
+    from synapseml_tpu.core.hashing import murmurhash3_32
+    from synapseml_tpu.native import murmur3_batch
+
+    cases = ["", "a", "ab", "abc", "abcd", "hello world", "é漢字",
+             "x" * 1000, "f1", "ns:tok"]
+    out = murmur3_batch(cases, seed=7)
+    assert out is not None
+    assert out.tolist() == [murmurhash3_32(c, 7) for c in cases]
+
+
+def test_vw_parse_matches_python():
+    """Native parser must agree with parse_vw_line token-for-token on the
+    full grammar: labels, importance, tags, namespaces with weights,
+    valued/unvalued features, malformed floats, multiple namespaces."""
+    import numpy as np
+    from synapseml_tpu.models.online.generic import (parse_vw_line,
+                                                     vectorize_vw_lines)
+    from synapseml_tpu.native import vw_parse_batch
+    from synapseml_tpu.core.hashing import murmurhash3_32
+
+    lines = [
+        "1 |f a b c",
+        "-1 2.0 |f x:0.5 y",
+        "0.5 | a b",
+        "|n:2.5 p q:3",
+        "'tag |f z",
+        "1 'tag |f z",
+        "2 | x:bad y:1e2",
+        "1 |a one |b:0.5 two three:4",
+        "1 |f",
+        "3.5",
+        "",
+        "1 |f a:nan b:inf",
+        "1 |f dup dup dup",
+    ]
+    num_bits, seed = 10, 3
+    parsed = vw_parse_batch(lines, num_bits, seed)
+    assert parsed is not None
+    rows, idxs, vals, labels, weights, has = parsed
+    dim = 1 << num_bits
+    for i, line in enumerate(lines):
+        lab, imp, feats = parse_vw_line(line)
+        if lab is None:
+            assert has[i] == 0 and weights[i] == 0.0
+        else:
+            assert has[i] == 1
+            np.testing.assert_allclose(labels[i], lab, rtol=1e-6)
+            np.testing.assert_allclose(weights[i], imp, rtol=1e-6)
+        mine = sorted((int(idxs[j]), float(vals[j]))
+                      for j in range(len(rows)) if rows[j] == i)
+        ref = sorted((murmurhash3_32(ns + name, seed) % dim, float(v))
+                     for ns, name, v in feats)
+        # NaN-valued features compare by index only
+        assert [m[0] for m in mine] == [r[0] for r in ref]
+        finite = [(m, r) for m, r in zip(mine, ref)
+                  if not (np.isnan(m[1]) or np.isnan(r[1]))]
+        for m, r in finite:
+            np.testing.assert_allclose(m[1], r[1], rtol=1e-6)
+
+    # end-to-end vectorize equality vs forced-Python fallback
+    x_nat, y_nat, w_nat = vectorize_vw_lines(lines, num_bits, seed)
+    import synapseml_tpu.native as nat
+    orig = nat.vw_parse_batch
+    nat.vw_parse_batch = lambda *a, **k: None
+    try:
+        x_py, y_py, w_py = vectorize_vw_lines(lines, num_bits, seed)
+    finally:
+        nat.vw_parse_batch = orig
+    np.testing.assert_allclose(np.nan_to_num(x_nat, nan=-7.0),
+                               np.nan_to_num(x_py, nan=-7.0), rtol=1e-6)
+    np.testing.assert_allclose(y_nat, y_py)
+    np.testing.assert_allclose(w_nat, w_py)
+
+
+def test_vw_parse_python_float_grammar_parity():
+    """Native float parsing must match Python float(): hex rejected,
+    underscores between digits accepted, long tokens fine, Unicode
+    whitespace splits, namespace check is space/tab only."""
+    import numpy as np
+    from synapseml_tpu.models.online.generic import vectorize_vw_lines
+    import synapseml_tpu.native as nat
+
+    lines = [
+        "0x10 |f a",              # hex label: Python unlabeled
+        "1 |f x:0x2",             # hex value: falls back to 1.0
+        "1 |f y:1_5",             # underscore literal = 15.0
+        "1_0 |f z",               # underscore label = 10.0
+        "1 |f w:1__5",            # double underscore: invalid -> 1.0
+        "1 |f v:_5",              # leading underscore: invalid -> 1.0
+        "1 |f " + "t" * 300 + ":2.5",   # long token
+        "1 |f a\u00a0b",          # NBSP splits tokens in Python
+        "1 |\u2003f q",          # EM-space after '|': namespace still
+                                  # attaches (Python checks ' '/'\t' only)
+        "1 |\x1cf r",            # 0x1c: Python-space, not a namespace
+        "inf |f s",               # inf label
+        "1 infinity |f s",        # infinity importance
+    ]
+    num_bits, seed = 10, 5
+    x_nat, y_nat, w_nat = vectorize_vw_lines(lines, num_bits, seed)
+    orig = nat.vw_parse_batch
+    nat.vw_parse_batch = lambda *a, **k: None
+    try:
+        x_py, y_py, w_py = vectorize_vw_lines(lines, num_bits, seed)
+    finally:
+        nat.vw_parse_batch = orig
+    np.testing.assert_allclose(x_nat, x_py, rtol=1e-6)
+    np.testing.assert_allclose(y_nat, y_py)
+    np.testing.assert_allclose(w_nat, w_py)
