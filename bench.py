@@ -57,13 +57,17 @@ def bench_bert():
 
     state, m = step(state, (bi, bm), bl, key)        # compile
     jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(BERT_STEPS):
-        state, m = step(state, (bi, bm), bl, key)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-    samples_per_sec = BERT_STEPS * bs / dt
-    return samples_per_sec / len(devs)
+    # the tunneled chip is shared: throughput varies with co-tenant load.
+    # Measure three windows and report the median (robust to one
+    # contended window without the upward bias of a max).
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(BERT_STEPS):
+            state, m = step(state, (bi, bm), bl, key)
+        jax.block_until_ready(m["loss"])
+        rates.append(BERT_STEPS * bs / (time.perf_counter() - t0))
+    return sorted(rates)[1] / len(devs)
 
 
 def bench_gbdt():

@@ -1,0 +1,56 @@
+"""Online learners: the Vowpal Wabbit replacement.
+
+SGD/logistic learners over hashed features, raw VW-format text input
+(parsed by the native C++ engine), and a contextual bandit.
+"""
+
+import numpy as np
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.models.online import (ContextualBandit, HashingFeaturizer,
+                                         OnlineGeneric, OnlineSGDClassifier)
+
+rng = np.random.default_rng(0)
+
+# 1) hashing featurizer + SGD classifier (VowpalWabbitFeaturizer + Classifier)
+words_pos, words_neg = ["good", "great", "fine"], ["bad", "awful", "poor"]
+texts = [[str(w) for w in rng.choice(words_pos if i % 2 else words_neg, 4)]
+         for i in range(1200)]
+ds = Dataset({"text": texts, "label": np.arange(1200) % 2})
+feats = HashingFeaturizer(inputCols=["text"], outputCol="features",
+                          numBits=12).transform(ds)
+clf = OnlineSGDClassifier(featuresCol="features", labelCol="label",
+                          lossFunction="logistic", numPasses=3,
+                          learningRate=0.5)
+model = clf.fit(feats)
+pred = np.asarray(model.transform(feats)["prediction"])
+print("featurizer+SGD accuracy:", np.mean((pred > 0.5) == (np.arange(1200) % 2)))
+
+# 2) raw VW-format lines (VowpalWabbitGeneric)
+lines = [f"{(i % 2) * 2 - 1} |f " + " ".join(
+    str(w) for w in rng.choice(words_pos if i % 2 else words_neg, 4))
+    for i in range(1200)]
+vw = OnlineGeneric(lossFunction="logistic", numBits=12, numPasses=3,
+                   learningRate=0.5).fit(Dataset({"value": lines}))
+p = np.asarray(vw.transform(Dataset({"value": lines}))["prediction"])
+print("VW-format accuracy:", np.mean((p > 0.5) == (np.arange(1200) % 2)))
+
+# 3) contextual bandit (VowpalWabbitContextualBandit): shared context +
+# per-action features, logged action/cost/propensity
+n = 1500
+shared = rng.normal(size=(n, 2)).astype(np.float32)
+action_feats = np.eye(3, dtype=np.float32)
+chosen = rng.integers(0, 3, n)
+cost = np.where(chosen == (shared[:, 0] > 0).astype(int), -1.0, 0.5)
+bds = Dataset({
+    "shared": list(shared),
+    "features": [[action_feats[k] for k in range(3)] for _ in range(n)],
+    "chosenAction": chosen + 1,                  # 1-based
+    "label": cost.astype(np.float32),            # observed cost
+    "probability": np.full(n, 1 / 3, np.float32),
+})
+bandit = ContextualBandit(numPasses=6, learningRate=0.3).fit(bds)
+scores = np.stack(bandit.transform(bds)["prediction"])
+picked = scores.argmin(axis=1)                   # lowest predicted cost
+print("bandit regret-optimal pick rate:",
+      np.mean(picked == (shared[:, 0] > 0).astype(int)))

@@ -1,0 +1,32 @@
+"""HTTP serving: the Spark Serving replacement — serve any fitted pipeline."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.models.gbdt import GBDTClassifier
+from synapseml_tpu.serving import PipelineServer
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1000, 4)).astype(np.float32)
+y = (X[:, 0] > 0).astype(float)
+model = GBDTClassifier(numIterations=10, numLeaves=7, minDataInLeaf=5,
+                       numShards=1).fit(Dataset({"features": list(X), "label": y}))
+
+def parse(request):
+    body = json.loads(request.body)
+    return {"features": np.asarray(body["features"], np.float32)}
+
+
+server = PipelineServer(model, parse, output_col="probability")
+try:
+    req = urllib.request.Request(
+        server.url,
+        data=json.dumps({"features": [1.0, 0.0, 0.0, 0.0]}).encode(),
+        headers={"Content-Type": "application/json"})
+    reply = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    print("served prediction:", reply)
+finally:
+    server.close()

@@ -64,6 +64,12 @@ class ImageTransformer(Transformer):
         return self._append({"op": "crop", "x": x, "y": y,
                              "height": height, "width": width})
 
+    def center_crop(self, height: int, width: int) -> "ImageTransformer":
+        """Crop centered on the image midpoint, clamped to the image size
+        (reference: CenterCropImage, opencv/.../ImageTransformer.scala:139)."""
+        return self._append({"op": "centercrop", "height": int(height),
+                             "width": int(width)})
+
     def color_format(self, mode: str) -> "ImageTransformer":
         return self._append({"op": "color", "mode": mode})
 
@@ -101,6 +107,12 @@ class ImageTransformer(Transformer):
             elif op == "crop":
                 x = ops.center_crop(x, spec["x"], spec["y"],
                                     spec["width"], spec["height"])
+            elif op == "centercrop":
+                h, w = int(x.shape[1]), int(x.shape[2])
+                ch = min(spec["height"], h)
+                cw = min(spec["width"], w)
+                x = ops.center_crop(x, w // 2 - cw // 2, h // 2 - ch // 2,
+                                    cw, ch)
             elif op == "color":
                 x = ops.color_convert(x, spec["mode"])
             elif op in ("blur", "gaussian"):

@@ -399,6 +399,31 @@ class EvalRecord:
     value: float
 
 
+@dataclasses.dataclass
+class InstrumentationMeasures:
+    """Per-phase wall-clock training instrumentation (reference:
+    TaskInstrumentationMeasures / InstrumentationMeasures,
+    lightgbm/.../LightGBMPerformance.scala:11-111).  Attached to the
+    trained Booster as ``.measures`` and surfaced by the estimators."""
+    binning_s: float = 0.0            # bin-mapper fit + transform (sampling)
+    data_prep_s: float = 0.0          # labels/weights/padding/device put
+    compile_s: float = 0.0            # first-iteration jit compile + run
+    training_s: float = 0.0           # whole boosting loop
+    eval_s: float = 0.0               # validation metric evaluation
+    iterations: int = 0
+    total_s: float = 0.0
+
+    def iterations_per_sec(self) -> float:
+        post = self.training_s - self.compile_s
+        steady = max(self.iterations - 1, 1)
+        return steady / post if post > 0 else 0.0
+
+    def as_dict(self) -> Dict[str, float]:
+        d = dataclasses.asdict(self)
+        d["iterations_per_sec"] = self.iterations_per_sec()
+        return d
+
+
 def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
           sample_weight: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = None,
@@ -414,6 +439,9 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     When ``mesh`` is given, rows are sharded over its ``data`` axis and each
     iteration's histograms ride one psum — the entire distributed story.
     """
+    import time as _time
+    measures = InstrumentationMeasures()
+    _t0 = _time.perf_counter()
     X = np.ascontiguousarray(X, np.float32)
     n, F = X.shape
     K = config.num_class if config.objective in ("multiclass", "multiclassova") else 1
@@ -428,6 +456,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                                 sample_count=config.bin_sample_count,
                                 seed=config.seed)
     binned_np = mapper.transform(X)
+    measures.binning_s = _time.perf_counter() - _t0
+    _t_prep = _time.perf_counter()
 
     # -- labels / weights --------------------------------------------------
     w = np.ones(n, np.float32) if sample_weight is None else \
@@ -570,6 +600,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             metric_fn, larger_better = metrics_mod.METRICS.get(
                 metric_name, metrics_mod.METRICS["l2"])
 
+    measures.data_prep_s = _time.perf_counter() - _t_prep
+    _t_train = _time.perf_counter()
     trees: List[Tree] = []
     tree_class: List[int] = []
     tree_weights: List[float] = []
@@ -615,6 +647,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                                   jnp.asarray(bag), jnp.asarray(feature_mask),
                                   key, upper_bounds, num_bins)
         new_trees = [Tree(*[np.asarray(a[k]) for a in tstack]) for k in range(K)]
+        if it == 0:
+            measures.compile_s = _time.perf_counter() - _t_train
 
         dropped_weight_changes = []
         if is_dart and dropped:
@@ -649,6 +683,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
         # validation eval + early stopping (TrainUtils.scala:143-169)
         if have_valid:
+            _t_eval = _time.perf_counter()
             # incremental: new trees, plus weight deltas of dart-dropped trees
             for k in range(K):
                 contrib = np.asarray(_predict_binned_tree(
@@ -677,20 +712,26 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 rounds_no_improve += 1
                 if (config.early_stopping_round > 0
                         and rounds_no_improve >= config.early_stopping_round):
+                    measures.eval_s += _time.perf_counter() - _t_eval
                     break
+            measures.eval_s += _time.perf_counter() - _t_eval
         if callbacks:
             for cb in callbacks:
                 cb(it, trees, eval_history)
 
-    if init_model is not None:
+    measures.training_s = _time.perf_counter() - _t_train
+    measures.iterations = len(trees) // max(K, 1)  # this fit only — before
+    if init_model is not None:                     # the warm-start fold-in
         # continued training: carry previous trees forward (modelString
         # warm-start fold-in, LightGBMBase.scala:38-59)
         trees = init_model.trees + trees
         tree_class = init_model.tree_class + tree_class
         tree_weights = init_model.tree_weights + tree_weights
+    measures.total_s = _time.perf_counter() - _t0
     booster = Booster(trees, tree_class, tree_weights, K, config.objective,
                       init_sc, mapper, feature_names, config,
                       best_iteration=best_iter)
+    booster.measures = measures
     return booster, eval_history
 
 

@@ -158,6 +158,13 @@ class GBDTModelBase(Model):
     def booster(self) -> Booster:
         return self.boosterModel
 
+    @property
+    def training_measures(self):
+        """Per-phase wall-clock instrumentation of the fit that produced
+        this model (reference: getAllTrainingMeasures on the estimator,
+        LightGBMPerformance.scala:90-111); None for deserialized models."""
+        return getattr(self.booster, "measures", None)
+
     def get_feature_importances(self, importance_type: str = "split") -> List[float]:
         return list(self.booster.feature_importance(importance_type))
 

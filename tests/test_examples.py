@@ -1,0 +1,29 @@
+"""Run the example scripts end to end (the reference's nbtest analogue:
+notebooks submitted as jobs, DatabricksUtilities.scala:87-360 — here each
+example runs as a subprocess on the simulated 8-chip CPU mesh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = ["gbdt_classification", "online_learning", "deep_learning",
+            "explainability", "serving"]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += " --xla_force_host_platform_device_count=8"
+    env["XLA_FLAGS"] = flags.strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", f"{name}.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]

@@ -233,6 +233,19 @@ class TestImageOps:
         out = t.transform(ds)
         assert out["out"][0].shape == (16, 16, 3)
 
+    def test_center_crop(self):
+        """CenterCropImage semantics: crop around the midpoint, clamped
+        (reference: ImageTransformer.scala:139-151)."""
+        img = np.arange(10 * 10 * 3, dtype=np.float64).reshape(10, 10, 3)
+        ds = Dataset({"image": [img]})
+        t = ImageTransformer(inputCol="image", outputCol="out").center_crop(4, 6)
+        out = t.transform(ds)["out"][0]
+        assert out.shape == (4, 6, 3)
+        np.testing.assert_allclose(out, img[3:7, 2:8, :])
+        # larger than image: clamps to full size
+        t2 = ImageTransformer(inputCol="image", outputCol="out").center_crop(99, 99)
+        assert t2.transform(ds)["out"][0].shape == (10, 10, 3)
+
     def test_tensor_normalize(self):
         ds = Dataset({"image": [np.full((8, 8, 3), 255.0)]})
         t = (ImageTransformer(inputCol="image", outputCol="out")

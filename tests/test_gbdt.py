@@ -343,3 +343,18 @@ def test_pallas_hist_matches_scatter():
         jnp.asarray(hess), jnp.asarray(mask), F, B,
         use_pallas=False)).reshape(F, B, 3)
     np.testing.assert_allclose(out_p, out_s, rtol=1e-4, atol=1e-4)
+
+
+def test_training_instrumentation():
+    """Per-phase timing measures (LightGBMPerformance.scala analogue)."""
+    X, y = binary_data(n=1000)
+    clf = GBDTClassifier(featuresCol="features", labelCol="label",
+                         numIterations=5, numLeaves=7, minDataInLeaf=5,
+                         numShards=1)
+    model = clf.fit(vec_dataset(X, y))
+    m = model.training_measures
+    assert m is not None and m.iterations == 5
+    assert m.total_s > 0 and m.training_s > 0 and m.binning_s > 0
+    assert m.compile_s <= m.training_s
+    d = m.as_dict()
+    assert "iterations_per_sec" in d and d["iterations_per_sec"] > 0
