@@ -70,6 +70,18 @@ class WordTokenizer:
             mask[i, :len(toks)] = True
         return ids, mask
 
+    def decode(self, ids) -> List[str]:
+        """ids (n, T) → detokenized strings (special/hash ids dropped)."""
+        inv = getattr(self, "_inverse_vocab", None)
+        if inv is None:
+            inv = {v: k for k, v in self.vocab.items()}
+            self._inverse_vocab = inv
+        out = []
+        for row in np.asarray(ids):
+            words = [inv[int(t)] for t in row if int(t) in inv]
+            out.append(" ".join(words))
+        return out
+
     def to_dict(self) -> dict:
         return {"vocab": self.vocab, "vocab_size": self.vocab_size,
                 "num_hash_buckets": self.num_hash_buckets}

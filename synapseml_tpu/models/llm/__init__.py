@@ -2,12 +2,14 @@
 reference's only LLM surface is remote OpenAI calls,
 cognitive/.../openai/OpenAI.scala:246)."""
 
+from .generate import generate, sample_logits
 from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
                     causal_lm_loss, init_cache, rope_frequencies)
+from .stage import LLMTransformer
 
 __all__ = [
-    "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LlamaConfig",
-    "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss", "init_cache",
-    "rope_frequencies",
+    "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LLMTransformer",
+    "LlamaConfig", "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss",
+    "generate", "init_cache", "rope_frequencies", "sample_logits",
 ]

@@ -1,0 +1,106 @@
+"""Autoregressive generation: jitted prefill + decode loop with sampling.
+
+The reference's only text-generation surface is the remote OpenAI
+completion stage (reference: cognitive/.../openai/OpenAI.scala:246,
+OpenAIPrompt.scala:172); this is the TPU-native local equivalent over
+:class:`~synapseml_tpu.models.llm.model.LlamaModel`.  The whole decode
+loop is ONE compiled XLA program: prefill writes the prompt's K/V into the
+cache, then a ``lax.scan`` of single-token steps — each step one
+dynamic-slice cache update and one sampled token; no host round-trips
+until the finished (B, max_new) block returns.
+
+Sampling: greedy (temperature=0), temperature, top-k, and nucleus
+(top-p), composable in the usual k-then-p order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .model import LlamaConfig, LlamaModel, init_cache
+
+
+def sample_logits(logits: jnp.ndarray, key: jnp.ndarray,
+                  temperature: float, top_k: int, top_p: float) -> jnp.ndarray:
+    """Sample token ids from (B, V) logits.  temperature<=0 → argmax."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(max(temperature, 1e-6))
+    V = logits.shape[-1]
+    if top_k and top_k < V:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # keep the smallest prefix with mass >= top_p (always >= 1 token)
+        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1,
+                             keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "model", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id",
+    "pad_id"))
+def _generate_jit(model: LlamaModel, variables: Any,
+                  prompt_ids: jnp.ndarray, key: jnp.ndarray,
+                  max_new_tokens: int, temperature: float, top_k: int,
+                  top_p: float, eos_id: Optional[int], pad_id: int
+                  ) -> jnp.ndarray:
+    cfg = model.cfg
+    B, P = prompt_ids.shape
+    total = P + max_new_tokens
+    cache = init_cache(cfg, B, total)
+
+    # prefill: one batched pass over the prompt
+    positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+    logits, cache = model.apply(variables, prompt_ids, positions=positions,
+                                cache=cache, cache_index=0)
+    key, sub = jax.random.split(key)
+    next_tok = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+    done = jnp.zeros(B, bool) if eos_id is None else (next_tok == eos_id)
+
+    def step(carry, t):
+        # t-th scan step feeds generated token #t, which sits at sequence
+        # position P + t - 1 (prefill covered positions [0, P))
+        cache, tok, done, key = carry
+        ids = tok[:, None]
+        pos = jnp.full((B, 1), P + t - 1, jnp.int32)
+        logits, cache = model.apply(variables, ids, positions=pos,
+                                    cache=cache, cache_index=P + t - 1)
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        nxt = jnp.where(done, pad_id, nxt)
+        new_done = done if eos_id is None else (done | (nxt == eos_id))
+        return (cache, nxt, new_done, key), tok
+
+    (_, last, _, _), toks = lax.scan(
+        step, (cache, next_tok, done, key),
+        jnp.arange(max_new_tokens - 1) + 1)
+    out = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    return out
+
+
+def generate(model: LlamaModel, variables: Any, prompt_ids,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 1.0,
+             eos_id: Optional[int] = None, pad_id: int = 0,
+             seed: int = 0) -> np.ndarray:
+    """Generate ``max_new_tokens`` continuations for a batch of
+    equal-length prompts (B, P) → (B, max_new_tokens) int32."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    out = _generate_jit(model, variables, prompt_ids,
+                        jax.random.PRNGKey(seed), int(max_new_tokens),
+                        float(temperature), int(top_k), float(top_p),
+                        eos_id, int(pad_id))
+    return np.asarray(out)

@@ -1,0 +1,79 @@
+"""LLMTransformer: local text-completion pipeline stage.
+
+The pipeline-API face of the TP-sharded Llama decoder — the local
+counterpart of the reference's remote ``OpenAICompletion``/``OpenAIPrompt``
+stages (reference: cognitive/.../openai/OpenAI.scala:246,
+OpenAIPrompt.scala:172): prompt column in, completion column out, with a
+``promptTemplate`` for OpenAIPrompt-style column interpolation.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...core.dataset import Dataset
+from ...core.params import (FloatParam, IntParam, PyObjectParam, StringParam)
+from ...core.pipeline import Transformer
+from .generate import generate
+
+
+class LLMTransformer(Transformer):
+    """Generate completions for a prompt column with a local LLM.
+
+    ``bundle`` carries {"model": LlamaModel, "variables": pytree,
+    "tokenizer": WordTokenizer-like with encode/decode}.  Rows are grouped
+    by prompt token length so every jitted generate call sees equal-length
+    prompts (one compile per distinct length).
+    """
+
+    inputCol = StringParam(doc="prompt column", default="prompt")
+    outputCol = StringParam(doc="completion output column",
+                            default="completion")
+    promptTemplate = StringParam(
+        doc="optional template with {column} slots (OpenAIPrompt analogue)",
+        default=None)
+    maxNewTokens = IntParam(doc="tokens to generate", default=32)
+    temperature = FloatParam(doc="0 = greedy", default=0.0)
+    topK = IntParam(doc="top-k sampling cutoff (0 = off)", default=0)
+    topP = FloatParam(doc="nucleus sampling mass (1 = off)", default=1.0)
+    seed = IntParam(doc="sampling seed", default=0)
+    bundle = PyObjectParam(doc="{model, variables, tokenizer}")
+
+    def _prompts(self, ds: Dataset) -> List[str]:
+        template = self.get("promptTemplate")
+        if not template:
+            return [str(p) for p in ds[self.inputCol]]
+        cols = re.findall(r"\{(\w+)\}", template)
+        missing = [c for c in cols if c not in ds]
+        if missing:
+            raise ValueError(
+                f"promptTemplate references column(s) {missing} not present "
+                f"in the dataset (columns: {ds.columns})")
+        return [template.format(**{c: ds[c][i] for c in cols})
+                for i in range(ds.num_rows)]
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        b: Dict[str, Any] = self.get("bundle")
+        model, variables, tok = b["model"], b["variables"], b["tokenizer"]
+        prompts = self._prompts(ds)
+        # leave room in the context window for the generated continuation
+        budget = max(model.cfg.max_len - int(self.maxNewTokens), 2)
+        enc = [[t for t in row if t]            # strip padding
+               for row in tok.encode(prompts, budget)[0]]
+        out: List[Optional[str]] = [None] * len(prompts)
+        by_len: Dict[int, List[int]] = {}
+        for i, ids in enumerate(enc):
+            by_len.setdefault(len(ids), []).append(i)
+        for L, idxs in sorted(by_len.items()):
+            batch = np.asarray([enc[i] for i in idxs], np.int32)
+            toks = generate(model, variables, batch,
+                            max_new_tokens=self.maxNewTokens,
+                            temperature=self.temperature,
+                            top_k=self.topK, top_p=self.topP,
+                            seed=self.seed)
+            for i, text in zip(idxs, tok.decode(toks)):
+                out[i] = text
+        return ds.with_column(self.outputCol, out)
