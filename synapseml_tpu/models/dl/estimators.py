@@ -59,6 +59,8 @@ class _DLParamsBase(Params):
     numDevices = IntParam(doc="devices to use (0=all)", default=0)
     modelParallelism = IntParam(doc="tensor-parallel size over mesh 'model' "
                                     "axis", default=1)
+    zero1 = BoolParam(doc="shard optimizer moments over the data axis "
+                          "(ZeRO-1 weight-update sharding)", default=False)
     validationFraction = FloatParam(doc="fraction held out for eval logging",
                                     default=0.0)
     checkpointDir = StringParam(doc="step-checkpoint directory (resume "
@@ -211,7 +213,8 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
 
         cfg = self._model_config(num_classes)
         model = TextEncoder(cfg)
-        trainer = DLTrainer(model, self._opt_config(total_steps), mesh)
+        trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
+                            zero1=bool(self.zero1))
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, ids[:sample_n], mask[:sample_n])
         step = trainer.train_step()
@@ -327,7 +330,8 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
 
         model = make_backbone(self.backbone, num_classes=len(classes))
         trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
-                            has_batch_stats=True, train_kwarg="train")
+                            has_batch_stats=True, train_kwarg="train",
+                            zero1=bool(self.zero1))
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, imgs[:sample_n])
         step = trainer.train_step()

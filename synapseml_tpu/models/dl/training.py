@@ -98,6 +98,42 @@ def _state_shardings(abs_state, mesh: Mesh, rules=LOGICAL_RULES):
     return nn.logical_to_mesh_sharding(specs, mesh, usable_rules(mesh, rules))
 
 
+def _zero1_shardings(state_shardings: "TrainState", abs_state: "TrainState",
+                     mesh: Mesh) -> "TrainState":
+    """ZeRO-1: shard optimizer moments over the ``data`` axis.
+
+    (Xu et al., "Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training", arXiv:2004.13336 — the GSPMD formulation: give
+    the optimizer state a data-sharded layout and let XLA turn the weight
+    update into reduce_scatter(grad) → sharded update → all_gather(param).)
+
+    Each opt-state leaf that is replicated on ``data`` and has a dimension
+    divisible by the data-axis size gets that dimension sharded; everything
+    else keeps its existing (e.g. tensor-parallel) layout.
+    """
+    data_n = mesh.shape.get(DATA_AXIS, 1)
+    if data_n <= 1:
+        return state_shardings
+
+    def shard_leaf(sh, ab):
+        shape = getattr(ab, "shape", ())
+        if not isinstance(sh, NamedSharding) or not shape:
+            return sh
+        spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
+        if DATA_AXIS in jax.tree_util.tree_leaves([s for s in spec if s]):
+            return sh
+        for d, size in enumerate(shape):
+            if spec[d] is None and size % data_n == 0 and size >= data_n:
+                spec[d] = DATA_AXIS
+                return NamedSharding(mesh, P(*spec))
+        return sh
+
+    return state_shardings.replace(
+        opt_state=jax.tree_util.tree_map(shard_leaf,
+                                         state_shardings.opt_state,
+                                         abs_state.opt_state))
+
+
 class DLTrainer:
     """Builds sharded state + jitted train/eval steps for a flax model whose
     ``__call__(batch_inputs..., train/deterministic)`` returns logits."""
@@ -105,9 +141,11 @@ class DLTrainer:
     def __init__(self, model: nn.Module, optimizer: OptimizerConfig,
                  mesh: Mesh, loss_fn: Optional[Callable] = None,
                  has_batch_stats: bool = False,
-                 train_kwarg: str = "deterministic"):
+                 train_kwarg: str = "deterministic",
+                 zero1: bool = False):
         self.model = model
         self.mesh = mesh
+        self.zero1 = zero1
         self.tx = optimizer.build()
         self.has_batch_stats = has_batch_stats
         self.train_kwarg = train_kwarg
@@ -136,6 +174,9 @@ class DLTrainer:
         rng = jax.random.PRNGKey(seed)
         abs_state = jax.eval_shape(self._make_state, rng, *sample_inputs)
         self.state_shardings = _state_shardings(abs_state, self.mesh)
+        if self.zero1:
+            self.state_shardings = _zero1_shardings(self.state_shardings,
+                                                    abs_state, self.mesh)
         init = jax.jit(self._make_state,
                        out_shardings=self.state_shardings)
         return init(rng, *sample_inputs)
@@ -189,8 +230,14 @@ class DLTrainer:
 
     def train_step(self):
         if self._step_fn is None:
+            out_shardings = None
+            if self.zero1 and self.state_shardings is not None:
+                # pin the output state to the ZeRO-1 layout so the updated
+                # params all_gather and the moments stay sharded
+                out_shardings = (self.state_shardings, None)
             self._step_fn = jax.jit(
-                self._build_step(), donate_argnums=(0,))
+                self._build_step(), donate_argnums=(0,),
+                out_shardings=out_shardings)
         return self._step_fn
 
     def eval_step(self):

@@ -9,7 +9,6 @@ OpenAIPrompt.scala:172): prompt column in, completion column out, with a
 
 from __future__ import annotations
 
-import re
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -17,6 +16,7 @@ import numpy as np
 from ...core.dataset import Dataset
 from ...core.params import (FloatParam, IntParam, PyObjectParam, StringParam)
 from ...core.pipeline import Transformer
+from ...services.openai import _TEMPLATE_RE
 from .generate import generate
 
 
@@ -46,21 +46,28 @@ class LLMTransformer(Transformer):
         template = self.get("promptTemplate")
         if not template:
             return [str(p) for p in ds[self.inputCol]]
-        cols = re.findall(r"\{(\w+)\}", template)
-        missing = [c for c in cols if c not in ds]
-        if missing:
-            raise ValueError(
-                f"promptTemplate references column(s) {missing} not present "
-                f"in the dataset (columns: {ds.columns})")
-        return [template.format(**{c: ds[c][i] for c in cols})
-                for i in range(ds.num_rows)]
+        # regex substitution like OpenAIPrompt (services/openai.py): only
+        # {column} slots whose column exists are replaced; literal braces
+        # and unknown slots pass through unchanged
+        def fill(i):
+            return _TEMPLATE_RE.sub(
+                lambda m: str(ds[m.group(1)][i]) if m.group(1) in ds
+                else m.group(0), template)
+
+        return [fill(i) for i in range(ds.num_rows)]
 
     def _transform(self, ds: Dataset) -> Dataset:
         b: Dict[str, Any] = self.get("bundle")
         model, variables, tok = b["model"], b["variables"], b["tokenizer"]
         prompts = self._prompts(ds)
         # leave room in the context window for the generated continuation
-        budget = max(model.cfg.max_len - int(self.maxNewTokens), 2)
+        budget = model.cfg.max_len - int(self.maxNewTokens)
+        if budget < 4:
+            raise ValueError(
+                f"maxNewTokens={int(self.maxNewTokens)} leaves fewer than 4 "
+                f"prompt tokens of the model's max_len={model.cfg.max_len} "
+                "context window; lower maxNewTokens or use a longer-context "
+                "model")
         enc = [[t for t in row if t]            # strip padding
                for row in tok.encode(prompts, budget)[0]]
         out: List[Optional[str]] = [None] * len(prompts)

@@ -191,3 +191,23 @@ class TestGeneration:
         with mesh:
             out = generate(model, sharded_vars, prompt, max_new_tokens=5)
         np.testing.assert_array_equal(ref, out)
+
+    def test_stage_template_edge_cases(self, tiny_model):
+        from synapseml_tpu.models.dl.tokenizer import WordTokenizer
+        from synapseml_tpu.models.llm import LLMTransformer
+        from synapseml_tpu import Dataset
+        import pytest
+
+        cfg, model, variables, _ = tiny_model
+        tok = WordTokenizer.fit(["a b c"] * 4, vocab_size=cfg.vocab_size)
+        bundle = {"model": model, "variables": variables, "tokenizer": tok}
+        ds = Dataset({"prompt": ["x"], "word": ["hi"]})
+        # literal braces + unknown slots pass through (OpenAIPrompt parity)
+        stage = LLMTransformer(bundle=bundle, inputCol="prompt",
+                               promptTemplate="say {word} not {missing} {{lit}}",
+                               maxNewTokens=2)
+        assert stage.transform(ds).num_rows == 1
+        # maxNewTokens eating the whole context is an error, not silence
+        with pytest.raises(ValueError, match="maxNewTokens"):
+            LLMTransformer(bundle=bundle, inputCol="prompt",
+                           maxNewTokens=cfg.max_len).transform(ds)
