@@ -6,5 +6,6 @@ from .params import (ArrayParam, BoolParam, ComplexParam, DatasetParam,
 from .pipeline import (Estimator, Evaluator, Model, Pipeline, PipelineModel,
                        PipelineStage, Transformer, load_dataset, load_stage,
                        save_dataset)
+from .profiling import PhaseTimer, trace
 from .utils import (KahanSum, SharedVariable, StopWatch,
                     assert_models_equal, retry, retry_with_timeout, using)

@@ -1,0 +1,73 @@
+"""Profiling: JAX device traces + named host-side phase timing.
+
+The reference instruments training with per-phase wall-clock measures
+(reference: lightgbm/.../LightGBMPerformance.scala:11-111) and has no
+device-level profiler; the TPU-native equivalent pairs host phase timing
+(:class:`PhaseTimer`) with XLA's profiler (:func:`trace` writes a
+TensorBoard-loadable trace of device ops, infeed, and collectives).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+__all__ = ["PhaseTimer", "trace"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a JAX/XLA profiler trace into ``log_dir`` (view with
+    TensorBoard or xprof).  Degrades to a no-op if the profiler is
+    unavailable or a trace is already active — entry failures are caught,
+    body exceptions are not."""
+    ctx = None
+    try:
+        import jax
+        ctx = jax.profiler.trace(log_dir, create_perfetto_link=False)
+        ctx.__enter__()
+    except Exception:  # pragma: no cover - profiler unavailable/active
+        ctx = None
+    try:
+        yield
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception:  # pragma: no cover
+                pass
+
+
+class PhaseTimer:
+    """Accumulating named phase timer.
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("binning"): ...
+    >>> with t.phase("train"): ...
+    >>> t.report()   # {"binning": 0.01, "train": 1.2}
+    """
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] = self._acc.get(name, 0.0) + (
+                time.perf_counter() - t0)
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def report(self) -> Dict[str, float]:
+        return dict(self._acc)
+
+    def counts(self) -> Dict[str, int]:
+        return dict(self._counts)
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._counts.clear()

@@ -231,7 +231,12 @@ class DLTrainer:
     def train_step(self):
         if self._step_fn is None:
             out_shardings = None
-            if self.zero1 and self.state_shardings is not None:
+            if self.zero1:
+                if self.state_shardings is None:
+                    raise RuntimeError(
+                        "zero1=True requires init_state() before "
+                        "train_step(): the step is pinned to the sharded "
+                        "optimizer-state layout computed at init")
                 # pin the output state to the ZeRO-1 layout so the updated
                 # params all_gather and the moments stay sharded
                 out_shardings = (self.state_shardings, None)

@@ -9,6 +9,8 @@ OpenAIPrompt.scala:172): prompt column in, completion column out, with a
 
 from __future__ import annotations
 
+import re
+
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -16,8 +18,10 @@ import numpy as np
 from ...core.dataset import Dataset
 from ...core.params import (FloatParam, IntParam, PyObjectParam, StringParam)
 from ...core.pipeline import Transformer
-from ...services.openai import _TEMPLATE_RE
 from .generate import generate
+
+#: {column} slots (same grammar as services.openai.OpenAIPrompt)
+_TEMPLATE_RE = re.compile(r"\{(\w+)\}")
 
 
 class LLMTransformer(Transformer):

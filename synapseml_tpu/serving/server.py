@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -124,19 +123,20 @@ class ServingServer:
     # -- source side (micro-batch pull; HTTPSourceV2 getBatch analogue) ----
     def get_batch(self, max_rows: int = 64,
                   timeout_s: float = 0.05) -> List[ServingRequest]:
+        """Block up to ``timeout_s`` for the first request, then drain only
+        what is already queued — continuous-mode semantics: a lone request
+        is served immediately instead of waiting out the batch window,
+        while a burst still rides one batched transform."""
         out: List[_Exchange] = []
-        deadline = time.monotonic() + timeout_s
+        try:
+            out.append(self._queue.get(timeout=timeout_s))
+        except Empty:
+            return []
         while len(out) < max_rows:
-            left = deadline - time.monotonic()
-            if left <= 0 and out:
-                break
             try:
-                out.append(self._queue.get(timeout=max(left, 0.001)))
+                out.append(self._queue.get_nowait())
             except Empty:
-                if out:
-                    break
-                if left <= 0:
-                    break
+                break
         return [e.request for e in out]
 
     # -- sink side (ServingUDFs.sendReplyUDF analogue) ---------------------

@@ -218,3 +218,25 @@ def test_logging_scrubber():
     from synapseml_tpu.core.logging import scrub
     assert "####" in scrub("https://x?sig=abcdef123&x=1")
     assert "secret" not in scrub("key=secretsecret1234")
+
+
+def test_phase_timer_and_trace(tmp_path):
+    import time as _time
+    from synapseml_tpu.core import PhaseTimer, trace
+
+    t = PhaseTimer()
+    with t.phase("a"):
+        _time.sleep(0.01)
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    rep = t.report()
+    assert rep["a"] >= 0.01 and "b" in rep
+    assert t.counts()["a"] == 2
+    # device trace context works end to end (writes a profile dir)
+    import jax.numpy as jnp
+    with trace(str(tmp_path / "prof")):
+        jnp.ones(8).sum().block_until_ready()
+    t.reset()
+    assert t.report() == {}
