@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import contextlib
+import re
 import threading
 import time
 from typing import Any, Callable, Generic, Iterable, List, Optional, TypeVar
@@ -204,3 +205,17 @@ def assert_models_equal(m1, m2, loose_params: Iterable[str] = ()) -> None:
             continue  # NaN scalars match, like equal_nan for arrays
         elif v1 != v2:
             raise AssertionError(f"param {name}: {v1!r} != {v2!r}")
+
+
+#: ``{column}`` interpolation slots shared by the prompt-templating stages
+#: (services.openai.OpenAIPrompt and models.llm.LLMTransformer)
+TEMPLATE_RE = re.compile(r"\{(\w+)\}")
+
+
+def interpolate_template(template: str, lookup) -> str:
+    """Replace ``{name}`` slots via ``lookup(name) -> Optional[str]``;
+    slots whose lookup returns None (and literal braces) pass through."""
+    def sub(m):
+        v = lookup(m.group(1))
+        return m.group(0) if v is None else str(v)
+    return TEMPLATE_RE.sub(sub, template)

@@ -10,12 +10,13 @@ batch — no per-row JNI, one fused program per pipeline.
 
 from .ops import (gaussian_kernel, gaussian_blur, resize_bilinear,
                   center_crop, flip, threshold, color_convert)
-from .stages import ImageTransformer, UnrollImage, UnrollBinaryImage
+from .stages import (ImageSetAugmenter, ImageTransformer, UnrollImage,
+                     UnrollBinaryImage)
 from .superpixel import SuperpixelTransformer, slic_segments
 
 __all__ = [
     "gaussian_kernel", "gaussian_blur", "resize_bilinear", "center_crop",
     "flip", "threshold", "color_convert",
-    "ImageTransformer", "UnrollImage", "UnrollBinaryImage",
+    "ImageSetAugmenter", "ImageTransformer", "UnrollImage", "UnrollBinaryImage",
     "SuperpixelTransformer", "slic_segments",
 ]

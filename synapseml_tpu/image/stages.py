@@ -182,3 +182,35 @@ class UnrollImage(Transformer):
 class UnrollBinaryImage(UnrollImage):
     """Parity alias (reference: image/UnrollBinaryImage.scala) — binary
     payloads are decoded by the IO layer before reaching this stage."""
+
+
+class ImageSetAugmenter(Transformer):
+    """Supplement a training set with flipped copies of its images
+    (reference: opencv/.../ImageSetAugmenter.scala:20-67 — identity rows
+    plus a left-right and/or up-down flipped union, other columns kept)."""
+
+    inputCol = StringParam(doc="image column", default="image")
+    outputCol = StringParam(doc="augmented image column", default="augmented")
+    flipLeftRight = BoolParam(doc="add left-right flipped copies",
+                              default=True)
+    flipUpDown = BoolParam(doc="add up-down flipped copies", default=False)
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        out = ds.with_column(self.outputCol, ds[self.inputCol])
+        # OpenCV flip codes (ImageTransformer.flip): 1 = left-right, 0 = up-down
+        for enabled, code in ((self.flipLeftRight, 1), (self.flipUpDown, 0)):
+            if not enabled:
+                continue
+            flipped = (ImageTransformer(inputCol=self.inputCol,
+                                        outputCol=self.outputCol)
+                       .flip(code).transform(ds))
+            # keep the augmented column dtype-homogeneous with the identity
+            # rows (ImageTransformer computes in float32)
+            col = flipped[self.outputCol]
+            src = ds[self.inputCol]
+            cast = np.empty(len(col), object)
+            for i in range(len(col)):
+                cast[i] = np.asarray(col[i]).astype(
+                    np.asarray(src[i]).dtype)
+            out = out.union(flipped.with_column(self.outputCol, cast))
+        return out

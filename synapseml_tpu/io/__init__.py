@@ -2,7 +2,9 @@
 (reference: core/.../io/)."""
 
 from .http import (HTTPClient, HTTPRequestData, HTTPResponseData,
+                   CustomInputParser, CustomOutputParser,
                    HTTPTransformer, JSONInputParser, JSONOutputParser,
+                   StringOutputParser,
                    SimpleHTTPTransformer)
 from .binary import BinaryFileReader, read_binary_files
 from .image import decode_image, read_images
@@ -10,7 +12,8 @@ from .powerbi import PowerBIResponseError, PowerBIWriter
 
 __all__ = [
     "HTTPClient", "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
-    "JSONInputParser", "JSONOutputParser", "SimpleHTTPTransformer",
+    "CustomInputParser", "CustomOutputParser", "JSONInputParser",
+    "JSONOutputParser", "StringOutputParser", "SimpleHTTPTransformer",
     "BinaryFileReader", "read_binary_files", "decode_image", "read_images",
     "PowerBIWriter", "PowerBIResponseError",
 ]

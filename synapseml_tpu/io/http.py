@@ -185,6 +185,41 @@ class JSONOutputParser:
         except (ValueError, UnicodeDecodeError):
             return None
 
+class CustomInputParser:
+    """Row dict -> HTTPRequestData via a user function
+    (reference: parsers CustomInputParser — udf-driven request building)."""
+
+    def __init__(self, udf):
+        self.udf = udf
+
+    def __call__(self, row):
+        out = self.udf(row)
+        if isinstance(out, HTTPRequestData):
+            return out
+        raise TypeError("CustomInputParser udf must return HTTPRequestData")
+
+
+class StringOutputParser:
+    """HTTPResponseData -> decoded body string
+    (reference: parsers StringOutputParser)."""
+
+    def __call__(self, resp: HTTPResponseData) -> Optional[str]:
+        if resp.status_code == 0 or resp.entity is None:
+            return None
+        return resp.entity.decode("utf-8", errors="replace")
+
+
+class CustomOutputParser:
+    """HTTPResponseData -> anything via a user function
+    (reference: parsers CustomOutputParser)."""
+
+    def __init__(self, udf):
+        self.udf = udf
+
+    def __call__(self, resp: HTTPResponseData):
+        return self.udf(resp)
+
+
 
 class SimpleHTTPTransformer(Transformer):
     """JSON-in / JSON-out service call per row

@@ -9,7 +9,6 @@ OpenAIPrompt.scala:172): prompt column in, completion column out, with a
 
 from __future__ import annotations
 
-import re
 
 from typing import Any, Dict, List, Optional
 
@@ -18,10 +17,8 @@ import numpy as np
 from ...core.dataset import Dataset
 from ...core.params import (FloatParam, IntParam, PyObjectParam, StringParam)
 from ...core.pipeline import Transformer
+from ...core.utils import interpolate_template
 from .generate import generate
-
-#: {column} slots (same grammar as services.openai.OpenAIPrompt)
-_TEMPLATE_RE = re.compile(r"\{(\w+)\}")
 
 
 class LLMTransformer(Transformer):
@@ -50,15 +47,12 @@ class LLMTransformer(Transformer):
         template = self.get("promptTemplate")
         if not template:
             return [str(p) for p in ds[self.inputCol]]
-        # regex substitution like OpenAIPrompt (services/openai.py): only
-        # {column} slots whose column exists are replaced; literal braces
-        # and unknown slots pass through unchanged
-        def fill(i):
-            return _TEMPLATE_RE.sub(
-                lambda m: str(ds[m.group(1)][i]) if m.group(1) in ds
-                else m.group(0), template)
-
-        return [fill(i) for i in range(ds.num_rows)]
+        # shared {column} interpolation (core.utils.interpolate_template,
+        # same grammar as OpenAIPrompt): unknown slots and literal braces
+        # pass through unchanged
+        return [interpolate_template(
+                    template, lambda c, i=i: ds[c][i] if c in ds else None)
+                for i in range(ds.num_rows)]
 
     def _transform(self, ds: Dataset) -> Dataset:
         b: Dict[str, Any] = self.get("bundle")

@@ -12,7 +12,9 @@ averaging with ``psum`` over the device mesh.
 from .sgd import SGDConfig, SGDState, train_sgd, predict_margin
 from .estimators import (OnlineSGDClassifier, OnlineSGDClassificationModel,
                          OnlineSGDRegressor, OnlineSGDRegressionModel)
-from .featurizer import FeatureInteractions, HashingFeaturizer
+from .dsjson import DSJsonTransformer
+from .featurizer import (FeatureInteractions, HashingFeaturizer,
+                         VectorZipper)
 from .bandit import (ContextualBandit, ContextualBanditModel)
 from .generic import (OnlineGeneric, OnlineGenericModel,
                       OnlineGenericProgressive, parse_vw_line,
@@ -24,7 +26,8 @@ __all__ = [
     "SGDConfig", "SGDState", "train_sgd", "predict_margin",
     "OnlineSGDClassifier", "OnlineSGDClassificationModel",
     "OnlineSGDRegressor", "OnlineSGDRegressionModel",
-    "HashingFeaturizer", "FeatureInteractions",
+    "DSJsonTransformer", "HashingFeaturizer", "FeatureInteractions",
+    "VectorZipper",
     "ContextualBandit", "ContextualBanditModel",
     "OnlineGeneric", "OnlineGenericModel", "OnlineGenericProgressive",
     "parse_vw_line", "vectorize_vw_lines",

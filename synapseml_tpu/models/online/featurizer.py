@@ -123,3 +123,19 @@ class FeatureInteractions(Transformer):
             # overwrite-on-collision: last position hashing to a slot wins
             out[:, idx] = cross
         return ds.with_column(self.outputCol, [row for row in out])
+
+
+class VectorZipper(Transformer):
+    """Combine one or more input columns into a sequence column
+    (reference: vw/VectorZipper.scala:15-45 — used to assemble per-action
+    columns into the action-features list for contextual bandits)."""
+
+    inputCols = ListParam(doc="columns to zip")
+    outputCol = StringParam(doc="sequence output column", default="zipped")
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        cols = [ds[c] for c in self.inputCols]
+        out = np.empty(ds.num_rows, object)
+        for i in range(ds.num_rows):
+            out[i] = [c[i] for c in cols]
+        return ds.with_column(self.outputCol, out)

@@ -249,3 +249,47 @@ class RankingTrainValidationSplitModel(Model):
 
     def _transform(self, ds: Dataset) -> Dataset:
         return self.get("bestModel").transform(ds)
+
+
+class RankingAdapter(Estimator):
+    """Adapt any recommender estimator for ranking evaluation
+    (reference: RankingAdapter.scala — fit the wrapped estimator, then
+    ``transform`` emits one row per user with the top-k predicted item
+    list and the ground-truth item list, the schema RankingEvaluator
+    consumes)."""
+
+    recommender = PyObjectParam(doc="wrapped recommender estimator")
+    k = IntParam(doc="recommendations per user", default=10)
+    userCol = StringParam(doc="user column", default="user")
+    itemCol = StringParam(doc="item column", default="item")
+
+    def _fit(self, ds: Dataset) -> "RankingAdapterModel":
+        model = self.get("recommender").fit(ds)
+        out = RankingAdapterModel()
+        out.set("recommenderModel", model)
+        out._copy_values_from(self)
+        return out
+
+
+class RankingAdapterModel(Model):
+    recommenderModel = PyObjectParam(doc="fitted recommender")
+    k = IntParam(doc="recommendations per user", default=10)
+    userCol = StringParam(doc="user column", default="user")
+    itemCol = StringParam(doc="item column", default="item")
+
+    def _transform(self, ds: Dataset) -> Dataset:
+        model = self.get("recommenderModel")
+        recs = model.recommend_for_all_users(int(self.k))
+        rec_map: Dict[Any, List] = {}
+        rec_col = recs.columns[1]
+        for r in recs.iter_rows():
+            rec_map[r[recs.columns[0]]] = [m["item"] for m in r[rec_col]]
+        actual_map: Dict[Any, List] = {}
+        for r in ds.iter_rows():
+            actual_map.setdefault(r[self.userCol], []).append(r[self.itemCol])
+        users = [u for u in actual_map if u in rec_map]
+        return Dataset({
+            self.userCol: np.asarray(users, dtype=object),
+            "prediction": [rec_map[u] for u in users],
+            "label": [actual_map[u] for u in users],
+        })

@@ -15,6 +15,7 @@ from typing import Any, Dict
 
 from ..core.params import DictParam, FloatParam, IntParam, StringParam
 from ..io.http import HTTPRequestData
+from ..core.utils import interpolate_template
 from .base import RemoteServiceTransformer, ServiceParam
 
 
@@ -70,7 +71,6 @@ class OpenAIEmbedding(RemoteServiceTransformer):
         return value
 
 
-_TEMPLATE_RE = re.compile(r"\{(\w+)\}")
 
 
 class OpenAIPrompt(OpenAICompletion):
@@ -85,8 +85,7 @@ class OpenAIPrompt(OpenAICompletion):
         template = self.promptTemplate
         if not template:
             raise ValueError("promptTemplate is required")
-        prompt = _TEMPLATE_RE.sub(
-            lambda m: str(row.get(m.group(1), m.group(0))), template)
+        prompt = interpolate_template(template, row.get)
         return super().prepare_request({**row, self.promptCol: prompt})
 
     def parse_response(self, value: Any) -> Any:

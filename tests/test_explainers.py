@@ -307,3 +307,25 @@ class TestImageExplainers:
         exp = np.asarray(out["explanation"][0])[0]
         fx = BrightQuadrantModel().transform(ds)["score"][0]
         assert exp[0] + exp[1:].sum() == pytest.approx(fx, rel=0.1)
+
+
+class TestImageSetAugmenter:
+    def test_lr_flip_doubles_rows(self):
+        from synapseml_tpu.image import ImageSetAugmenter
+        img = np.arange(4 * 4 * 3, dtype=np.float64).reshape(4, 4, 3)
+        ds = Dataset({"image": [img, img * 2], "label": [0.0, 1.0]})
+        aug = ImageSetAugmenter(inputCol="image", outputCol="augmented",
+                                flipLeftRight=True, flipUpDown=False)
+        out = aug.transform(ds)
+        assert out.num_rows == 4
+        # other columns carried through the union
+        assert list(out["label"]) == [0.0, 1.0, 0.0, 1.0]
+        np.testing.assert_allclose(np.asarray(out["augmented"][2]),
+                                   img[:, ::-1, :])
+
+    def test_both_flips_triple(self):
+        from synapseml_tpu.image import ImageSetAugmenter
+        img = np.ones((2, 3, 3))
+        ds = Dataset({"image": [img]})
+        aug = ImageSetAugmenter(flipLeftRight=True, flipUpDown=True)
+        assert aug.transform(ds).num_rows == 3

@@ -219,3 +219,25 @@ class TestPipelineServer:
             assert b"kaboom" in exc.value.read()
         finally:
             ps.close()
+
+
+class TestParserStages:
+    def test_string_and_custom_parsers(self):
+        from synapseml_tpu.io import (CustomInputParser, CustomOutputParser,
+                                      StringOutputParser)
+        from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
+
+        sp = StringOutputParser()
+        assert sp(HTTPResponseData(status_code=200, entity=b"ok",
+                                   headers={})) == "ok"
+        assert sp(HTTPResponseData(status_code=0, entity=None,
+                                   headers={})) is None
+
+        cip = CustomInputParser(lambda row: HTTPRequestData(
+            url="http://x/", method="GET", headers={}, entity=None))
+        req = cip({"a": 1})
+        assert req.method == "GET"
+
+        cop = CustomOutputParser(lambda resp: resp.status_code * 2)
+        assert cop(HTTPResponseData(status_code=21, entity=b"",
+                                    headers={})) == 42

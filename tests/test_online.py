@@ -240,3 +240,27 @@ class TestSyncSchedule:
         state, _ = train_sgd(x, y, cfg, mesh=mesh)
         pred = predict_margin(state, x)
         assert np.corrcoef(pred, y)[0, 1] > 0.98
+
+
+class TestVectorZipperAndDSJson:
+    def test_vector_zipper(self):
+        from synapseml_tpu.models.online import VectorZipper
+        ds = Dataset({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+        out = VectorZipper(inputCols=["a", "b"], outputCol="z").transform(ds)
+        assert list(out["z"][0]) == [1.0, 3.0]
+        assert list(out["z"][1]) == [2.0, 4.0]
+
+    def test_dsjson_extracts_header_columns(self):
+        import json
+        from synapseml_tpu.models.online import DSJsonTransformer
+        ev = {"EventId": "abc", "_label_cost": -1.0,
+              "_label_probability": 0.25, "_labelIndex": 2,
+              "c": {"x": 1}}
+        ds = Dataset({"value": [json.dumps(ev), json.dumps(
+            {"EventId": "def", "_label_cost": 0.0,
+             "_label_probability": 0.5, "_labelIndex": 0})]})
+        out = DSJsonTransformer().transform(ds)
+        assert list(out["EventId"]) == ["abc", "def"]
+        assert out["rewards"][0] == {"reward": -1.0}
+        np.testing.assert_allclose(out["probLog"], [0.25, 0.5])
+        assert list(out["chosenActionIndex"]) == [2, 0]
