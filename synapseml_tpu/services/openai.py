@@ -10,7 +10,6 @@ OpenAI."""
 from __future__ import annotations
 
 import json
-import re
 from typing import Any, Dict
 
 from ..core.params import DictParam, FloatParam, IntParam, StringParam
