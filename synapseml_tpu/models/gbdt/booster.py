@@ -615,6 +615,24 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if pad:
         bag[n:] = 0.0
     base_bag = bag.copy()
+    # tunnel/PCIe round trips dominate small-step training: dart, per-iter
+    # validation and callbacks need each tree on the host DURING the loop;
+    # everything else runs fully async — device-resident masks are hoisted
+    # and tree downloads deferred until after the last dispatch
+    eager_host = is_dart or have_valid or bool(callbacks)
+    pending_stacks: List[Tuple[Tree, List[float]]] = []
+
+    def append_stack(tstack: Tree, per_class_weights: List[float]) -> None:
+        """Download a (K, M) tree stack — one transfer per field — and
+        append its K per-class trees with their weights."""
+        host_fields = [np.asarray(a) for a in tstack]
+        for k in range(K):
+            trees.append(Tree(*[a[k] for a in host_fields]))
+            tree_class.append(k)
+            tree_weights.append(per_class_weights[k])
+    bag_dev = None
+    fmask_dev = None
+    rf_reset_scores = None
     # leaf-wise depth is bounded by num_leaves-1 splits; never truncate
     depth_hint = max(2, config.num_leaves)
     bag_rng = np.random.default_rng(config.bagging_seed)
@@ -626,11 +644,18 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 and (config.bagging_freq == 0 or it % max(config.bagging_freq, 1) == 0)):
             mask = (bag_rng.random(N) < config.bagging_fraction).astype(np.float32)
             bag = base_bag * mask
-        feature_mask = np.ones(F, bool)
+            bag_dev = None                    # re-upload the new mask
         if config.feature_fraction < 1.0:
             k = max(1, int(round(F * config.feature_fraction)))
             feature_mask = np.zeros(F, bool)
             feature_mask[rng.choice(F, k, replace=False)] = True
+            fmask_dev = None
+        elif fmask_dev is None:
+            feature_mask = np.ones(F, bool)
+        if bag_dev is None:
+            bag_dev = jnp.asarray(bag)
+        if fmask_dev is None:
+            fmask_dev = jnp.asarray(feature_mask)
 
         # dart: drop trees, rebase scores
         dropped: List[int] = []
@@ -644,10 +669,15 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
         key = jax.random.PRNGKey(config.seed * 100003 + it)
         tstack, new_scores = step(bins_t, scores, labels, weights,
-                                  jnp.asarray(bag), jnp.asarray(feature_mask),
+                                  bag_dev, fmask_dev,
                                   key, upper_bounds, num_bins)
-        new_trees = [Tree(*[np.asarray(a[k]) for a in tstack]) for k in range(K)]
+        if eager_host:
+            new_trees = [Tree(*[np.asarray(a[k]) for a in tstack])
+                         for k in range(K)]
+        else:
+            new_trees = None                  # downloaded after the loop
         if it == 0:
+            jax.block_until_ready(new_scores)
             measures.compile_s = _time.perf_counter() - _t_train
 
         dropped_weight_changes = []
@@ -672,14 +702,21 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             scores = new_scores
             weights_new = [1.0] * K
 
-        for k in range(K):
-            trees.append(new_trees[k])
-            tree_class.append(k)
-            tree_weights.append(weights_new[k])
+        if eager_host:
+            for k in range(K):
+                trees.append(new_trees[k])
+                tree_class.append(k)
+                tree_weights.append(weights_new[k])
+        else:
+            pending_stacks.append((tstack, weights_new))
         if is_rf:
             rf_denominator += 1
-            # rf: gradients always at init margin → reset scores
-            scores = put(base_margin.astype(np.float32), base_margin.ndim)
+            # rf: gradients always at init margin → reset scores (the
+            # reset array is device-resident once, reused every iteration)
+            if rf_reset_scores is None:
+                rf_reset_scores = put(base_margin.astype(np.float32),
+                                      base_margin.ndim)
+            scores = rf_reset_scores
 
         # validation eval + early stopping (TrainUtils.scala:143-169)
         if have_valid:
@@ -719,6 +756,11 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             for cb in callbacks:
                 cb(it, trees, eval_history)
 
+    # deferred mode: one sync for the whole run, then download all trees
+    if pending_stacks:
+        jax.block_until_ready([t for t, _ in pending_stacks])
+        for tstack, w in pending_stacks:
+            append_stack(tstack, w)
     measures.training_s = _time.perf_counter() - _t_train
     measures.iterations = len(trees) // max(K, 1)  # this fit only — before
     if init_model is not None:                     # the warm-start fold-in
