@@ -487,3 +487,26 @@ class TestReviewRegressions:
                       "prediction": np.array([0, 1])})
         with pytest.raises(ValueError, match="AUC requires"):
             ComputeModelStatistics(evaluationMetric="AUC").transform(ds)
+
+
+class TestNewStageFuzzing(TransformerFuzzing):
+    """Fuzzing coverage (experiment + serialization + getter/setter) for
+    the parity stages added after the original suites."""
+
+    def fuzzing_objects(self):
+        import json
+        from synapseml_tpu.image import ImageSetAugmenter
+        from synapseml_tpu.models.online import (DSJsonTransformer,
+                                                 VectorZipper)
+
+        img = np.arange(12, dtype=np.float64).reshape(2, 2, 3)
+        return [
+            TestObject(ImageSetAugmenter(flipLeftRight=True),
+                       Dataset({"image": [img]})),
+            TestObject(VectorZipper(inputCols=["a", "b"], outputCol="z"),
+                       Dataset({"a": [1.0], "b": [2.0]})),
+            TestObject(DSJsonTransformer(),
+                       Dataset({"value": [json.dumps(
+                           {"EventId": "e", "_label_cost": -1.0,
+                            "_label_probability": 0.5, "_labelIndex": 1})]})),
+        ]
