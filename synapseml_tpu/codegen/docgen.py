@@ -40,7 +40,11 @@ def generate_docs(stages: Dict[str, type], out_dir: str) -> List[str]:
     for qual, cls in stages.items():
         by_module[cls.__module__].append(cls)
     paths = []
-    index = ["# synapseml_tpu API reference", ""]
+    index = ["# synapseml_tpu API reference", "",
+             "Generated from stage param metadata; regenerate with::", "",
+             "    python -c \"from synapseml_tpu.codegen import "
+             "discover_stages, generate_docs; "
+             "generate_docs(discover_stages(), 'docs/api')\"", ""]
     for module, classes in sorted(by_module.items()):
         fname = module.replace("synapseml_tpu.", "").replace(".", "_") + ".md"
         path = os.path.join(out_dir, fname)

@@ -299,11 +299,19 @@ class Booster:
 def _make_step(p: GrowthParams, objective_fn, num_class: int,
                learning_rate: float, mesh: Optional[Mesh], use_goss: bool,
                top_rate: float, other_rate: float, ova: bool = False,
-               use_pallas: bool = False):
+               use_pallas: bool = False, bagging_fraction: float = 1.0):
     """Build the jitted one-iteration step.
 
-    step(binned, scores, labels, weights, bag_mask, feature_mask, key,
-         upper_bounds, num_bins) -> (trees, new_scores)
+    step(binned, scores, labels, weights, (base_bag, bag_key),
+         feature_mask, key, upper_bounds, num_bins) -> (trees, new_scores)
+
+    Bagging happens ON DEVICE: ``base_bag`` is the constant pad-row mask
+    and the per-iteration row subsample is drawn from ``bag_key`` when
+    ``bagging_fraction < 1`` — no per-iteration host mask upload.  Passing
+    the same bag_key across iterations reproduces bagging_freq persistence.
+    Each shard folds its mesh index into the key, so bagged models are
+    deterministic for a fixed mesh size but differ across mesh sizes
+    (the unbagged paths remain mesh-invariant).
 
     For num_class==1 labels are float targets; for multiclass labels are
     int class ids and scores are (N, K).
@@ -325,8 +333,17 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
         amp = (1.0 - top_rate) / jnp.maximum(other_rate, 1e-6)
         return jnp.where(topset, 1.0, jnp.where(rest_keep, amp, 0.0)) * bag
 
-    def one_step(bins_t, scores, labels, weights, bag_mask, feature_mask,
+    def one_step(bins_t, scores, labels, weights, bag_in, feature_mask,
                  key, upper_bounds, num_bins):
+        base_bag, bag_key = bag_in
+        if bagging_fraction < 1.0:
+            if axis is not None:
+                bag_key = jax.random.fold_in(bag_key, lax.axis_index(axis))
+            bag_mask = base_bag * (
+                jax.random.uniform(bag_key, base_bag.shape)
+                < bagging_fraction).astype(jnp.float32)
+        else:
+            bag_mask = base_bag
         trees = []
         if num_class == 1:
             grad, hess = objective_fn(scores, labels, weights)
@@ -366,7 +383,8 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
     ndim_scores = 1 if num_class == 1 else 2
     in_specs = (P(None, DATA_AXIS),                       # bins_t (F, N)
                 P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None),
-                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),  # labels/weights/bag
+                P(DATA_AXIS), P(DATA_AXIS),                # labels/weights
+                (P(DATA_AXIS), P()),                       # (base_bag, bag_key)
                 P(), P(), P(), P())                        # fmask/key/bounds/nbins
     out_specs = (P(),                                      # trees replicated
                  P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None))
@@ -568,10 +586,14 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     lr = 1.0 if is_rf else config.learning_rate
 
     p = config.growth_params()
+    use_bagging = (config.bagging_fraction < 1.0
+                   and (is_rf or config.bagging_freq > 0))
     step = _make_step(p, objective_fn, K, lr, mesh, use_goss,
                       config.top_rate, config.other_rate,
                       ova=(config.objective == "multiclassova"),
-                      use_pallas=use_pallas)
+                      use_pallas=use_pallas,
+                      bagging_fraction=(config.bagging_fraction
+                                        if use_bagging else 1.0))
 
     # -- validation setup (validationIndicatorCol analogue) ----------------
     have_valid = valid is not None
@@ -614,13 +636,14 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     bag = np.ones(N, np.float32)
     if pad:
         bag[n:] = 0.0
-    base_bag = bag.copy()
     # tunnel/PCIe round trips dominate small-step training: dart, per-iter
     # validation and callbacks need each tree on the host DURING the loop;
     # everything else runs fully async — device-resident masks are hoisted
     # and tree downloads deferred until after the last dispatch
     eager_host = is_dart or have_valid or bool(callbacks)
     pending_stacks: List[Tuple[Tree, List[float]]] = []
+    base_bag_dev = jnp.asarray(bag)     # pad-row mask, uploaded once
+    bag_root_key = jax.random.PRNGKey(config.bagging_seed)
 
     def append_stack(tstack: Tree, per_class_weights: List[float]) -> None:
         """Download a (K, M) tree stack — one transfer per field — and
@@ -630,21 +653,17 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             trees.append(Tree(*[a[k] for a in host_fields]))
             tree_class.append(k)
             tree_weights.append(per_class_weights[k])
-    bag_dev = None
     fmask_dev = None
     rf_reset_scores = None
     # leaf-wise depth is bounded by num_leaves-1 splits; never truncate
     depth_hint = max(2, config.num_leaves)
-    bag_rng = np.random.default_rng(config.bagging_seed)
 
     for it in range(config.num_iterations):
-        # bagging (bagging_fraction/freq semantics)
-        if (config.bagging_fraction < 1.0
-                and (is_rf or config.bagging_freq > 0)
-                and (config.bagging_freq == 0 or it % max(config.bagging_freq, 1) == 0)):
-            mask = (bag_rng.random(N) < config.bagging_fraction).astype(np.float32)
-            bag = base_bag * mask
-            bag_dev = None                    # re-upload the new mask
+        # bagging (bagging_fraction/freq semantics): the mask is drawn on
+        # device from this key; reusing a key across freq iterations
+        # reproduces the persist-until-refresh behavior
+        bag_key = jax.random.fold_in(bag_root_key,
+                                     it // max(config.bagging_freq, 1))
         if config.feature_fraction < 1.0:
             k = max(1, int(round(F * config.feature_fraction)))
             feature_mask = np.zeros(F, bool)
@@ -652,8 +671,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             fmask_dev = None
         elif fmask_dev is None:
             feature_mask = np.ones(F, bool)
-        if bag_dev is None:
-            bag_dev = jnp.asarray(bag)
         if fmask_dev is None:
             fmask_dev = jnp.asarray(feature_mask)
 
@@ -669,7 +686,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
         key = jax.random.PRNGKey(config.seed * 100003 + it)
         tstack, new_scores = step(bins_t, scores, labels, weights,
-                                  bag_dev, fmask_dev,
+                                  (base_bag_dev, bag_key), fmask_dev,
                                   key, upper_bounds, num_bins)
         if eager_host:
             new_trees = [Tree(*[np.asarray(a[k]) for a in tstack])
