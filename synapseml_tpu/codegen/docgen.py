@@ -41,7 +41,7 @@ def generate_docs(stages: Dict[str, type], out_dir: str) -> List[str]:
         by_module[cls.__module__].append(cls)
     paths = []
     index = ["# synapseml_tpu API reference", "",
-             "Generated from stage param metadata; regenerate with::", "",
+             "Generated from stage param metadata; regenerate with:", "",
              "    python -c \"from synapseml_tpu.codegen import "
              "discover_stages, generate_docs; "
              "generate_docs(discover_stages(), 'docs/api')\"", ""]

@@ -9,7 +9,7 @@ import sys
 import pytest
 
 EXAMPLES = ["gbdt_classification", "online_learning", "deep_learning",
-            "explainability", "serving"]
+            "explainability", "serving", "onnx_inference"]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
