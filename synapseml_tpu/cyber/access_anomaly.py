@@ -179,7 +179,15 @@ class AccessAnomalyModel(Model):
             uniq_r = list(dict.fromkeys(ress[rows]))
             u_idx = {u: i for i, u in enumerate(uniq_u)}
             r_idx = {r: i for i, r in enumerate(uniq_r)}
-            rank = len(next(iter(uv_map.values()))) if uv_map else 1
+            # rank from whichever map is non-empty: a tenant can have an
+            # empty user map but rank>1 resource vectors (or vice versa),
+            # and a rank-1 matrix would break the assignment below
+            if uv_map:
+                rank = len(next(iter(uv_map.values())))
+            elif rv_map:
+                rank = len(next(iter(rv_map.values())))
+            else:
+                rank = 1
             u_mat = np.zeros((len(uniq_u), rank))
             u_known = np.zeros(len(uniq_u), bool)
             for i, u in enumerate(uniq_u):

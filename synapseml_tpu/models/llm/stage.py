@@ -68,6 +68,10 @@ class LLMTransformer(Transformer):
                 "model")
         enc = [[t for t in row if t]            # strip padding
                for row in tok.encode(prompts, budget)[0]]
+        # an empty/all-unknown prompt would make a (B, 0) batch and crash
+        # the prefill's logits[:, -1] inside jit — seed it with one pad
+        # token (id 0) so generation starts from a neutral context
+        enc = [ids if ids else [0] for ids in enc]
         out: List[Optional[str]] = [None] * len(prompts)
         by_len: Dict[int, List[int]] = {}
         for i, ids in enumerate(enc):

@@ -26,6 +26,17 @@ PROB_LOGGED_COL = "probLog"
 CHOSEN_ACTION_INDEX_COL = "chosenActionIndex"
 
 
+def _reward_value(raw) -> float:
+    """Missing or malformed reward fields become NaN (the reference emits
+    Spark nulls); one corrupt event must not abort the whole batch."""
+    if raw is None:
+        return float("nan")
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
 class DSJsonTransformer(Transformer):
     """Parse ds-json bandit events into typed columns."""
 
@@ -40,11 +51,13 @@ class DSJsonTransformer(Transformer):
         event_ids = np.empty(n, object)
         reward_rows = np.empty(n, object)
         prob = np.full(n, np.nan, np.float32)
-        chosen = np.zeros(n, np.int32)
+        # -1 = missing (the reference emits Spark nulls for absent fields;
+        # 0 is a valid action index so it cannot double as the sentinel)
+        chosen = np.full(n, -1, np.int32)
         for i, raw in enumerate(ds[self.dsJsonColumn]):
             obj = json.loads(str(raw))
             event_ids[i] = obj.get(EVENT_ID_COL)
-            reward_rows[i] = {alias: float(obj.get(field, 0.0) or 0.0)
+            reward_rows[i] = {alias: _reward_value(obj.get(field))
                               for alias, field in rewards.items()}
             p = obj.get("_label_probability")
             if p is not None:

@@ -28,6 +28,7 @@ class _BufferedBatcherBase(Iterator[List[T]]):
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
         self._consumed = 0          # bumped by every __next__ (liveness)
+        self._finished = threading.Event()   # producer exited (± sentinel)
         self._thread = threading.Thread(target=self._produce, daemon=True)
 
     def _produce(self) -> None:
@@ -37,6 +38,10 @@ class _BufferedBatcherBase(Iterator[List[T]]):
             self._error = e
         finally:
             self._put_sentinel()
+            # even when _put_sentinel gave up on a saturated queue, the
+            # consumer's _get_blocking treats empty-queue + finished
+            # producer as end-of-stream, so the sentinel is never lost
+            self._finished.set()
 
     def _fill(self) -> None:
         raise NotImplementedError
@@ -72,6 +77,17 @@ class _BufferedBatcherBase(Iterator[List[T]]):
                     stalled_ticks = 0
                 else:
                     stalled_ticks += 1
+
+    def _get_blocking(self):
+        """Next queue item, or the sentinel once the producer has exited
+        and the queue is drained (covers the saturated-queue give-up path
+        in _put_sentinel)."""
+        while True:
+            try:
+                return self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._finished.is_set() and self._queue.empty():
+                    return _SENTINEL
 
     def _exhausted(self) -> None:
         """Sentinel seen: stay exhausted, surface any producer error."""
@@ -110,7 +126,7 @@ class DynamicBufferedBatcher(_BufferedBatcherBase):
     def __next__(self) -> List[T]:
         self.start()
         self._consumed += 1
-        first = self._queue.get()
+        first = self._get_blocking()
         if first is _SENTINEL:
             self._exhausted()
             raise StopIteration
@@ -152,7 +168,7 @@ class FixedBufferedBatcher(_BufferedBatcherBase):
     def __next__(self) -> List[T]:
         self.start()
         self._consumed += 1
-        item = self._queue.get()
+        item = self._get_blocking()
         if item is _SENTINEL:
             self._exhausted()
             raise StopIteration
@@ -183,7 +199,7 @@ class TimeIntervalBatcher(_BufferedBatcherBase):
     def __next__(self) -> List[T]:
         self.start()
         self._consumed += 1
-        first = self._queue.get()
+        first = self._get_blocking()
         if first is _SENTINEL:
             self._exhausted()
             raise StopIteration

@@ -182,6 +182,33 @@ class RecommendationIndexerModel(Model):
         return np.asarray(self.get("itemVocabulary"))[np.asarray(idx)]
 
 
+def _top_k_actuals(ds: Dataset, user_col: str, item_col: str,
+                   rating_col: str, k: int) -> Dict[Any, List]:
+    """Per-user ground-truth item lists, windowed by rating desc / item
+    asc and truncated to k (reference: RankingAdapter.scala transform's
+    Window + rank <= k).  Null ratings sort last (Spark desc default);
+    non-comparable item ties fall back to string ordering rather than
+    raising."""
+    has_rating = rating_col in ds.columns
+    rows_by_user: Dict[Any, List] = {}
+    for r in ds.iter_rows():
+        rating = r[rating_col] if has_rating else 0.0
+        # None and NaN both mean "no rating" (NaN is how float columns
+        # store nulls here) and must sort last, not poison the sort
+        neg = float("inf") if rating is None else -float(rating)
+        if neg != neg:                      # NaN rating
+            neg = float("inf")
+        rows_by_user.setdefault(r[user_col], []).append((neg, r[item_col]))
+    out = {}
+    for u, rows in rows_by_user.items():
+        try:
+            ordered = sorted(rows)
+        except TypeError:
+            ordered = sorted(rows, key=lambda p: (p[0], str(p[1])))
+        out[u] = [it for _, it in ordered[:k]]
+    return out
+
+
 class RankingTrainValidationSplit(Estimator):
     """Per-user leave-out split + fit + ranking evaluation
     (reference: RankingTrainValidationSplit.scala).  The estimator must
@@ -193,6 +220,8 @@ class RankingTrainValidationSplit(Estimator):
                             default=0.75)
     userCol = StringParam(doc="user column", default="user")
     itemCol = StringParam(doc="item column", default="item")
+    ratingCol = StringParam(doc="rating column for ground-truth ranking",
+                            default="rating")
     seed = IntParam(doc="rng seed", default=0)
     minRatingsPerUser = IntParam(doc="drop users with fewer events",
                                  default=1)
@@ -222,10 +251,8 @@ class RankingTrainValidationSplit(Estimator):
         rec_col = recs.columns[1]
         for r in recs.iter_rows():
             rec_map[r[recs.columns[0]]] = [m["item"] for m in r[rec_col]]
-        actual_map: Dict[Any, List] = {}
-        for r in test.iter_rows():
-            actual_map.setdefault(r[self.userCol], []).append(
-                r[self.itemCol])
+        actual_map = _top_k_actuals(test, self.userCol, self.itemCol,
+                                    self.ratingCol, k)
         eval_users = [u for u in actual_map if u in rec_map]
         eval_ds = Dataset({
             "user": np.asarray(eval_users, dtype=object),
@@ -262,6 +289,8 @@ class RankingAdapter(Estimator):
     k = IntParam(doc="recommendations per user", default=10)
     userCol = StringParam(doc="user column", default="user")
     itemCol = StringParam(doc="item column", default="item")
+    ratingCol = StringParam(doc="rating column for ground-truth ranking",
+                            default="rating")
 
     def _fit(self, ds: Dataset) -> "RankingAdapterModel":
         model = self.get("recommender").fit(ds)
@@ -276,17 +305,22 @@ class RankingAdapterModel(Model):
     k = IntParam(doc="recommendations per user", default=10)
     userCol = StringParam(doc="user column", default="user")
     itemCol = StringParam(doc="item column", default="item")
+    ratingCol = StringParam(doc="rating column for ground-truth ranking",
+                            default="rating")
 
     def _transform(self, ds: Dataset) -> Dataset:
         model = self.get("recommenderModel")
-        recs = model.recommend_for_all_users(int(self.k))
+        k = int(self.k)
+        recs = model.recommend_for_all_users(k)
         rec_map: Dict[Any, List] = {}
         rec_col = recs.columns[1]
         for r in recs.iter_rows():
             rec_map[r[recs.columns[0]]] = [m["item"] for m in r[rec_col]]
-        actual_map: Dict[Any, List] = {}
-        for r in ds.iter_rows():
-            actual_map.setdefault(r[self.userCol], []).append(r[self.itemCol])
+        # ground truth mirrors the reference's Window(rating desc, item asc)
+        # + rank <= k truncation (RankingAdapter.scala transform): only each
+        # user's top-k actual items count as relevant for recall/MAP/NDCG.
+        actual_map = _top_k_actuals(ds, self.userCol, self.itemCol,
+                                    self.ratingCol, k)
         users = [u for u in actual_map if u in rec_map]
         return Dataset({
             self.userCol: np.asarray(users, dtype=object),

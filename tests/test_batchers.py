@@ -113,3 +113,29 @@ class TestProducerErrorPropagation:
         time.sleep(0.3)            # producer hits full queue + exhausts src
         rest = list(b)             # must terminate, not hang
         assert rest == [[2, 3], [4, 5]]
+
+    def test_lost_sentinel_falls_back_to_finished_flag(self):
+        """Even if _put_sentinel gave up (30s saturated-queue timeout), a
+        consumer draining the queue later must see end-of-stream via the
+        producer-finished flag, not block forever (advisor finding,
+        round 1)."""
+        from synapseml_tpu.ops.batchers import FixedBufferedBatcher
+
+        b = FixedBufferedBatcher(iter(range(4)), batch_size=2,
+                                 max_buffer_size=2)
+        assert next(b) == [0, 1]
+        b._thread.join(timeout=5.0)
+        # simulate the give-up path: strip the sentinel the producer
+        # managed to enqueue, leaving only real batches + finished flag
+        items = []
+        while not b._queue.empty():
+            it = b._queue.get_nowait()
+            if not isinstance(it, list):
+                continue
+            items.append(it)
+        for it in items:
+            b._queue.put(it)
+        assert next(b) == [2, 3]
+        import pytest
+        with pytest.raises(StopIteration):
+            b.__next__()

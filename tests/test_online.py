@@ -264,3 +264,16 @@ class TestVectorZipperAndDSJson:
         assert out["rewards"][0] == {"reward": -1.0}
         np.testing.assert_allclose(out["probLog"], [0.25, 0.5])
         assert list(out["chosenActionIndex"]) == [2, 0]
+
+    def test_dsjson_missing_fields_use_sentinels(self):
+        """Absent header fields must be distinguishable from real values
+        (reference emits Spark nulls): chosenActionIndex=-1, reward=NaN —
+        never a valid-looking 0 (advisor finding, round 1)."""
+        import json
+        from synapseml_tpu.models.online import DSJsonTransformer
+        ds = Dataset({"value": [json.dumps({"EventId": "only-context",
+                                            "c": {"x": 1}})]})
+        out = DSJsonTransformer().transform(ds)
+        assert out["chosenActionIndex"][0] == -1
+        assert np.isnan(out["probLog"][0])
+        assert np.isnan(out["rewards"][0]["reward"])

@@ -178,3 +178,27 @@ def test_ranking_adapter_roundtrip():
     assert set(out.columns) >= {"user", "prediction", "label"}
     metric = RankingEvaluator(k=10, metricName="recallAtK").evaluate(out)
     assert metric > 0.0
+
+
+def test_ranking_adapter_truncates_label_to_top_k():
+    """Ground truth is windowed by rating desc / item asc and truncated to
+    k rows per user before collection (reference: RankingAdapter.scala
+    transform) — users with more than k interactions must not emit them
+    all as relevant."""
+    from synapseml_tpu.recommendation import RankingAdapter, SAR
+    rows = []
+    # user u0: 6 interactions with distinct ratings; k=3 keeps the 3
+    # highest-rated items (i5, i4, i3)
+    for i in range(6):
+        rows.append({"user": "u0", "item": f"i{i}", "rating": float(i)})
+    for u in range(1, 8):          # enough co-occurrence for SAR to fit
+        for i in range(4):
+            rows.append({"user": f"u{u}", "item": f"i{i}", "rating": 1.0})
+    ds = Dataset.from_rows(rows)
+    adapter = RankingAdapter(recommender=SAR(userCol="user", itemCol="item",
+                                             ratingCol="rating"), k=3)
+    out = adapter.fit(ds).transform(ds)
+    labels = {r["user"]: r["label"] for r in out.iter_rows()}
+    assert labels["u0"] == ["i5", "i4", "i3"]
+    # ties broken by item ascending
+    assert labels["u1"] == ["i0", "i1", "i2"]
