@@ -11,6 +11,28 @@ executor→GPU placement.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Persistent XLA compilation cache: first-compile of the jitted training
+# steps costs tens of seconds on TPU; caching compiled executables on disk
+# makes every later process (bench runs, notebooks, serving restarts) start
+# warm.  Opt out with SYNAPSEML_TPU_NO_COMPILE_CACHE=1.
+if not _os.environ.get("SYNAPSEML_TPU_NO_COMPILE_CACHE"):
+    _cache = _os.path.join(_os.path.expanduser("~"), ".cache",
+                           "synapseml_tpu", "xla_cache")
+    _os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    try:
+        # if jax was imported before us its config already snapshotted the
+        # env — set the live config too (works regardless of import order)
+        import jax as _jax
+        if _jax.config.jax_compilation_cache_dir is None:
+            _jax.config.update("jax_compilation_cache_dir",
+                               _os.environ["JAX_COMPILATION_CACHE_DIR"])
+            _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:  # never let cache setup break import
+        pass
+
 from .core.dataset import Dataset
 from .core.params import Params
 from .core.pipeline import (Estimator, Evaluator, Model, Pipeline,

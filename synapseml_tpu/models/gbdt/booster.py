@@ -35,8 +35,9 @@ from ...parallel.mesh import DATA_AXIS, batch_sharding, replicated
 from . import metrics as metrics_mod
 from .binning import BinMapper, fit_bin_mapper
 from .objectives import (get_objective, initial_score, softmax_grad_hess)
-from .trainer import (GrowthParams, Tree, grow_tree, max_nodes,
-                      predict_raw_features, stack_trees, tree_depth)
+from .trainer import (GrowthParams, Tree, default_n_slots, grow_tree,
+                      grow_tree_depthwise, max_nodes, predict_raw_features,
+                      stack_trees, tree_depth)
 
 
 @dataclasses.dataclass
@@ -80,6 +81,10 @@ class BoostingConfig:
     verbosity: int = -1
     parallelism: str = "data_parallel"     # data_parallel | voting_parallel
     top_k: int = 20                        # voting-parallel votes per rank
+    #: "depthwise": wave growth, all of a level's histograms in one batched
+    #: device pass (fast path); "lossguide": strict best-first leaf-wise
+    #: (LightGBM's exact growth order).  voting_parallel implies lossguide.
+    growth_policy: str = "depthwise"
     pass_through: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def growth_params(self) -> GrowthParams:
@@ -296,10 +301,23 @@ class Booster:
 # training
 # --------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=None)
+def _objective_with_kwargs(name, kwargs_items):
+    """Objective + frozen kwargs as a STABLE function object, so the
+    _make_step cache below keys on something that repeats across calls."""
+    base = get_objective(name)
+    if not kwargs_items:
+        return base
+    kw = dict(kwargs_items)
+    return lambda s, l, ww: base(s, l, ww, **kw)
+
+
+@functools.lru_cache(maxsize=16)
 def _make_step(p: GrowthParams, objective_fn, num_class: int,
                learning_rate: float, mesh: Optional[Mesh], use_goss: bool,
                top_rate: float, other_rate: float, ova: bool = False,
-               use_pallas: bool = False, bagging_fraction: float = 1.0):
+               use_pallas: bool = False, bagging_fraction: float = 1.0,
+               growth_policy: str = "depthwise"):
     """Build the jitted one-iteration step.
 
     step(binned, scores, labels, weights, (base_bag, bag_key),
@@ -317,6 +335,11 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
     int class ids and scores are (N, K).
     """
     axis = DATA_AXIS if mesh is not None else None
+    if growth_policy == "depthwise" and p.voting_k == 0:
+        grower = functools.partial(grow_tree_depthwise,
+                                   n_slots=default_n_slots(p.num_leaves))
+    else:
+        grower = grow_tree            # lossguide / voting-parallel
 
     def goss_weights(g_abs, bag, key):
         """Gradient one-side sampling: keep top_rate by |grad|, sample
@@ -350,9 +373,9 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
             rv = bag_mask
             if use_goss:
                 rv = goss_weights(jnp.abs(grad), bag_mask, key)
-            tree, node_id = grow_tree(bins_t, grad, hess, rv, feature_mask,
-                                      upper_bounds, num_bins, learning_rate,
-                                      p, axis, use_pallas)
+            tree, node_id = grower(bins_t, grad, hess, rv, feature_mask,
+                                   upper_bounds, num_bins, learning_rate,
+                                   p, axis, use_pallas)
             new_scores = scores + tree.leaf_value[node_id]
             trees.append(tree)
         else:
@@ -370,9 +393,9 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                 if use_goss:
                     rv = goss_weights(jnp.abs(grad[:, k]), bag_mask,
                                       jax.random.fold_in(key, k))
-                tree, node_id = grow_tree(bins_t, grad[:, k], hess[:, k], rv,
-                                          feature_mask, upper_bounds, num_bins,
-                                          learning_rate, p, axis, use_pallas)
+                tree, node_id = grower(bins_t, grad[:, k], hess[:, k], rv,
+                                       feature_mask, upper_bounds, num_bins,
+                                       learning_rate, p, axis, use_pallas)
                 new_scores = new_scores.at[:, k].add(tree.leaf_value[node_id])
                 trees.append(tree)
         return stack_trees(trees), new_scores
@@ -473,13 +496,13 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         mapper = fit_bin_mapper(X, config.max_bin,
                                 sample_count=config.bin_sample_count,
                                 seed=config.seed)
-    binned_np = mapper.transform(X)
     measures.binning_s = _time.perf_counter() - _t0
     _t_prep = _time.perf_counter()
 
     # -- labels / weights --------------------------------------------------
     w = np.ones(n, np.float32) if sample_weight is None else \
         np.asarray(sample_weight, np.float32).copy()
+    w_scaled = False
     if config.objective == "binary":
         yb = (np.asarray(y) > 0).astype(np.float32)
         if config.is_unbalance or config.scale_pos_weight != 1.0:
@@ -487,6 +510,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             neg = max(float(n - yb.sum()), 1.0)
             spw = (neg / pos) if config.is_unbalance else config.scale_pos_weight
             w = np.where(yb > 0, w * spw, w).astype(np.float32)
+            w_scaled = True
         labels_np = yb
     elif K > 1:
         labels_np = np.asarray(y, np.float32)
@@ -501,10 +525,10 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
           and config.objective not in ("multiclass", "multiclassova")):
         s0 = initial_score(config.objective, labels_np, w)
         init_sc = np.full(K, s0, np.float32)
-        base_margin = np.full((n, K) if K > 1 else n, s0, np.float32)
+        base_margin = None                 # constant margin built on device
     else:
         init_sc = np.zeros(K, np.float32)
-        base_margin = np.zeros((n, K) if K > 1 else n, np.float32)
+        base_margin = None
 
     # -- padding + device placement ---------------------------------------
     # pallas kernel constraints: VMEM one-hot scratch 8*B*CHUNK*2 bytes must
@@ -519,13 +543,9 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         pad_unit = shards * hist_pad_multiple()
     pad = (-n) % pad_unit
     if pad:
-        binned_np = np.concatenate([binned_np, np.zeros((pad, F), np.int32)])
         labels_np = np.concatenate([labels_np, np.zeros(pad, labels_np.dtype)])
-        w = np.concatenate([w, np.zeros(pad, np.float32)])
-        if base_margin.ndim == 1:
-            base_margin = np.concatenate([base_margin, np.zeros(pad, np.float32)])
-        else:
-            base_margin = np.concatenate([base_margin, np.zeros((pad, K), np.float32)])
+        if sample_weight is not None or w_scaled:
+            w = np.concatenate([w, np.zeros(pad, np.float32)])
     N = n + pad
 
     def put(xx, ndim):
@@ -533,17 +553,51 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             return jnp.asarray(xx)
         return jax.device_put(xx, batch_sharding(mesh, ndim))
 
-    # transpose ONCE on host: every boosting iteration reads the (F, N)
-    # layout; re-transposing in-step would copy ~N*F*4B per iteration
-    bins_t_np = np.ascontiguousarray(binned_np.T)
-    if mesh is None:
-        bins_t = jnp.asarray(bins_t_np)
+    def dev_fill(fill, shape):
+        """Constant arrays are built ON the chip — no host→device traffic
+        (the link behind the driver tunnel runs ~20 MB/s)."""
+        if mesh is None:
+            return jnp.full(shape, fill, jnp.float32)
+        return jax.jit(lambda: jnp.full(shape, fill, jnp.float32),
+                       out_shardings=batch_sharding(mesh, len(shape)))()
+
+    # host-bin to the narrowest integer type (native multithreaded search)
+    # and upcast/transpose on device: ships 1-2 bytes/cell instead of 4 —
+    # the upload, not the searchsorted, is the fixed cost that bounds short
+    # training runs
+    _t_bin2 = _time.perf_counter()
+    if mapper.max_bin <= 255:
+        from ...native import bin_columns_u8
+        binned_small = bin_columns_u8(X, mapper.upper_bounds, mapper.max_bin)
     else:
-        bins_t = jax.device_put(
-            bins_t_np, NamedSharding(mesh, P(None, DATA_AXIS)))
+        binned_small = mapper.transform(X).astype(np.uint16)
+    if pad:
+        binned_small = np.concatenate(
+            [binned_small, np.zeros((pad, F), binned_small.dtype)])
+    b_dev = put(binned_small, 2)
+    if mesh is None:
+        bins_t = jax.jit(lambda b: b.astype(jnp.int32).T)(b_dev)
+    else:
+        bins_t = jax.jit(
+            lambda b: jax.lax.with_sharding_constraint(
+                b.astype(jnp.int32).T,
+                NamedSharding(mesh, P(None, DATA_AXIS))))(b_dev)
+    del b_dev
+    measures.binning_s += _time.perf_counter() - _t_bin2
     labels = put(labels_np, 1)
-    weights = put(w, 1)
-    scores = put(base_margin.astype(np.float32), base_margin.ndim)
+    if sample_weight is None and not w_scaled:
+        weights = dev_fill(1.0, (N,))
+    else:
+        weights = put(w, 1)
+    if init_model is not None:
+        if pad:
+            shp = (pad,) if base_margin.ndim == 1 else (pad, K)
+            base_margin = np.concatenate(
+                [base_margin, np.zeros(shp, np.float32)])
+        scores = put(base_margin.astype(np.float32), base_margin.ndim)
+    else:
+        scores = dev_fill(float(init_sc[0]), (N,) if K == 1 else (N, K))
+    init_scores_dev = scores            # rf resets to this every iteration
     upper_bounds = jnp.asarray(mapper.upper_bounds)
     num_bins = jnp.asarray(mapper.num_bins)
     if mesh is not None:
@@ -574,9 +628,10 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             label_gain=np.asarray(config.label_gain, np.float32)
             if config.label_gain else None)
     elif K == 1:
-        base_obj = get_objective(config.objective)
-        objective_fn = (lambda s, l, ww: base_obj(s, l, ww, **obj_kwargs)) \
-            if obj_kwargs else base_obj
+        # cached factory -> stable function identity, so the _make_step
+        # cache hits across train() calls even with objective kwargs
+        objective_fn = _objective_with_kwargs(
+            config.objective, tuple(sorted(obj_kwargs.items())))
     else:
         objective_fn = None
 
@@ -588,10 +643,15 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     p = config.growth_params()
     use_bagging = (config.bagging_fraction < 1.0
                    and (is_rf or config.bagging_freq > 0))
-    step = _make_step(p, objective_fn, K, lr, mesh, use_goss,
+    # lambdarank's objective closes over per-dataset arrays: a cache entry
+    # would both never hit again and pin the arrays — bypass the cache
+    make = (_make_step.__wrapped__ if config.objective == "lambdarank"
+            else _make_step)
+    step = make(p, objective_fn, K, lr, mesh, use_goss,
                       config.top_rate, config.other_rate,
                       ova=(config.objective == "multiclassova"),
                       use_pallas=use_pallas,
+                      growth_policy=config.growth_policy,
                       bagging_fraction=(config.bagging_fraction
                                         if use_bagging else 1.0))
 
@@ -645,14 +705,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     base_bag_dev = jnp.asarray(bag)     # pad-row mask, uploaded once
     bag_root_key = jax.random.PRNGKey(config.bagging_seed)
 
-    def append_stack(tstack: Tree, per_class_weights: List[float]) -> None:
-        """Download a (K, M) tree stack — one transfer per field — and
-        append its K per-class trees with their weights."""
-        host_fields = [np.asarray(a) for a in tstack]
-        for k in range(K):
-            trees.append(Tree(*[a[k] for a in host_fields]))
-            tree_class.append(k)
-            tree_weights.append(per_class_weights[k])
     fmask_dev = None
     rf_reset_scores = None
     # leaf-wise depth is bounded by num_leaves-1 splits; never truncate
@@ -731,8 +783,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             # rf: gradients always at init margin → reset scores (the
             # reset array is device-resident once, reused every iteration)
             if rf_reset_scores is None:
-                rf_reset_scores = put(base_margin.astype(np.float32),
-                                      base_margin.ndim)
+                rf_reset_scores = init_scores_dev
             scores = rf_reset_scores
 
         # validation eval + early stopping (TrainUtils.scala:143-169)
@@ -773,11 +824,17 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             for cb in callbacks:
                 cb(it, trees, eval_history)
 
-    # deferred mode: one sync for the whole run, then download all trees
+    # deferred mode: one sync for the whole run, then download every tree in
+    # ONE transfer per field (T, K, M) — per-stack downloads pay a tunnel/PCIe
+    # round trip each, which dominates small-tree training
     if pending_stacks:
-        jax.block_until_ready([t for t, _ in pending_stacks])
-        for tstack, w in pending_stacks:
-            append_stack(tstack, w)
+        all_fields = [np.asarray(a) for a in
+                      stack_trees([t for t, _ in pending_stacks])]
+        for i, (_, per_class_weights) in enumerate(pending_stacks):
+            for k in range(K):
+                trees.append(Tree(*[a[i, k] for a in all_fields]))
+                tree_class.append(k)
+                tree_weights.append(per_class_weights[k])
     measures.training_s = _time.perf_counter() - _t_train
     measures.iterations = len(trees) // max(K, 1)  # this fit only — before
     if init_model is not None:                     # the warm-start fold-in

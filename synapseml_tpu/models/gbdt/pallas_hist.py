@@ -107,4 +107,186 @@ def build_hist_pallas(bins_t: jnp.ndarray,    # (F, N) int32, N % CHUNK == 0
 
 
 def hist_pad_multiple() -> int:
-    return CHUNK
+    # rows pad to the ROUTING chunk (the larger of the two kernels' chunks)
+    # so both grids divide evenly; ≤0.8% waste at 1M rows
+    return ROUTE_CHUNK
+
+
+# --------------------------------------------------------------------------
+# node-batched histogram build (depth-level growth)
+# --------------------------------------------------------------------------
+#
+# The leaf-wise loop launches one full-data histogram pass per split — 31
+# sequential passes per tree, each paying the full VPU one-hot construction
+# cost for an MXU matmul whose N dimension is only 8 lanes (one node's
+# value channels) out of the 128-wide MXU tile.  Batching S node slots into
+# the lane dimension builds S histograms for the one-hot cost of one:
+#
+#     hist[f·B+b, j·8+v] += OH(f·B+b, c) · (slot(c)==j) · vals(c, v)
+#
+# The (C, S·8) per-node value matrix is built in-kernel from the row→slot
+# assignment (S masked copies of the 8-channel vals block — S·8·C VPU ops,
+# ~1/16 of the one-hot cost), so HBM traffic stays O(N) per pass instead of
+# O(N·S).  A depth level of up to S=16 nodes then costs ONE pass.
+
+#: value channels per node slot in the batched kernel
+SLOT_LANES = 8
+
+
+def _hist_nodes_kernel(bins_ref, slot_ref, vals_ref, out_ref, oh_ref, vn_ref):
+    """Grid (F//FEAT_TILE, N//CHUNK).  bins block (8, C) int32; slot block
+    (1, C) int32 (row's node slot, -1 = no slot); vals block (C, 8) bf16;
+    out block (1, 8·B, S·8) f32 revisited across the chunk dim."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    C = bins_ref.shape[1]
+    B = out_ref.shape[1] // FEAT_TILE
+    S = vn_ref.shape[1] // SLOT_LANES
+    iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
+    for f in range(FEAT_TILE):
+        b = bins_ref[f, :]
+        oh_ref[f * B:(f + 1) * B, :] = (iota_b == b[None, :]).astype(jnp.bfloat16)
+    sid = slot_ref[0, :]
+    vals = vals_ref[...]
+    for j in range(S):
+        # minor-dim insertion must happen on a 32-bit type (Mosaic limit)
+        m = (sid == j).astype(jnp.float32)[:, None].astype(jnp.bfloat16)
+        vn_ref[:, j * SLOT_LANES:(j + 1) * SLOT_LANES] = vals * m
+    contrib = lax.dot_general(oh_ref[...], vn_ref[...],
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    out_ref[...] += contrib[None]
+
+
+def prep_hist_vals(grad: jnp.ndarray, hess: jnp.ndarray,
+                   mask: jnp.ndarray) -> jnp.ndarray:
+    """Per-row value channels (N, 8) bf16: g/h in hi/lo split pairs (exact
+    ~f32 reconstruction after the bf16 dot) + a count channel.  Hoisted out
+    of the per-level loop: depends only on the iteration's grad/hess/mask."""
+    g = grad * mask
+    h = hess * mask
+    count = (mask > 0).astype(jnp.float32)
+    g_hi = g.astype(jnp.bfloat16)
+    g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    h_hi = h.astype(jnp.bfloat16)
+    h_lo = (h - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    z = jnp.zeros_like(count, jnp.bfloat16)
+    return jnp.stack([g_hi, g_lo, h_hi, h_lo,
+                      count.astype(jnp.bfloat16), z, z, z], axis=-1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_slots", "total_bins", "interpret"))
+def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 0
+                            slot: jnp.ndarray,     # (N,) int32 in [-1, n_slots)
+                            vals: jnp.ndarray,     # (N, 8) bf16 from prep_hist_vals
+                            n_slots: int,
+                            total_bins: int,
+                            interpret: bool = False) -> jnp.ndarray:
+    """→ (n_slots, F, B, 3) float32 [grad, hess, count] histograms."""
+    F, N = bins_t.shape
+    B = total_bins
+    assert N % CHUNK == 0, f"N={N} must be a multiple of {CHUNK}"
+
+    Fp = ((F + FEAT_TILE - 1) // FEAT_TILE) * FEAT_TILE
+    if Fp != F:
+        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+
+    out = pl.pallas_call(
+        _hist_nodes_kernel,
+        grid=(Fp // FEAT_TILE, N // CHUNK),
+        in_specs=[
+            pl.BlockSpec((FEAT_TILE, CHUNK), lambda f, c: (f, c)),
+            pl.BlockSpec((1, CHUNK), lambda f, c: (0, c)),
+            pl.BlockSpec((CHUNK, SLOT_LANES), lambda f, c: (c, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, FEAT_TILE * B, n_slots * SLOT_LANES),
+                               lambda f, c: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (Fp // FEAT_TILE, FEAT_TILE * B, n_slots * SLOT_LANES), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((FEAT_TILE * B, CHUNK), jnp.bfloat16),
+                        pltpu.VMEM((CHUNK, n_slots * SLOT_LANES), jnp.bfloat16)],
+        interpret=interpret,
+    )(bins_t, slot[None, :], vals)
+
+    # (F/8, 8·B, S·8) → (F, B, S, 8) → (S, F, B, 3)
+    out = out.reshape(Fp // FEAT_TILE, FEAT_TILE, B, n_slots, SLOT_LANES)
+    out = out.reshape(Fp, B, n_slots, SLOT_LANES)[:F]
+    out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)
+    gsum = out[..., 0] + out[..., 1]
+    hsum = out[..., 2] + out[..., 3]
+    return jnp.stack([gsum, hsum, out[..., 4]], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# row routing kernel (depth-level growth)
+# --------------------------------------------------------------------------
+#
+# Applying a wave's splits in plain XLA costs several full-N passes (node→slot
+# gather, per-row feature gather — the latter lowers to a ~160 ms random
+# gather at 1M×28 — plus select chains).  This kernel fuses the whole wave
+# routing into one pass over the binned matrix: for each of the S selected
+# leaves (scalar-prefetched metadata) it tests membership + split direction
+# and emits the new per-row node id and the row's histogram slot (slot j if
+# the row goes LEFT under split j, else -1).
+
+
+#: rows per routing grid step — routing has no VMEM-hungry scratch, so a
+#: big chunk amortizes per-step grid overhead (8× fewer steps than CHUNK)
+ROUTE_CHUNK = 8192
+
+
+def _route_kernel(leaf_ref, feat_ref, thr_ref, lid_ref, rid_ref,
+                  bins_ref, nid_ref, newid_ref, bslot_ref):
+    """Grid (N//ROUTE_CHUNK,).  bins block (F, C); nid block (1, C) int32."""
+    nid = nid_ref[0, :]
+    new = nid
+    bslot = jnp.full_like(nid, -1)
+    S = leaf_ref.shape[0]
+    for j in range(S):
+        xb = bins_ref[pl.dslice(feat_ref[j], 1), :][0]
+        inleaf = nid == leaf_ref[j]
+        gl = xb <= thr_ref[j]
+        new = jnp.where(inleaf, jnp.where(gl, lid_ref[j], rid_ref[j]), new)
+        bslot = jnp.where(inleaf & gl, j, bslot)
+    newid_ref[0, :] = new
+    bslot_ref[0, :] = bslot
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def route_rows_pallas(bins_t: jnp.ndarray,     # (F, N) int32, N % CHUNK == 0
+                      node_id: jnp.ndarray,    # (N,) int32
+                      leaf: jnp.ndarray,       # (S,) int32 leaf being split
+                      feat: jnp.ndarray,       # (S,) int32 split feature
+                      thr_bin: jnp.ndarray,    # (S,) int32 split bin (<= goes left)
+                      l_id: jnp.ndarray,       # (S,) int32 left-child node id
+                      r_id: jnp.ndarray,       # (S,) int32 right-child node id
+                      interpret: bool = False):
+    """→ (new_node_id (N,) int32, bslot (N,) int32 in [-1, S))."""
+    F, N = bins_t.shape
+    rc = ROUTE_CHUNK if N % ROUTE_CHUNK == 0 else CHUNK
+    assert N % rc == 0, f"N={N} must be a multiple of {rc}"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(N // rc,),
+        in_specs=[
+            pl.BlockSpec((F, rc), lambda c, *_: (0, c)),
+            pl.BlockSpec((1, rc), lambda c, *_: (0, c)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, rc), lambda c, *_: (0, c)),
+            pl.BlockSpec((1, rc), lambda c, *_: (0, c)),
+        ],
+    )
+    new_id, bslot = pl.pallas_call(
+        _route_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((1, N), jnp.int32),
+                   jax.ShapeDtypeStruct((1, N), jnp.int32)],
+        interpret=interpret,
+    )(leaf, feat, thr_bin, l_id, r_id, bins_t, node_id[None, :])
+    return new_id[0], bslot[0]

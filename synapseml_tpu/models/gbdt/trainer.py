@@ -354,6 +354,248 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     return tree, state["node_id"]
 
 
+# -- depth-level growth ------------------------------------------------------
+#
+# The leaf-wise grower above launches one full-data histogram pass per split
+# (num_leaves-1 sequential passes per tree).  The depth-level grower selects
+# up to ``n_slots`` best leaves per wave (gain-ordered, budget-capped — the
+# depthwise/lossguide hybrid used by accelerator GBDT implementations) and
+# builds ALL their left-child histograms in ONE data pass, with the node
+# assignment folded into the matmul lane dimension (pallas_hist.py,
+# build_hist_nodes_pallas).  Right children come from histogram subtraction
+# as before.  Typical tree cost: 1 root pass + ceil(log2-ish) wave passes
+# (≈6 for 31 leaves) instead of 31.
+
+
+def _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot, n_slots, F, B):
+    """XLA scatter fallback: (n_slots, F, B, 3) node-batched histograms.
+    Rows with slot -1 scatter into a junk slot that is dropped."""
+    s = jnp.where(slot >= 0, slot, n_slots)
+    ids = flat_bins + (s * (F * B))[None, :]                  # (F, N)
+    count = (mask > 0).astype(jnp.float32)
+    upd = jnp.stack([grad * mask, hess * mask, count], axis=-1)   # (N,3)
+    upd = jnp.broadcast_to(upd[None, :, :], (F,) + upd.shape)     # (F,N,3)
+    hist = jnp.zeros(((n_slots + 1) * F * B, 3), jnp.float32)
+    hist = hist.at[ids].add(upd)
+    return hist.reshape(n_slots + 1, F, B, 3)[:n_slots]
+
+
+def _build_hist_nodes(bins_t, flat_bins, vals8, grad, hess, mask, slot,
+                      n_slots, F, B, use_pallas):
+    if use_pallas:
+        from .pallas_hist import build_hist_nodes_pallas
+        return build_hist_nodes_pallas(bins_t, slot, vals8, n_slots, B)
+    return _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot,
+                                 n_slots, F, B)
+
+
+def default_n_slots(num_leaves: int) -> int:
+    """Node slots per wave: 16 slots × 8 value channels = the full 128-lane
+    MXU tile; fewer when the leaf budget is smaller."""
+    return max(1, min(16, num_leaves - 1))
+
+
+@functools.partial(jax.jit, static_argnames=("p", "axis_name", "use_pallas",
+                                             "n_slots"))
+def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
+                        grad: jnp.ndarray,       # (N,) f32
+                        hess: jnp.ndarray,       # (N,) f32
+                        row_valid: jnp.ndarray,  # (N,) f32 bag/GOSS weight
+                        feature_mask: jnp.ndarray,   # (F,) bool
+                        upper_bounds: jnp.ndarray,   # (F, B-1) f32
+                        num_bins: jnp.ndarray,       # (F,) int32
+                        learning_rate: float,
+                        p: GrowthParams,
+                        axis_name: Optional[str] = None,
+                        use_pallas: bool = False,
+                        n_slots: int = 16,
+                        ) -> Tuple[Tree, jnp.ndarray]:
+    """Grow one tree wave-by-wave; returns (tree, per-row leaf node ids).
+
+    Semantics match :func:`grow_tree` except for the order leaves are split
+    in: within a wave all selected leaves split simultaneously, so when the
+    leaf budget runs out mid-wave the marginal leaves may differ from strict
+    best-first order.  Split decisions per node are identical.
+    """
+    from .pallas_hist import prep_hist_vals
+
+    F, N = bins_t.shape
+    B = p.total_bins
+    L = p.num_leaves
+    M = max_nodes(L)
+    S = n_slots
+    JUNK = M - 1              # node index never reached (num_nodes <= M-1)
+    HJUNK = L                 # hist-buffer junk slot
+    rows = jnp.arange(N)
+
+    def ar(x):
+        return lax.psum(x, axis_name) if axis_name else x
+
+    vals8 = prep_hist_vals(grad, hess, row_valid) if use_pallas else None
+    flat_bins = None
+    if not use_pallas:
+        flat_bins = bins_t + (jnp.arange(F, dtype=jnp.int32) * B)[:, None]
+
+    def build(slot):
+        return ar(_build_hist_nodes(bins_t, flat_bins, vals8, grad, hess,
+                                    row_valid, slot, S, F, B, use_pallas))
+
+    pick = functools.partial(_best_split, num_bins=num_bins,
+                             feature_mask=feature_mask, p=p)
+    vpick = jax.vmap(lambda h, g, hh, c, d: pick(h, g, hh, c, node_depth=d))
+
+    # root: one batched pass with every row in slot 0
+    root_hist = build(jnp.zeros(N, jnp.int32))[0]          # (F, B, 3)
+    root_stats = jnp.sum(root_hist[0], axis=0)
+    root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
+
+    zi = jnp.zeros(M, jnp.int32)
+    zf = jnp.zeros(M, jnp.float32)
+    bg, bf_, bb, bgl, bhl, bcl = pick(root_hist, root_g, root_h, root_c,
+                                      node_depth=jnp.zeros((), jnp.int32))
+    state = dict(
+        node_id=jnp.zeros(N, jnp.int32),
+        hist=jnp.zeros((L + 2, F * B, 3), jnp.float32).at[0].set(
+            root_hist.reshape(F * B, 3)),
+        slot=zi,
+        sum_g=zf.at[0].set(root_g),
+        sum_h=zf.at[0].set(root_h),
+        sum_c=zf.at[0].set(root_c),
+        depth=zi,
+        best_gain=jnp.full(M, -jnp.inf, jnp.float32).at[0].set(bg),
+        best_feat=zi.at[0].set(bf_), best_bin=zi.at[0].set(bb),
+        best_gl=zf.at[0].set(bgl), best_hl=zf.at[0].set(bhl),
+        best_cl=zf.at[0].set(bcl),
+        active=jnp.zeros(M, jnp.bool_).at[0].set(True),
+        split_feature=jnp.full(M, -1, jnp.int32),
+        split_bin=zi,
+        split_gain=zf,
+        threshold=zf,
+        left_child=jnp.full(M, -1, jnp.int32),
+        right_child=jnp.full(M, -1, jnp.int32),
+        num_nodes=jnp.ones((), jnp.int32),
+        next_slot=jnp.ones((), jnp.int32),
+    )
+
+    def cond(s):
+        leaves = (s["num_nodes"] + 1) // 2
+        gains = jnp.where(s["active"], s["best_gain"], -jnp.inf)
+        return (leaves < L) & (jnp.max(gains) > p.min_gain_to_split)
+
+    def wave(s):
+        gains = jnp.where(s["active"], s["best_gain"], -jnp.inf)
+        tv, ti = lax.top_k(gains, S)                     # leaves to split
+        budget = L - (s["num_nodes"] + 1) // 2
+        jidx = jnp.arange(S, dtype=jnp.int32)
+        valid = (tv > p.min_gain_to_split) & (jidx < budget)
+        n_valid = jnp.sum(valid.astype(jnp.int32))
+        parents = jnp.where(valid, ti, JUNK)
+
+        # valid slots are packed first by top_k's sort, so child ids are
+        # contiguous: left 2j, right 2j+1 past num_nodes
+        l_ids = jnp.where(valid, s["num_nodes"] + 2 * jidx, JUNK)
+        r_ids = jnp.where(valid, s["num_nodes"] + 2 * jidx + 1, JUNK)
+
+        # route rows: new node id + histogram slot (-1 = not a left child).
+        # JUNK parents match no row, so invalid slots route nothing.
+        if use_pallas:
+            from .pallas_hist import route_rows_pallas
+            new_node_id, bslot = route_rows_pallas(
+                bins_t, s["node_id"], parents, s["best_feat"][parents],
+                s["best_bin"][parents], l_ids, r_ids)
+        else:
+            slot_of_leaf = jnp.full(M, -1, jnp.int32).at[parents].set(
+                jnp.where(valid, jidx, -1))
+            rslot = slot_of_leaf[s["node_id"]]           # (N,)
+            feat_r = s["best_feat"][s["node_id"]]
+            bin_r = s["best_bin"][s["node_id"]]
+            go_left = bins_t[feat_r, rows] <= bin_r
+            new_node_id = jnp.where(
+                rslot >= 0,
+                jnp.where(go_left, l_ids[rslot], r_ids[rslot]),
+                s["node_id"])
+            bslot = jnp.where(go_left, rslot, -1)
+
+        # ONE pass: left-child histograms for every selected leaf
+        l_hists = build(bslot)                           # (S, F, B, 3)
+        l_flat = l_hists.reshape(S, F * B, 3)
+        pslot = jnp.where(valid, s["slot"][parents], HJUNK)
+        r_flat = s["hist"][pslot] - l_flat
+        r_slots = jnp.where(valid, s["next_slot"] + jidx, HJUNK)
+        hist = s["hist"].at[pslot].set(l_flat).at[r_slots].set(r_flat)
+
+        lg, lh, lc = (s["best_gl"][parents], s["best_hl"][parents],
+                      s["best_cl"][parents])
+        rg = s["sum_g"][parents] - lg
+        rh = s["sum_h"][parents] - lh
+        rc = s["sum_c"][parents] - lc
+        cdepth = s["depth"][parents] + 1
+
+        child_hists = jnp.concatenate(
+            [l_flat.reshape(S, F, B, 3), r_flat.reshape(S, F, B, 3)])
+        cg = jnp.concatenate([lg, rg])
+        ch = jnp.concatenate([lh, rh])
+        cc = jnp.concatenate([lc, rc])
+        cd = jnp.concatenate([cdepth, cdepth])
+        cbg, cbf, cbb, cbgl, cbhl, cbcl = vpick(child_hists, cg, ch, cc, cd)
+
+        cids = jnp.concatenate([l_ids, r_ids])           # (2S,)
+        thr = jnp.where(s["best_bin"][parents] >= 1,
+                        upper_bounds[s["best_feat"][parents],
+                                     jnp.maximum(s["best_bin"][parents] - 1, 0)],
+                        -jnp.inf)
+
+        out = dict(
+            node_id=new_node_id,
+            hist=hist,
+            slot=s["slot"].at[l_ids].set(pslot).at[r_ids].set(r_slots),
+            sum_g=s["sum_g"].at[cids].set(cg),
+            sum_h=s["sum_h"].at[cids].set(ch),
+            sum_c=s["sum_c"].at[cids].set(cc),
+            depth=s["depth"].at[cids].set(cd),
+            best_gain=s["best_gain"].at[cids].set(cbg),
+            best_feat=s["best_feat"].at[cids].set(cbf),
+            best_bin=s["best_bin"].at[cids].set(cbb),
+            best_gl=s["best_gl"].at[cids].set(cbgl),
+            best_hl=s["best_hl"].at[cids].set(cbhl),
+            best_cl=s["best_cl"].at[cids].set(cbcl),
+            active=s["active"].at[parents].set(False).at[cids].set(True),
+            split_feature=s["split_feature"].at[parents].set(
+                jnp.where(valid, s["best_feat"][parents], -1)),
+            split_bin=s["split_bin"].at[parents].set(s["best_bin"][parents]),
+            split_gain=s["split_gain"].at[parents].set(
+                jnp.where(valid, s["best_gain"][parents], 0.0)),
+            threshold=s["threshold"].at[parents].set(thr),
+            left_child=s["left_child"].at[parents].set(l_ids),
+            right_child=s["right_child"].at[parents].set(r_ids),
+            num_nodes=s["num_nodes"] + 2 * n_valid,
+            next_slot=s["next_slot"] + n_valid,
+        )
+        # the junk row absorbed every masked-out write; scrub it
+        out["active"] = out["active"].at[JUNK].set(False)
+        out["best_gain"] = out["best_gain"].at[JUNK].set(-jnp.inf)
+        out["split_feature"] = out["split_feature"].at[JUNK].set(-1)
+        out["left_child"] = out["left_child"].at[JUNK].set(-1)
+        out["right_child"] = out["right_child"].at[JUNK].set(-1)
+        return out
+
+    state = lax.while_loop(cond, wave, state)
+
+    node_value = learning_rate * _leaf_output(state["sum_g"], state["sum_h"],
+                                              p.lambda_l1, p.lambda_l2)
+    leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
+    tree = Tree(split_feature=state["split_feature"],
+                split_bin=state["split_bin"],
+                threshold=state["threshold"],
+                split_gain=state["split_gain"],
+                left_child=state["left_child"],
+                right_child=state["right_child"],
+                leaf_value=leaf_value,
+                node_value=node_value,
+                num_nodes=state["num_nodes"])
+    return tree, state["node_id"]
+
+
 # -- prediction -------------------------------------------------------------
 
 def _traverse(binned, tree: Tree, depth_bound: int):

@@ -84,6 +84,10 @@ def _get_lib() -> Optional[ctypes.CDLL]:
         lib.sml_colstore_read.argtypes = [ctypes.c_char_p, f32p,
                                           ctypes.c_int64, ctypes.c_int64]
         lib.sml_colstore_read.restype = ctypes.c_int
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.sml_bin_u8.argtypes = [f32p, ctypes.c_int64, ctypes.c_int64,
+                                   f32p, ctypes.c_int64, u8p, ctypes.c_int]
+        lib.sml_bin_u8.restype = ctypes.c_int
         _LIB = lib
         return _LIB
 
@@ -135,6 +139,48 @@ def read_csv_matrix(path: str, delim: str = ",",
                         skip_header=1 if has_header else 0,
                         dtype=np.float32, ndmin=2)
     return mat, names[:mat.shape[1]]
+
+
+def bin_columns_u8(features: np.ndarray, upper_bounds: np.ndarray,
+                   max_bin: int, n_threads: int = 0) -> np.ndarray:
+    """Quantile-bin raw (n, F) float32 features → (n, F) uint8 bins
+    (NaN → 0, content bins 1..max_bin).  Native path: row-blocked
+    multithreaded binary search; fallback: threaded numpy searchsorted.
+    The uint8 result is the array shipped to the device — 4× less
+    host→device traffic than raw floats."""
+    if not 1 <= max_bin <= 255:
+        raise ValueError(
+            f"bin_columns_u8 requires max_bin in [1, 255], got {max_bin}; "
+            "use BinMapper.transform (int32) for wider bin ranges")
+    features = np.ascontiguousarray(features, np.float32)
+    upper_bounds = np.ascontiguousarray(upper_bounds, np.float32)
+    n, f = features.shape
+    out = np.empty((n, f), np.uint8)
+    lib = _get_lib()
+    if lib is not None:
+        rc = lib.sml_bin_u8(
+            features.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, f,
+            upper_bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_bin, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            int(n_threads))
+        if rc == 0:
+            return out
+
+    def one(j):
+        col = features[:, j]
+        idx = np.searchsorted(upper_bounds[j, :max_bin], col, side="left")
+        b = np.minimum(idx, max_bin - 1).astype(np.uint8) + 1
+        b[np.isnan(col)] = 0
+        out[:, j] = b
+
+    from concurrent.futures import ThreadPoolExecutor
+    if n * f > 1 << 20:
+        with ThreadPoolExecutor() as pool:
+            list(pool.map(one, range(f)))
+    else:
+        for j in range(f):
+            one(j)
+    return out
 
 
 def write_colstore(path: str, matrix: np.ndarray) -> None:

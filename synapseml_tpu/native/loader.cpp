@@ -13,6 +13,7 @@
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
+#include <algorithm>
 #include <atomic>
 #include <cctype>
 #include <cerrno>
@@ -247,6 +248,56 @@ int sml_colstore_read(const char* path, float* out, int64_t rows,
   size_t got = fread(out, sizeof(float), want, f);
   fclose(f);
   return got == want ? 0 : -3;
+}
+
+// Quantile binning to uint8: feats row-major (n, f); bounds row-major
+// (f, max_bin) with +inf fill past each feature's real boundaries; out
+// row-major (n, f).  bin = min(lower_bound(bounds_f, x), max_bin-1) + 1,
+// NaN -> 0.  Row-blocked across threads (GIL-free); the uint8 output is
+// what rides the host->device link, 4x smaller than raw floats.
+int sml_bin_u8(const float* feats, int64_t n, int64_t f,
+               const float* bounds, int64_t max_bin, uint8_t* out,
+               int n_threads) {
+  if (max_bin < 1 || max_bin > 255) return -1;
+  if (n_threads <= 0)
+    n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads < 1) n_threads = 1;
+  int64_t block = (n + n_threads - 1) / n_threads;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * block;
+    int64_t hi = std::min(n, lo + block);
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i) {
+        const float* row = feats + i * f;
+        uint8_t* orow = out + i * f;
+        for (int64_t j = 0; j < f; ++j) {
+          float x = row[j];
+          if (std::isnan(x)) {
+            orow[j] = 0;
+            continue;
+          }
+          const float* b = bounds + j * max_bin;
+          // lower_bound over the (sorted, +inf-padded) boundary row
+          int64_t lo_i = 0, len = max_bin;
+          while (len > 0) {
+            int64_t half = len >> 1;
+            if (b[lo_i + half] < x) {
+              lo_i += half + 1;
+              len -= half + 1;
+            } else {
+              len = half;
+            }
+          }
+          if (lo_i > max_bin - 1) lo_i = max_bin - 1;
+          orow[j] = static_cast<uint8_t>(lo_i + 1);
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  return 0;
 }
 
 }  // extern "C"

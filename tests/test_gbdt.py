@@ -143,12 +143,15 @@ def test_voting_parallel_close_to_data_parallel():
     auc_v = auc(y, 1 / (1 + np.exp(-bv.predict_margin(X))))
     assert auc_v > auc_f - 0.01
     # with top_k = F every feature is aggregated → exactly data-parallel
+    # (compared against the lossguide grower: voting implies strict
+    # best-first leaf order, so the reference must grow the same way)
     exact = BoostingConfig(objective="binary", num_iterations=4,
                            num_leaves=7, min_data_in_leaf=5,
                            parallelism="voting_parallel", top_k=X.shape[1])
     be, _ = train(X, y, exact, mesh=mesh)
     ref = BoostingConfig(objective="binary", num_iterations=4,
-                         num_leaves=7, min_data_in_leaf=5)
+                         num_leaves=7, min_data_in_leaf=5,
+                         growth_policy="lossguide")
     br, _ = train(X, y, ref, mesh=mesh)
     np.testing.assert_allclose(be.predict_margin(X), br.predict_margin(X),
                                atol=1e-4)
@@ -319,6 +322,95 @@ class TestGBDTRegressorFuzzing(EstimatorFuzzing):
             GBDTRegressor(numIterations=3, numLeaves=7, minDataInLeaf=5,
                           numShards=1),
             vec_dataset(X, y))]
+
+
+def test_depthwise_matches_lossguide_quality():
+    """The wave grower (one batched histogram pass per level) must match
+    strict leaf-wise quality; trees may differ only in how the tail of the
+    leaf budget is allocated."""
+    X, y = binary_data()
+    aucs = {}
+    for pol in ("depthwise", "lossguide"):
+        cfg = BoostingConfig(objective="binary", num_iterations=20,
+                             num_leaves=15, learning_rate=0.2,
+                             min_data_in_leaf=5, growth_policy=pol)
+        b, _ = train(X[:2400], y[:2400], cfg)
+        aucs[pol] = auc(y[2400:], b.predict_margin(X[2400:]))
+    assert abs(aucs["depthwise"] - aucs["lossguide"]) < 0.01, aucs
+
+
+def test_depthwise_unbounded_budget_matches_lossguide_exactly():
+    """With min_gain huge... rather: when every positive-gain leaf fits the
+    budget, wave order and best-first order split the SAME node set — the
+    growers must agree exactly."""
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    # num_leaves large enough that the budget never truncates a wave
+    for pol in ("depthwise", "lossguide"):
+        cfg = BoostingConfig(objective="binary", num_iterations=3,
+                             num_leaves=64, min_data_in_leaf=60,
+                             growth_policy=pol)
+        b, _ = train(X, y, cfg)
+        if pol == "depthwise":
+            ref = b.predict_margin(X)
+        else:
+            np.testing.assert_allclose(ref, b.predict_margin(X), atol=1e-5)
+
+
+def test_node_batched_hist_matches_scatter():
+    """Node-batched Pallas kernel (interpret) vs the XLA scatter fallback."""
+    import jax.numpy as jnp
+    from synapseml_tpu.models.gbdt.pallas_hist import (
+        build_hist_nodes_pallas, prep_hist_vals)
+    from synapseml_tpu.models.gbdt.trainer import _build_hist_nodes_xla
+
+    rng = np.random.default_rng(3)
+    N, F, B, S = 2048, 11, 64, 5
+    bins_t = rng.integers(0, B, (F, N)).astype(np.int32)
+    grad = rng.normal(size=N).astype(np.float32)
+    hess = (np.abs(grad) + 0.1).astype(np.float32)
+    mask = (rng.random(N) < 0.7).astype(np.float32) * 1.5
+    slot = rng.integers(-1, S, N).astype(np.int32)
+    vals = prep_hist_vals(jnp.asarray(grad), jnp.asarray(hess),
+                          jnp.asarray(mask))
+    out_p = np.asarray(build_hist_nodes_pallas(
+        jnp.asarray(bins_t), jnp.asarray(slot), vals, S, B, interpret=True))
+    flat = bins_t + (np.arange(F, dtype=np.int32) * B)[:, None]
+    out_x = np.asarray(_build_hist_nodes_xla(
+        jnp.asarray(flat), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), jnp.asarray(slot), S, F, B))
+    np.testing.assert_allclose(out_p, out_x, rtol=1e-4, atol=1e-4)
+
+
+def test_route_kernel_matches_xla():
+    """Pallas row-routing kernel (interpret) vs the plain formulation."""
+    import jax.numpy as jnp
+    from synapseml_tpu.models.gbdt.pallas_hist import route_rows_pallas
+
+    rng = np.random.default_rng(4)
+    N, F, S = 2048, 6, 4
+    bins_t = rng.integers(0, 64, (F, N)).astype(np.int32)
+    node_id = rng.integers(0, 8, N).astype(np.int32)
+    leaf = np.array([1, 3, 5, 61], np.int32)      # last = junk, matches no row... 61>7
+    feat = rng.integers(0, F, S).astype(np.int32)
+    thr = rng.integers(0, 64, S).astype(np.int32)
+    l_id = np.array([10, 12, 14, 61], np.int32)
+    r_id = np.array([11, 13, 15, 61], np.int32)
+    new_id, bslot = route_rows_pallas(
+        jnp.asarray(bins_t), jnp.asarray(node_id), jnp.asarray(leaf),
+        jnp.asarray(feat), jnp.asarray(thr), jnp.asarray(l_id),
+        jnp.asarray(r_id), interpret=True)
+    # reference formulation
+    exp_id = node_id.copy()
+    exp_slot = np.full(N, -1, np.int32)
+    for j in range(S):
+        inleaf = node_id == leaf[j]
+        gl = bins_t[feat[j], :] <= thr[j]
+        exp_id = np.where(inleaf, np.where(gl, l_id[j], r_id[j]), exp_id)
+        exp_slot = np.where(inleaf & gl, j, exp_slot)
+    np.testing.assert_array_equal(np.asarray(new_id), exp_id)
+    np.testing.assert_array_equal(np.asarray(bslot), exp_slot)
 
 
 def test_pallas_hist_matches_scatter():
