@@ -1,0 +1,368 @@
+"""Pretrained-weight import: HF/torch/flax checkpoints → our param trees.
+
+The reference fine-tunes REAL pretrained weights — HF
+``AutoModelForSequenceClassification.from_pretrained`` for text
+(reference: deep-learning/.../dl/LitDeepTextModel.py:86,
+DeepTextClassifier.py:239) and pretrained torchvision backbones for vision
+(DeepVisionClassifier.py:31).  This module is the TPU-native equivalent:
+read a checkpoint file (safetensors / torch pickle / flax msgpack, single
+file, sharded-index dir, or HF model dir), translate tensor names + layouts
+through a per-family mapping table, and splice the arrays into an
+initialized flax param tree — preserving each leaf's ``nn.Partitioned``
+sharding metadata so TP/DP placement is untouched.
+
+Families:
+- ``import_bert``      → :class:`~synapseml_tpu.models.dl.transformer.TextEncoder`
+  (HF BertForSequenceClassification naming; token-type embeddings are folded
+  into the position table — row 0 is added to every position — which is
+  exact for single-segment inputs, the reference classifier's case)
+- ``import_llama``     → :class:`~synapseml_tpu.models.llm.model.LlamaModel`
+  (HF LlamaForCausalLM naming; HF stores q/k pre-arranged for the
+  rotate-half RoPE our ``apply_rope`` implements, so weights copy verbatim)
+- ``import_resnet``    → :class:`~synapseml_tpu.models.dl.resnet.ResNet`
+  (torchvision naming; conv OIHW→HWIO, BatchNorm running stats land in the
+  ``batch_stats`` collection)
+
+Torch ``Linear.weight`` is (out, in); flax ``Dense.kernel`` is (in, out) —
+every dense mapping transposes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["read_checkpoint", "import_bert", "import_llama", "import_resnet",
+           "load_into_params"]
+
+
+# --------------------------------------------------------------------------
+# readers
+# --------------------------------------------------------------------------
+
+def _to_numpy(t) -> np.ndarray:
+    """torch tensor / jax array / numpy → float-compatible numpy."""
+    if hasattr(t, "detach"):                       # torch tensor
+        t = t.detach().cpu()
+        if str(t.dtype) == "torch.bfloat16":
+            t = t.float()
+        return t.numpy()
+    arr = np.asarray(t)
+    if arr.dtype.name == "bfloat16":
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _read_safetensors(path: str) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+    out = {}
+    with safe_open(path, framework="np") as f:
+        for k in f.keys():
+            try:
+                out[k] = f.get_tensor(k)
+            except (TypeError, ValueError):
+                pass
+    if out:
+        return out
+    # bf16 tensors can defeat the numpy framework; fall back to flax
+    from safetensors.flax import load_file
+    return {k: _to_numpy(v) for k, v in load_file(path).items()}
+
+
+def _read_torch(path: str) -> Dict[str, np.ndarray]:
+    import torch
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "state_dict" in state:
+        state = state["state_dict"]
+    return {k: _to_numpy(v) for k, v in state.items()}
+
+
+def _read_msgpack(path: str) -> Dict[str, np.ndarray]:
+    import flax
+    with open(path, "rb") as f:
+        tree = flax.serialization.msgpack_restore(f.read())
+
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = _to_numpy(node)
+
+    walk("", tree)
+    return flat
+
+
+def read_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Flat {name: array} from a checkpoint file or HF-style model dir
+    (handles sharded ``*.index.json`` checkpoints)."""
+    if os.path.isdir(path):
+        for name in ("model.safetensors", "pytorch_model.bin",
+                     "flax_model.msgpack"):
+            p = os.path.join(path, name)
+            if os.path.exists(p):
+                return read_checkpoint(p)
+        for idx_name in ("model.safetensors.index.json",
+                         "pytorch_model.bin.index.json"):
+            idx = os.path.join(path, idx_name)
+            if os.path.exists(idx):
+                with open(idx) as f:
+                    weight_map = json.load(f)["weight_map"]
+                out: Dict[str, np.ndarray] = {}
+                for shard in sorted(set(weight_map.values())):
+                    out.update(read_checkpoint(os.path.join(path, shard)))
+                return out
+        raise FileNotFoundError(
+            f"{path}: no model.safetensors / pytorch_model.bin / "
+            "flax_model.msgpack (or sharded index) found")
+    if path.endswith(".safetensors"):
+        return _read_safetensors(path)
+    if path.endswith(".msgpack"):
+        return _read_msgpack(path)
+    return _read_torch(path)
+
+
+# --------------------------------------------------------------------------
+# splicing into flax trees
+# --------------------------------------------------------------------------
+
+def _set_path(tree: Dict, path: Tuple[str, ...], value: np.ndarray):
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def load_into_params(target, imported: Dict[Tuple[str, ...], np.ndarray],
+                     strict: bool = True):
+    """Replace leaves of an initialized flax variable tree with imported
+    arrays addressed by path tuples, preserving ``nn.Partitioned`` metadata
+    (tensor-placement under TP sharding is untouched — only values change).
+    """
+    import flax.linen as nn
+    import jax
+
+    flat = _flatten_tree(target)
+    unused = dict(imported)
+    out = {}
+    for path, leaf in flat.items():
+        if path in unused:
+            val = unused.pop(path)
+            ref = leaf.value if isinstance(leaf, nn.Partitioned) else leaf
+            if tuple(ref.shape) != tuple(val.shape):
+                raise ValueError(
+                    f"shape mismatch at {'/'.join(path)}: checkpoint "
+                    f"{val.shape} vs model {ref.shape}")
+            new = jax.numpy.asarray(np.asarray(val), dtype=ref.dtype)
+            # keep the tensor's device placement: TP/DP sharded leaves get
+            # the imported values distributed exactly like the originals
+            sharding = getattr(ref, "sharding", None)
+            if sharding is not None and hasattr(ref, "devices"):
+                try:
+                    new = jax.device_put(new, sharding)
+                except (ValueError, RuntimeError):
+                    pass
+            out[path] = (leaf.replace_boxed(new)
+                         if isinstance(leaf, nn.Partitioned) else new)
+        else:
+            if strict:
+                raise ValueError(f"checkpoint missing tensor for "
+                                 f"{'/'.join(path)}")
+            out[path] = leaf
+    if unused and strict:
+        raise ValueError("unmapped checkpoint tensors: "
+                         + ", ".join("/".join(p) for p in list(unused)[:8]))
+    rebuilt: Dict = {}
+    for path, leaf in out.items():
+        _set_path(rebuilt, path, leaf)
+    return rebuilt
+
+
+def _flatten_tree(tree, prefix=()) -> Dict[Tuple[str, ...], Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_tree(v, prefix + (str(k),)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+# --------------------------------------------------------------------------
+# BERT (HF BertForSequenceClassification → TextEncoder)
+# --------------------------------------------------------------------------
+
+def _bert_mapping(hf: Dict[str, np.ndarray], num_layers: int,
+                  with_head: bool) -> Dict[Tuple[str, ...], np.ndarray]:
+    def g(key):
+        for prefix in ("bert.", ""):
+            if prefix + key in hf:
+                return hf[prefix + key]
+        raise KeyError(key)
+
+    m: Dict[Tuple[str, ...], np.ndarray] = {}
+    tok = g("embeddings.word_embeddings.weight")
+    pos = g("embeddings.position_embeddings.weight").copy()
+    # fold segment-0 token-type embedding into every position (exact for
+    # single-segment inputs — the reference classifier path)
+    try:
+        pos = pos + g("embeddings.token_type_embeddings.weight")[0:1]
+    except KeyError:
+        pass
+    m[("tok_embed", "embedding")] = tok
+    m[("pos_embed", "embedding")] = pos
+    m[("ln_embed", "scale")] = g("embeddings.LayerNorm.weight")
+    m[("ln_embed", "bias")] = g("embeddings.LayerNorm.bias")
+    for i in range(num_layers):
+        hfp = f"encoder.layer.{i}."
+        our = f"layer_{i}"
+        for hf_name, our_name in (("attention.self.query", "query"),
+                                  ("attention.self.key", "key"),
+                                  ("attention.self.value", "value"),
+                                  ("attention.output.dense", "out")):
+            m[(our, "attention", our_name, "kernel")] = \
+                g(hfp + hf_name + ".weight").T
+            m[(our, "attention", our_name, "bias")] = g(hfp + hf_name + ".bias")
+        m[(our, "ln_att", "scale")] = g(hfp + "attention.output.LayerNorm.weight")
+        m[(our, "ln_att", "bias")] = g(hfp + "attention.output.LayerNorm.bias")
+        m[(our, "ffn_up", "kernel")] = g(hfp + "intermediate.dense.weight").T
+        m[(our, "ffn_up", "bias")] = g(hfp + "intermediate.dense.bias")
+        m[(our, "ffn_down", "kernel")] = g(hfp + "output.dense.weight").T
+        m[(our, "ffn_down", "bias")] = g(hfp + "output.dense.bias")
+        m[(our, "ln_ffn", "scale")] = g(hfp + "output.LayerNorm.weight")
+        m[(our, "ln_ffn", "bias")] = g(hfp + "output.LayerNorm.bias")
+    m[("pooler", "kernel")] = g("pooler.dense.weight").T
+    m[("pooler", "bias")] = g("pooler.dense.bias")
+    if with_head:
+        m[("classifier", "kernel")] = hf["classifier.weight"].T
+        m[("classifier", "bias")] = hf["classifier.bias"]
+    return m
+
+
+def import_bert(params: Dict, checkpoint, num_layers: int,
+                load_head: Optional[bool] = None) -> Dict:
+    """Splice an HF BERT checkpoint (path or flat dict) into TextEncoder
+    params.  ``load_head=None`` loads the classifier head only when its
+    shape matches (fine-tuning a new task keeps the fresh head, parity with
+    AutoModelForSequenceClassification.from_pretrained's re-init)."""
+    hf = read_checkpoint(checkpoint) if isinstance(checkpoint, str) else checkpoint
+    if load_head is None:
+        have = "classifier.weight" in hf
+        if have:
+            flat = _flatten_tree(params)
+            leaf = flat.get(("classifier", "kernel"))
+            ref = getattr(leaf, "value", leaf)
+            load_head = (ref is not None
+                         and hf["classifier.weight"].T.shape == tuple(ref.shape))
+        else:
+            load_head = False
+    mapped = _bert_mapping(hf, num_layers, with_head=load_head)
+    return load_into_params(params, mapped, strict=False)
+
+
+# --------------------------------------------------------------------------
+# Llama (HF LlamaForCausalLM → LlamaModel)
+# --------------------------------------------------------------------------
+
+def _llama_mapping(hf: Dict[str, np.ndarray], num_layers: int,
+                   tie_embeddings: bool) -> Dict[Tuple[str, ...], np.ndarray]:
+    def g(key):
+        for prefix in ("model.", ""):
+            if prefix + key in hf:
+                return hf[prefix + key]
+        raise KeyError(key)
+
+    m: Dict[Tuple[str, ...], np.ndarray] = {}
+    m[("tok_embed", "embedding")] = g("embed_tokens.weight")
+    for i in range(num_layers):
+        hfp = f"layers.{i}."
+        our = f"layer_{i}"
+        m[(our, "ln_attn", "scale")] = g(hfp + "input_layernorm.weight")
+        m[(our, "ln_mlp", "scale")] = g(hfp + "post_attention_layernorm.weight")
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            m[(our, "attn", proj, "kernel")] = \
+                g(hfp + f"self_attn.{proj}.weight").T
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            m[(our, proj, "kernel")] = g(hfp + f"mlp.{proj}.weight").T
+    m[("ln_final", "scale")] = g("norm.weight")
+    if not tie_embeddings:
+        if "lm_head.weight" in hf:
+            m[("lm_head", "kernel")] = hf["lm_head.weight"].T
+        else:                      # tied checkpoint into untied model
+            m[("lm_head", "kernel")] = g("embed_tokens.weight").T
+    return m
+
+
+def import_llama(params: Dict, checkpoint, num_layers: int,
+                 tie_embeddings: bool = False) -> Dict:
+    hf = read_checkpoint(checkpoint) if isinstance(checkpoint, str) else checkpoint
+    mapped = _llama_mapping(hf, num_layers, tie_embeddings)
+    return load_into_params(params, mapped, strict=False)
+
+
+# --------------------------------------------------------------------------
+# ResNet (torchvision naming → flax ResNet)
+# --------------------------------------------------------------------------
+
+def _resnet_mapping(tv: Dict[str, np.ndarray], stage_sizes,
+                    bottleneck: bool, load_head: bool):
+    """torchvision resnet state_dict → (params paths, batch_stats paths)."""
+    params: Dict[Tuple[str, ...], np.ndarray] = {}
+    stats: Dict[Tuple[str, ...], np.ndarray] = {}
+    block_name = ("BottleneckResNetBlock" if bottleneck else "ResNetBlock")
+
+    def conv(dst: Tuple[str, ...], key: str):
+        params[dst + ("kernel",)] = tv[key].transpose(2, 3, 1, 0)  # OIHW→HWIO
+
+    def bn(dst_parent: Tuple[str, ...], bn_name: str, key: str):
+        params[dst_parent + (bn_name, "scale")] = tv[key + ".weight"]
+        params[dst_parent + (bn_name, "bias")] = tv[key + ".bias"]
+        stats[dst_parent + (bn_name, "mean")] = tv[key + ".running_mean"]
+        stats[dst_parent + (bn_name, "var")] = tv[key + ".running_var"]
+
+    conv(("conv_init",), "conv1.weight")
+    bn((), "bn_init", "bn1")
+    n_convs = 3 if bottleneck else 2
+    idx = 0
+    for s, size in enumerate(stage_sizes):
+        for j in range(size):
+            blk = (f"{block_name}_{idx}",)
+            tvp = f"layer{s + 1}.{j}"
+            for c in range(n_convs):
+                conv(blk + (f"Conv_{c}",), f"{tvp}.conv{c + 1}.weight")
+                bn(blk, f"BatchNorm_{c}", f"{tvp}.bn{c + 1}")
+            if f"{tvp}.downsample.0.weight" in tv:
+                conv(blk + ("conv_proj",), f"{tvp}.downsample.0.weight")
+                bn(blk, "norm_proj", f"{tvp}.downsample.1")
+            idx += 1
+    if load_head:
+        params[("head", "kernel")] = tv["fc.weight"].T
+        params[("head", "bias")] = tv["fc.bias"]
+    return params, stats
+
+
+def import_resnet(variables: Dict, checkpoint, stage_sizes,
+                  bottleneck: bool, load_head: Optional[bool] = None) -> Dict:
+    """Splice a torchvision-format resnet checkpoint into a flax ResNet
+    variable dict ({'params': ..., 'batch_stats': ...})."""
+    tv = read_checkpoint(checkpoint) if isinstance(checkpoint, str) else checkpoint
+    tv = {re.sub(r"^(module|model)\.", "", k): v for k, v in tv.items()}
+    if load_head is None:
+        flat = _flatten_tree(variables.get("params", {}))
+        leaf = flat.get(("head", "kernel"))
+        ref = getattr(leaf, "value", leaf)
+        load_head = (ref is not None and "fc.weight" in tv
+                     and tv["fc.weight"].T.shape == tuple(ref.shape))
+    p_map, s_map = _resnet_mapping(tv, stage_sizes, bottleneck, load_head)
+    out = dict(variables)
+    out["params"] = load_into_params(variables["params"], p_map, strict=False)
+    if "batch_stats" in variables:
+        out["batch_stats"] = load_into_params(variables["batch_stats"],
+                                              s_map, strict=False)
+    return out

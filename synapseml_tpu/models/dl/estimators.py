@@ -13,6 +13,7 @@ maxTokenLen mirror the reference's TorchEstimator kwargs (captured there by
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Any, Dict, List, Optional
 
@@ -26,13 +27,46 @@ from ...core.params import (BoolParam, FloatParam, IntParam, ListParam,
                             Params, PyObjectParam, StringParam)
 from ...core.pipeline import Estimator, Model
 from .resnet import make_backbone
-from .tokenizer import WordTokenizer
+from .tokenizer import (WordPieceTokenizer, WordTokenizer,
+                        tokenizer_from_dict)
 from .training import (DLTrainer, OptimizerConfig, TrainState,
                        iterate_minibatches, make_dl_mesh, num_minibatches)
 from .transformer import TextEncoder, TransformerConfig
 
 from flax import linen as nn
 from flax.core import freeze
+
+
+def _bert_checkpoint_assets(path, dropout_rate):
+    """Tokenizer + TransformerConfig for an HF-format BERT checkpoint dir
+    (config.json + vocab.txt); a bare weights file needs neither — the
+    caller keeps its configured dims and corpus tokenizer."""
+    import json
+    import os
+
+    d = path if os.path.isdir(path) else os.path.dirname(path)
+    cfg_path = os.path.join(d, "config.json")
+    vocab_path = os.path.join(d, "vocab.txt")
+    if not os.path.exists(cfg_path) or not os.path.exists(vocab_path):
+        raise ValueError(
+            f"checkpoint {path!r} needs config.json and vocab.txt beside the "
+            "weights (an HF model directory) so dims and tokenization match "
+            "the pretrained weights")
+    with open(cfg_path) as f:
+        hc = json.load(f)
+    tokenizer = WordPieceTokenizer.from_vocab_file(
+        vocab_path, lowercase=hc.get("do_lower_case", True))
+    # max_len must equal the pretrained position table for weight import;
+    # callers truncate sequences separately via maxTokenLen
+    cfg = TransformerConfig(
+        vocab_size=hc["vocab_size"],
+        max_len=int(hc.get("max_position_embeddings", 512)),
+        num_layers=hc["num_hidden_layers"],
+        num_heads=hc["num_attention_heads"],
+        d_model=hc["hidden_size"],
+        d_ff=hc["intermediate_size"],
+        dropout_rate=dropout_rate)
+    return tokenizer, cfg
 
 
 def _host_params(state: TrainState):
@@ -148,6 +182,11 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
     vocabSize = IntParam(doc="tokenizer vocab size", default=8192)
     modelSize = StringParam(doc="tiny|small|base", default="small",
                             allowed=("tiny", "small", "base"))
+    checkpoint = StringParam(
+        doc="HF-format BERT checkpoint to fine-tune from: a model dir "
+            "(config.json + vocab.txt + weights) or a weights file; "
+            "overrides modelSize/vocabSize with the checkpoint's dims "
+            "(from_pretrained analogue, LitDeepTextModel.py:86)")
     dropoutRate = FloatParam(doc="dropout rate", default=0.1)
     numExperts = IntParam(doc="0 = dense FFN; >0 = MoE FFN with this many "
                               "experts, sharded over the mesh expert axis",
@@ -175,7 +214,13 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         labels = np.searchsorted(classes, y_raw).astype(np.int32)
         num_classes = len(classes)
 
-        tokenizer = WordTokenizer.fit(texts, self.vocabSize)
+        ckpt_path = self.get("checkpoint")
+        ckpt_cfg = None
+        if ckpt_path:
+            tokenizer, ckpt_cfg = _bert_checkpoint_assets(
+                ckpt_path, self.dropoutRate)
+        else:
+            tokenizer = WordTokenizer.fit(texts, self.vocabSize)
         ids, mask = tokenizer.encode(texts, self.maxTokenLen)
 
         ep = int(self.expertParallelism)
@@ -211,12 +256,19 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         n = len(labels)
         total_steps = num_minibatches(n, self.batchSize, shards) * self.maxEpochs
 
-        cfg = self._model_config(num_classes)
+        if ckpt_cfg is not None:
+            cfg = dataclasses.replace(ckpt_cfg, num_classes=num_classes)
+        else:
+            cfg = self._model_config(num_classes)
         model = TextEncoder(cfg)
         trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
                             zero1=bool(self.zero1))
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, ids[:sample_n], mask[:sample_n])
+        if ckpt_path:
+            from .checkpoints import import_bert
+            state = state.replace(params=import_bert(
+                state.params, ckpt_path, num_layers=cfg.num_layers))
         step = trainer.train_step()
         eval_step = trainer.eval_step()
         rng = np.random.default_rng(self.seed)
@@ -274,7 +326,7 @@ class DeepTextModel(Model):
         payload = self.modelPayload
         cfg: TransformerConfig = payload["config"]
         model = TextEncoder(cfg)
-        tokenizer = WordTokenizer.from_dict(payload["tokenizer"])
+        tokenizer = tokenizer_from_dict(payload["tokenizer"])
         variables = payload["variables"]
         classes = np.asarray(payload["classes"])
 
@@ -311,6 +363,11 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
     imageCol = StringParam(doc="image column (HWC arrays)", default="image")
     backbone = StringParam(doc="resnet18|resnet34|resnet50|resnet101|resnet152",
                            default="resnet50")
+    checkpoint = StringParam(
+        doc="torchvision-format resnet checkpoint (state-dict file) to "
+            "fine-tune from; the classifier head reloads only when its "
+            "shape matches (pretrained-backbone analogue, "
+            "DeepVisionClassifier.py:31)")
 
     def _fit(self, ds: Dataset) -> "DeepVisionModel":
         imgs = np.stack([np.asarray(im, np.float32) for im in ds[self.imageCol]])
@@ -334,6 +391,19 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
                             zero1=bool(self.zero1))
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, imgs[:sample_n])
+        if self.get("checkpoint"):
+            from .checkpoints import import_resnet
+            from .resnet import BACKBONES, BottleneckResNetBlock
+            bb = BACKBONES[self.backbone]
+            new_vars = import_resnet(
+                {"params": state.params, **state.extra_vars},
+                self.get("checkpoint"),
+                stage_sizes=bb.keywords["stage_sizes"],
+                bottleneck=bb.keywords["block_cls"] is BottleneckResNetBlock)
+            state = state.replace(
+                params=new_vars["params"],
+                extra_vars={k: v for k, v in new_vars.items()
+                            if k != "params"})
         step = trainer.train_step()
         rng = np.random.default_rng(self.seed)
         key = jax.random.PRNGKey(self.seed)

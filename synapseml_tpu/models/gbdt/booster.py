@@ -217,7 +217,8 @@ class Booster:
                     break
                 f = np.maximum(feat, 0)
                 x = features[rows, f]
-                go_left = (x <= t.threshold[cur]) | np.isnan(x)
+                go_left = np.where(np.isnan(x), t.default_left[cur],
+                                   x <= t.threshold[cur])
                 nxt = np.where(go_left, t.left_child[cur], t.right_child[cur])
                 nxt = np.where(internal, nxt, cur)
                 delta = (nv[nxt] - nv[cur]) * w
@@ -265,7 +266,11 @@ class Booster:
         }
 
     def to_string(self) -> str:
-        return json.dumps(self.to_dict())
+        """LightGBM text model format (saveToString parity,
+        LightGBMBooster.scala:272-284) — loadable by any LightGBM runtime.
+        The JSON form (:meth:`to_dict`) remains the internal format."""
+        from .lgbm_format import booster_to_lgbm_string
+        return booster_to_lgbm_string(self)
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Booster":
@@ -287,14 +292,28 @@ class Booster:
                 right_child=np.asarray(td["right_child"], np.int32),
                 leaf_value=np.asarray(td["leaf_value"], np.float32),
                 node_value=np.asarray(td["node_value"], np.float32),
-                num_nodes=np.asarray(td["num_nodes"], np.int32)))
+                num_nodes=np.asarray(td["num_nodes"], np.int32),
+                default_left=np.asarray(
+                    td.get("default_left",
+                           np.ones(len(td["leaf_value"]), bool)), bool)))
         return Booster(trees, d["tree_class"], d["tree_weights"], d["num_class"],
                        d["objective"], np.asarray(d["init_score"], np.float32),
                        bm, d["feature_names"], cfg, d["best_iteration"])
 
     @staticmethod
     def from_string(s: str) -> "Booster":
-        return Booster.from_dict(json.loads(s))
+        """Parse either format: LightGBM text models (native interop,
+        LightGBMClassifier.scala:196-211) or the internal JSON."""
+        if s.lstrip().startswith("{"):
+            return Booster.from_dict(json.loads(s))
+        from .lgbm_format import booster_from_lgbm_string
+        return booster_from_lgbm_string(s)
+
+    @staticmethod
+    def from_file(path: str) -> "Booster":
+        """loadNativeModelFromFile analogue (LightGBMClassifier.scala:196)."""
+        with open(path) as f:
+            return Booster.from_string(f.read())
 
 
 # --------------------------------------------------------------------------
@@ -465,6 +484,10 @@ class InstrumentationMeasures:
         return d
 
 
+def _placeholder_mapper(m: BinMapper) -> bool:
+    return bool(np.all(m.num_bins <= 1)) and bool(np.all(np.isinf(m.upper_bounds)))
+
+
 def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
           sample_weight: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = None,
@@ -490,7 +513,10 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     rng = np.random.default_rng(config.seed)
 
     # -- binning (calculateRowStatistics analogue) -------------------------
-    if init_model is not None:
+    # imported LightGBM models carry a placeholder mapper (all-inf bounds);
+    # warm-starting from one must fit a REAL mapper or every row would land
+    # in bin 1 and the new trees would be stumps
+    if init_model is not None and not _placeholder_mapper(init_model.bin_mapper):
         mapper = init_model.bin_mapper
     else:
         mapper = fit_bin_mapper(X, config.max_bin,

@@ -278,10 +278,18 @@ class GBDTClassificationModel(GBDTModelBase):
 
     @staticmethod
     def load_native_model_from_string(s: str, **kw) -> "GBDTClassificationModel":
-        """loadNativeModelFromString analogue (LightGBMClassifier.scala:196)."""
+        """loadNativeModelFromString analogue (LightGBMClassifier.scala:196);
+        accepts LightGBM text models and the internal JSON."""
         b = Booster.from_string(s)
         return GBDTClassificationModel(boosterModel=b,
                                        numClasses=max(b.num_class, 2), **kw)
+
+    @staticmethod
+    def load_native_model_from_file(path: str, **kw) -> "GBDTClassificationModel":
+        """loadNativeModelFromFile analogue (LightGBMClassifier.scala:196)."""
+        with open(path) as f:
+            return GBDTClassificationModel.load_native_model_from_string(
+                f.read(), **kw)
 
 
 class GBDTRegressor(GBDTParams, Estimator):
@@ -334,6 +342,11 @@ class GBDTRegressionModel(GBDTModelBase):
     def load_native_model_from_string(s: str, **kw) -> "GBDTRegressionModel":
         return GBDTRegressionModel(boosterModel=Booster.from_string(s), **kw)
 
+    @staticmethod
+    def load_native_model_from_file(path: str, **kw) -> "GBDTRegressionModel":
+        with open(path) as f:
+            return GBDTRegressionModel.load_native_model_from_string(f.read(), **kw)
+
 
 class GBDTRanker(GBDTParams, Estimator):
     """LightGBMRanker analogue (lambdarank objective + groupCol)."""
@@ -377,6 +390,15 @@ class GBDTRanker(GBDTParams, Estimator):
 
 
 class GBDTRankerModel(GBDTModelBase):
+    @staticmethod
+    def load_native_model_from_string(s: str, **kw) -> "GBDTRankerModel":
+        return GBDTRankerModel(boosterModel=Booster.from_string(s), **kw)
+
+    @staticmethod
+    def load_native_model_from_file(path: str, **kw) -> "GBDTRankerModel":
+        with open(path) as f:
+            return GBDTRankerModel.load_native_model_from_string(f.read(), **kw)
+
     def _transform(self, ds: Dataset) -> Dataset:
         X = ds.to_numpy([self.featuresCol])
         self._check_features(X)

@@ -1,0 +1,298 @@
+"""LightGBM text model format: emit + parse.
+
+The reference round-trips models through the native LightGBM model STRING
+(reference: LightGBMBooster.saveToString booster/LightGBMBooster.scala:272-284;
+LightGBMClassificationModel.loadNativeModelFromFile/String
+LightGBMClassifier.scala:196-211).  This module speaks the same text format
+so existing LightGBM models can be imported and our boosters exported to any
+LightGBM runtime:
+
+- ``tree`` header block: version/num_class/num_tree_per_iteration/
+  max_feature_idx/objective/feature_names/average_output.
+- Per-tree blocks ``Tree=i``: LightGBM node convention — internal nodes are
+  indexed 0..num_leaves-2 and leaves appear as bitwise-complement indices
+  (child < 0 ⇒ leaf ~child); splits are ``x <= threshold`` → left with the
+  default-left/NaN flags packed into ``decision_type``.
+
+Export folds per-tree weights (dart normalization, shrinkage already applied
+by training) and the init score (into the first tree per class) into leaf
+values, so a file's predictions equal ours with no side-channel: that is
+also how LightGBM's own files behave (boost_from_average is baked in).
+Imported models carry a placeholder bin mapper — raw-feature prediction
+(`predict_margin`, `predict_contrib`) never consults bins.
+
+Limitations: categorical splits (``num_cat > 0``) and linear-leaf models are
+rejected explicitly; ``leaf_weight``/``leaf_count`` export as zeros because
+our Tree keeps no per-node hessian/count stats after training.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+import numpy as np
+
+from .binning import BinMapper
+
+#: decision_type flags (LightGBM: include/LightGBM/tree.h semantics)
+_CATEGORICAL_MASK = 1
+_DEFAULT_LEFT_MASK = 2
+_MISSING_TYPE_NAN = 2 << 2
+
+
+def _fmt(v: float) -> str:
+    return f"{float(v):.17g}"
+
+
+def _objective_string(objective: str, num_class: int) -> str:
+    if objective == "binary":
+        return "binary sigmoid:1"
+    if objective == "multiclass":
+        return f"multiclass num_class:{num_class}"
+    if objective == "multiclassova":
+        return f"multiclassova num_class:{num_class} sigmoid:1"
+    if objective in ("regression", "mse", "l2"):
+        return "regression"
+    return objective
+
+
+def _parse_objective(s: str) -> Dict[str, object]:
+    parts = s.split()
+    name = parts[0] if parts else "regression"
+    kv = dict(p.split(":", 1) for p in parts[1:] if ":" in p)
+    num_class = int(kv.get("num_class", 1))
+    if name == "regression_l2":
+        name = "regression"
+    return {"objective": name, "num_class": num_class}
+
+
+# --------------------------------------------------------------------------
+# export
+# --------------------------------------------------------------------------
+
+def _tree_block(tree, weight: float, bias: float, index: int,
+                shrinkage: float) -> str:
+    """One ``Tree=i`` section in LightGBM node numbering."""
+    n_nodes = int(tree.num_nodes)
+    lc = np.asarray(tree.left_child[:n_nodes])
+    rc = np.asarray(tree.right_child[:n_nodes])
+    internal = np.nonzero(lc >= 0)[0]
+    leaves = np.nonzero(lc < 0)[0]
+    int_idx = {int(n): i for i, n in enumerate(internal)}
+    leaf_idx = {int(n): i for i, n in enumerate(leaves)}
+
+    def child(c: int) -> int:
+        c = int(c)
+        return int_idx[c] if int(lc[c]) >= 0 else ~leaf_idx[c]
+
+    lines = [f"Tree={index}",
+             f"num_leaves={len(leaves)}",
+             "num_cat=0"]
+    leaf_vals = [float(tree.node_value[n]) * weight + bias for n in leaves]
+    if len(internal):
+        dl = np.asarray(tree.default_left[:n_nodes])
+
+        def dtype_of(n):
+            return (_DEFAULT_LEFT_MASK if dl[n] else 0) | _MISSING_TYPE_NAN
+
+        lines += [
+            "split_feature=" + " ".join(str(int(tree.split_feature[n]))
+                                        for n in internal),
+            "split_gain=" + " ".join(_fmt(tree.split_gain[n])
+                                     for n in internal),
+            "threshold=" + " ".join(_fmt(tree.threshold[n])
+                                    for n in internal),
+            "decision_type=" + " ".join(str(dtype_of(n)) for n in internal),
+            "left_child=" + " ".join(str(child(lc[n])) for n in internal),
+            "right_child=" + " ".join(str(child(rc[n])) for n in internal),
+        ]
+    lines += [
+        "leaf_value=" + " ".join(_fmt(v) for v in leaf_vals),
+        "leaf_weight=" + " ".join("0" for _ in leaves),
+        "leaf_count=" + " ".join("0" for _ in leaves),
+    ]
+    if len(internal):
+        lines += [
+            "internal_value=" + " ".join(
+                _fmt(float(tree.node_value[n]) * weight + bias)
+                for n in internal),
+            "internal_weight=" + " ".join("0" for _ in internal),
+            "internal_count=" + " ".join("0" for _ in internal),
+        ]
+    lines += ["is_linear=0", f"shrinkage={_fmt(shrinkage)}"]
+    return "\n".join(lines) + "\n"
+
+
+def booster_to_lgbm_string(booster) -> str:
+    """Serialize a Booster to LightGBM's text model format
+    (saveToString parity, LightGBMBooster.scala:272-284)."""
+    K = booster.num_class
+    F = booster.bin_mapper.num_features
+    is_rf = booster.config.boosting_type == "rf"
+    blocks: List[str] = []
+    seen_class: Dict[int, bool] = {}
+    for i, tree in enumerate(booster.trees):
+        k = booster.tree_class[i]
+        w = float(booster.tree_weights[i])
+        # init score folds into leaf values: once per class for summed
+        # models, into EVERY tree for averaged (rf) models so that
+        # mean(leaves) keeps the full bias
+        if is_rf:
+            bias = float(booster.init_score[min(k, len(booster.init_score) - 1)])
+        else:
+            bias = 0.0
+            if not seen_class.get(k):
+                seen_class[k] = True
+                bias = float(
+                    booster.init_score[min(k, len(booster.init_score) - 1)])
+        blocks.append(_tree_block(tree, w, bias, i,
+                                  booster.config.learning_rate))
+
+    header = ["tree", "version=v3",
+              f"num_class={K}",
+              f"num_tree_per_iteration={K}",
+              "label_index=0",
+              f"max_feature_idx={F - 1}",
+              "objective=" + _objective_string(booster.objective, K),
+              "feature_names=" + " ".join(booster.feature_names),
+              "feature_infos=" + " ".join("[-1e+308:1e+308]"
+                                          for _ in range(F))]
+    if booster.config.boosting_type == "rf":
+        header.append("average_output")
+    body = "\n\n".join(blocks)
+    header.append("tree_sizes=" + " ".join(str(len(b) + 1) for b in blocks))
+    return "\n".join(header) + "\n\n" + body + "\nend of trees\n"
+
+
+# --------------------------------------------------------------------------
+# import
+# --------------------------------------------------------------------------
+
+def _parse_block(text: str) -> Dict[str, str]:
+    out = {}
+    for line in text.splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _tree_from_block(fields: Dict[str, str], max_leaves: int):
+    from .trainer import Tree
+
+    n_leaves = int(fields["num_leaves"])
+    if int(fields.get("num_cat", "0") or 0) > 0:
+        raise ValueError("categorical splits (num_cat>0) are not supported")
+    if fields.get("is_linear", "0").strip() == "1":
+        raise ValueError("linear-leaf trees (is_linear=1) are not supported")
+    n_int = max(n_leaves - 1, 0)
+    M = 2 * max_leaves
+    split_feature = np.full(M, -1, np.int32)
+    threshold = np.zeros(M, np.float32)
+    split_gain = np.zeros(M, np.float32)
+    left = np.full(M, -1, np.int32)
+    right = np.full(M, -1, np.int32)
+    node_value = np.zeros(M, np.float32)
+    leaf_value = np.zeros(M, np.float32)
+    default_left = np.ones(M, bool)
+
+    def arr(key, dtype, n, default=None):
+        if key not in fields:
+            if default is not None:
+                return np.full(n, default, dtype)
+            raise ValueError(f"model string missing '{key}'")
+        vals = fields[key].split()
+        if len(vals) != n:
+            raise ValueError(f"'{key}' has {len(vals)} values, expected {n}")
+        return np.asarray([dtype(v) for v in vals])
+
+    lv = arr("leaf_value", float, n_leaves)
+    if n_int:
+        sf = arr("split_feature", int, n_int)
+        th = arr("threshold", float, n_int)
+        sg = arr("split_gain", float, n_int, default=0.0)
+        lc = arr("left_child", int, n_int)
+        rc = arr("right_child", int, n_int)
+        iv = arr("internal_value", float, n_int, default=0.0)
+        dt = np.asarray(arr("decision_type", int, n_int,
+                            default=_DEFAULT_LEFT_MASK | _MISSING_TYPE_NAN))
+        if np.any(dt & _CATEGORICAL_MASK):
+            raise ValueError("categorical decision_type is not supported")
+        # missing_type bits 2-3: 0=None, 1=Zero, 2=NaN.  NaN missing (the
+        # LightGBM float default) keeps the stored default direction.  For
+        # None, LightGBM coerces NaN input to 0.0 — emulated exactly by
+        # routing NaN where 0.0 would compare.  Zero missing (0.0 itself
+        # treated as missing) has no Tree representation — reject loudly
+        # rather than mispredict.
+        mtype = (dt >> 2) & 3
+        if np.any(mtype == 1):
+            raise ValueError(
+                "missing_type=Zero splits are not supported (only "
+                "NaN/None-missing models import exactly)")
+
+        def map_child(c: int) -> int:
+            return int(c) if c >= 0 else n_int + (~int(c))
+
+        for j in range(n_int):
+            split_feature[j] = sf[j]
+            threshold[j] = th[j]
+            split_gain[j] = sg[j]
+            left[j] = map_child(lc[j])
+            right[j] = map_child(rc[j])
+            node_value[j] = iv[j]
+            if ((dt[j] >> 2) & 3) == 0:          # None: NaN behaves as 0.0
+                default_left[j] = bool(0.0 <= th[j])
+            else:
+                default_left[j] = bool(dt[j] & _DEFAULT_LEFT_MASK)
+    for l in range(n_leaves):
+        node_value[n_int + l] = lv[l]
+        leaf_value[n_int + l] = lv[l]
+    return Tree(split_feature=split_feature,
+                split_bin=np.zeros(M, np.int32),
+                threshold=threshold.astype(np.float32),
+                split_gain=split_gain.astype(np.float32),
+                left_child=left, right_child=right,
+                leaf_value=leaf_value, node_value=node_value,
+                num_nodes=np.asarray(n_int + n_leaves, np.int32),
+                default_left=default_left)
+
+
+def booster_from_lgbm_string(s: str):
+    """Parse a LightGBM text model into a Booster
+    (loadNativeModelFromString parity, LightGBMClassifier.scala:196-211)."""
+    from .booster import Booster, BoostingConfig
+
+    head, _, tail = s.partition("Tree=")
+    if not tail:
+        raise ValueError("not a LightGBM model string: no 'Tree=' block")
+    header = _parse_block(head)
+    obj = _parse_objective(header.get("objective", "regression"))
+    K = max(int(header.get("num_tree_per_iteration", obj["num_class"])), 1)
+    F = int(header.get("max_feature_idx", "0")) + 1
+    feature_names = header.get("feature_names", "").split() or \
+        [f"f{i}" for i in range(F)]
+    is_rf = bool(re.search(r"^average_output\s*$", head, re.MULTILINE))
+
+    tree_texts = ("Tree=" + tail).split("end of trees")[0]
+    blocks = [b for b in re.split(r"\n(?=Tree=\d)", tree_texts) if b.strip()]
+    parsed = [_parse_block(b) for b in blocks]
+    max_leaves = max(int(p["num_leaves"]) for p in parsed)
+    trees = [_tree_from_block(p, max_leaves) for p in parsed]
+
+    objective = str(obj["objective"])
+    cfg = BoostingConfig(objective=objective,
+                         boosting_type="rf" if is_rf else "gbdt",
+                         num_class=K if K > 1 else 1,
+                         num_leaves=max(max_leaves, 2))
+    mapper = BinMapper(upper_bounds=np.full((F, 255), np.inf, np.float32),
+                       num_bins=np.ones(F, np.int32), max_bin=255)
+    return Booster(trees=trees,
+                   tree_class=[i % K for i in range(len(trees))],
+                   tree_weights=[1.0] * len(trees),
+                   num_class=K if K > 1 else 1,
+                   objective=objective,
+                   init_score=np.zeros(max(K, 1), np.float32),
+                   bin_mapper=mapper,
+                   feature_names=feature_names[:F],
+                   config=cfg)

@@ -62,6 +62,9 @@ class Tree(NamedTuple):
     leaf_value: jnp.ndarray           # (MAX_NODES,) f32 (already shrunk)
     node_value: jnp.ndarray           # (MAX_NODES,) f32 output at every node
     num_nodes: jnp.ndarray            # () int32
+    default_left: jnp.ndarray         # (MAX_NODES,) bool — NaN routing per
+                                      # node (training always emits True;
+                                      # imported LightGBM models may not)
 
 
 def max_nodes(num_leaves: int) -> int:
@@ -350,7 +353,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
                 right_child=state["right_child"],
                 leaf_value=leaf_value,
                 node_value=node_value,
-                num_nodes=state["num_nodes"])
+                num_nodes=state["num_nodes"],
+                default_left=jnp.ones(M, jnp.bool_))
     return tree, state["node_id"]
 
 
@@ -592,7 +596,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 right_child=state["right_child"],
                 leaf_value=leaf_value,
                 node_value=node_value,
-                num_nodes=state["num_nodes"])
+                num_nodes=state["num_nodes"],
+                default_left=jnp.ones(M, jnp.bool_))
     return tree, state["node_id"]
 
 
@@ -637,7 +642,8 @@ def predict_raw_features(features, trees_stacked: Tree, depth_bound: int):
             is_leaf = feat < 0
             f = jnp.maximum(feat, 0)
             x = features[rows, f]
-            go_left = (x <= t.threshold[node]) | jnp.isnan(x)
+            go_left = jnp.where(jnp.isnan(x), t.default_left[node],
+                                x <= t.threshold[node])
             child = jnp.where(go_left, t.left_child[node], t.right_child[node])
             return jnp.where(is_leaf, node, child)
 

@@ -5,11 +5,13 @@ cognitive/.../openai/OpenAI.scala:246)."""
 from .generate import generate, sample_logits
 from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
-                    causal_lm_loss, init_cache, rope_frequencies)
+                    causal_lm_loss, init_cache, llama_from_pretrained,
+                    rope_frequencies)
 from .stage import LLMTransformer
 
 __all__ = [
     "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LLMTransformer",
     "LlamaConfig", "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss",
-    "generate", "init_cache", "rope_frequencies", "sample_logits",
+    "generate", "init_cache", "llama_from_pretrained",
+    "rope_frequencies", "sample_logits",
 ]

@@ -236,3 +236,53 @@ def causal_lm_loss(logits: jnp.ndarray, input_ids: jnp.ndarray,
         m = mask[:, 1:].astype(jnp.float32)
         return (losses * m).sum() / jnp.maximum(m.sum(), 1.0)
     return losses.mean()
+
+
+def llama_from_pretrained(path: str, dtype: Any = jnp.bfloat16,
+                          max_len: Optional[int] = None,
+                          config: Optional[LlamaConfig] = None,
+                          rng_seed: int = 0):
+    """Build a LlamaModel + variables from an HF-format checkpoint.
+
+    ``path``: HF model dir (config.json + safetensors/bin, possibly
+    sharded) or a bare weights file (then ``config`` is required).  The
+    weight import goes through the family mapping table in
+    models/dl/checkpoints.py — torch (out, in) Linear layouts transpose to
+    flax kernels, and HF's rotate-half RoPE arrangement matches
+    ``apply_rope`` as-is.  Returns ``(model, {"params": ...})`` for
+    LLMTransformer's bundle.
+    """
+    import json
+    import os
+
+    from ..dl.checkpoints import import_llama, read_checkpoint
+
+    if config is None:
+        cfg_path = os.path.join(path, "config.json") if os.path.isdir(path) \
+            else os.path.join(os.path.dirname(path), "config.json")
+        if not os.path.exists(cfg_path):
+            raise ValueError(
+                f"no config.json beside {path!r}; pass config= explicitly")
+        with open(cfg_path) as f:
+            hc = json.load(f)
+        config = LlamaConfig(
+            vocab_size=hc["vocab_size"],
+            d_model=hc["hidden_size"],
+            num_layers=hc["num_hidden_layers"],
+            num_heads=hc["num_attention_heads"],
+            num_kv_heads=hc.get("num_key_value_heads",
+                                hc["num_attention_heads"]),
+            d_ff=hc["intermediate_size"],
+            max_len=max_len or int(hc.get("max_position_embeddings", 8192)),
+            # HF's default when config.json omits it (Llama-1/2 era)
+            rope_theta=float(hc.get("rope_theta", 10_000.0)),
+            rms_norm_eps=float(hc.get("rms_norm_eps", 1e-5)),
+            tie_embeddings=bool(hc.get("tie_word_embeddings", False)),
+            dtype=dtype)
+    model = LlamaModel(config)
+    probe = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(rng_seed), probe)["params"]
+    hf = read_checkpoint(path)
+    params = import_llama(params, hf, num_layers=config.num_layers,
+                          tie_embeddings=config.tie_embeddings)
+    return model, {"params": params}

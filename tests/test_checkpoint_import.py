@@ -1,0 +1,299 @@
+"""Pretrained-weight import: HF/torch checkpoints → our param trees.
+
+The reference fine-tunes real pretrained weights (reference:
+LitDeepTextModel.py:86 AutoModelForSequenceClassification.from_pretrained,
+DeepVisionClassifier.py:31 torchvision backbones).  These tests build
+REAL-format checkpoints locally — actual transformers models saved to
+HF-style dirs — and assert output parity and tensor placement.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from synapseml_tpu.models.dl.checkpoints import (import_bert, import_llama,
+                                                 import_resnet,
+                                                 read_checkpoint)
+from synapseml_tpu.models.dl.tokenizer import WordPieceTokenizer
+from synapseml_tpu.models.dl.transformer import TextEncoder, TransformerConfig
+
+torch = pytest.importorskip("torch")
+
+
+def _tiny_hf_bert(num_labels=3, seed=0):
+    from transformers import BertConfig, BertForSequenceClassification
+    cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, num_labels=num_labels,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(seed)
+    return BertForSequenceClassification(cfg).eval(), cfg
+
+
+def _our_bert_cfg(num_classes=3):
+    return TransformerConfig(vocab_size=120, max_len=64, num_layers=2,
+                             num_heads=4, d_model=32, d_ff=64,
+                             num_classes=num_classes, dtype=jnp.float32,
+                             dropout_rate=0.0)
+
+
+def test_bert_import_matches_hf_forward():
+    hf_model, _ = _tiny_hf_bert()
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    model = TextEncoder(_our_bert_cfg())
+    ids = np.random.default_rng(0).integers(0, 120, (4, 10))
+    mask = np.ones((4, 10), bool)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids),
+                        jnp.asarray(mask))["params"]
+    params = import_bert(params, sd, num_layers=2)
+    ours = np.asarray(model.apply({"params": params}, jnp.asarray(ids),
+                                  jnp.asarray(mask)))
+    with torch.no_grad():
+        theirs = hf_model(input_ids=torch.tensor(ids),
+                          attention_mask=torch.ones(4, 10, dtype=torch.long)
+                          ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-3)
+
+
+def test_bert_import_head_reinit_on_class_mismatch():
+    """from_pretrained parity: a different num_labels keeps the fresh head
+    but still loads the encoder."""
+    hf_model, _ = _tiny_hf_bert(num_labels=7)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    model = TextEncoder(_our_bert_cfg(num_classes=2))     # 2 != 7
+    ids = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    before = np.asarray(jax.tree.leaves(params["classifier"])[0])
+    out = import_bert(params, sd, num_layers=2)
+    import flax.linen as nn
+    unboxed = nn.meta.unbox(out)
+    np.testing.assert_allclose(
+        np.asarray(unboxed["tok_embed"]["embedding"]),
+        sd["bert.embeddings.word_embeddings.weight"], atol=1e-6)
+    # head untouched (random init preserved)
+    after = np.asarray(jax.tree.leaves(out["classifier"])[0])
+    np.testing.assert_allclose(before, after)
+
+
+def test_bert_import_preserves_tp_sharding():
+    """Under a (data, model) mesh the imported leaves keep the exact
+    sharding of the initialized ones (tensor-placement assert)."""
+    from synapseml_tpu.models.dl.training import DLTrainer, OptimizerConfig
+    from synapseml_tpu.models.dl.training import make_dl_mesh
+
+    hf_model, _ = _tiny_hf_bert()
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    mesh = make_dl_mesh(2)                      # model-parallel size 2
+    model = TextEncoder(_our_bert_cfg())
+    trainer = DLTrainer(model, OptimizerConfig(learning_rate=1e-4), mesh)
+    ids = np.zeros((8, 10), np.int64)
+    state = trainer.init_state(0, ids, np.ones((8, 10), bool))
+    imported = import_bert(state.params, sd, num_layers=2)
+
+    flat_a = jax.tree.leaves(state.params)
+    flat_b = jax.tree.leaves(imported)
+    assert len(flat_a) == len(flat_b)
+    checked = 0
+    for a, b in zip(flat_a, flat_b):
+        if hasattr(a, "sharding") and hasattr(b, "sharding"):
+            assert a.sharding.is_equivalent_to(b.sharding, a.ndim), (
+                a.sharding, b.sharding)
+            assert a.shape == b.shape
+            checked += 1
+    assert checked > 10
+
+
+def test_deep_text_classifier_checkpoint_fine_tune(tmp_path):
+    """DeepTextClassifier(checkpoint=dir) loads HF weights + WordPiece
+    vocab and fine-tunes (the reference's from_pretrained path)."""
+    from safetensors.numpy import save_file
+
+    from synapseml_tpu import Dataset
+    from synapseml_tpu.models.dl.estimators import DeepTextClassifier
+
+    hf_model, hf_cfg = _tiny_hf_bert(num_labels=2)
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    save_file({k: v.detach().numpy().copy()
+               for k, v in hf_model.state_dict().items()},
+              str(d / "model.safetensors"))
+    (d / "config.json").write_text(json.dumps({
+        "vocab_size": 120, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 64}))
+    vocab = ["[PAD]", "[CLS]", "[SEP]", "[UNK]", "the", "good", "bad",
+             "##ly", "great", "awful", "movie", "a"] + \
+        [f"tok{i}" for i in range(108)]
+    (d / "vocab.txt").write_text("\n".join(vocab))
+
+    texts = (["great movie", "good movie"] * 8
+             + ["bad movie", "awful movie"] * 8)
+    labels = np.array([1.0, 1.0] * 8 + [0.0, 0.0] * 8)
+    ds = Dataset({"text": texts, "label": labels})
+    clf = DeepTextClassifier(checkpoint=str(d), batchSize=8, maxEpochs=8,
+                             learningRate=1e-2, numDevices=1, maxTokenLen=16,
+                             seed=1)
+    model = clf.fit(ds)
+    out = model.transform(ds)
+    acc = (np.asarray(out["prediction"]) == labels).mean()
+    assert acc > 0.9, acc
+    # the fitted payload carries the checkpoint's WordPiece tokenizer
+    assert model.modelPayload["tokenizer"]["kind"] == "wordpiece"
+
+
+def test_llama_import_matches_hf_forward():
+    from transformers import LlamaConfig as HFLlamaConfig, LlamaForCausalLM
+
+    from synapseml_tpu.models.llm.model import LlamaConfig, LlamaModel
+
+    hcfg = HFLlamaConfig(vocab_size=100, hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, max_position_embeddings=64,
+                         rms_norm_eps=1e-5, rope_theta=10000.0,
+                         tie_word_embeddings=False)
+    torch.manual_seed(1)
+    hl = LlamaForCausalLM(hcfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hl.state_dict().items()}
+    lcfg = LlamaConfig(vocab_size=100, d_model=32, d_ff=64, num_layers=2,
+                       num_heads=4, num_kv_heads=2, rms_norm_eps=1e-5,
+                       rope_theta=10000.0, tie_embeddings=False,
+                       dtype=jnp.float32, max_len=64)
+    lm = LlamaModel(lcfg)
+    ids = np.random.default_rng(1).integers(0, 100, (2, 8))
+    params = lm.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    params = import_llama(params, sd, num_layers=2, tie_embeddings=False)
+    ours = np.asarray(lm.apply({"params": params}, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hl(input_ids=torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-3)
+
+
+def test_llama_from_pretrained_dir(tmp_path):
+    """HF-format model dir (config.json + safetensors) → ready bundle."""
+    from safetensors.numpy import save_file
+    from transformers import LlamaConfig as HFLlamaConfig, LlamaForCausalLM
+
+    from synapseml_tpu.models.llm import llama_from_pretrained
+
+    hcfg = HFLlamaConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         num_key_value_heads=1, max_position_embeddings=32,
+                         rms_norm_eps=1e-5, rope_theta=10000.0,
+                         tie_word_embeddings=False)
+    torch.manual_seed(2)
+    hl = LlamaForCausalLM(hcfg).eval()
+    d = tmp_path / "llama"
+    d.mkdir()
+    save_file({k: v.detach().numpy().copy()
+               for k, v in hl.state_dict().items()},
+              str(d / "model.safetensors"))
+    (d / "config.json").write_text(json.dumps({
+        "vocab_size": 64, "hidden_size": 16, "intermediate_size": 32,
+        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "num_key_value_heads": 1, "max_position_embeddings": 32,
+        "rms_norm_eps": 1e-5, "rope_theta": 10000.0,
+        "tie_word_embeddings": False}))
+    model, variables = llama_from_pretrained(str(d), dtype=jnp.float32)
+    ids = np.random.default_rng(3).integers(0, 64, (2, 6))
+    ours = np.asarray(model.apply(variables, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hl(input_ids=torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-3)
+
+
+def _synthetic_torchvision_resnet18():
+    """State dict with torchvision resnet18 names/shapes (random values)."""
+    rng = np.random.default_rng(7)
+    sd = {}
+
+    def conv(name, cout, cin, k):
+        sd[name] = rng.normal(size=(cout, cin, k, k)).astype(np.float32) * 0.05
+
+    def bn(name, c):
+        sd[name + ".weight"] = np.abs(rng.normal(size=c)).astype(np.float32)
+        sd[name + ".bias"] = rng.normal(size=c).astype(np.float32) * 0.01
+        sd[name + ".running_mean"] = rng.normal(size=c).astype(np.float32) * 0.01
+        sd[name + ".running_var"] = np.abs(rng.normal(size=c)).astype(np.float32) + 1
+        sd[name + ".num_batches_tracked"] = np.asarray(1)
+
+    conv("conv1.weight", 64, 3, 7)
+    bn("bn1", 64)
+    chans = [64, 128, 256, 512]
+    cin = 64
+    for s, c in enumerate(chans):
+        for j in range(2):
+            p = f"layer{s + 1}.{j}"
+            conv(f"{p}.conv1.weight", c, cin if j == 0 else c, 3)
+            bn(f"{p}.bn1", c)
+            conv(f"{p}.conv2.weight", c, c, 3)
+            bn(f"{p}.bn2", c)
+            if j == 0 and (s > 0 or cin != c):
+                conv(f"{p}.downsample.0.weight", c, cin, 1)
+                bn(f"{p}.downsample.1", c)
+            cin = c
+    sd["fc.weight"] = rng.normal(size=(1000, 512)).astype(np.float32) * 0.01
+    sd["fc.bias"] = np.zeros(1000, np.float32)
+    return sd
+
+
+def test_resnet_import_placement():
+    from synapseml_tpu.models.dl.resnet import make_backbone
+
+    sd = _synthetic_torchvision_resnet18()
+    model = make_backbone("resnet18", num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = import_resnet(variables, sd, stage_sizes=[2, 2, 2, 2],
+                        bottleneck=False)
+    import flax.linen as nn
+    p = nn.meta.unbox(out["params"])
+    bs = out["batch_stats"]
+    # conv OIHW → HWIO transpose landed where torchvision's conv1 lives
+    np.testing.assert_allclose(np.asarray(p["conv_init"]["kernel"]),
+                               sd["conv1.weight"].transpose(2, 3, 1, 0),
+                               atol=1e-6)
+    # running stats landed in batch_stats
+    np.testing.assert_allclose(np.asarray(bs["bn_init"]["mean"]),
+                               sd["bn1.running_mean"], atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(p["ResNetBlock_2"]["conv_proj"]["kernel"]),
+        sd["layer2.0.downsample.0.weight"].transpose(2, 3, 1, 0), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p["head"]["kernel"]),
+                               sd["fc.weight"].T, atol=1e-6)
+    # and the model still runs with imported weights
+    logits = model.apply(out, x)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_read_checkpoint_sharded_safetensors(tmp_path):
+    from safetensors.numpy import save_file
+
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.ones(4, np.float32)
+    save_file({"w.a": a}, str(tmp_path / "m-00001.safetensors"))
+    save_file({"w.b": b}, str(tmp_path / "m-00002.safetensors"))
+    (tmp_path / "model.safetensors.index.json").write_text(json.dumps(
+        {"weight_map": {"w.a": "m-00001.safetensors",
+                        "w.b": "m-00002.safetensors"}}))
+    out = read_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(out["w.a"], a)
+    np.testing.assert_array_equal(out["w.b"], b)
+
+
+def test_wordpiece_tokenizer(tmp_path):
+    vocab = ["[PAD]", "[CLS]", "[SEP]", "[UNK]", "un", "##break", "##able",
+             "break", "the"]
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(vocab))
+    tok = WordPieceTokenizer.from_vocab_file(str(p))
+    ids, mask = tok.encode(["the unbreakable break", "zzz"], max_len=10)
+    # greedy longest-match: unbreakable → un ##break ##able
+    assert list(ids[0][:7]) == [1, 8, 4, 5, 6, 7, 2]
+    assert mask[0][:7].all() and not mask[0][7:].any()
+    assert ids[1][1] == 3                      # [UNK]
+    assert tok.decode(ids[:1]) == ["the unbreakable break"]

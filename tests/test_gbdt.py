@@ -14,7 +14,8 @@ from synapseml_tpu import Dataset
 from synapseml_tpu.core.pipeline import load_stage
 from synapseml_tpu.models.gbdt import (Booster, BoostingConfig,
                                        GBDTClassifier, GBDTRanker,
-                                       GBDTRegressor, train)
+                                       GBDTRegressionModel, GBDTRegressor,
+                                       train)
 from synapseml_tpu.models.gbdt.binning import fit_bin_mapper
 from synapseml_tpu.models.gbdt.metrics import (auc, binary_error, multi_error,
                                                ndcg_at, rmse)
@@ -169,13 +170,144 @@ def test_voting_parallel_estimator():
 
 
 def test_model_string_roundtrip():
+    """to_string now emits the LightGBM text format
+    (saveToString/loadNativeModelFromString parity)."""
     X, y = binary_data(n=1000)
     cfg = BoostingConfig(objective="binary", num_iterations=5,
                          num_leaves=7, min_data_in_leaf=5)
     b, _ = train(X, y, cfg)
+    s = b.to_string()
+    assert s.startswith("tree\n") and "Tree=0" in s and "end of trees" in s
+    b2 = Booster.from_string(s)
+    np.testing.assert_allclose(b.predict_margin(X), b2.predict_margin(X),
+                               atol=1e-5)
+    # re-export → re-import is a fixed point
+    b3 = Booster.from_string(b2.to_string())
+    np.testing.assert_allclose(b2.predict_margin(X), b3.predict_margin(X),
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("objective,boosting", [
+    ("regression", "gbdt"), ("binary", "dart"), ("binary", "rf"),
+    ("multiclass", "gbdt")])
+def test_lgbm_format_roundtrip_modes(objective, boosting):
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(800, 5)).astype(np.float32)
+    if objective == "multiclass":
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+        cfg = BoostingConfig(objective=objective, num_class=3,
+                             boosting_type=boosting, num_iterations=6,
+                             num_leaves=7, min_data_in_leaf=5)
+    else:
+        y = ((X[:, 0] + X[:, 1] > 0).astype(np.float64)
+             if objective == "binary" else
+             (X[:, 0] * 2 + X[:, 1]).astype(np.float64))
+        cfg = BoostingConfig(objective=objective, boosting_type=boosting,
+                             num_iterations=6, num_leaves=7,
+                             min_data_in_leaf=5, bagging_fraction=0.8,
+                             bagging_freq=1)
+    b, _ = train(X, y, cfg)
     b2 = Booster.from_string(b.to_string())
     np.testing.assert_allclose(b.predict_margin(X), b2.predict_margin(X),
-                               atol=1e-6)
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_import_handwritten_lightgbm_file(tmp_path):
+    """A model file in the exact shape LightGBM writes (two trees, one with
+    a nested split, leaf children as complement indices) predicts what the
+    tree arithmetic says it should."""
+    model = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=2
+objective=regression
+feature_names=a b c
+feature_infos=[-10:10] [-10:10] [-10:10]
+tree_sizes=400 200
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=10 5
+threshold=0.5 -1.25
+decision_type=10 10
+left_child=1 -1
+right_child=-3 -2
+leaf_value=1.5 2.5 -3
+leaf_weight=0 0 0
+leaf_count=0 0 0
+internal_value=0 0.1
+internal_weight=0 0
+internal_count=0 0
+is_linear=0
+shrinkage=0.1
+
+Tree=1
+num_leaves=2
+num_cat=0
+split_feature=2
+split_gain=1
+threshold=0
+decision_type=10
+left_child=-1
+right_child=-2
+leaf_value=10 20
+leaf_weight=0 0
+leaf_count=0 0
+internal_value=0
+internal_weight=0
+internal_count=0
+is_linear=0
+shrinkage=0.1
+
+end of trees
+"""
+    p = tmp_path / "model.txt"
+    p.write_text(model)
+    b = Booster.from_file(str(p))
+    assert b.num_trees == 2
+    # tree0: x0<=0.5 -> (x1<=-1.25 -> leaf0=1.5 else leaf1=2.5), else leaf2=-3
+    # tree1: x2<=0 -> 10 else 20
+    X = np.array([
+        [0.0, -2.0, -1.0],    # 1.5 + 10 = 11.5
+        [0.0,  0.0,  1.0],    # 2.5 + 20 = 22.5
+        [1.0,  0.0, -1.0],    # -3 + 10 = 7
+        [np.nan, -2.0, np.nan],  # NaN routes left: 1.5 + 10 = 11.5
+    ], np.float32)
+    np.testing.assert_allclose(b.predict_margin(X),
+                               [11.5, 22.5, 7.0, 11.5], atol=1e-6)
+    # model-class loader (loadNativeModelFromFile analogue)
+    m = GBDTRegressionModel.load_native_model_from_file(str(p))
+    ds = Dataset({"features": list(X)})
+    np.testing.assert_allclose(np.asarray(m.transform(ds)["prediction"]),
+                               [11.5, 22.5, 7.0, 11.5], atol=1e-6)
+
+
+def test_lgbm_import_rejects_categorical():
+    s = """tree
+num_class=1
+num_tree_per_iteration=1
+max_feature_idx=0
+objective=regression
+tree_sizes=100
+
+Tree=0
+num_leaves=2
+num_cat=1
+split_feature=0
+threshold=0.5
+decision_type=11
+left_child=-1
+right_child=-2
+leaf_value=1 2
+
+end of trees
+"""
+    with pytest.raises(ValueError, match="categorical"):
+        Booster.from_string(s)
 
 
 def test_feature_importance_and_contrib():
