@@ -157,8 +157,41 @@ def bench_gbdt_anchor(X, y):
     return ips_at_bench_iters, os.cpu_count()
 
 
+def bench_resnet50():
+    """ResNet-50 ONNX batch inference img/s/chip (BASELINE config #2;
+    reference path: ONNXModel.scala:242-251 over ONNX Runtime CUDA)."""
+    from synapseml_tpu import Dataset
+    from synapseml_tpu.models.onnx import ONNXModel
+    from synapseml_tpu.models.onnx.zoo import build_resnet50
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.onnx.runner import compile_onnx
+
+    model_bytes, _ = build_resnet50(num_classes=1000, seed=0)
+    bs, steps = 32, 8
+    x = np.random.default_rng(0).normal(size=(bs, 3, 224, 224)).astype(np.float32)
+    fn = compile_onnx(model_bytes)
+    x_dev = jnp.asarray(x)                       # exclude the host->device
+    out = fn(data=x_dev)                         # link (dev tunnel ~20MB/s)
+    np.asarray(out["logits"][0, :1])             # true barrier (readback)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(data=x_dev)
+    np.asarray(out["logits"][0, :1])
+    return bs * steps / (time.perf_counter() - t0)
+
+
 def main():
     bert_sps, mfu, n_params = bench_bert()
+    resnet_ips = None
+    try:
+        resnet_ips = bench_resnet50()
+        print(f"[secondary] ResNet-50 ONNX batch inference: "
+              f"{resnet_ips:.1f} img/s/chip", file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] ResNet-50 bench failed: {e}", file=sys.stderr)
 
     gbdt_ips = gbdt_steady = None
     anchor_ips = anchor_cores = None
@@ -192,6 +225,8 @@ def main():
                                       if gbdt_steady else None),
         "gbdt_anchor_iters_per_sec": (round(anchor_ips, 3)
                                       if anchor_ips else None),
+        "resnet50_onnx_imgs_per_sec": (round(resnet_ips, 1)
+                                       if resnet_ips else None),
         "anchor": (f"sklearn HistGradientBoostingClassifier, same host, "
                    f"{anchor_cores} CPU cores" if anchor_ips else None),
     }

@@ -60,10 +60,13 @@ class OnnxFunction:
 
         self._jitted = jax.jit(_run)
 
-    def __call__(self, **inputs) -> Dict[str, np.ndarray]:
-        arrays = {k: np.asarray(v) for k, v in inputs.items()}
-        out = self._jitted(arrays)
-        return {k: np.asarray(v) for k, v in out.items()}
+    def __call__(self, **inputs) -> Dict[str, Any]:
+        # device arrays pass through untouched — np.asarray on a jax array
+        # would DOWNLOAD it and the dispatch would re-upload (a full
+        # round trip over the host<->device link per call)
+        arrays = {k: v if isinstance(v, jax.Array) else np.asarray(v)
+                  for k, v in inputs.items()}
+        return dict(self._jitted(arrays))
 
     def trace(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         """Traceable call for embedding in larger jitted programs."""

@@ -1,0 +1,125 @@
+"""Local ONNX model zoo: construct standard architectures as ONNX graphs.
+
+The reference downloads zoo models through ONNXHub (reference:
+deep-learning/.../onnx/ONNXHub.scala:181-255 — manifest, SHA check, cached
+bytes) and benchmarks ResNet-50 batch inference through ONNXModel
+(ONNXModel.scala:242-251, ImageFeaturizer.scala:34-270).  In a zero-egress
+environment the zoo is CONSTRUCTED instead of fetched: this module emits
+real, full-size ONNX graphs for well-known architectures via
+:class:`~synapseml_tpu.models.onnx.graph.GraphBuilder`, with weights
+supplied or randomly initialized.  Weight names follow torchvision's
+state-dict convention, so the same dict can drive a torch reference
+implementation (how the tests verify numerical correctness) or be filled
+from a real torchvision checkpoint via
+``models.dl.checkpoints.read_checkpoint``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .graph import GraphBuilder
+
+#: bottleneck block counts per stage
+RESNET50_STAGES = (3, 4, 6, 3)
+
+
+def _rand_weights_resnet50(num_classes: int, seed: int) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    w: Dict[str, np.ndarray] = {}
+
+    def conv(name, cout, cin, k):
+        fan_in = cin * k * k
+        w[name + ".weight"] = (rng.normal(size=(cout, cin, k, k))
+                               * np.sqrt(2.0 / fan_in)).astype(np.float32)
+
+    def bn(name, c):
+        w[name + ".weight"] = np.ones(c, np.float32)
+        w[name + ".bias"] = np.zeros(c, np.float32)
+        w[name + ".running_mean"] = (rng.normal(size=c) * 0.01).astype(np.float32)
+        w[name + ".running_var"] = np.ones(c, np.float32)
+
+    conv("conv1", 64, 3, 7)
+    bn("bn1", 64)
+    cin = 64
+    for s, blocks in enumerate(RESNET50_STAGES):
+        width = 64 * 2 ** s
+        for j in range(blocks):
+            p = f"layer{s + 1}.{j}"
+            conv(f"{p}.conv1", width, cin, 1)
+            bn(f"{p}.bn1", width)
+            conv(f"{p}.conv2", width, width, 3)
+            bn(f"{p}.bn2", width)
+            conv(f"{p}.conv3", width * 4, width, 1)
+            bn(f"{p}.bn3", width * 4)
+            if j == 0:
+                conv(f"{p}.downsample.0", width * 4, cin, 1)
+                bn(f"{p}.downsample.1", width * 4)
+            cin = width * 4
+    w["fc.weight"] = (rng.normal(size=(num_classes, cin)) * 0.01).astype(np.float32)
+    w["fc.bias"] = np.zeros(num_classes, np.float32)
+    return w
+
+
+def build_resnet50(num_classes: int = 1000, seed: int = 0,
+                   weights: Optional[Dict[str, np.ndarray]] = None,
+                   input_name: str = "data", output_name: str = "logits",
+                   ) -> Tuple[bytes, Dict[str, np.ndarray]]:
+    """ResNet-50 v1 (bottleneck [3,4,6,3]) as ONNX model bytes.
+
+    Input ``data``: (N, 3, H, W) float32 NCHW; output ``logits``:
+    (N, num_classes).  Returns ``(model_bytes, weights)`` — feed the weights
+    to a torch reference with ``load_state_dict`` for parity checks.
+    """
+    w = weights if weights is not None else _rand_weights_resnet50(num_classes, seed)
+    b = GraphBuilder("resnet50", opset=17)
+    x = b.input(input_name, (None, 3, None, None))
+
+    def init(name):
+        return b.initializer(name.replace(".", "_"), w[name])
+
+    def conv(x, name, k, stride=1):
+        pad = (k - 1) // 2
+        return b.node("Conv", [x, init(name + ".weight")],
+                      kernel_shape=[k, k], strides=[stride, stride],
+                      pads=[pad, pad, pad, pad])
+
+    def bn(x, name):
+        return b.node("BatchNormalization",
+                      [x, init(name + ".weight"), init(name + ".bias"),
+                       init(name + ".running_mean"),
+                       init(name + ".running_var")], epsilon=1e-5)
+
+    y = conv(x, "conv1", 7, 2)
+    y = bn(y, "bn1")
+    y = b.node("Relu", [y])
+    y = b.node("MaxPool", [y], kernel_shape=[3, 3], strides=[2, 2],
+               pads=[1, 1, 1, 1])
+
+    cin = 64
+    for s, blocks in enumerate(RESNET50_STAGES):
+        width = 64 * 2 ** s
+        for j in range(blocks):
+            p = f"layer{s + 1}.{j}"
+            stride = 2 if (s > 0 and j == 0) else 1
+            h = conv(y, f"{p}.conv1", 1)
+            h = b.node("Relu", [bn(h, f"{p}.bn1")])
+            h = conv(h, f"{p}.conv2", 3, stride)
+            h = b.node("Relu", [bn(h, f"{p}.bn2")])
+            h = bn(conv(h, f"{p}.conv3", 1), f"{p}.bn3")
+            if j == 0:
+                shortcut = bn(conv(y, f"{p}.downsample.0", 1, stride),
+                              f"{p}.downsample.1")
+            else:
+                shortcut = y
+            y = b.node("Relu", [b.node("Add", [h, shortcut])])
+            cin = width * 4
+
+    y = b.node("GlobalAveragePool", [y])
+    y = b.node("Flatten", [y], axis=1)
+    y = b.node("Gemm", [y, init("fc.weight"), init("fc.bias")],
+               transB=1, outputs=[output_name])
+    b.output(output_name)
+    return b.build(), w

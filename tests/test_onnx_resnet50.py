@@ -1,0 +1,112 @@
+"""ResNet-50-class ONNX proof (BASELINE config #2).
+
+The reference benchmarks real zoo CNNs through ONNXModel batch inference
+(reference: ONNXModel.scala:242-251, ImageFeaturizer.scala:34-270,
+ONNXHub.scala:181-255).  Zero egress here, so the zoo model is CONSTRUCTED:
+a full ResNet-50 v1.5 ONNX graph from models/onnx/zoo.py, numerically
+verified against a torch reference implementation sharing the same weights.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.models.onnx import ONNXModel
+from synapseml_tpu.models.onnx.zoo import RESNET50_STAGES, build_resnet50
+
+torch = pytest.importorskip("torch")
+from torch import nn  # noqa: E402
+
+
+class _Bottleneck(nn.Module):
+    def __init__(self, cin, width, stride):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, width * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(width * 4)
+        self.relu = nn.ReLU()
+        self.downsample = None
+        if stride != 1 or cin != width * 4:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(cin, width * 4, 1, stride, bias=False),
+                nn.BatchNorm2d(width * 4))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(y + idn)
+
+
+class _TorchResNet50(nn.Module):
+    def __init__(self, num_classes):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        cin = 64
+        for s, blocks in enumerate(RESNET50_STAGES):
+            width = 64 * 2 ** s
+            layer = []
+            for j in range(blocks):
+                stride = 2 if (s > 0 and j == 0) else 1
+                layer.append(_Bottleneck(cin, width, stride))
+                cin = width * 4
+            setattr(self, f"layer{s + 1}", nn.Sequential(*layer))
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        y = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for s in range(4):
+            y = getattr(self, f"layer{s + 1}")(y)
+        y = self.avgpool(y).flatten(1)
+        return self.fc(y)
+
+
+def test_resnet50_onnx_matches_torch_reference():
+    model_bytes, weights = build_resnet50(num_classes=10, seed=0)
+    assert len(model_bytes) > 80_000_000          # real 25M-param f32 graph
+
+    ref = _TorchResNet50(num_classes=10).eval()
+    missing, unexpected = ref.load_state_dict(
+        {k: torch.tensor(v) for k, v in weights.items()}, strict=False)
+    assert not unexpected
+    assert all("num_batches_tracked" in m for m in missing)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 64, 64)).astype(np.float32)
+    with torch.no_grad():
+        expected = ref(torch.tensor(x)).numpy()
+
+    m = (ONNXModel(model_bytes)
+         .set_feed_dict({"data": "image"})
+         .set_fetch_dict({"logits": "logits"})
+         .set_mini_batch_size(2))
+    out = m.transform(Dataset({"image": list(x)}))
+    got = np.stack(list(out["logits"]))
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_resnet50_image_featurizer_headless():
+    """ImageFeaturizer-style headless embeddings via slice_at_output
+    (ImageFeaturizer.scala:34-270: drop the classifier, emit pooled
+    features)."""
+    model_bytes, _ = build_resnet50(num_classes=10, seed=1)
+    m = ONNXModel(model_bytes).set_feed_dict({"data": "image"})
+    # find the flatten output feeding the final Gemm (the 2048-d features)
+    g = m._graph()
+    gemm = [n for n in g.nodes if n.op_type == "Gemm"][-1]
+    feat_name = gemm.inputs[0]
+    sliced = m.slice_at_output(feat_name)
+    sliced.set_fetch_dict({"features": feat_name}).set_mini_batch_size(2)
+    x = np.random.default_rng(2).normal(size=(2, 3, 64, 64)).astype(np.float32)
+    out = sliced.transform(Dataset({"image": list(x)}))
+    feats = np.stack(list(out["features"]))
+    assert feats.shape == (2, 2048)
+    assert np.isfinite(feats).all()
