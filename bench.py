@@ -160,11 +160,8 @@ def bench_gbdt_anchor(X, y):
 def bench_resnet50():
     """ResNet-50 ONNX batch inference img/s/chip (BASELINE config #2;
     reference path: ONNXModel.scala:242-251 over ONNX Runtime CUDA)."""
-    from synapseml_tpu import Dataset
-    from synapseml_tpu.models.onnx import ONNXModel
     from synapseml_tpu.models.onnx.zoo import build_resnet50
 
-    import jax
     import jax.numpy as jnp
 
     from synapseml_tpu.models.onnx.runner import compile_onnx

@@ -1,0 +1,192 @@
+"""Chunked, bounded-memory columnar source over SMLC column stores.
+
+The reference never materializes a partition's rows: micro-batches stream
+into a shared native dataset (reference: lightgbm/.../StreamingPartitionTask.
+scala:101-422 — LGBM_DatasetCreateFromSampledColumn + per-batch
+PushRowsWithMetadata), with per-partition row counts computed up front
+(ClusterUtil.getNumRowsPerPartition, core/utils/ClusterUtil.scala:46).
+This is the TPU-native equivalent: the on-disk SMLC column store (written
+by the native loader, ``native/loader.cpp``) is memory-mapped and read in
+row CHUNKS, so host memory stays O(chunk) while the consumer (GBDT
+streaming train, DL minibatch iterators) assembles device-side state
+incrementally.  ``shard(i, n)`` restricts a source to host ``i``'s
+contiguous row range — the partition→host placement table for multi-host
+input pipelines.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HEADER_BYTES = 4 + 4 + 8 + 8       # magic, version, rows, cols
+
+
+def _open_colstore(path: str) -> Tuple[np.memmap, int, int]:
+    with open(path, "rb") as f:
+        if f.read(4) != b"SMLC":
+            raise IOError(f"{path}: not an SMLC column store")
+        np.frombuffer(f.read(4), np.uint32)          # version
+        rows = int(np.frombuffer(f.read(8), np.int64)[0])
+        cols = int(np.frombuffer(f.read(8), np.int64)[0])
+    mm = np.memmap(path, np.float32, mode="r", offset=_HEADER_BYTES,
+                   shape=(cols, rows))
+    return mm, rows, cols
+
+
+class ChunkedColumnSource:
+    """Row-chunk iteration over an SMLC file with optional label/weight
+    columns split out of the feature matrix.
+
+    ``feature_cols``/``label_col``/``weight_col`` are column indices into
+    the stored matrix; by default every column is a feature.  The memmap
+    is the only handle on the data — a chunk read touches each feature
+    column's contiguous slice, so resident memory is O(chunk_rows · F).
+    """
+
+    def __init__(self, path: str,
+                 feature_cols: Optional[Sequence[int]] = None,
+                 label_col: Optional[int] = None,
+                 weight_col: Optional[int] = None,
+                 chunk_rows: int = 65_536,
+                 row_range: Optional[Tuple[int, int]] = None):
+        self.path = path
+        self._mm, total_rows, total_cols = _open_colstore(path)
+        if feature_cols is None:
+            excluded = {c for c in (label_col, weight_col) if c is not None}
+            feature_cols = [c for c in range(total_cols) if c not in excluded]
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self.weight_col = weight_col
+        self.chunk_rows = int(chunk_rows)
+        lo, hi = row_range if row_range is not None else (0, total_rows)
+        if not 0 <= lo <= hi <= total_rows:
+            raise ValueError(f"row_range {row_range} outside [0, {total_rows}]")
+        self._lo, self._hi = lo, hi
+
+    # -- shape -------------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self._hi - self._lo
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_cols)
+
+    # -- placement (partition→host map analogue) ---------------------------
+    def shard(self, index: int, count: int) -> "ChunkedColumnSource":
+        """Host ``index``'s contiguous row range out of ``count`` hosts
+        (deterministic balanced split: first ``rows % count`` shards carry
+        one extra row — the same rule every host computes locally, no
+        rendezvous required)."""
+        if not 0 <= index < count:
+            raise ValueError(f"shard index {index} outside [0, {count})")
+        n = self.num_rows
+        base, extra = divmod(n, count)
+        lo = self._lo + index * base + min(index, extra)
+        hi = lo + base + (1 if index < extra else 0)
+        return ChunkedColumnSource(
+            self.path, self.feature_cols, self.label_col, self.weight_col,
+            self.chunk_rows, row_range=(lo, hi))
+
+    # -- reads -------------------------------------------------------------
+    def _rows(self, lo: int, hi: int) -> np.ndarray:
+        out = np.empty((hi - lo, len(self.feature_cols)), np.float32)
+        for j, c in enumerate(self.feature_cols):
+            out[:, j] = self._mm[c, lo:hi]
+        return out
+
+    def _read_chunk(self, lo: int, hi: int) -> Tuple[np.ndarray,
+                                                     Optional[np.ndarray],
+                                                     Optional[np.ndarray]]:
+        y = (np.asarray(self._mm[self.label_col, lo:hi], np.float32)
+             if self.label_col is not None else None)
+        w = (np.asarray(self._mm[self.weight_col, lo:hi], np.float32)
+             if self.weight_col is not None else None)
+        return self._rows(lo, hi), y, w
+
+    def iter_chunks(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray],
+                                            Optional[np.ndarray]]]:
+        """Yield (X_chunk, y_chunk | None, w_chunk | None) row chunks."""
+        for lo in range(self._lo, self._hi, self.chunk_rows):
+            yield self._read_chunk(lo, min(lo + self.chunk_rows, self._hi))
+
+    def read_labels(self) -> Optional[np.ndarray]:
+        if self.label_col is None:
+            return None
+        return np.asarray(self._mm[self.label_col, self._lo:self._hi],
+                          np.float32)
+
+    def read_weights(self) -> Optional[np.ndarray]:
+        if self.weight_col is None:
+            return None
+        return np.asarray(self._mm[self.weight_col, self._lo:self._hi],
+                          np.float32)
+
+    def sample_rows(self, k: int, seed: int = 0) -> np.ndarray:
+        """Uniform row sample (same draw as fit_bin_mapper's in-memory
+        sampling, so streamed and in-memory training bin identically)."""
+        n = self.num_rows
+        if n <= k:
+            return self._rows(self._lo, self._hi)
+        rng = np.random.default_rng(seed)
+        idx = np.sort(rng.choice(n, k, replace=False)) + self._lo
+        out = np.empty((k, len(self.feature_cols)), np.float32)
+        for j, c in enumerate(self.feature_cols):
+            out[:, j] = self._mm[c][idx]
+        return out
+
+    def iter_batches(self, batch_size: int,
+                     rng: Optional[np.random.Generator] = None,
+                     ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray],
+                                         Optional[np.ndarray]]]:
+        """Fixed-size minibatches for DL training loops.  With ``rng``,
+        chunk ORDER and intra-chunk rows are shuffled (bounded-memory
+        approximate shuffle: exact within a chunk, chunk-granular across
+        the file — the streaming-shuffle tradeoff every out-of-core loader
+        makes); the tail partial batch is dropped.
+        """
+        starts = list(range(self._lo, self._hi, self.chunk_rows))
+        if rng is not None:
+            rng.shuffle(starts)
+        leftovers: Optional[Tuple[np.ndarray, ...]] = None
+        for lo in starts:
+            X, y, w = self._read_chunk(lo, min(lo + self.chunk_rows,
+                                               self._hi))
+            if rng is not None:
+                perm = rng.permutation(len(X))
+                X = X[perm]
+                y = y[perm] if y is not None else None
+                w = w[perm] if w is not None else None
+            if leftovers is not None:
+                X = np.concatenate([leftovers[0], X])
+                y = (np.concatenate([leftovers[1], y])
+                     if y is not None else None)
+                w = (np.concatenate([leftovers[2], w])
+                     if w is not None else None)
+            full = (len(X) // batch_size) * batch_size
+            for s in range(0, full, batch_size):
+                yield (X[s:s + batch_size],
+                       y[s:s + batch_size] if y is not None else None,
+                       w[s:s + batch_size] if w is not None else None)
+            leftovers = (X[full:], y[full:] if y is not None else None,
+                         w[full:] if w is not None else None)
+
+
+def write_matrix(path: str, matrix: np.ndarray) -> None:
+    """Write a float32 matrix as an SMLC column store (native fast path
+    when the toolchain is available)."""
+    from ..native import write_colstore
+    write_colstore(path, np.asarray(matrix, np.float32))
+
+
+def csv_to_colstore(csv_path: str, out_path: str,
+                    delim: str = ",") -> Tuple[int, list]:
+    """Parse a CSV with the native multithreaded loader and persist it as
+    an SMLC column store; returns (rows, column_names)."""
+    from ..native import read_csv_matrix, write_colstore
+    mat, names = read_csv_matrix(csv_path, delim)
+    write_colstore(out_path, mat)
+    return mat.shape[0], names

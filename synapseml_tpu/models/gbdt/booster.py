@@ -502,12 +502,31 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
     When ``mesh`` is given, rows are sharded over its ``data`` axis and each
     iteration's histograms ride one psum — the entire distributed story.
+
+    ``X`` may be a numpy matrix OR a chunked source (anything with
+    ``num_rows``/``num_features``/``iter_chunks``/``sample_rows`` — e.g.
+    :class:`~synapseml_tpu.io.colstore.ChunkedColumnSource`): then features
+    stream from disk in micro-batches into the device-resident binned
+    matrix and host memory stays O(chunk) — the StreamingPartitionTask
+    ingestion model (StreamingPartitionTask.scala:101-422).  With a source
+    carrying a label column, ``y=None`` reads labels from it.
     """
     import time as _time
     measures = InstrumentationMeasures()
     _t0 = _time.perf_counter()
-    X = np.ascontiguousarray(X, np.float32)
-    n, F = X.shape
+    source = X if hasattr(X, "iter_chunks") else None
+    if source is not None:
+        n, F = source.num_rows, source.num_features
+        if y is None:
+            y = source.read_labels()
+            if y is None:
+                raise ValueError("streaming train with y=None needs the "
+                                 "source to carry a label_col")
+        if sample_weight is None:
+            sample_weight = source.read_weights()
+    else:
+        X = np.ascontiguousarray(X, np.float32)
+        n, F = X.shape
     K = config.num_class if config.objective in ("multiclass", "multiclassova") else 1
     feature_names = list(feature_names) if feature_names else [f"f{i}" for i in range(F)]
     rng = np.random.default_rng(config.seed)
@@ -518,6 +537,11 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # in bin 1 and the new trees would be stumps
     if init_model is not None and not _placeholder_mapper(init_model.bin_mapper):
         mapper = init_model.bin_mapper
+    elif source is not None:
+        mapper = fit_bin_mapper(
+            source.sample_rows(config.bin_sample_count, config.seed),
+            config.max_bin, sample_count=config.bin_sample_count,
+            seed=config.seed)
     else:
         mapper = fit_bin_mapper(X, config.max_bin,
                                 sample_count=config.bin_sample_count,
@@ -545,7 +569,12 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
     # -- init score (boost_from_average) -----------------------------------
     if init_model is not None:
-        base_margin = init_model.predict_margin(X)
+        if source is not None:
+            base_margin = np.concatenate(
+                [init_model.predict_margin(cx)
+                 for cx, _, _ in source.iter_chunks()])
+        else:
+            base_margin = init_model.predict_margin(X)
         init_sc = init_model.init_score
     elif (config.boost_from_average
           and config.objective not in ("multiclass", "multiclassova")):
@@ -592,23 +621,46 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # the upload, not the searchsorted, is the fixed cost that bounds short
     # training runs
     _t_bin2 = _time.perf_counter()
-    if mapper.max_bin <= 255:
-        from ...native import bin_columns_u8
-        binned_small = bin_columns_u8(X, mapper.upper_bounds, mapper.max_bin)
+
+    def bin_host(mat):
+        if mapper.max_bin <= 255:
+            from ...native import bin_columns_u8
+            return bin_columns_u8(mat, mapper.upper_bounds, mapper.max_bin)
+        return mapper.transform(mat).astype(np.uint16)
+
+    if source is not None:
+        # micro-batch push (StreamingPartitionTask analogue): each chunk is
+        # binned and shipped independently; the full matrix exists only on
+        # DEVICE, assembled by one concatenate — host peak stays O(chunk)
+        dev_chunks = [put(bin_host(cx), 2)
+                      for cx, _, _ in source.iter_chunks()]
+        if pad:
+            dev_chunks.append(put(
+                np.zeros((pad, F),
+                         np.uint8 if mapper.max_bin <= 255 else np.uint16), 2))
+        if mesh is None:
+            bins_t = jax.jit(lambda *cs: jnp.concatenate(cs)
+                             .astype(jnp.int32).T)(*dev_chunks)
+        else:
+            bins_t = jax.jit(
+                lambda *cs: jax.lax.with_sharding_constraint(
+                    jnp.concatenate(cs).astype(jnp.int32).T,
+                    NamedSharding(mesh, P(None, DATA_AXIS))))(*dev_chunks)
+        del dev_chunks
     else:
-        binned_small = mapper.transform(X).astype(np.uint16)
-    if pad:
-        binned_small = np.concatenate(
-            [binned_small, np.zeros((pad, F), binned_small.dtype)])
-    b_dev = put(binned_small, 2)
-    if mesh is None:
-        bins_t = jax.jit(lambda b: b.astype(jnp.int32).T)(b_dev)
-    else:
-        bins_t = jax.jit(
-            lambda b: jax.lax.with_sharding_constraint(
-                b.astype(jnp.int32).T,
-                NamedSharding(mesh, P(None, DATA_AXIS))))(b_dev)
-    del b_dev
+        binned_small = bin_host(X)
+        if pad:
+            binned_small = np.concatenate(
+                [binned_small, np.zeros((pad, F), binned_small.dtype)])
+        b_dev = put(binned_small, 2)
+        if mesh is None:
+            bins_t = jax.jit(lambda b: b.astype(jnp.int32).T)(b_dev)
+        else:
+            bins_t = jax.jit(
+                lambda b: jax.lax.with_sharding_constraint(
+                    b.astype(jnp.int32).T,
+                    NamedSharding(mesh, P(None, DATA_AXIS))))(b_dev)
+        del b_dev
     measures.binning_s += _time.perf_counter() - _t_bin2
     labels = put(labels_np, 1)
     if sample_weight is None and not w_scaled:

@@ -98,9 +98,7 @@ def build_resnet50(num_classes: int = 1000, seed: int = 0,
     y = b.node("MaxPool", [y], kernel_shape=[3, 3], strides=[2, 2],
                pads=[1, 1, 1, 1])
 
-    cin = 64
     for s, blocks in enumerate(RESNET50_STAGES):
-        width = 64 * 2 ** s
         for j in range(blocks):
             p = f"layer{s + 1}.{j}"
             stride = 2 if (s > 0 and j == 0) else 1
@@ -115,7 +113,6 @@ def build_resnet50(num_classes: int = 1000, seed: int = 0,
             else:
                 shortcut = y
             y = b.node("Relu", [b.node("Add", [h, shortcut])])
-            cin = width * 4
 
     y = b.node("GlobalAveragePool", [y])
     y = b.node("Flatten", [y], axis=1)

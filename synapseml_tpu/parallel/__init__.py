@@ -1,6 +1,7 @@
 from .collectives import (all_gather, allreduce_fn, axis_index, barrier,
-                          pmax, pmean, pmin, ppermute, psum, reduce_scatter,
-                          ring_shift, shard_map_over)
+                          hierarchical_psum, pmax, pmean, pmin, ppermute,
+                          psum, reduce_scatter, ring_allreduce, ring_shift,
+                          shard_map_over, tree_psum_bucketed)
 from .distributed import ClusterConfig, initialize_cluster, shutdown_cluster
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    batch_sharding, data_parallel_mesh, dp_ep_mesh, dp_sp_tp_mesh,

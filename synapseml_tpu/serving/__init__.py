@@ -1,6 +1,7 @@
 """Model serving (reference: core Spark Serving layer)."""
 
-from .server import PipelineServer, ServingReply, ServingRequest, ServingServer
+from .server import (ApiHandle, MultiPipelineServer, PipelineServer,
+                     ServingReply, ServingRequest, ServingServer)
 
-__all__ = ["PipelineServer", "ServingReply", "ServingRequest",
-           "ServingServer"]
+__all__ = ["ApiHandle", "MultiPipelineServer", "PipelineServer",
+           "ServingReply", "ServingRequest", "ServingServer"]

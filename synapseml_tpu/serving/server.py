@@ -4,12 +4,19 @@ Re-designs Spark Serving (reference: core/src/main/scala/org/apache/spark/
 sql/execution/streaming/HTTPSourceV2.scala:56-90 — an HttpServer hosted in
 a partition task turning requests into rows {id, request}; ServingUDFs.
 scala:40-53 — ``sendReplyUDF`` routing response bytes back to the open
-exchange by request id; DistributedHTTPSource.scala:88,203 — one server
-per JVM).  Here the source/sink pair is explicit: :class:`ServingServer`
-accepts requests into a micro-batch queue and parks each exchange on an
-event until :meth:`reply` lands; :class:`PipelineServer` is the
-continuous-serving loop — batch → ``model.transform`` → reply — so the
-jitted model sees fixed-size batches instead of per-request calls.
+exchange by request id; DistributedHTTPSource.scala:88,203 — ONE server per
+JVM hosting MULTIPLE named APIs).  Here the source/sink pair is explicit:
+
+- :class:`ServingServer` hosts any number of registered APIs on one
+  listener; each API owns a bounded micro-batch queue (backpressure: a
+  full queue answers 503 immediately instead of parking the exchange) and
+  a pending-exchange map keyed by request id.
+- :class:`PipelineServer` is the continuous-serving loop for one API —
+  batch → ``model.transform`` → reply — so the jitted model sees
+  fixed-size batches instead of per-request calls.
+- :class:`MultiPipelineServer` runs several named pipelines on one
+  server, one serving loop per API (the multi-API routing of
+  HTTPSourceV2's ServiceInfo registry).
 """
 
 from __future__ import annotations
@@ -19,7 +26,7 @@ import threading
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from queue import Empty, Queue
+from queue import Empty, Full, Queue
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -58,67 +65,40 @@ class _Exchange:
         self.reply: Optional[ServingReply] = None
 
 
-class ServingServer:
-    """HTTP source + reply sink (one server per host — the
-    DistributedHTTPSource model; multi-host serving runs one per TPU-VM
-    worker behind an external balancer)."""
+class ApiHandle:
+    """One named API's source/sink pair: bounded request queue + pending
+    exchanges.  ``get_batch``/``reply`` mirror HTTPSourceV2 getBatch and
+    ServingUDFs.sendReplyUDF for this API only."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 api_path: str = "/", reply_timeout_s: float = 30.0):
-        self.api_path = api_path.rstrip("/") or "/"
+    def __init__(self, path: str, max_queue: int = 1024,
+                 reply_timeout_s: float = 30.0):
+        self.path = path
         self.reply_timeout_s = reply_timeout_s
-        self._queue: "Queue[_Exchange]" = Queue()
+        self._queue: "Queue[_Exchange]" = Queue(maxsize=max_queue)
         self._pending: Dict[str, _Exchange] = {}
         self._lock = threading.Lock()
-        outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
+    # -- server side -------------------------------------------------------
+    def submit(self, req: ServingRequest) -> Optional[_Exchange]:
+        """Enqueue; None ⇒ queue saturated (caller answers 503).
 
-            def _serve(self):
-                if outer.api_path != "/" and \
-                        not self.path.startswith(outer.api_path):
-                    self.send_error(404)
-                    return
-                length = int(self.headers.get("Content-Length", 0) or 0)
-                body = self.rfile.read(length) if length else b""
-                req = ServingRequest(
-                    id=uuid.uuid4().hex, method=self.command,
-                    path=self.path, headers=dict(self.headers), body=body)
-                ex = _Exchange(req)
-                with outer._lock:
-                    outer._pending[req.id] = ex
-                outer._queue.put(ex)
-                ok = ex.event.wait(outer.reply_timeout_s)
-                with outer._lock:
-                    outer._pending.pop(req.id, None)
-                if not ok or ex.reply is None:
-                    self.send_error(504, "serving pipeline timeout")
-                    return
-                rep = ex.reply
-                self.send_response(rep.status)
-                for k, v in rep.headers.items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(rep.body)))
-                self.end_headers()
-                self.wfile.write(rep.body)
+        Registered in ``_pending`` BEFORE the queue put: a fast pipeline
+        can drain + reply the instant the exchange is visible, and a reply
+        must find the registration or it would be silently dropped."""
+        ex = _Exchange(req)
+        with self._lock:
+            self._pending[req.id] = ex
+        try:
+            self._queue.put_nowait(ex)
+        except Full:
+            with self._lock:
+                self._pending.pop(req.id, None)
+            return None
+        return ex
 
-            do_GET = do_POST = do_PUT = _serve
-
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-
-    @property
-    def address(self) -> Tuple[str, int]:
-        return self._httpd.server_address[:2]
-
-    @property
-    def url(self) -> str:
-        h, p = self.address
-        return f"http://{h}:{p}{'' if self.api_path == '/' else self.api_path}"
+    def forget(self, request_id: str) -> None:
+        with self._lock:
+            self._pending.pop(request_id, None)
 
     # -- source side (micro-batch pull; HTTPSourceV2 getBatch analogue) ----
     def get_batch(self, max_rows: int = 64,
@@ -149,56 +129,148 @@ class ServingServer:
         ex.event.set()
         return True
 
+
+class ServingServer:
+    """One HTTP listener per host hosting any number of named APIs (the
+    DistributedHTTPSource model — one server per JVM, many sources;
+    multi-host serving runs one per TPU-VM worker behind an external
+    balancer).  The single-API constructor arguments keep the original
+    one-endpoint usage working unchanged."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout_s: float = 30.0,
+                 max_queue: int = 1024):
+        self.api_path = api_path.rstrip("/") or "/"
+        self._apis: Dict[str, ApiHandle] = {}
+        self._apis_lock = threading.Lock()
+        self._default = self.register_api(self.api_path, max_queue,
+                                          reply_timeout_s)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _serve(self):
+                api = outer._route(self.path)
+                if api is None:
+                    self.send_error(404, "no API registered at this path")
+                    return
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                req = ServingRequest(
+                    id=uuid.uuid4().hex, method=self.command,
+                    path=self.path, headers=dict(self.headers), body=body)
+                ex = api.submit(req)
+                if ex is None:                       # backpressure
+                    self.send_error(503, "serving queue saturated")
+                    return
+                ok = ex.event.wait(api.reply_timeout_s)
+                api.forget(req.id)
+                if not ok or ex.reply is None:
+                    self.send_error(504, "serving pipeline timeout")
+                    return
+                rep = ex.reply
+                self.send_response(rep.status)
+                for k, v in rep.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(rep.body)))
+                self.end_headers()
+                self.wfile.write(rep.body)
+
+            do_GET = do_POST = do_PUT = _serve
+
+        class _Server(ThreadingHTTPServer):
+            # default listen backlog (5) RSTs bursts of concurrent connects
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._httpd = _Server((host, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- API registry (HTTPSourceV2 ServiceInfo analogue) ------------------
+    def register_api(self, path: str, max_queue: int = 1024,
+                     reply_timeout_s: float = 30.0) -> ApiHandle:
+        path = path.rstrip("/") or "/"
+        with self._apis_lock:
+            if path in self._apis:
+                return self._apis[path]
+            handle = ApiHandle(path, max_queue, reply_timeout_s)
+            self._apis[path] = handle
+            return handle
+
+    def _route(self, request_path: str) -> Optional[ApiHandle]:
+        """Longest registered prefix wins ("/a/b" before "/a")."""
+        with self._apis_lock:
+            best = None
+            for path, handle in self._apis.items():
+                if path == "/" or request_path == path \
+                        or request_path.startswith(path + "/") \
+                        or request_path.startswith(path + "?"):
+                    if best is None or len(path) > len(best.path):
+                        best = handle
+            return best
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        h, p = self.address
+        return f"http://{h}:{p}{'' if self.api_path == '/' else self.api_path}"
+
+    def url_for(self, path: str) -> str:
+        h, p = self.address
+        path = path.rstrip("/") or "/"
+        return f"http://{h}:{p}{'' if path == '/' else path}"
+
+    # -- default-API passthrough (original one-endpoint surface) -----------
+    def get_batch(self, max_rows: int = 64,
+                  timeout_s: float = 0.05) -> List[ServingRequest]:
+        return self._default.get_batch(max_rows, timeout_s)
+
+    def reply(self, request_id: str, reply: ServingReply) -> bool:
+        # request ids are unique across APIs; try the owning handle first
+        if self._default.reply(request_id, reply):
+            return True
+        with self._apis_lock:
+            handles = list(self._apis.values())
+        return any(h.reply(request_id, reply) for h in handles
+                   if h is not self._default)
+
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
 
 
-class PipelineServer:
-    """Continuous serving loop: requests → Dataset → ``model.transform`` →
-    replies (the ``readStream.continuousServer()`` pipeline of reference
-    §3.5 collapsed into one object).
+class _ApiLoop:
+    """One API's continuous loop: batch → transform → reply."""
 
-    ``input_parser(request) -> dict`` produces one row; the transformed
-    column ``output_col`` is JSON-encoded back (override with
-    ``output_formatter``).
-    """
-
-    def __init__(self, model: Transformer,
+    def __init__(self, server: ServingServer, api: ApiHandle,
+                 model: Transformer,
                  input_parser: Callable[[ServingRequest], Dict[str, Any]],
-                 output_col: str = "prediction",
-                 output_formatter: Optional[Callable[[Any], bytes]] = None,
-                 host: str = "127.0.0.1", port: int = 0,
-                 api_path: str = "/", batch_size: int = 64,
-                 batch_timeout_s: float = 0.01):
+                 output_col: str,
+                 output_formatter: Callable[[Any], bytes],
+                 batch_size: int, batch_timeout_s: float):
+        self.server = server
+        self.api = api
         self.model = model
         self.input_parser = input_parser
         self.output_col = output_col
-        self.output_formatter = output_formatter or self._default_format
+        self.output_formatter = output_formatter
         self.batch_size = batch_size
         self.batch_timeout_s = batch_timeout_s
-        self.server = ServingServer(host, port, api_path)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    @staticmethod
-    def _default_format(value: Any) -> bytes:
-        if isinstance(value, np.ndarray):
-            value = value.tolist()
-        elif isinstance(value, (np.generic,)):
-            value = value.item()
-        return json.dumps({"prediction": value}).encode()
-
-    @property
-    def url(self) -> str:
-        return self.server.url
-
     def _loop(self) -> None:
         while not self._stop.is_set():
-            batch = self.server.get_batch(self.batch_size,
-                                          self.batch_timeout_s)
+            batch = self.api.get_batch(self.batch_size, self.batch_timeout_s)
             if not batch:
                 continue
             try:
@@ -207,15 +279,92 @@ class PipelineServer:
                 out = self.model.transform(ds)
                 col = out[self.output_col]
                 for req, val in zip(batch, col):
-                    self.server.reply(req.id, ServingReply(
+                    self.api.reply(req.id, ServingReply(
                         200, self.output_formatter(val),
                         {"Content-Type": "application/json"}))
             except Exception as e:  # noqa: BLE001 — serving must not die
                 body = json.dumps({"error": str(e)}).encode()
                 for req in batch:
-                    self.server.reply(req.id, ServingReply(500, body))
+                    self.api.reply(req.id, ServingReply(500, body))
 
-    def close(self) -> None:
+    def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+
+
+def _default_format(value: Any) -> bytes:
+    if isinstance(value, np.ndarray):
+        value = value.tolist()
+    elif isinstance(value, (np.generic,)):
+        value = value.item()
+    return json.dumps({"prediction": value}).encode()
+
+
+class PipelineServer:
+    """Continuous serving loop for ONE model: requests → Dataset →
+    ``model.transform`` → replies (the ``readStream.continuousServer()``
+    pipeline of reference §3.5 collapsed into one object)."""
+
+    def __init__(self, model: Transformer,
+                 input_parser: Callable[[ServingRequest], Dict[str, Any]],
+                 output_col: str = "prediction",
+                 output_formatter: Optional[Callable[[Any], bytes]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", batch_size: int = 64,
+                 batch_timeout_s: float = 0.01, max_queue: int = 1024):
+        self.model = model
+        self.server = ServingServer(host, port, api_path,
+                                    max_queue=max_queue)
+        self._loop = _ApiLoop(self.server, self.server._default, model,
+                              input_parser, output_col,
+                              output_formatter or _default_format,
+                              batch_size, batch_timeout_s)
+
+    _default_format = staticmethod(_default_format)
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def close(self) -> None:
+        self._loop.stop()
+        self.server.close()
+
+
+class MultiPipelineServer:
+    """Several named pipelines on ONE server — request paths route to the
+    API whose pipeline should serve them (reference: multiple named APIs
+    with per-executor shared servers, HTTPSourceV2.scala:47-90,
+    DistributedHTTPSource.scala:203).
+
+    ``apis``: {path: spec} where spec is a dict with keys ``model``,
+    ``input_parser`` and optional ``output_col``/``output_formatter``/
+    ``batch_size``/``batch_timeout_s``/``max_queue``.
+    """
+
+    def __init__(self, apis: Dict[str, Dict[str, Any]],
+                 host: str = "127.0.0.1", port: int = 0):
+        if not apis:
+            raise ValueError("MultiPipelineServer needs at least one API")
+        first = next(iter(apis))
+        self.server = ServingServer(
+            host, port, api_path=first,
+            max_queue=int(apis[first].get("max_queue", 1024)))
+        self._loops: List[_ApiLoop] = []
+        for path, spec in apis.items():
+            handle = self.server.register_api(
+                path, max_queue=int(spec.get("max_queue", 1024)))
+            self._loops.append(_ApiLoop(
+                self.server, handle, spec["model"], spec["input_parser"],
+                spec.get("output_col", "prediction"),
+                spec.get("output_formatter") or _default_format,
+                int(spec.get("batch_size", 64)),
+                float(spec.get("batch_timeout_s", 0.01))))
+
+    def url_for(self, path: str) -> str:
+        return self.server.url_for(path)
+
+    def close(self) -> None:
+        for loop in self._loops:
+            loop.stop()
         self.server.close()

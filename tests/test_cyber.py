@@ -168,3 +168,18 @@ class TestComplementFuzzing(TransformerFuzzing):
         return [TestObject(ComplementAccessTransformer(
             partitionKey="tenant", indexedColNamesArr=["ui", "ri"],
             complementsetFactor=2, seed=1), ds)]
+
+
+def test_separate_tenants_flag_identical_scores():
+    """separateTenants True/False must score identically: the docstring's
+    block-separability argument (tenants never couple in the normal
+    equations), pinned by an actual run instead of argued (round-1 advisor
+    item)."""
+    ds = _access_dataset(seed=3)
+    kw = dict(tenantCol="tenant", userCol="user", resCol="res",
+              likelihoodCol="likelihood", rankParam=4, maxIter=4, seed=7)
+    m_joint = AccessAnomaly(separateTenants=False, **kw).fit(ds)
+    m_sep = AccessAnomaly(separateTenants=True, **kw).fit(ds)
+    s_joint = np.asarray(m_joint.transform(ds)["anomaly_score"], np.float64)
+    s_sep = np.asarray(m_sep.transform(ds)["anomaly_score"], np.float64)
+    np.testing.assert_allclose(s_joint, s_sep, rtol=1e-5, atol=1e-5)
