@@ -64,6 +64,7 @@ SLOW_TESTS = {
     "test_null_effect_not_significant",
     "test_recovers_known_ate",
     "test_heterogeneous_effects_ordered",
+    "test_recovers_group_effect_magnitudes",
     "test_random_search_improves",
     "test_unreferenced_model_gets_default_trial",
     "test_grid_search_all_trials",

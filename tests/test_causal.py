@@ -105,3 +105,30 @@ class TestDoubleMLFuzzing(EstimatorFuzzing):
             outcomeModel=OnlineSGDRegressor(numPasses=2),
             treatmentCol="treatment", outcomeCol="outcome", maxIter=1)
         return [TestObject(est, ds)]
+
+
+class TestOrthoForestRecovery:
+    def test_recovers_group_effect_magnitudes(self, rng):
+        """Quantitative CATE recovery: per-group mean predicted effect
+        within tolerance of the true group effects (reference behavior:
+        OrthoForestDMLEstimator.scala heterogeneous-effect output)."""
+        ds = _causal_data(rng, n=2400, effect=1.5, heterogeneous=True)
+        est = OrthoForestDMLEstimator(
+            treatmentModel=_nuisance(), outcomeModel=_nuisance(),
+            treatmentCol="treatment", outcomeCol="outcome", seed=5)
+        eff = est.fit(ds).transform(ds)["treatmentEffect"]
+        x1 = np.stack([np.asarray(v) for v in ds["features"]])[:, 1]
+        hi, lo = eff[x1 > 0].mean(), eff[x1 <= 0].mean()
+        assert abs(hi - 3.0) < 1.0, hi          # true effect 3.0 for x1>0
+        assert abs(lo - 1.5) < 1.0, lo          # true effect 1.5 otherwise
+
+
+class TestOrthoForestFuzzing(EstimatorFuzzing):
+    def fuzzing_objects(self):
+        rng = np.random.default_rng(6)
+        ds = _causal_data(rng, n=150)
+        est = OrthoForestDMLEstimator(
+            treatmentModel=OnlineSGDRegressor(numPasses=2),
+            outcomeModel=OnlineSGDRegressor(numPasses=2),
+            treatmentCol="treatment", outcomeCol="outcome", seed=1)
+        return [TestObject(est, ds)]
