@@ -36,8 +36,9 @@ from . import metrics as metrics_mod
 from .binning import BinMapper, fit_bin_mapper
 from .objectives import (get_objective, initial_score, softmax_grad_hess)
 from .trainer import (GrowthParams, Tree, default_n_slots, grow_tree,
-                      grow_tree_depthwise, max_nodes, predict_raw_features,
-                      stack_trees, tree_depth)
+                      grow_tree_depthwise, grow_tree_feature_parallel,
+                      max_nodes, predict_raw_features, stack_trees,
+                      tree_depth)
 
 
 @dataclasses.dataclass
@@ -79,7 +80,10 @@ class BoostingConfig:
     bin_sample_count: int = 200_000
     bagging_seed: int = 3
     verbosity: int = -1
-    parallelism: str = "data_parallel"     # data_parallel | voting_parallel
+    #: data_parallel (histogram psum) | voting_parallel (PV-Tree top-k
+    #: vote) | feature_parallel (vertical sharding: local histograms,
+    #: gathered best splits, owner-broadcast routing)
+    parallelism: str = "data_parallel"
     top_k: int = 20                        # voting-parallel votes per rank
     #: "depthwise": wave growth, all of a level's histograms in one batched
     #: device pass (fast path); "lossguide": strict best-first leaf-wise
@@ -336,7 +340,8 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                learning_rate: float, mesh: Optional[Mesh], use_goss: bool,
                top_rate: float, other_rate: float, ova: bool = False,
                use_pallas: bool = False, bagging_fraction: float = 1.0,
-               growth_policy: str = "depthwise"):
+               growth_policy: str = "depthwise",
+               feature_parallel: bool = False):
     """Build the jitted one-iteration step.
 
     step(binned, scores, labels, weights, (base_bag, bag_key),
@@ -354,7 +359,10 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
     int class ids and scores are (N, K).
     """
     axis = DATA_AXIS if mesh is not None else None
-    if growth_policy == "depthwise" and p.voting_k == 0:
+    if feature_parallel:
+        grower = functools.partial(grow_tree_feature_parallel,
+                                   n_slots=default_n_slots(p.num_leaves))
+    elif growth_policy == "depthwise" and p.voting_k == 0:
         grower = functools.partial(grow_tree_depthwise,
                                    n_slots=default_n_slots(p.num_leaves))
     else:
@@ -379,7 +387,9 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                  key, upper_bounds, num_bins):
         base_bag, bag_key = bag_in
         if bagging_fraction < 1.0:
-            if axis is not None:
+            # feature-parallel replicates rows: every rank must draw the
+            # SAME bag; data-parallel ranks each own distinct rows
+            if axis is not None and not feature_parallel:
                 bag_key = jax.random.fold_in(bag_key, lax.axis_index(axis))
             bag_mask = base_bag * (
                 jax.random.uniform(bag_key, base_bag.shape)
@@ -423,13 +433,22 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
         return jax.jit(one_step)
 
     ndim_scores = 1 if num_class == 1 else 2
-    in_specs = (P(None, DATA_AXIS),                       # bins_t (F, N)
-                P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None),
-                P(DATA_AXIS), P(DATA_AXIS),                # labels/weights
-                (P(DATA_AXIS), P()),                       # (base_bag, bag_key)
-                P(), P(), P(), P())                        # fmask/key/bounds/nbins
-    out_specs = (P(),                                      # trees replicated
-                 P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None))
+    if feature_parallel:
+        # vertical sharding: FEATURES split over the axis, rows replicated
+        in_specs = (P(DATA_AXIS, None),                    # bins_t (F, N)
+                    P(), P(), P(),                         # scores/labels/w
+                    (P(), P()),                            # (base_bag, key)
+                    P(DATA_AXIS), P(),                     # fmask/key
+                    P(DATA_AXIS, None), P(DATA_AXIS))      # bounds/nbins
+        out_specs = (P(), P())                             # all replicated
+    else:
+        in_specs = (P(None, DATA_AXIS),                    # bins_t (F, N)
+                    P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None),
+                    P(DATA_AXIS), P(DATA_AXIS),            # labels/weights
+                    (P(DATA_AXIS), P()),                   # (base_bag, bag_key)
+                    P(), P(), P(), P())                    # fmask/key/bounds/nbins
+        out_specs = (P(),                                  # trees replicated
+                     P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None))
     return jax.jit(jax.shard_map(one_step, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=False))
 
@@ -592,10 +611,25 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     use_pallas = (jax.default_backend() == "tpu"
                   and B_total <= 512 and B_total % 8 == 0)
     shards = mesh.shape[DATA_AXIS] if mesh is not None else 1
-    pad_unit = shards
+    featpar = config.parallelism == "feature_parallel" and mesh is not None
+    if featpar and config.boosting_type == "dart":
+        raise NotImplementedError(
+            "feature_parallel + dart: dart rescoring traverses binned "
+            "columns that are sharded across ranks; use data_parallel")
+    if featpar and config.growth_policy == "lossguide":
+        raise NotImplementedError(
+            "feature_parallel grows depth-level waves; strict lossguide "
+            "order is only available with data_parallel/voting_parallel")
+    # feature_parallel replicates ROWS and shards FEATURES: rows pad only
+    # for the pallas chunk, features pad to the rank count
+    row_shards = 1 if featpar else shards
+    pad_unit = row_shards
     if use_pallas:
         from .pallas_hist import hist_pad_multiple
-        pad_unit = shards * hist_pad_multiple()
+        pad_unit = row_shards * hist_pad_multiple()
+    Fp = F
+    if featpar:
+        Fp = F + (-F) % shards
     pad = (-n) % pad_unit
     if pad:
         labels_np = np.concatenate([labels_np, np.zeros(pad, labels_np.dtype)])
@@ -606,6 +640,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     def put(xx, ndim):
         if mesh is None:
             return jnp.asarray(xx)
+        if featpar:                       # rows replicated on every rank
+            return jax.device_put(xx, replicated(mesh))
         return jax.device_put(xx, batch_sharding(mesh, ndim))
 
     def dev_fill(fill, shape):
@@ -613,8 +649,9 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         (the link behind the driver tunnel runs ~20 MB/s)."""
         if mesh is None:
             return jnp.full(shape, fill, jnp.float32)
+        sh = replicated(mesh) if featpar else batch_sharding(mesh, len(shape))
         return jax.jit(lambda: jnp.full(shape, fill, jnp.float32),
-                       out_shardings=batch_sharding(mesh, len(shape)))()
+                       out_shardings=sh)()
 
     # host-bin to the narrowest integer type (native multithreaded search)
     # and upcast/transpose on device: ships 1-2 bytes/cell instead of 4 —
@@ -628,6 +665,35 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             return bin_columns_u8(mat, mapper.upper_bounds, mapper.max_bin)
         return mapper.transform(mat).astype(np.uint16)
 
+    if mesh is None:
+        bins_spec = None
+    elif featpar:
+        bins_spec = NamedSharding(mesh, P(DATA_AXIS, None))   # F sharded
+    else:
+        bins_spec = NamedSharding(mesh, P(None, DATA_AXIS))   # N sharded
+
+    def put_bins(mat):
+        """Upload a host (rows, F) small-int block.  Feature-parallel pads
+        the feature axis on HOST and ships each rank only its own feature
+        slice (P(None, data)) — replicating the full matrix would multiply
+        both link traffic and HBM by the rank count."""
+        if featpar:
+            if Fp != F:
+                mat = np.concatenate(
+                    [mat, np.zeros((len(mat), Fp - F), mat.dtype)], axis=1)
+            return jax.device_put(mat, NamedSharding(mesh, P(None, DATA_AXIS)))
+        return put(mat, 2)
+
+    def finish_bins(stacked_dev):
+        """(N, Fp) small-int device array → (Fp, N) int32 with the mode's
+        sharding (for feature-parallel the transpose is shard-local)."""
+        def fn(b):
+            out = b.astype(jnp.int32).T
+            if bins_spec is not None:
+                out = jax.lax.with_sharding_constraint(out, bins_spec)
+            return out
+        return jax.jit(fn)(stacked_dev)
+
     if source is not None:
         # micro-batch push (StreamingPartitionTask analogue): each chunk is
         # binned and shipped independently; the full matrix exists only on
@@ -638,29 +704,16 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             dev_chunks.append(put(
                 np.zeros((pad, F),
                          np.uint8 if mapper.max_bin <= 255 else np.uint16), 2))
-        if mesh is None:
-            bins_t = jax.jit(lambda *cs: jnp.concatenate(cs)
-                             .astype(jnp.int32).T)(*dev_chunks)
-        else:
-            bins_t = jax.jit(
-                lambda *cs: jax.lax.with_sharding_constraint(
-                    jnp.concatenate(cs).astype(jnp.int32).T,
-                    NamedSharding(mesh, P(None, DATA_AXIS))))(*dev_chunks)
+        bins_t = finish_bins(
+            jax.jit(lambda *cs: jnp.concatenate(cs))(*dev_chunks))             if len(dev_chunks) > 1 else finish_bins(dev_chunks[0])
         del dev_chunks
     else:
         binned_small = bin_host(X)
         if pad:
             binned_small = np.concatenate(
                 [binned_small, np.zeros((pad, F), binned_small.dtype)])
-        b_dev = put(binned_small, 2)
-        if mesh is None:
-            bins_t = jax.jit(lambda b: b.astype(jnp.int32).T)(b_dev)
-        else:
-            bins_t = jax.jit(
-                lambda b: jax.lax.with_sharding_constraint(
-                    b.astype(jnp.int32).T,
-                    NamedSharding(mesh, P(None, DATA_AXIS))))(b_dev)
-        del b_dev
+        bins_t = finish_bins(put_bins(binned_small))
+        del binned_small
     measures.binning_s += _time.perf_counter() - _t_bin2
     labels = put(labels_np, 1)
     if sample_weight is None and not w_scaled:
@@ -676,11 +729,21 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     else:
         scores = dev_fill(float(init_sc[0]), (N,) if K == 1 else (N, K))
     init_scores_dev = scores            # rf resets to this every iteration
-    upper_bounds = jnp.asarray(mapper.upper_bounds)
-    num_bins = jnp.asarray(mapper.num_bins)
+    ub_np = mapper.upper_bounds
+    nb_np = mapper.num_bins
+    if Fp != F:                         # padded features: 1 bin, never split
+        ub_np = np.concatenate(
+            [ub_np, np.full((Fp - F, ub_np.shape[1]), np.inf, np.float32)])
+        nb_np = np.concatenate([nb_np, np.ones(Fp - F, np.int32)])
+    upper_bounds = jnp.asarray(ub_np)
+    num_bins = jnp.asarray(nb_np)
     if mesh is not None:
-        upper_bounds = jax.device_put(upper_bounds, replicated(mesh))
-        num_bins = jax.device_put(num_bins, replicated(mesh))
+        fp_sh = (NamedSharding(mesh, P(DATA_AXIS, None)) if featpar
+                 else replicated(mesh))
+        fp_sh1 = (NamedSharding(mesh, P(DATA_AXIS)) if featpar
+                  else replicated(mesh))
+        upper_bounds = jax.device_put(upper_bounds, fp_sh)
+        num_bins = jax.device_put(num_bins, fp_sh1)
 
     # -- objective ---------------------------------------------------------
     obj_kwargs = {}
@@ -730,6 +793,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                       ova=(config.objective == "multiclassova"),
                       use_pallas=use_pallas,
                       growth_policy=config.growth_policy,
+                      feature_parallel=featpar,
                       bagging_fraction=(config.bagging_fraction
                                         if use_bagging else 1.0))
 
@@ -796,13 +860,17 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                                      it // max(config.bagging_freq, 1))
         if config.feature_fraction < 1.0:
             k = max(1, int(round(F * config.feature_fraction)))
-            feature_mask = np.zeros(F, bool)
+            feature_mask = np.zeros(Fp, bool)      # padded features stay off
             feature_mask[rng.choice(F, k, replace=False)] = True
             fmask_dev = None
         elif fmask_dev is None:
-            feature_mask = np.ones(F, bool)
+            feature_mask = np.zeros(Fp, bool)
+            feature_mask[:F] = True
         if fmask_dev is None:
             fmask_dev = jnp.asarray(feature_mask)
+            if featpar:
+                fmask_dev = jax.device_put(
+                    fmask_dev, NamedSharding(mesh, P(DATA_AXIS)))
 
         # dart: drop trees, rebase scores
         dropped: List[int] = []

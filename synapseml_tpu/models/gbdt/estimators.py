@@ -75,9 +75,11 @@ class GBDTParams(Params):
     numShards = IntParam(
         doc="data-parallel shards over the device mesh; 0 = all local "
             "devices (partition→chip placement)", default=0)
-    parallelism = StringParam(doc="data_parallel|voting_parallel",
-                              default="data_parallel",
-                              allowed=("data_parallel", "voting_parallel"))
+    parallelism = StringParam(
+        doc="data_parallel|voting_parallel|feature_parallel (the reference's "
+            "tree_learner values, params/LightGBMParams.scala:24-26)",
+        default="data_parallel",
+        allowed=("data_parallel", "voting_parallel", "feature_parallel"))
     topK = IntParam(doc="voting-parallel top features per shard", default=20)
     passThroughArgs = DictParam(doc="extra engine params (ParamsStringBuilder "
                                     "pass-through analogue)")

@@ -601,6 +601,231 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     return tree, state["node_id"]
 
 
+# -- feature-parallel growth -------------------------------------------------
+#
+# LightGBM's ``tree_learner=feature_parallel`` (vertical partitioning; the
+# reference only passes the string through to native code,
+# params/BaseTrainParams.scala:99): every worker holds ALL rows but only a
+# SLICE of the features.  Histograms never cross the interconnect — each
+# rank scans its own feature columns, local best splits ride one tiny
+# all-gather, and the winning split's owner broadcasts the row routing via
+# a psum of owner-exclusive masks.  Communication per wave is O(S·N) bits
+# + O(ranks·S) floats instead of O(F·B) histograms — the right trade when
+# features outnumber rows.
+
+
+@functools.partial(jax.jit, static_argnames=("p", "axis_name", "use_pallas",
+                                             "n_slots"))
+def grow_tree_feature_parallel(
+        bins_t: jnp.ndarray,          # (F_local, N) int32 — THIS RANK's slice
+        grad: jnp.ndarray,            # (N,) f32 replicated
+        hess: jnp.ndarray,            # (N,) f32 replicated
+        row_valid: jnp.ndarray,       # (N,) f32 replicated
+        feature_mask: jnp.ndarray,    # (F_local,) bool
+        upper_bounds: jnp.ndarray,    # (F_local, B-1) f32
+        num_bins: jnp.ndarray,        # (F_local,) int32
+        learning_rate: float,
+        p: GrowthParams,
+        axis_name: str,
+        use_pallas: bool = False,
+        n_slots: int = 16,
+) -> Tuple[Tree, jnp.ndarray]:
+    """Depth-level growth with the FEATURE axis sharded over ``axis_name``.
+
+    Returns the identical tree on every rank; ``split_feature`` carries
+    GLOBAL feature ids (rank · F_local + local id).  Semantics match
+    :func:`grow_tree_depthwise` on the unsharded data.
+    """
+    from .pallas_hist import prep_hist_vals
+
+    FL, N = bins_t.shape
+    B = p.total_bins
+    L = p.num_leaves
+    M = max_nodes(L)
+    S = n_slots
+    JUNK = M - 1
+    rank = lax.axis_index(axis_name)
+
+    vals8 = prep_hist_vals(grad, hess, row_valid) if use_pallas else None
+    flat_bins = None
+    if not use_pallas:
+        flat_bins = bins_t + (jnp.arange(FL, dtype=jnp.int32) * B)[:, None]
+
+    def build(slot):
+        # LOCAL histograms only — the defining property of feature-parallel
+        return _build_hist_nodes(bins_t, flat_bins, vals8, grad, hess,
+                                 row_valid, slot, S, FL, B, use_pallas)
+
+    def pick_local(hist, g, h, c, depth):
+        return _best_split(hist, g, h, c, num_bins, feature_mask, depth, p)
+
+    def global_pick(hist_s, g, h, c, depth):
+        """Per-node: local best over this rank's features, then a tiny
+        all-gather picks the winner; returns global feature ids and the
+        owner's raw-value threshold."""
+        bg, bf_, bb, bgl, bhl, bcl = pick_local(hist_s, g, h, c, depth)
+        thr = jnp.where(bb >= 1, upper_bounds[bf_, jnp.maximum(bb - 1, 0)],
+                        -jnp.inf)
+        packed = jnp.stack([bg, (rank * FL + bf_).astype(jnp.float32),
+                            bb.astype(jnp.float32), bgl, bhl, bcl, thr])
+        allp = lax.all_gather(packed, axis_name)           # (ranks, 7)
+        win = jnp.argmax(allp[:, 0])
+        wg, wf, wb, wgl, whl, wcl, wthr = (allp[win, i] for i in range(7))
+        return (wg, wf.astype(jnp.int32), wb.astype(jnp.int32),
+                wgl, whl, wcl, wthr)
+
+    # root: stats directly from grad/hess (no rank owns every feature)
+    root_g = jnp.sum(grad * row_valid)
+    root_h = jnp.sum(hess * row_valid)
+    root_c = jnp.sum((row_valid > 0).astype(jnp.float32))
+    root_hist = build(jnp.zeros(N, jnp.int32))[0]
+
+    zi = jnp.zeros(M, jnp.int32)
+    zf = jnp.zeros(M, jnp.float32)
+    bg, bf_, bb, bgl, bhl, bcl, bthr = global_pick(
+        root_hist, root_g, root_h, root_c, jnp.zeros((), jnp.int32))
+    state = dict(
+        node_id=jnp.zeros(N, jnp.int32),
+        hist=jnp.zeros((L + 2, FL * B, 3), jnp.float32).at[0].set(
+            root_hist.reshape(FL * B, 3)),
+        slot=zi,
+        sum_g=zf.at[0].set(root_g),
+        sum_h=zf.at[0].set(root_h),
+        sum_c=zf.at[0].set(root_c),
+        depth=zi,
+        best_gain=jnp.full(M, -jnp.inf, jnp.float32).at[0].set(bg),
+        best_feat=zi.at[0].set(bf_), best_bin=zi.at[0].set(bb),
+        best_gl=zf.at[0].set(bgl), best_hl=zf.at[0].set(bhl),
+        best_cl=zf.at[0].set(bcl),
+        best_thr=zf.at[0].set(bthr),
+        active=jnp.zeros(M, jnp.bool_).at[0].set(True),
+        split_feature=jnp.full(M, -1, jnp.int32),
+        split_bin=zi,
+        split_gain=zf,
+        threshold=zf,
+        left_child=jnp.full(M, -1, jnp.int32),
+        right_child=jnp.full(M, -1, jnp.int32),
+        num_nodes=jnp.ones((), jnp.int32),
+        next_slot=jnp.ones((), jnp.int32),
+    )
+
+    def cond(s):
+        leaves = (s["num_nodes"] + 1) // 2
+        gains = jnp.where(s["active"], s["best_gain"], -jnp.inf)
+        return (leaves < L) & (jnp.max(gains) > p.min_gain_to_split)
+
+    def wave(s):
+        gains = jnp.where(s["active"], s["best_gain"], -jnp.inf)
+        tv, ti = lax.top_k(gains, S)
+        budget = L - (s["num_nodes"] + 1) // 2
+        jidx = jnp.arange(S, dtype=jnp.int32)
+        valid = (tv > p.min_gain_to_split) & (jidx < budget)
+        n_valid = jnp.sum(valid.astype(jnp.int32))
+        parents = jnp.where(valid, ti, JUNK)
+        l_ids = jnp.where(valid, s["num_nodes"] + 2 * jidx, JUNK)
+        r_ids = jnp.where(valid, s["num_nodes"] + 2 * jidx + 1, JUNK)
+
+        # owner-exclusive routing: this rank contributes the go-left mask
+        # only for slots whose winning feature lives in its slice; one psum
+        # assembles every slot's mask on every rank
+        wf = s["best_feat"][parents]                        # (S,) global ids
+        wb = s["best_bin"][parents]
+        owner = wf // FL
+        floc = jnp.clip(wf - rank * FL, 0, FL - 1)
+        mine = (owner == rank) & valid
+        local_gl = (bins_t[floc, :] <= wb[:, None])         # (S, N)
+        gl_slots = lax.psum(
+            jnp.where(mine[:, None], local_gl, False).astype(jnp.int8),
+            axis_name) > 0                                  # (S, N) bool
+
+        slot_of_leaf = jnp.full(M, -1, jnp.int32).at[parents].set(
+            jnp.where(valid, jidx, -1))
+        rslot = slot_of_leaf[s["node_id"]]                  # (N,)
+        go_left = jnp.take_along_axis(
+            gl_slots, jnp.clip(rslot, 0)[None, :], axis=0)[0]
+        new_node_id = jnp.where(
+            rslot >= 0,
+            jnp.where(go_left, l_ids[rslot], r_ids[rslot]),
+            s["node_id"])
+        bslot = jnp.where(go_left, rslot, -1)
+
+        l_hists = build(bslot)                              # (S, FL, B, 3)
+        l_flat = l_hists.reshape(S, FL * B, 3)
+        pslot = jnp.where(valid, s["slot"][parents], L)
+        r_flat = s["hist"][pslot] - l_flat
+        r_slots = jnp.where(valid, s["next_slot"] + jidx, L)
+        hist = s["hist"].at[pslot].set(l_flat).at[r_slots].set(r_flat)
+
+        lg = s["best_gl"][parents]
+        lh = s["best_hl"][parents]
+        lc = s["best_cl"][parents]
+        rg = s["sum_g"][parents] - lg
+        rh = s["sum_h"][parents] - lh
+        rc = s["sum_c"][parents] - lc
+        cdepth = s["depth"][parents] + 1
+
+        child_hists = jnp.concatenate(
+            [l_flat.reshape(S, FL, B, 3), r_flat.reshape(S, FL, B, 3)])
+        cg = jnp.concatenate([lg, rg])
+        ch = jnp.concatenate([lh, rh])
+        cc = jnp.concatenate([lc, rc])
+        cd = jnp.concatenate([cdepth, cdepth])
+        vg = jax.vmap(global_pick)(child_hists, cg, ch, cc, cd)
+        cbg, cbf, cbb, cbgl, cbhl, cbcl, cbthr = vg
+
+        cids = jnp.concatenate([l_ids, r_ids])
+        out = dict(
+            node_id=new_node_id,
+            hist=hist,
+            slot=s["slot"].at[l_ids].set(pslot).at[r_ids].set(r_slots),
+            sum_g=s["sum_g"].at[cids].set(cg),
+            sum_h=s["sum_h"].at[cids].set(ch),
+            sum_c=s["sum_c"].at[cids].set(cc),
+            depth=s["depth"].at[cids].set(cd),
+            best_gain=s["best_gain"].at[cids].set(cbg),
+            best_feat=s["best_feat"].at[cids].set(cbf),
+            best_bin=s["best_bin"].at[cids].set(cbb),
+            best_gl=s["best_gl"].at[cids].set(cbgl),
+            best_hl=s["best_hl"].at[cids].set(cbhl),
+            best_cl=s["best_cl"].at[cids].set(cbcl),
+            best_thr=s["best_thr"].at[cids].set(cbthr),
+            active=s["active"].at[parents].set(False).at[cids].set(True),
+            split_feature=s["split_feature"].at[parents].set(
+                jnp.where(valid, s["best_feat"][parents], -1)),
+            split_bin=s["split_bin"].at[parents].set(s["best_bin"][parents]),
+            split_gain=s["split_gain"].at[parents].set(
+                jnp.where(valid, s["best_gain"][parents], 0.0)),
+            threshold=s["threshold"].at[parents].set(s["best_thr"][parents]),
+            left_child=s["left_child"].at[parents].set(l_ids),
+            right_child=s["right_child"].at[parents].set(r_ids),
+            num_nodes=s["num_nodes"] + 2 * n_valid,
+            next_slot=s["next_slot"] + n_valid,
+        )
+        out["active"] = out["active"].at[JUNK].set(False)
+        out["best_gain"] = out["best_gain"].at[JUNK].set(-jnp.inf)
+        out["split_feature"] = out["split_feature"].at[JUNK].set(-1)
+        out["left_child"] = out["left_child"].at[JUNK].set(-1)
+        out["right_child"] = out["right_child"].at[JUNK].set(-1)
+        return out
+
+    state = lax.while_loop(cond, wave, state)
+
+    node_value = learning_rate * _leaf_output(state["sum_g"], state["sum_h"],
+                                              p.lambda_l1, p.lambda_l2)
+    leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
+    tree = Tree(split_feature=state["split_feature"],
+                split_bin=state["split_bin"],
+                threshold=state["threshold"],
+                split_gain=state["split_gain"],
+                left_child=state["left_child"],
+                right_child=state["right_child"],
+                leaf_value=leaf_value,
+                node_value=node_value,
+                num_nodes=state["num_nodes"],
+                default_left=jnp.ones(M, jnp.bool_))
+    return tree, state["node_id"]
+
+
 # -- prediction -------------------------------------------------------------
 
 def _traverse(binned, tree: Tree, depth_bound: int):
