@@ -223,6 +223,124 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 
 
 
 # --------------------------------------------------------------------------
+# fused route + histogram kernel (depth-level growth, one pass per wave)
+# --------------------------------------------------------------------------
+#
+# The wave loop needs two things from the binned matrix: (1) apply the
+# selected splits to every row (new node id + histogram slot) and (2) build
+# the left-child histograms.  As separate kernels each scans the matrix
+# once; fused, the grid runs chunk-major (f innermost) so each chunk's
+# routing is computed ONCE at f==0 — from a full-F view of the same bins
+# array — and the per-chunk slot assignment + node-masked value matrix stay
+# in VMEM for the F/8 histogram steps that follow.  The histogram
+# accumulator is a single constant-index output block (F/8, 8B, S·8 ≈ 4 MB)
+# resident in VMEM for the whole launch.
+
+
+def _fused_route_hist_kernel(leaf_ref, feat_ref, thr_ref, lid_ref, rid_ref,
+                             bins_full_ref, bins_ref, nid_ref, vals_ref,
+                             newid_ref, out_ref, oh_ref, vn_ref, slot_ref):
+    """Grid (N//CHUNK, F//FEAT_TILE) — f fastest.  bins_full block (F, C)
+    (routing view), bins block (8, C) (histogram tile), nid (1, C),
+    vals (C, 8) bf16; outputs: newid (1, C) and the resident histogram
+    accumulator (F//8, 8B, S·8) f32."""
+    c = pl.program_id(0)
+    f = pl.program_id(1)
+
+    @pl.when((c == 0) & (f == 0))
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    C = bins_ref.shape[1]
+    B = oh_ref.shape[0] // FEAT_TILE
+    S = vn_ref.shape[1] // SLOT_LANES
+
+    @pl.when(f == 0)
+    def _route():
+        nid = nid_ref[0, :]
+        new = nid
+        bslot = jnp.full_like(nid, -1)
+        for j in range(S):
+            xb = bins_full_ref[pl.dslice(feat_ref[j], 1), :][0]
+            inleaf = nid == leaf_ref[j]
+            gl = xb <= thr_ref[j]
+            new = jnp.where(inleaf, jnp.where(gl, lid_ref[j], rid_ref[j]),
+                            new)
+            bslot = jnp.where(inleaf & gl, j, bslot)
+        newid_ref[0, :] = new
+        slot_ref[0, :] = bslot
+        vals = vals_ref[...]
+        for j in range(S):
+            m = (bslot == j).astype(jnp.float32)[:, None].astype(jnp.bfloat16)
+            vn_ref[:, j * SLOT_LANES:(j + 1) * SLOT_LANES] = vals * m
+
+    iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
+    for ft in range(FEAT_TILE):
+        b = bins_ref[ft, :]
+        oh_ref[ft * B:(ft + 1) * B, :] = (iota_b == b[None, :]).astype(jnp.bfloat16)
+    contrib = lax.dot_general(oh_ref[...], vn_ref[...],
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    out_ref[f, :, :] += contrib
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "total_bins",
+                                             "interpret"))
+def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 0
+                          node_id: jnp.ndarray,  # (N,) int32
+                          leaf: jnp.ndarray,     # (S,) int32 leaf being split
+                          feat: jnp.ndarray,     # (S,) int32 split feature
+                          thr_bin: jnp.ndarray,  # (S,) int32 bin (<= goes left)
+                          l_id: jnp.ndarray,     # (S,) int32 left child id
+                          r_id: jnp.ndarray,     # (S,) int32 right child id
+                          vals: jnp.ndarray,     # (N, 8) bf16 prep_hist_vals
+                          n_slots: int,
+                          total_bins: int,
+                          interpret: bool = False):
+    """One pass: → (new_node_id (N,), hists (n_slots, F, B, 3))."""
+    F, N = bins_t.shape
+    B = total_bins
+    assert N % CHUNK == 0, f"N={N} must be a multiple of {CHUNK}"
+    Fp = ((F + FEAT_TILE - 1) // FEAT_TILE) * FEAT_TILE
+    if Fp != F:
+        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+    VN = n_slots * SLOT_LANES
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(N // CHUNK, Fp // FEAT_TILE),
+        in_specs=[
+            pl.BlockSpec((Fp, CHUNK), lambda c, f, *_: (0, c)),
+            pl.BlockSpec((FEAT_TILE, CHUNK), lambda c, f, *_: (f, c)),
+            pl.BlockSpec((1, CHUNK), lambda c, f, *_: (0, c)),
+            pl.BlockSpec((CHUNK, SLOT_LANES), lambda c, f, *_: (c, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, CHUNK), lambda c, f, *_: (0, c)),
+            pl.BlockSpec((Fp // FEAT_TILE, FEAT_TILE * B, VN),
+                         lambda c, f, *_: (0, 0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((FEAT_TILE * B, CHUNK), jnp.bfloat16),
+                        pltpu.VMEM((CHUNK, VN), jnp.bfloat16),
+                        pltpu.VMEM((1, CHUNK), jnp.int32)],
+    )
+    new_id, out = pl.pallas_call(
+        _fused_route_hist_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((1, N), jnp.int32),
+                   jax.ShapeDtypeStruct(
+                       (Fp // FEAT_TILE, FEAT_TILE * B, VN), jnp.float32)],
+        interpret=interpret,
+    )(leaf, feat, thr_bin, l_id, r_id,
+      bins_t, bins_t, node_id[None, :], vals)
+
+    out = out.reshape(Fp, B, n_slots, SLOT_LANES)[:F]
+    out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)
+    gsum = out[..., 0] + out[..., 1]
+    hsum = out[..., 2] + out[..., 3]
+    return new_id[0], jnp.stack([gsum, hsum, out[..., 4]], axis=-1)
+
+
+# --------------------------------------------------------------------------
 # row routing kernel (depth-level growth)
 # --------------------------------------------------------------------------
 #

@@ -500,13 +500,16 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         l_ids = jnp.where(valid, s["num_nodes"] + 2 * jidx, JUNK)
         r_ids = jnp.where(valid, s["num_nodes"] + 2 * jidx + 1, JUNK)
 
-        # route rows: new node id + histogram slot (-1 = not a left child).
-        # JUNK parents match no row, so invalid slots route nothing.
+        # route rows (new node id + histogram slot; JUNK parents match no
+        # row) and build every selected leaf's left-child histogram in ONE
+        # pass over the binned matrix — the fused kernel computes each
+        # chunk's routing once and keeps it in VMEM for the histogram tiles
         if use_pallas:
-            from .pallas_hist import route_rows_pallas
-            new_node_id, bslot = route_rows_pallas(
+            from .pallas_hist import route_and_hist_pallas
+            new_node_id, l_hists = route_and_hist_pallas(
                 bins_t, s["node_id"], parents, s["best_feat"][parents],
-                s["best_bin"][parents], l_ids, r_ids)
+                s["best_bin"][parents], l_ids, r_ids, vals8, S, B)
+            l_hists = ar(l_hists)
         else:
             slot_of_leaf = jnp.full(M, -1, jnp.int32).at[parents].set(
                 jnp.where(valid, jidx, -1))
@@ -519,9 +522,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 jnp.where(go_left, l_ids[rslot], r_ids[rslot]),
                 s["node_id"])
             bslot = jnp.where(go_left, rslot, -1)
-
-        # ONE pass: left-child histograms for every selected leaf
-        l_hists = build(bslot)                           # (S, F, B, 3)
+            l_hists = build(bslot)                       # (S, F, B, 3)
         l_flat = l_hists.reshape(S, F * B, 3)
         pslot = jnp.where(valid, s["slot"][parents], HJUNK)
         r_flat = s["hist"][pslot] - l_flat
