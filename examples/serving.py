@@ -20,6 +20,10 @@ def parse(request):
     return {"features": np.asarray(body["features"], np.float32)}
 
 
+# warm the predict jit before serving so the first request's latency window
+# covers inference, not compilation (matters on loaded CI hosts)
+model.transform(Dataset({"features": list(X[:1])}))
+
 server = PipelineServer(model, parse, output_col="probability")
 try:
     req = urllib.request.Request(

@@ -192,14 +192,21 @@ class Booster:
         p1 = 1.0 / (1.0 + np.exp(-margin))
         return np.stack([1 - p1, p1], axis=1)
 
-    def predict_contrib(self, features: np.ndarray) -> np.ndarray:
-        """Per-feature contributions + bias, path-attribution (Saabas) style
-        — the featuresShap analogue (LightGBMBooster.featuresShap).  Exact
-        TreeSHAP is a planned refinement; path attribution is its fast
-        first-order approximation.
+    def predict_contrib(self, features: np.ndarray,
+                        approximate: bool = False) -> np.ndarray:
+        """Per-feature contributions + bias — the featuresShap analogue
+        (LightGBMBooster.featuresShap): EXACT TreeSHAP (Lundberg
+        polynomial algorithm over the per-node covers) by default;
+        ``approximate=True`` selects Saabas path attribution, which is
+        also the automatic fallback for models without cover counts
+        (old serialized models, LightGBM imports lacking
+        ``internal_count``).
 
         Returns (n, F+1) for single-output models, (n, K*(F+1)) for
         multiclass (last slot of each block = bias)."""
+        from .shap import has_cover_counts, tree_shap_values
+        if not approximate and has_cover_counts(self):
+            return tree_shap_values(self, features)
         features = np.ascontiguousarray(features, np.float32)
         n = features.shape[0]
         F = self.bin_mapper.num_features
@@ -299,7 +306,10 @@ class Booster:
                 num_nodes=np.asarray(td["num_nodes"], np.int32),
                 default_left=np.asarray(
                     td.get("default_left",
-                           np.ones(len(td["leaf_value"]), bool)), bool)))
+                           np.ones(len(td["leaf_value"]), bool)), bool),
+                node_count=np.asarray(
+                    td.get("node_count",
+                           np.zeros(len(td["leaf_value"]))), np.float32)))
         return Booster(trees, d["tree_class"], d["tree_weights"], d["num_class"],
                        d["objective"], np.asarray(d["init_score"], np.float32),
                        bm, d["feature_names"], cfg, d["best_iteration"])

@@ -22,8 +22,9 @@ Imported models carry a placeholder bin mapper — raw-feature prediction
 (`predict_margin`, `predict_contrib`) never consults bins.
 
 Limitations: categorical splits (``num_cat > 0``) and linear-leaf models are
-rejected explicitly; ``leaf_weight``/``leaf_count`` export as zeros because
-our Tree keeps no per-node hessian/count stats after training.
+rejected explicitly; ``leaf_weight`` exports as zeros (our Tree keeps no
+per-node hessian sums) while ``leaf_count``/``internal_count`` carry the
+real covers (they feed exact TreeSHAP on both sides of a round trip).
 """
 
 from __future__ import annotations
@@ -107,10 +108,11 @@ def _tree_block(tree, weight: float, bias: float, index: int,
             "left_child=" + " ".join(str(child(lc[n])) for n in internal),
             "right_child=" + " ".join(str(child(rc[n])) for n in internal),
         ]
+    counts = np.asarray(tree.node_count[:n_nodes])
     lines += [
         "leaf_value=" + " ".join(_fmt(v) for v in leaf_vals),
         "leaf_weight=" + " ".join("0" for _ in leaves),
-        "leaf_count=" + " ".join("0" for _ in leaves),
+        "leaf_count=" + " ".join(str(int(counts[n])) for n in leaves),
     ]
     if len(internal):
         lines += [
@@ -118,7 +120,8 @@ def _tree_block(tree, weight: float, bias: float, index: int,
                 _fmt(float(tree.node_value[n]) * weight + bias)
                 for n in internal),
             "internal_weight=" + " ".join("0" for _ in internal),
-            "internal_count=" + " ".join("0" for _ in internal),
+            "internal_count=" + " ".join(str(int(counts[n]))
+                                         for n in internal),
         ]
     lines += ["is_linear=0", f"shrinkage={_fmt(shrinkage)}"]
     return "\n".join(lines) + "\n"
@@ -196,6 +199,7 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
     node_value = np.zeros(M, np.float32)
     leaf_value = np.zeros(M, np.float32)
     default_left = np.ones(M, bool)
+    node_count = np.zeros(M, np.float32)
 
     def arr(key, dtype, n, default=None):
         if key not in fields:
@@ -208,6 +212,9 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
         return np.asarray([dtype(v) for v in vals])
 
     lv = arr("leaf_value", float, n_leaves)
+    lcnt = arr("leaf_count", float, n_leaves, default=0.0)
+    icnt = (arr("internal_count", float, n_int, default=0.0)
+            if n_int else np.zeros(0))
     if n_int:
         sf = arr("split_feature", int, n_int)
         th = arr("threshold", float, n_int)
@@ -241,6 +248,7 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
             left[j] = map_child(lc[j])
             right[j] = map_child(rc[j])
             node_value[j] = iv[j]
+            node_count[j] = icnt[j]
             if ((dt[j] >> 2) & 3) == 0:          # None: NaN behaves as 0.0
                 default_left[j] = bool(0.0 <= th[j])
             else:
@@ -248,6 +256,7 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
     for l in range(n_leaves):
         node_value[n_int + l] = lv[l]
         leaf_value[n_int + l] = lv[l]
+        node_count[n_int + l] = lcnt[l]
     return Tree(split_feature=split_feature,
                 split_bin=np.zeros(M, np.int32),
                 threshold=threshold.astype(np.float32),
@@ -255,7 +264,8 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
                 left_child=left, right_child=right,
                 leaf_value=leaf_value, node_value=node_value,
                 num_nodes=np.asarray(n_int + n_leaves, np.int32),
-                default_left=default_left)
+                default_left=default_left,
+                node_count=node_count)
 
 
 def booster_from_lgbm_string(s: str):

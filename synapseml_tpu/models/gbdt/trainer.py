@@ -65,6 +65,8 @@ class Tree(NamedTuple):
     default_left: jnp.ndarray         # (MAX_NODES,) bool — NaN routing per
                                       # node (training always emits True;
                                       # imported LightGBM models may not)
+    node_count: jnp.ndarray           # (MAX_NODES,) f32 — rows covering
+                                      # each node (TreeSHAP cover weights)
 
 
 def max_nodes(num_leaves: int) -> int:
@@ -354,7 +356,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
                 leaf_value=leaf_value,
                 node_value=node_value,
                 num_nodes=state["num_nodes"],
-                default_left=jnp.ones(M, jnp.bool_))
+                default_left=jnp.ones(M, jnp.bool_),
+                node_count=state["sum_c"])
     return tree, state["node_id"]
 
 
@@ -598,7 +601,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 leaf_value=leaf_value,
                 node_value=node_value,
                 num_nodes=state["num_nodes"],
-                default_left=jnp.ones(M, jnp.bool_))
+                default_left=jnp.ones(M, jnp.bool_),
+                node_count=state["sum_c"])
     return tree, state["node_id"]
 
 
@@ -823,7 +827,8 @@ def grow_tree_feature_parallel(
                 leaf_value=leaf_value,
                 node_value=node_value,
                 num_nodes=state["num_nodes"],
-                default_left=jnp.ones(M, jnp.bool_))
+                default_left=jnp.ones(M, jnp.bool_),
+                node_count=state["sum_c"])
     return tree, state["node_id"]
 
 

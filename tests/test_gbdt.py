@@ -349,6 +349,94 @@ end of trees
         Booster.from_string(s)
 
 
+def _brute_force_shap(booster, x):
+    """Exact Shapley values by subset enumeration against the tree-path
+    cover-weighted conditional expectation — the definition TreeSHAP
+    computes in polynomial time."""
+    import itertools
+    import math
+
+    F = booster.bin_mapper.num_features
+
+    def cond_exp(S):
+        total = float(booster.init_score[0])
+        for i, t in enumerate(booster.trees):
+            w = booster.tree_weights[i]
+
+            def rec(j):
+                f = int(t.split_feature[j])
+                if f < 0:
+                    return float(t.node_value[j])
+                if f in S:
+                    xv = x[f]
+                    go_left = bool(t.default_left[j]) if np.isnan(xv) \
+                        else bool(xv <= t.threshold[j])
+                    return rec(int(t.left_child[j]) if go_left
+                               else int(t.right_child[j]))
+                cl, cr = (float(t.node_count[int(t.left_child[j])]),
+                          float(t.node_count[int(t.right_child[j])]))
+                tot = max(cl + cr, 1e-12)
+                return (cl * rec(int(t.left_child[j]))
+                        + cr * rec(int(t.right_child[j]))) / tot
+
+            total += rec(0) * w
+        return total
+
+    phi = np.zeros(F + 1)
+    phi[F] = cond_exp(frozenset())
+    for f in range(F):
+        rest = [g for g in range(F) if g != f]
+        for r in range(F):
+            for S in itertools.combinations(rest, r):
+                wgt = (math.factorial(r) * math.factorial(F - r - 1)
+                       / math.factorial(F))
+                phi[f] += wgt * (cond_exp(frozenset(S) | {f})
+                                 - cond_exp(frozenset(S)))
+    return phi
+
+
+def test_exact_treeshap_matches_brute_force():
+    """predict_contrib is EXACT TreeSHAP (featuresShap parity,
+    LightGBMBooster.featuresShap): equals subset-enumeration Shapley on a
+    small model, not just the Saabas approximation."""
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    cfg = BoostingConfig(objective="binary", num_iterations=4, num_leaves=7,
+                         min_data_in_leaf=10)
+    b, _ = train(X, y, cfg)
+    contrib = b.predict_contrib(X[:5])
+    for r in range(5):
+        expected = _brute_force_shap(b, X[r])
+        np.testing.assert_allclose(contrib[r], expected, rtol=1e-4,
+                                   atol=1e-5)
+    # contributions still sum to the margin
+    np.testing.assert_allclose(contrib.sum(1), b.predict_margin(X[:5]),
+                               rtol=1e-4, atol=1e-4)
+    # the Saabas approximation remains available and also sums to margin
+    approx = b.predict_contrib(X[:5], approximate=True)
+    np.testing.assert_allclose(approx.sum(1), b.predict_margin(X[:5]),
+                               rtol=1e-4, atol=1e-4)
+    assert not np.allclose(approx, contrib)      # genuinely different paths
+
+
+def test_treeshap_counts_survive_lgbm_roundtrip():
+    """Cover counts ride the LightGBM text format (leaf_count /
+    internal_count), so exact SHAP works on re-imported models."""
+    X, y = binary_data(n=800, F=5)
+    cfg = BoostingConfig(objective="binary", num_iterations=3, num_leaves=7,
+                         min_data_in_leaf=10)
+    b, _ = train(X, y, cfg)
+    b2 = Booster.from_string(b.to_string())
+    c1 = b.predict_contrib(X[:8])
+    c2 = b2.predict_contrib(X[:8])
+    # per-feature attributions identical through the round trip (bias is
+    # folded into the first tree's leaves on export, shifting only how the
+    # total splits between bias and feature columns sums)
+    np.testing.assert_allclose(c1.sum(1), c2.sum(1), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(c1[:, :-1], c2[:, :-1], rtol=1e-3, atol=1e-4)
+
+
 def test_feature_importance_and_contrib():
     X, y = binary_data(n=2000)
     cfg = BoostingConfig(objective="binary", num_iterations=10,
