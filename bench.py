@@ -30,7 +30,7 @@ import time
 import numpy as np
 
 BERT_STEPS = 20
-BERT_BATCH = 32
+BERT_BATCH = 128      # per-chip; fills the MXU (+18% over 32, 0.45 vs 0.38 MFU)
 BERT_SEQ = 128
 
 GBDT_ROWS = 1_000_000
@@ -149,8 +149,10 @@ def bench_gbdt_anchor(X, y):
         clf.fit(X, y)
         return time.perf_counter() - t0
 
-    t_small = run(2)
-    t_big = run(ANCHOR_ITERS)
+    # the shared host is noisy and the fixed/per-iter differencing
+    # amplifies it: take the best of two runs of each size
+    t_small = min(run(2), run(2))
+    t_big = min(run(ANCHOR_ITERS), run(ANCHOR_ITERS))
     per_iter = max((t_big - t_small) / (ANCHOR_ITERS - 2), 1e-9)
     fixed = max(t_small - 2 * per_iter, 0.0)
     ips_at_bench_iters = GBDT_ITERS / (fixed + GBDT_ITERS * per_iter)
@@ -180,8 +182,39 @@ def bench_resnet50():
     return bs * steps / (time.perf_counter() - t0)
 
 
+def bench_llm():
+    """Llama-3-1B-class autoregressive decode tokens/s/chip (the TP-ready
+    LLM stretch path; KV-cached jitted scan decode)."""
+    import jax
+    from synapseml_tpu.models.llm import LlamaConfig, LlamaModel, generate
+
+    import jax.numpy as jnp
+
+    cfg = LlamaConfig.llama3_1b(max_len=256)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    B, P, NEW = 8, 32, 64
+    ids = rng.integers(0, cfg.vocab_size, (B, P))
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, 8), jnp.int32))
+    generate(model, variables, ids, max_new_tokens=NEW)      # compile
+    t0 = time.perf_counter()
+    out = generate(model, variables, ids, max_new_tokens=NEW)
+    dt = time.perf_counter() - t0
+    assert out.shape == (B, NEW)
+    return B * NEW / dt
+
+
 def main():
     bert_sps, mfu, n_params = bench_bert()
+    llm_tps = None
+    try:
+        llm_tps = bench_llm()
+        print(f"[secondary] Llama-1B decode: {llm_tps:.0f} tokens/s/chip "
+              f"(batch 8)", file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] LLM bench failed: {e}", file=sys.stderr)
+
     resnet_ips = None
     try:
         resnet_ips = bench_resnet50()
@@ -224,6 +257,8 @@ def main():
                                       if anchor_ips else None),
         "resnet50_onnx_imgs_per_sec": (round(resnet_ips, 1)
                                        if resnet_ips else None),
+        "llama1b_decode_tokens_per_sec": (round(llm_tps, 1)
+                                          if llm_tps else None),
         "anchor": (f"sklearn HistGradientBoostingClassifier, same host, "
                    f"{anchor_cores} CPU cores" if anchor_ips else None),
     }
