@@ -1,0 +1,48 @@
+"""Multi-endpoint serving: several fitted pipelines behind ONE server with
+named-API routing and backpressure (the reference's multi-API Spark
+Serving: HTTPSourceV2 ServiceInfo registry + DistributedHTTPSource
+shared servers)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.models.gbdt import GBDTClassifier, GBDTRegressor
+from synapseml_tpu.serving import MultiPipelineServer
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1200, 4)).astype(np.float32)
+ds_cls = Dataset({"features": list(X), "label": (X[:, 0] > 0).astype(float)})
+ds_reg = Dataset({"features": list(X),
+                  "label": (2 * X[:, 0] + X[:, 1]).astype(float)})
+
+clf = GBDTClassifier(numIterations=10, numLeaves=7, minDataInLeaf=5,
+                     numShards=1).fit(ds_cls)
+reg = GBDTRegressor(numIterations=10, numLeaves=7, minDataInLeaf=5,
+                    numShards=1).fit(ds_reg)
+for m in (clf, reg):                      # warm the predict jits
+    m.transform(Dataset({"features": list(X[:1])}))
+
+
+def parse(request):
+    return {"features": np.asarray(request.json()["features"], np.float32)}
+
+
+server = MultiPipelineServer({
+    "/classify": {"model": clf, "input_parser": parse,
+                  "output_col": "probability"},
+    "/regress": {"model": reg, "input_parser": parse,
+                 "output_col": "prediction", "max_queue": 256},
+})
+try:
+    probe = {"features": [1.0, -0.5, 0.2, 0.0]}
+    for api in ("/classify", "/regress"):
+        req = urllib.request.Request(
+            server.url_for(api), data=json.dumps(probe).encode(),
+            headers={"Content-Type": "application/json"})
+        reply = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        print(api, "->", reply)
+finally:
+    server.close()

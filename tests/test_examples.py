@@ -9,7 +9,9 @@ import sys
 import pytest
 
 EXAMPLES = ["gbdt_classification", "online_learning", "deep_learning",
-            "explainability", "serving", "onnx_inference"]
+            "explainability", "serving", "onnx_inference",
+            "lightgbm_interop", "streaming_out_of_core",
+            "multi_endpoint_serving"]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
