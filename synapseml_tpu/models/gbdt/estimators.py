@@ -81,6 +81,13 @@ class GBDTParams(Params):
         default="data_parallel",
         allowed=("data_parallel", "voting_parallel", "feature_parallel"))
     topK = IntParam(doc="voting-parallel top features per shard", default=20)
+    checkpointDir = StringParam(
+        doc="iteration-checkpoint directory: training saves the partial "
+            "booster every checkpointInterval iterations and a re-fit "
+            "resumes from the newest one (step-level resume, beyond the "
+            "reference's numBatches warm start)")
+    checkpointInterval = IntParam(doc="save every N boosting iterations "
+                                      "(0 = off)", default=0)
     passThroughArgs = DictParam(doc="extra engine params (ParamsStringBuilder "
                                     "pass-through analogue)")
     predictDisableShapeCheck = BoolParam(doc="skip feature-count check at "
@@ -229,7 +236,8 @@ class GBDTClassifier(GBDTParams, Estimator):
 
         booster, history = _train_batched(
             X, y, cfg, w, valid, self.numBatches, self._mesh(len(X)),
-            seed=self.seed)
+            seed=self.seed, checkpoint_dir=self.get("checkpointDir"),
+            checkpoint_interval=int(self.checkpointInterval))
         model = GBDTClassificationModel(
             boosterModel=booster,
             featuresCol=self.featuresCol,
@@ -319,7 +327,8 @@ class GBDTRegressor(GBDTParams, Estimator):
                      if self.weightCol else None)
         booster, history = _train_batched(
             X, y, cfg, w, valid, self.numBatches, self._mesh(len(X)),
-            seed=self.seed)
+            seed=self.seed, checkpoint_dir=self.get("checkpointDir"),
+            checkpoint_interval=int(self.checkpointInterval))
         model = GBDTRegressionModel(
             boosterModel=booster,
             featuresCol=self.featuresCol,
@@ -410,9 +419,15 @@ class GBDTRankerModel(GBDTModelBase):
         return self._maybe_add_leaves(out, X)
 
 
-def _train_batched(X, y, cfg, w, valid, num_batches: int, mesh, seed: int):
+def _train_batched(X, y, cfg, w, valid, num_batches: int, mesh, seed: int,
+                   checkpoint_dir=None, checkpoint_interval=0):
     """numBatches fold-over warm start (LightGBMBase.scala:44-59)."""
     if num_batches and num_batches > 1:
+        if checkpoint_dir:
+            raise ValueError(
+                "checkpointDir cannot combine with numBatches > 1: the "
+                "batch fold is itself a warm-start sequence — checkpoint "
+                "single-batch training instead")
         n = len(X)
         idx = np.array_split(np.arange(n), num_batches)
         booster = None
@@ -423,4 +438,6 @@ def _train_batched(X, y, cfg, w, valid, num_batches: int, mesh, seed: int):
                                valid=valid, mesh=mesh, init_model=booster)
             history.extend(h)
         return booster, history
-    return train(X, y, cfg, sample_weight=w, valid=valid, mesh=mesh)
+    return train(X, y, cfg, sample_weight=w, valid=valid, mesh=mesh,
+                 checkpoint_dir=checkpoint_dir,
+                 checkpoint_interval=checkpoint_interval)
