@@ -186,9 +186,9 @@ def bench_llm():
     """Llama-3-1B-class autoregressive decode tokens/s/chip (the TP-ready
     LLM stretch path; KV-cached jitted scan decode)."""
     import jax
-    from synapseml_tpu.models.llm import LlamaConfig, LlamaModel, generate
-
     import jax.numpy as jnp
+
+    from synapseml_tpu.models.llm import LlamaConfig, LlamaModel, generate
 
     cfg = LlamaConfig.llama3_1b(max_len=256)
     model = LlamaModel(cfg)

@@ -541,9 +541,9 @@ def _write_checkpoint(directory: str, booster: Booster,
     with open(tmp, "w") as f:
         json.dump(booster.to_dict(), f)
     os.replace(tmp, path)
-    steps = sorted(int(_re.match(r"iter_(\d+)\.json$", x).group(1))
-                   for x in os.listdir(directory)
-                   if _re.match(r"iter_(\d+)\.json$", x))
+    matches = (_re.match(r"iter_(\d+)\.json$", x)
+               for x in os.listdir(directory))
+    steps = sorted(int(m.group(1)) for m in matches if m)
     for old in steps[:-keep]:
         try:
             os.remove(os.path.join(directory, f"iter_{old:08d}.json"))
@@ -1046,12 +1046,11 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             for cb in callbacks:
                 cb(it, trees, eval_history)
         if ckpt_every and (it + 1) % ckpt_every == 0:
+            pre_t, pre_c, pre_w = (
+                (init_model.trees, init_model.tree_class,
+                 init_model.tree_weights) if init_model else ([], [], []))
             _write_checkpoint(checkpoint_dir, Booster(
-                (init_model.trees + trees) if init_model else trees,
-                (init_model.tree_class + tree_class) if init_model
-                else tree_class,
-                (init_model.tree_weights + tree_weights) if init_model
-                else tree_weights,
+                pre_t + trees, pre_c + tree_class, pre_w + tree_weights,
                 K, config.objective, init_sc, mapper, feature_names,
                 config))
 

@@ -213,8 +213,7 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
 
     lv = arr("leaf_value", float, n_leaves)
     lcnt = arr("leaf_count", float, n_leaves, default=0.0)
-    icnt = (arr("internal_count", float, n_int, default=0.0)
-            if n_int else np.zeros(0))
+    icnt = arr("internal_count", float, n_int, default=0.0)
     if n_int:
         sf = arr("split_feature", int, n_int)
         th = arr("threshold", float, n_int)

@@ -117,16 +117,17 @@ def tree_shap_values(booster, features: np.ndarray) -> np.ndarray:
             w = w / max(sum(1 for c in booster.tree_class if c == k), 1)
         nn = int(t.num_nodes)
         sf = np.asarray(t.split_feature[:nn])
+        thr = np.asarray(t.threshold[:nn])
+        lc = np.asarray(t.left_child[:nn])
+        rc = np.asarray(t.right_child[:nn])
+        dl = np.asarray(t.default_left[:nn])
         leaf_mask = sf < 0
         nc = np.asarray(t.node_count[:nn], np.float64)
         lv = np.asarray(t.node_value[:nn], np.float64)
         out[:, k, F] += _expected_value(nc, leaf_mask, lv) * w
         for r in range(n):
-            _tree_shap_row(sf, np.asarray(t.threshold[:nn]),
-                           np.asarray(t.left_child[:nn]),
-                           np.asarray(t.right_child[:nn]),
-                           np.asarray(t.default_left[:nn]),
-                           nc, lv, features[r], out[r, k], w)
+            _tree_shap_row(sf, thr, lc, rc, dl, nc, lv,
+                           features[r], out[r, k], w)
     out[:, :, F] += booster.init_score[:K][None, :]
     if K == 1:
         return out[:, 0, :]
