@@ -26,6 +26,96 @@ from .graph import GraphBuilder
 RESNET50_STAGES = (3, 4, 6, 3)
 
 
+def build_bert_classifier(state_dict: Dict[str, np.ndarray],
+                          num_layers: int, num_heads: int,
+                          seq_len: int = 16,
+                          input_ids_name: str = "input_ids",
+                          mask_name: str = "attention_mask",
+                          output_name: str = "logits") -> bytes:
+    """A BertForSequenceClassification forward pass as an ONNX graph, built
+    from an HF-format state dict (the same tensor names
+    ``models.dl.checkpoints.import_bert`` consumes) — the transformer
+    counterpart of :func:`build_resnet50` for proving the ONNX→XLA path on
+    attention/LayerNorm/Gelu graphs.  Fixed ``seq_len``; single-segment
+    inputs (token-type row 0 folds into the additive embedding)."""
+    def g(key):
+        for prefix in ("bert.", ""):
+            if prefix + key in state_dict:
+                return np.asarray(state_dict[prefix + key], np.float32)
+        raise KeyError(key)
+
+    d_model = g("embeddings.word_embeddings.weight").shape[1]
+    d_head = d_model // num_heads
+    b = GraphBuilder("bert_classifier", opset=17)
+    ids = b.input(input_ids_name, (None, seq_len), dtype=np.int64)
+    mask = b.input(mask_name, (None, seq_len), dtype=np.float32)
+
+    def init(name, value):
+        return b.initializer(name.replace(".", "_"), value)
+
+    def linear(x, key, out_name_hint):
+        w = init(key + ".w", g(key + ".weight").T)
+        bias = init(key + ".b", g(key + ".bias"))
+        return b.node("Add", [b.node("MatMul", [x, w]), bias])
+
+    def layer_norm(x, key):
+        return b.node("LayerNormalization",
+                      [x, init(key + ".g", g(key + ".weight")),
+                       init(key + ".beta", g(key + ".bias"))],
+                      axis=-1, epsilon=1e-12)
+
+    # embeddings: gather words; positions + segment-0 are additive constants
+    tok = b.node("Gather", [init("tok", g("embeddings.word_embeddings.weight")),
+                            ids], axis=0)
+    pos_const = (g("embeddings.position_embeddings.weight")[:seq_len]
+                 + g("embeddings.token_type_embeddings.weight")[0:1])
+    x = b.node("Add", [tok, init("pos", pos_const[None, :, :])])
+    x = layer_norm(x, "embeddings.LayerNorm")
+
+    # additive attention mask (B, 1, 1, S): (1 - mask) * -1e9
+    m4 = b.node("Unsqueeze", [mask, init("axes11", np.array([1, 2], np.int64))])
+    neg = b.node("Mul", [b.node("Sub", [init("one", np.float32(1.0)), m4]),
+                         init("negbig", np.float32(-1e9))])
+
+    perm_heads = [0, 2, 1, 3]
+    shape_split = init("shape_split",
+                       np.array([0, seq_len, num_heads, d_head], np.int64))
+    shape_merge = init("shape_merge", np.array([0, seq_len, d_model], np.int64))
+    for i in range(num_layers):
+        p = f"encoder.layer.{i}."
+
+        def heads(name):
+            h = linear(x, p + "attention.self." + name, name)
+            h = b.node("Reshape", [h, shape_split])
+            return b.node("Transpose", [h], perm=perm_heads)  # (B,H,S,dh)
+
+        q, k, v = heads("query"), heads("key"), heads("value")
+        kt = b.node("Transpose", [k], perm=[0, 1, 3, 2])
+        scores = b.node("Div", [b.node("MatMul", [q, kt]),
+                                init(f"scale{i}", np.float32(np.sqrt(d_head)))])
+        scores = b.node("Add", [scores, neg])
+        probs = b.node("Softmax", [scores], axis=-1)
+        ctx = b.node("MatMul", [probs, v])
+        ctx = b.node("Transpose", [ctx], perm=perm_heads)
+        ctx = b.node("Reshape", [ctx, shape_merge])
+        att = linear(ctx, p + "attention.output.dense", "attout")
+        x = layer_norm(b.node("Add", [att, x]),
+                       p + "attention.output.LayerNorm")
+        h = b.node("Gelu", [linear(x, p + "intermediate.dense", "ffup")])
+        h = linear(h, p + "output.dense", "ffdown")
+        x = layer_norm(b.node("Add", [h, x]), p + "output.LayerNorm")
+
+    cls = b.node("Gather", [x, init("zero", np.array(0, np.int64))], axis=1)
+    pooled = b.node("Tanh", [linear(cls, "pooler.dense", "pool")])
+    wcls = init("cls.w", np.asarray(state_dict["classifier.weight"],
+                                    np.float32).T)
+    bcls = init("cls.b", np.asarray(state_dict["classifier.bias"], np.float32))
+    b.node("Add", [b.node("MatMul", [pooled, wcls]), bcls],
+           outputs=[output_name])
+    b.output(output_name)
+    return b.build()
+
+
 def _rand_weights_resnet50(num_classes: int, seed: int) -> Dict[str, np.ndarray]:
     rng = np.random.default_rng(seed)
     w: Dict[str, np.ndarray] = {}

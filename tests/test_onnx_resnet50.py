@@ -93,6 +93,40 @@ def test_resnet50_onnx_matches_torch_reference():
     np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
 
 
+def test_bert_onnx_matches_hf_forward():
+    """Transformer ONNX proof: a BertForSequenceClassification graph built
+    from an HF state dict (attention + LayerNormalization + Gelu + Softmax
+    through the ONNX→XLA lowering) matches transformers' own forward,
+    including attention-mask padding."""
+    from transformers import BertConfig, BertForSequenceClassification
+
+    from synapseml_tpu.models.onnx.runner import compile_onnx
+    from synapseml_tpu.models.onnx.zoo import build_bert_classifier
+
+    cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, num_labels=3,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = BertForSequenceClassification(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    mb = build_bert_classifier(sd, num_layers=2, num_heads=4, seq_len=10)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 120, (4, 10))
+    mask = np.ones((4, 10), np.float32)
+    mask[1, 6:] = 0                               # padded row
+    fn = compile_onnx(mb)
+    out = np.asarray(fn(input_ids=ids.astype(np.int64),
+                        attention_mask=mask)["logits"])
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids),
+                 attention_mask=torch.tensor(mask.astype(np.int64))
+                 ).logits.numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-3)
+
+
 def test_resnet50_image_featurizer_headless():
     """ImageFeaturizer-style headless embeddings via slice_at_output
     (ImageFeaturizer.scala:34-270: drop the classifier, emit pooled
