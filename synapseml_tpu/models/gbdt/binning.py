@@ -59,6 +59,138 @@ class BinMapper:
         return float(self.upper_bounds[feature, max(bin_id - 1, 0)])
 
 
+@dataclasses.dataclass
+class FeatureBundler:
+    """Exclusive feature bundling (EFB) over BINNED features.
+
+    LightGBM's answer to sparse/one-hot data (the native ``enable_bundle``
+    machinery behind the config strings of params/BaseTrainParams.scala):
+    features that are rarely non-default simultaneously merge into one
+    bundled column whose bin space concatenates their non-default bins —
+    histogram width drops from O(F·B) to O(bundles·B), which is also this
+    build's densification strategy for one-hot-heavy matrices (SURVEY §7
+    "sparse data" hard part).
+
+    ``bundle_of[f]`` / ``offset_of[f]`` place original feature ``f``;
+    ``owner[b, k]`` inverts a bundled bin back to its original feature so
+    split attributions map home.  Default bins (each feature's most common
+    bin in the fit sample) collapse to bundled bin 0.
+    """
+    bundle_of: np.ndarray        # (F,) int32 bundle id per original feature
+    offset_of: np.ndarray        # (F,) int32 bin offset inside the bundle
+    default_bin: np.ndarray      # (F,) int32 the bin that maps to 0
+    num_bins: np.ndarray         # (n_bundles,) int32 total bins per bundle
+    owner: list                  # per bundle: (total_bins,) int32 orig feature
+    n_features: int
+
+    @property
+    def num_bundles(self) -> int:
+        return len(self.num_bins)
+
+    @staticmethod
+    def fit(binned_sample: np.ndarray, num_bins: np.ndarray,
+            max_total_bins: int = 256,
+            max_conflict_rate: float = 0.0) -> "FeatureBundler":
+        """Greedy conflict-bounded bundling (LightGBM's graph-coloring
+        heuristic): features ordered by non-default density each join the
+        first bundle whose added conflicts stay within
+        ``max_conflict_rate`` of the sample and whose bin budget fits."""
+        n, F = binned_sample.shape
+        default_bin = np.empty(F, np.int32)
+        nondef = np.empty((n, F), bool)
+        for f in range(F):
+            counts = np.bincount(binned_sample[:, f],
+                                 minlength=int(num_bins[f]) + 1)
+            default_bin[f] = int(np.argmax(counts))
+            nondef[:, f] = binned_sample[:, f] != default_bin[f]
+        density = nondef.sum(axis=0)
+        order = np.argsort(-density, kind="stable")
+        budget = int(max_conflict_rate * n)
+
+        bundle_of = np.full(F, -1, np.int32)
+        bundles: list = []          # per bundle: [feature ids]
+        bundle_mask: list = []      # per bundle: rows with any non-default
+        bundle_bins: list = []      # per bundle: current extra-bin total
+        for f in order:
+            extra = int(num_bins[f])          # non-default bins of f (+1 slack)
+            placed = False
+            for bi in range(len(bundles)):
+                conflicts = int(np.sum(bundle_mask[bi] & nondef[:, f]))
+                if conflicts <= budget and \
+                        1 + bundle_bins[bi] + extra <= max_total_bins:
+                    bundles[bi].append(int(f))
+                    bundle_mask[bi] |= nondef[:, f]
+                    bundle_bins[bi] += extra
+                    bundle_of[f] = bi
+                    placed = True
+                    break
+            if not placed:
+                bundles.append([int(f)])
+                bundle_mask.append(nondef[:, f].copy())
+                bundle_bins.append(extra)
+                bundle_of[f] = len(bundles) - 1
+
+        offset_of = np.zeros(F, np.int32)
+        owners = []
+        total = np.zeros(len(bundles), np.int32)
+        for bi, feats in enumerate(bundles):
+            off = 0                            # bundled bin 0 = all-default
+            own = [feats[0]]                   # bin 0 owner: first feature
+            for f in feats:
+                offset_of[f] = off
+                own.extend([f] * int(num_bins[f]))
+                off += int(num_bins[f])
+            total[bi] = off + 1
+            owners.append(np.asarray(own, np.int32))
+        return FeatureBundler(bundle_of=bundle_of, offset_of=offset_of,
+                              default_bin=default_bin, num_bins=total,
+                              owner=owners, n_features=F)
+
+    def transform(self, binned: np.ndarray) -> np.ndarray:
+        """(n, F) original bins → (n, n_bundles) bundled bins.
+
+        A row's bundled bin is the remapped bin of its LAST-ordered
+        non-default feature in the bundle (with max_conflict_rate 0 at most
+        one exists; under allowed conflicts this is the deterministic
+        tie-break)."""
+        n = binned.shape[0]
+        out = np.zeros((n, self.num_bundles), binned.dtype
+                       if binned.dtype.itemsize >= 2 else np.uint16)
+        for f in range(self.n_features):
+            bi = self.bundle_of[f]
+            col = binned[:, f]
+            nd = col != self.default_bin[f]
+            # non-default bins rank 1..num_bins in order, skipping default:
+            # rank = bin + (bin < default ? 1 : 0) keeps ids dense
+            rank = col + np.where(col < self.default_bin[f], 1, 0)
+            vals = self.offset_of[f] + rank
+            out[nd, bi] = vals[nd].astype(out.dtype)
+        return out
+
+    def owner_of_split(self, bundle: int, bundled_bin: int) -> int:
+        """Original feature owning a bundled split bin (importance remap)."""
+        own = self.owner[bundle]
+        return int(own[min(max(bundled_bin, 0), len(own) - 1)])
+
+    def to_dict(self) -> dict:
+        return {"bundle_of": self.bundle_of.tolist(),
+                "offset_of": self.offset_of.tolist(),
+                "default_bin": self.default_bin.tolist(),
+                "num_bins": self.num_bins.tolist(),
+                "owner": [o.tolist() for o in self.owner],
+                "n_features": self.n_features}
+
+    @staticmethod
+    def from_dict(d: dict) -> "FeatureBundler":
+        return FeatureBundler(
+            bundle_of=np.asarray(d["bundle_of"], np.int32),
+            offset_of=np.asarray(d["offset_of"], np.int32),
+            default_bin=np.asarray(d["default_bin"], np.int32),
+            num_bins=np.asarray(d["num_bins"], np.int32),
+            owner=[np.asarray(o, np.int32) for o in d["owner"]],
+            n_features=d["n_features"])
+
+
 def fit_bin_mapper(features: np.ndarray, max_bin: int = 255,
                    sample_count: int = 200_000,
                    seed: int = 0) -> BinMapper:

@@ -33,12 +33,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...parallel.mesh import DATA_AXIS, batch_sharding, replicated
 from . import metrics as metrics_mod
-from .binning import BinMapper, fit_bin_mapper
+from .binning import BinMapper, FeatureBundler, fit_bin_mapper
 from .objectives import (get_objective, initial_score, softmax_grad_hess)
 from .trainer import (GrowthParams, Tree, default_n_slots, grow_tree,
                       grow_tree_depthwise, grow_tree_feature_parallel,
-                      max_nodes, predict_raw_features, stack_trees,
-                      tree_depth)
+                      max_nodes, predict_binned_stacked,
+                      predict_raw_features, stack_trees, tree_depth)
 
 
 @dataclasses.dataclass
@@ -89,6 +89,12 @@ class BoostingConfig:
     #: device pass (fast path); "lossguide": strict best-first leaf-wise
     #: (LightGBM's exact growth order).  voting_parallel implies lossguide.
     growth_policy: str = "depthwise"
+    #: exclusive feature bundling: merge rarely-co-nonzero (binned)
+    #: features into shared columns — the sparse/one-hot densification
+    #: strategy (LightGBM enable_bundle).  Bundled models predict through
+    #: bin space; LightGBM-format export and TreeSHAP are unavailable.
+    enable_bundle: bool = False
+    max_conflict_rate: float = 0.0
     pass_through: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def growth_params(self) -> GrowthParams:
@@ -114,7 +120,8 @@ class Booster:
                  tree_weights: List[float], num_class: int, objective: str,
                  init_score: np.ndarray, bin_mapper: BinMapper,
                  feature_names: List[str], config: BoostingConfig,
-                 best_iteration: int = -1):
+                 best_iteration: int = -1,
+                 bundler: Optional[FeatureBundler] = None):
         self.trees = [Tree(*[np.asarray(a) for a in t]) for t in trees]
         self.tree_class = list(tree_class)
         self.tree_weights = list(tree_weights)
@@ -125,6 +132,7 @@ class Booster:
         self.feature_names = list(feature_names)
         self.config = config
         self.best_iteration = best_iteration
+        self.bundler = bundler
 
     # -- prediction --------------------------------------------------------
     @property
@@ -154,6 +162,12 @@ class Booster:
         features = np.ascontiguousarray(features, np.float32)
         n = features.shape[0]
         depth = self.depth_bound()
+        bundled = None
+        if self.bundler is not None:
+            # EFB models split in bundled-bin space: bin then bundle, and
+            # traverse by split_bin instead of raw thresholds
+            bundled = jnp.asarray(self.bundler.transform(
+                self.bin_mapper.transform(features)).astype(np.int32))
         outs, leaves = [], []
         for k in range(self.num_class):
             stacked = self._stacked_for_class(k, num_iteration)
@@ -162,7 +176,10 @@ class Booster:
                                     np.float32))
                 leaves.append(np.zeros((0, n), np.int32))
                 continue
-            total, lv = predict_raw_features(features, stacked, depth)
+            if bundled is not None:
+                total, lv = predict_binned_stacked(bundled, stacked, depth)
+            else:
+                total, lv = predict_raw_features(features, stacked, depth)
             base = self.init_score[min(k, len(self.init_score) - 1)]
             total = np.asarray(total) + base
             if self.config.boosting_type == "rf":
@@ -204,6 +221,11 @@ class Booster:
 
         Returns (n, F+1) for single-output models, (n, K*(F+1)) for
         multiclass (last slot of each block = bias)."""
+        if self.bundler is not None:
+            raise NotImplementedError(
+                "predict_contrib on EFB-bundled models: bundled splits mix "
+                "several original features per column; train with "
+                "enable_bundle=False for attributions")
         from .shap import has_cover_counts, tree_shap_values
         if not approximate and has_cover_counts(self):
             return tree_shap_values(self, features)
@@ -243,16 +265,19 @@ class Booster:
 
     # -- introspection -----------------------------------------------------
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
-        """Split counts or total gains per feature
-        (getFeatureImportances analogue, LightGBMBooster.scala)."""
+        """Split counts or total gains per ORIGINAL feature
+        (getFeatureImportances analogue, LightGBMBooster.scala); bundled
+        splits map back to the original feature owning the split bin."""
         out = np.zeros(len(self.feature_names), np.float64)
         for t in self.trees:
-            internal = t.split_feature >= 0
-            feats = t.split_feature[internal]
-            if importance_type == "split":
-                np.add.at(out, feats, 1.0)
-            else:
-                np.add.at(out, feats, t.split_gain[internal].astype(np.float64))
+            internal = np.nonzero(np.asarray(t.split_feature) >= 0)[0]
+            for node in internal:
+                f = int(t.split_feature[node])
+                if self.bundler is not None:
+                    f = self.bundler.owner_of_split(f, int(t.split_bin[node]))
+                w = (1.0 if importance_type == "split"
+                     else float(t.split_gain[node]))
+                out[f] += w
         return out
 
     # -- serialization -----------------------------------------------------
@@ -272,6 +297,7 @@ class Booster:
                 "num_bins": self.bin_mapper.num_bins.tolist(),
                 "max_bin": self.bin_mapper.max_bin,
             },
+            "bundler": self.bundler.to_dict() if self.bundler else None,
             "trees": [{f: np.asarray(getattr(t, f)).tolist() for f in Tree._fields}
                       for t in self.trees],
         }
@@ -280,6 +306,11 @@ class Booster:
         """LightGBM text model format (saveToString parity,
         LightGBMBooster.scala:272-284) — loadable by any LightGBM runtime.
         The JSON form (:meth:`to_dict`) remains the internal format."""
+        if self.bundler is not None:
+            raise NotImplementedError(
+                "EFB-bundled models have no LightGBM text representation "
+                "(splits live in bundled-bin space); persist via save()/"
+                "to_dict() or train with enable_bundle=False")
         from .lgbm_format import booster_to_lgbm_string
         return booster_to_lgbm_string(self)
 
@@ -310,9 +341,12 @@ class Booster:
                 node_count=np.asarray(
                     td.get("node_count",
                            np.zeros(len(td["leaf_value"]))), np.float32)))
+        bundler = (FeatureBundler.from_dict(d["bundler"])
+                   if d.get("bundler") else None)
         return Booster(trees, d["tree_class"], d["tree_weights"], d["num_class"],
                        d["objective"], np.asarray(d["init_score"], np.float32),
-                       bm, d["feature_names"], cfg, d["best_iteration"])
+                       bm, d["feature_names"], cfg, d["best_iteration"],
+                       bundler=bundler)
 
     @staticmethod
     def from_string(s: str) -> "Booster":
@@ -739,6 +773,32 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             return bin_columns_u8(mat, mapper.upper_bounds, mapper.max_bin)
         return mapper.transform(mat).astype(np.uint16)
 
+    # exclusive feature bundling: fit on a binned sample, then every
+    # chunk/matrix flows through the bundle remap before device upload
+    bundler = None
+    if config.enable_bundle:
+        if featpar:
+            raise NotImplementedError(
+                "enable_bundle + feature_parallel: bundling changes the "
+                "feature axis per rank; use data_parallel/voting_parallel")
+        if init_model is not None and init_model.bundler is not None:
+            bundler = init_model.bundler
+        else:
+            if source is not None:
+                sample_mat = source.sample_rows(
+                    min(config.bin_sample_count, 50_000), config.seed)
+            else:
+                take = min(n, 50_000)
+                sample_mat = X[:take]
+            bundler = FeatureBundler.fit(
+                bin_host(np.ascontiguousarray(sample_mat, np.float32)),
+                mapper.num_bins, max_total_bins=config.max_bin + 1,
+                max_conflict_rate=config.max_conflict_rate)
+
+    def bin_eff(mat):
+        b = bin_host(mat)
+        return bundler.transform(b) if bundler is not None else b
+
     if mesh is None:
         bins_spec = None
     elif featpar:
@@ -772,20 +832,25 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         # micro-batch push (StreamingPartitionTask analogue): each chunk is
         # binned and shipped independently; the full matrix exists only on
         # DEVICE, assembled by one concatenate — host peak stays O(chunk)
-        dev_chunks = [put(bin_host(cx), 2)
+        dev_chunks = [put_bins(bin_eff(cx))
                       for cx, _, _ in source.iter_chunks()]
         if pad:
-            dev_chunks.append(put(
-                np.zeros((pad, F),
-                         np.uint8 if mapper.max_bin <= 255 else np.uint16), 2))
-        bins_t = finish_bins(
-            jax.jit(lambda *cs: jnp.concatenate(cs))(*dev_chunks))             if len(dev_chunks) > 1 else finish_bins(dev_chunks[0])
-        del dev_chunks
+            pad_f = bundler.num_bundles if bundler is not None else F
+            dev_chunks.append(put_bins(np.zeros(
+                (pad, pad_f),
+                np.uint8 if mapper.max_bin <= 255 else np.uint16)))
+        if len(dev_chunks) > 1:
+            stacked = jax.jit(lambda *cs: jnp.concatenate(cs))(*dev_chunks)
+        else:
+            stacked = dev_chunks[0]
+        bins_t = finish_bins(stacked)
+        del dev_chunks, stacked
     else:
-        binned_small = bin_host(X)
+        binned_small = bin_eff(X)
         if pad:
             binned_small = np.concatenate(
-                [binned_small, np.zeros((pad, F), binned_small.dtype)])
+                [binned_small,
+                 np.zeros((pad, binned_small.shape[1]), binned_small.dtype)])
         bins_t = finish_bins(put_bins(binned_small))
         del binned_small
     measures.binning_s += _time.perf_counter() - _t_bin2
@@ -803,8 +868,15 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     else:
         scores = dev_fill(float(init_sc[0]), (N,) if K == 1 else (N, K))
     init_scores_dev = scores            # rf resets to this every iteration
-    ub_np = mapper.upper_bounds
-    nb_np = mapper.num_bins
+    if bundler is not None:
+        # bundle thresholds live in bin space: raw-value bounds are moot
+        # (predict traverses split_bin); content bins exclude bundled bin 0
+        ub_np = np.zeros((bundler.num_bundles, mapper.upper_bounds.shape[1]),
+                         np.float32)
+        nb_np = (bundler.num_bins - 1).astype(np.int32)
+    else:
+        ub_np = mapper.upper_bounds
+        nb_np = mapper.num_bins
     if Fp != F:                         # padded features: 1 bin, never split
         ub_np = np.concatenate(
             [ub_np, np.full((Fp - F, ub_np.shape[1]), np.inf, np.float32)])
@@ -876,7 +948,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if have_valid:
         Xv, yv, wv = valid
         Xv = np.ascontiguousarray(Xv, np.float32)
-        binned_v = jnp.asarray(np.ascontiguousarray(mapper.transform(Xv).T))
+        binned_v = jnp.asarray(np.ascontiguousarray(
+            bin_eff(Xv).astype(np.int32).T))
         yv = (np.asarray(yv) > 0).astype(np.float32) if config.objective == "binary" \
             else np.asarray(yv, np.float32)
         # contributions accumulate separately from the init margin so rf can
@@ -898,6 +971,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             metric_fn, larger_better = metrics_mod.METRICS.get(
                 metric_name, metrics_mod.METRICS["l2"])
 
+    F_eff = bundler.num_bundles if bundler is not None else F
+    Fp_eff = F_eff if bundler is not None else Fp
     measures.data_prep_s = _time.perf_counter() - _t_prep
     _t_train = _time.perf_counter()
     trees: List[Tree] = []
@@ -935,13 +1010,13 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         bag_key = jax.random.fold_in(bag_root_key,
                                      it // max(config.bagging_freq, 1))
         if config.feature_fraction < 1.0:
-            k = max(1, int(round(F * config.feature_fraction)))
-            feature_mask = np.zeros(Fp, bool)      # padded features stay off
-            feature_mask[rng.choice(F, k, replace=False)] = True
+            k = max(1, int(round(F_eff * config.feature_fraction)))
+            feature_mask = np.zeros(Fp_eff, bool)  # padded features stay off
+            feature_mask[rng.choice(F_eff, k, replace=False)] = True
             fmask_dev = None
         elif fmask_dev is None:
-            feature_mask = np.zeros(Fp, bool)
-            feature_mask[:F] = True
+            feature_mask = np.zeros(Fp_eff, bool)
+            feature_mask[:F_eff] = True
         if fmask_dev is None:
             fmask_dev = jnp.asarray(feature_mask)
             if featpar:
@@ -1052,7 +1127,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             _write_checkpoint(checkpoint_dir, Booster(
                 pre_t + trees, pre_c + tree_class, pre_w + tree_weights,
                 K, config.objective, init_sc, mapper, feature_names,
-                config))
+                config, bundler=bundler))
 
     # deferred mode: one sync for the whole run, then download every tree in
     # ONE transfer per field (T, K, M) — per-stack downloads pay a tunnel/PCIe
@@ -1076,7 +1151,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     measures.total_s = _time.perf_counter() - _t0
     booster = Booster(trees, tree_class, tree_weights, K, config.objective,
                       init_sc, mapper, feature_names, config,
-                      best_iteration=best_iter)
+                      best_iteration=best_iter, bundler=bundler)
     booster.measures = measures
     return booster, eval_history
 

@@ -81,6 +81,14 @@ class GBDTParams(Params):
         default="data_parallel",
         allowed=("data_parallel", "voting_parallel", "feature_parallel"))
     topK = IntParam(doc="voting-parallel top features per shard", default=20)
+    enableBundle = BoolParam(
+        doc="exclusive feature bundling: merge rarely-co-nonzero features "
+            "into shared histogram columns (sparse/one-hot densification; "
+            "LightGBM enable_bundle). Bundled models predict via bin "
+            "space; SHAP and LightGBM-format export are unavailable",
+        default=False)
+    maxConflictRate = FloatParam(doc="EFB allowed conflict fraction",
+                                 default=0.0)
     checkpointDir = StringParam(
         doc="iteration-checkpoint directory: training saves the partial "
             "booster every checkpointInterval iterations and a re-fit "
@@ -125,6 +133,8 @@ class GBDTParams(Params):
             skip_drop=self.skipDrop,
             parallelism=self.parallelism,
             top_k=self.topK,
+            enable_bundle=self.enableBundle,
+            max_conflict_rate=self.maxConflictRate,
         )
         for k, v in extra.items():
             if hasattr(cfg, k):

@@ -857,6 +857,32 @@ def predict_binned(binned, tree: Tree, depth_bound: int):
 
 
 @functools.partial(jax.jit, static_argnames=("depth_bound",))
+def predict_binned_stacked(binned, trees_stacked: Tree, depth_bound: int):
+    """Sum of all trees' outputs on BINNED features (N, F) — the predict
+    path for EFB-bundled models, whose splits live in bin space (bundled
+    thresholds have no raw-value meaning)."""
+    N = binned.shape[0]
+    rows = jnp.arange(N)
+
+    def one_tree(carry, t: Tree):
+        def step(_, node):
+            feat = t.split_feature[node]
+            is_leaf = feat < 0
+            f = jnp.maximum(feat, 0)
+            go_left = binned[rows, f] <= t.split_bin[node]
+            child = jnp.where(go_left, t.left_child[node],
+                              t.right_child[node])
+            return jnp.where(is_leaf, node, child)
+
+        leaf = lax.fori_loop(0, depth_bound, step, jnp.zeros(N, jnp.int32))
+        return carry + t.leaf_value[leaf], leaf
+
+    total, leaves = lax.scan(one_tree, jnp.zeros(N, jnp.float32),
+                             trees_stacked)
+    return total, leaves
+
+
+@functools.partial(jax.jit, static_argnames=("depth_bound",))
 def predict_raw_features(features, trees_stacked: Tree, depth_bound: int):
     """Sum of all trees' outputs on raw float features — the batched
     replacement for the reference's per-row JNI predict

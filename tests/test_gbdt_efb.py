@@ -1,0 +1,132 @@
+"""Exclusive feature bundling (EFB) — sparse/one-hot densification.
+
+The reference's native engine bundles mutually-exclusive features before
+histogram construction (LightGBM enable_bundle behind the config strings of
+params/BaseTrainParams.scala); SURVEY §7 flags sparse data as a TPU hard
+part ("TPUs want dense — need a densification/bucketing strategy").  EFB is
+that strategy: one-hot blocks collapse into shared histogram columns.
+"""
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.models.gbdt import (Booster, BoostingConfig,
+                                       GBDTClassifier, train)
+from synapseml_tpu.models.gbdt.binning import FeatureBundler, fit_bin_mapper
+from synapseml_tpu.models.gbdt.metrics import auc
+
+
+def onehot_data(n=3000, n_cats=6, levels=10, n_dense=4, seed=0):
+    """One-hot-heavy matrix: 6 categorical vars x 10 levels + 4 dense."""
+    rng = np.random.default_rng(seed)
+    cats = rng.integers(0, levels, (n, n_cats))
+    dense = rng.normal(size=(n, n_dense)).astype(np.float32)
+    oh = np.zeros((n, n_cats * levels), np.float32)
+    for c in range(n_cats):
+        oh[np.arange(n), c * levels + cats[:, c]] = 1.0
+    X = np.concatenate([oh, dense], axis=1)
+    logit = ((cats[:, 0] < 3).astype(np.float32) * 2.0
+             - (cats[:, 1] > 6).astype(np.float32) * 1.5
+             + dense[:, 0])
+    y = (logit + rng.normal(scale=0.4, size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def test_bundler_collapses_onehot_blocks():
+    X, y = onehot_data()
+    mapper = fit_bin_mapper(X, max_bin=255)
+    binned = mapper.transform(X)
+    b = FeatureBundler.fit(binned[:2000], mapper.num_bins)
+    # 60 mutually-exclusive-ish one-hot columns + 4 dense shrink far below F
+    assert b.num_bundles < X.shape[1] // 3, b.num_bundles
+    out = b.transform(binned[:100])
+    assert out.shape == (100, b.num_bundles)
+    # round trip invariant: every non-default original bin is recoverable
+    # through the owner table
+    for r in range(20):
+        for bi in range(b.num_bundles):
+            bb = int(out[r, bi])
+            if bb > 0:
+                f = b.owner_of_split(bi, bb)
+                assert binned[r, f] != b.default_bin[f]
+
+
+def test_efb_quality_matches_unbundled():
+    X, y = onehot_data()
+    kw = dict(objective="binary", num_iterations=25, num_leaves=15,
+              learning_rate=0.2, min_data_in_leaf=5)
+    b_plain, _ = train(X[:2400], y[:2400], BoostingConfig(**kw))
+    b_efb, _ = train(X[:2400], y[:2400],
+                     BoostingConfig(enable_bundle=True, **kw))
+    assert b_efb.bundler is not None
+    a_plain = auc(y[2400:], b_plain.predict_margin(X[2400:]))
+    a_efb = auc(y[2400:], b_efb.predict_margin(X[2400:]))
+    assert a_efb > a_plain - 0.02, (a_plain, a_efb)
+
+
+def test_efb_serialization_and_importance():
+    X, y = onehot_data(n=1500)
+    cfg = BoostingConfig(objective="binary", num_iterations=8, num_leaves=15,
+                         min_data_in_leaf=5, enable_bundle=True)
+    b, _ = train(X, y, cfg)
+    # JSON round trip carries the bundler; predictions identical
+    b2 = Booster.from_dict(b.to_dict())
+    np.testing.assert_allclose(b.predict_margin(X[:256]),
+                               b2.predict_margin(X[:256]), atol=1e-6)
+    # importance lands on ORIGINAL features; informative block dominates
+    fi = b.feature_importance("split")
+    assert fi.shape == (X.shape[1],)
+    informative = fi[:10].sum() + fi[10:20].sum() + fi[60]
+    assert informative > fi.sum() * 0.5
+    # unsupported surfaces fail loudly
+    with pytest.raises(NotImplementedError, match="bundle"):
+        b.to_string()
+    with pytest.raises(NotImplementedError, match="bundle"):
+        b.predict_contrib(X[:4])
+
+
+def test_efb_distributed_and_valid():
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = onehot_data(n=2000)
+    cfg = BoostingConfig(objective="binary", num_iterations=6, num_leaves=15,
+                         min_data_in_leaf=5, enable_bundle=True,
+                         early_stopping_round=3)
+    b1, h1 = train(X[:1600], y[:1600], cfg,
+                   valid=(X[1600:], y[1600:], None))
+    assert h1                                     # eval ran on bundled bins
+    b8, _ = train(X[:1600], y[:1600], cfg, mesh=data_parallel_mesh(8))
+    np.testing.assert_allclose(
+        b1.predict_margin(X[:512], num_iteration=4),
+        b8.predict_margin(X[:512], num_iteration=4), atol=1e-4)
+
+
+def test_efb_estimator_param():
+    X, y = onehot_data(n=1200)
+    ds = Dataset({"features": list(X), "label": y})
+    clf = GBDTClassifier(numIterations=10, numLeaves=15, minDataInLeaf=5,
+                         enableBundle=True, numShards=1)
+    model = clf.fit(ds)
+    assert model.booster.bundler is not None
+    out = model.transform(ds)
+    assert auc(y, np.stack(list(out["probability"]))[:, 1]) > 0.9
+
+
+def test_efb_streaming_matches_in_memory(tmp_path):
+    """Bundling composes with out-of-core ingestion: chunks flow through
+    the bundle remap before upload, and the streamed model equals the
+    in-memory one on the same data."""
+    from synapseml_tpu.io import ChunkedColumnSource, write_matrix
+
+    X, y = onehot_data(n=4000, seed=2)
+    p = str(tmp_path / "d.smlc")
+    write_matrix(p, np.concatenate([X, y[:, None].astype(np.float32)],
+                                   axis=1))
+    src = ChunkedColumnSource(p, label_col=X.shape[1], chunk_rows=1024)
+    cfg = BoostingConfig(objective="binary", num_iterations=6, num_leaves=15,
+                         min_data_in_leaf=5, enable_bundle=True)
+    b_stream, _ = train(src, None, cfg)
+    b_mem, _ = train(X, y, cfg)
+    assert b_stream.bundler.num_bundles == b_mem.bundler.num_bundles
+    np.testing.assert_allclose(b_stream.predict_margin(X[:512]),
+                               b_mem.predict_margin(X[:512]), atol=1e-5)
