@@ -49,6 +49,8 @@ SLOW_TESTS = {
     "test_moe_expert_parallel_training",
     "test_deep_text_classifier_learns",
     "test_deep_text_classifier_zero1_flag",
+    "test_deep_text_classifier_remat_flag",
+    "test_remat_identical_gradients",
     "test_text_model_save_load",
     "test_deep_text_nondefault_labels",
     "test_moe_matches_dense_structure",

@@ -272,3 +272,44 @@ def test_ring_attention_long_sequence():
     p /= p.sum(-1, keepdims=True)
     ref = np.einsum("bhqk,bkhd->bqhd", p, v)
     np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_remat_identical_gradients():
+    """gradientCheckpointing (jax.checkpoint over encoder blocks) changes
+    memory, not math: loss and gradients are bit-identical."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.dl.transformer import (TextEncoder,
+                                                     TransformerConfig)
+
+    ids = np.random.default_rng(0).integers(0, 1024, (4, 16))
+    mask = np.ones((4, 16), bool)
+    results = {}
+    for remat in (False, True):
+        cfg = TransformerConfig.tiny(remat=remat, dropout_rate=0.0,
+                                     dtype=jnp.float32)
+        m = TextEncoder(cfg)
+        v = m.init(jax.random.PRNGKey(0), jnp.asarray(ids), jnp.asarray(mask))
+
+        def loss(p):
+            return jnp.sum(m.apply({"params": p}, jnp.asarray(ids),
+                                   jnp.asarray(mask)) ** 2)
+
+        results[remat] = jax.value_and_grad(loss)(v["params"])
+    assert np.isclose(results[False][0], results[True][0])
+    for a, b in zip(jax.tree.leaves(results[False][1]),
+                    jax.tree.leaves(results[True][1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_deep_text_classifier_remat_flag():
+    texts = ["good day"] * 20 + ["bad day"] * 20
+    labels = np.array([1.0] * 20 + [0.0] * 20)
+    ds = Dataset({"text": texts, "label": labels})
+    clf = DeepTextClassifier(modelSize="tiny", batchSize=8, maxEpochs=2,
+                             numDevices=1, gradientCheckpointing=True,
+                             maxTokenLen=8)
+    model = clf.fit(ds)
+    out = model.transform(ds)
+    assert "prediction" in out.columns
