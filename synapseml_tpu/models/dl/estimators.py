@@ -262,12 +262,12 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         n = len(labels)
         total_steps = num_minibatches(n, self.batchSize, shards) * self.maxEpochs
 
-        if ckpt_cfg is not None:
-            cfg = dataclasses.replace(
-                ckpt_cfg, num_classes=num_classes,
-                remat=bool(self.gradientCheckpointing))
-        else:
-            cfg = self._model_config(num_classes)
+        base_cfg = (ckpt_cfg if ckpt_cfg is not None
+                    else self._model_config(num_classes))
+        # estimator-level overrides applied once, whichever branch built
+        # the config (the checkpoint path carries the pretrained dims)
+        cfg = dataclasses.replace(base_cfg, num_classes=num_classes,
+                                  remat=bool(self.gradientCheckpointing))
         model = TextEncoder(cfg)
         trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
                             zero1=bool(self.zero1))
