@@ -33,6 +33,11 @@ class BinMapper:
     upper_bounds: np.ndarray          # (F, max_bin) float32
     num_bins: np.ndarray              # (F,) int32
     max_bin: int
+    #: categorical features: {feature index: (sorted raw values, bin ids)}
+    #: — bin ids are target-statistic ordered (LightGBM's sorted-by-G/H
+    #: idea applied at binning time), so range splits in bin space act as
+    #: category-subset splits; unseen categories land in bin 0
+    cat_features: Optional[dict] = None
 
     @property
     def num_features(self) -> int:
@@ -42,12 +47,24 @@ class BinMapper:
     def total_bins(self) -> int:      # content bins + missing bin
         return self.max_bin + 1
 
+    @property
+    def has_categorical(self) -> bool:
+        return bool(self.cat_features)
+
     def transform(self, features: np.ndarray) -> np.ndarray:
         """Map raw (n, F) floats → (n, F) int32 bins ∈ [0, max_bin]."""
         n, f = features.shape
         out = np.empty((n, f), np.int32)
+        cat = self.cat_features or {}
         for j in range(f):
             col = features[:, j]
+            if j in cat:
+                vals, bins = cat[j]
+                idx = np.searchsorted(vals, col)
+                idx_c = np.minimum(idx, len(vals) - 1)
+                hit = (len(vals) > 0) & (vals[idx_c] == col)
+                out[:, j] = np.where(hit, bins[idx_c], MISSING_BIN)
+                continue
             # searchsorted over this feature's bounds; bin ids are 1-based
             idx = np.searchsorted(self.upper_bounds[j], col, side="left")
             out[:, j] = np.minimum(idx, self.max_bin - 1) + 1
@@ -193,24 +210,66 @@ class FeatureBundler:
 
 def fit_bin_mapper(features: np.ndarray, max_bin: int = 255,
                    sample_count: int = 200_000,
-                   seed: int = 0) -> BinMapper:
+                   seed: int = 0,
+                   categorical_features=None,
+                   y: Optional[np.ndarray] = None) -> BinMapper:
     """Compute quantile bin boundaries from a row sample.
 
     Mirrors the reference's sampled dataset creation
     (LGBM_DatasetCreateFromSampledColumn, StreamingPartitionTask.scala:374):
     sample rows, per-feature quantiles as boundaries, dedup to distinct
     values when a feature has few uniques.
+
+    ``categorical_features``: feature indexes treated as category codes
+    (the reference's categoricalSlotIndexes param,
+    params/LightGBMParams.scala).  Their bins are ordered by the mean of
+    ``y`` per category when labels are provided — the sorted-by-target-
+    statistic trick that lets monotone bin-range splits act like
+    LightGBM's category-subset splits — else by value; categories beyond
+    ``max_bin`` (rarest first) and unseen ones fall into bin 0.
     """
     n, f = features.shape
     if n > sample_count:
         rng = np.random.default_rng(seed)
-        sample = features[rng.choice(n, sample_count, replace=False)]
+        pick = rng.choice(n, sample_count, replace=False)
+        sample = features[pick]
+        y_sample = None if y is None else np.asarray(y)[pick]
     else:
         sample = features
+        y_sample = None if y is None else np.asarray(y)
     upper = np.full((f, max_bin), np.inf, np.float32)
     nbins = np.zeros(f, np.int32)
+    cat_set = set(int(c) for c in (categorical_features or []))
+    cat_out: dict = {}
     for j in range(f):
         col = sample[:, j]
+        if j in cat_set:
+            valid = ~np.isnan(col)
+            vals, inv, counts = np.unique(col[valid], return_inverse=True,
+                                          return_counts=True)
+            if len(vals) > max_bin:      # keep the most frequent max_bin
+                keep = np.sort(np.argsort(-counts)[:max_bin])
+                remap = np.full(len(vals), -1)
+                remap[keep] = np.arange(len(keep))
+                mask = remap[inv] >= 0
+                vals, inv, counts = (vals[keep],
+                                     remap[inv][mask],
+                                     counts[keep])
+                yv = (y_sample[valid][mask]
+                      if y_sample is not None else None)
+            else:
+                yv = y_sample[valid] if y_sample is not None else None
+            if yv is not None and len(vals):
+                sums = np.bincount(inv, weights=yv, minlength=len(vals))
+                order = np.argsort(sums / np.maximum(counts, 1),
+                                   kind="stable")
+            else:
+                order = np.arange(len(vals))
+            bins = np.empty(len(vals), np.int32)
+            bins[order] = np.arange(1, len(vals) + 1)
+            cat_out[j] = (vals.astype(np.float32), bins)
+            nbins[j] = len(vals)
+            continue
         col = col[~np.isnan(col)]
         if col.size == 0:
             nbins[j] = 1
@@ -228,4 +287,5 @@ def fit_bin_mapper(features: np.ndarray, max_bin: int = 255,
             k = len(bounds)
             upper[j, :k] = bounds
             nbins[j] = k + 1
-    return BinMapper(upper_bounds=upper, num_bins=nbins, max_bin=max_bin)
+    return BinMapper(upper_bounds=upper, num_bins=nbins, max_bin=max_bin,
+                     cat_features=cat_out or None)

@@ -95,6 +95,11 @@ class BoostingConfig:
     #: bin space; LightGBM-format export and TreeSHAP are unavailable.
     enable_bundle: bool = False
     max_conflict_rate: float = 0.0
+    #: feature indexes holding category codes (categoricalSlotIndexes,
+    #: params/LightGBMParams.scala): binned by target-statistic order so
+    #: bin-range splits act as category-subset splits; such models predict
+    #: through bin space (no raw-threshold semantics)
+    categorical_feature: Optional[List[int]] = None
     pass_through: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def growth_params(self) -> GrowthParams:
@@ -163,11 +168,13 @@ class Booster:
         n = features.shape[0]
         depth = self.depth_bound()
         bundled = None
-        if self.bundler is not None:
-            # EFB models split in bundled-bin space: bin then bundle, and
-            # traverse by split_bin instead of raw thresholds
-            bundled = jnp.asarray(self.bundler.transform(
-                self.bin_mapper.transform(features)).astype(np.int32))
+        if self.bundler is not None or self.bin_mapper.has_categorical:
+            # EFB/categorical models split in bin space: bin (and bundle),
+            # then traverse by split_bin instead of raw thresholds
+            binned = self.bin_mapper.transform(features)
+            if self.bundler is not None:
+                binned = self.bundler.transform(binned)
+            bundled = jnp.asarray(binned.astype(np.int32))
         outs, leaves = [], []
         for k in range(self.num_class):
             stacked = self._stacked_for_class(k, num_iteration)
@@ -221,11 +228,12 @@ class Booster:
 
         Returns (n, F+1) for single-output models, (n, K*(F+1)) for
         multiclass (last slot of each block = bias)."""
-        if self.bundler is not None:
+        if self.bundler is not None or self.bin_mapper.has_categorical:
             raise NotImplementedError(
-                "predict_contrib on EFB-bundled models: bundled splits mix "
-                "several original features per column; train with "
-                "enable_bundle=False for attributions")
+                "predict_contrib needs raw-threshold trees: EFB-bundled "
+                "and categorical models split in bin space — train with "
+                "enable_bundle=False and without categorical_feature for "
+                "attributions")
         from .shap import has_cover_counts, tree_shap_values
         if not approximate and has_cover_counts(self):
             return tree_shap_values(self, features)
@@ -296,6 +304,10 @@ class Booster:
                 "upper_bounds": self.bin_mapper.upper_bounds.tolist(),
                 "num_bins": self.bin_mapper.num_bins.tolist(),
                 "max_bin": self.bin_mapper.max_bin,
+                "cat_features": {
+                    str(f): [v.tolist(), b.tolist()]
+                    for f, (v, b) in (self.bin_mapper.cat_features or {}).items()
+                } or None,
             },
             "bundler": self.bundler.to_dict() if self.bundler else None,
             "trees": [{f: np.asarray(getattr(t, f)).tolist() for f in Tree._fields}
@@ -306,11 +318,11 @@ class Booster:
         """LightGBM text model format (saveToString parity,
         LightGBMBooster.scala:272-284) — loadable by any LightGBM runtime.
         The JSON form (:meth:`to_dict`) remains the internal format."""
-        if self.bundler is not None:
+        if self.bundler is not None or self.bin_mapper.has_categorical:
             raise NotImplementedError(
-                "EFB-bundled models have no LightGBM text representation "
-                "(splits live in bundled-bin space); persist via save()/"
-                "to_dict() or train with enable_bundle=False")
+                "EFB-bundled/categorical models have no LightGBM text "
+                "representation here (splits live in bin space); persist "
+                "via save()/to_dict()")
         from .lgbm_format import booster_to_lgbm_string
         return booster_to_lgbm_string(self)
 
@@ -319,10 +331,15 @@ class Booster:
         cfg_d = dict(d["config"])
         cfg = BoostingConfig(**{k: v for k, v in cfg_d.items()
                                 if k in {f.name for f in dataclasses.fields(BoostingConfig)}})
+        cat_raw = d["bin_mapper"].get("cat_features")
         bm = BinMapper(
             upper_bounds=np.asarray(d["bin_mapper"]["upper_bounds"], np.float32),
             num_bins=np.asarray(d["bin_mapper"]["num_bins"], np.int32),
-            max_bin=d["bin_mapper"]["max_bin"])
+            max_bin=d["bin_mapper"]["max_bin"],
+            cat_features={int(f): (np.asarray(v, np.float32),
+                                   np.asarray(b, np.int32))
+                          for f, (v, b) in cat_raw.items()}
+            if cat_raw else None)
         trees = []
         for td in d["trees"]:
             trees.append(Tree(
@@ -665,14 +682,19 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if init_model is not None and not _placeholder_mapper(init_model.bin_mapper):
         mapper = init_model.bin_mapper
     elif source is not None:
+        # streamed samples carry no aligned labels: categorical bins order
+        # by value instead of target statistic (documented fallback)
         mapper = fit_bin_mapper(
             source.sample_rows(config.bin_sample_count, config.seed),
             config.max_bin, sample_count=config.bin_sample_count,
-            seed=config.seed)
+            seed=config.seed,
+            categorical_features=config.categorical_feature)
     else:
         mapper = fit_bin_mapper(X, config.max_bin,
                                 sample_count=config.bin_sample_count,
-                                seed=config.seed)
+                                seed=config.seed,
+                                categorical_features=config.categorical_feature,
+                                y=np.asarray(y, np.float64))
     measures.binning_s = _time.perf_counter() - _t0
     _t_prep = _time.perf_counter()
 
@@ -768,6 +790,12 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     _t_bin2 = _time.perf_counter()
 
     def bin_host(mat):
+        if mapper.has_categorical:
+            # categorical LUTs live in the python mapper; the native fast
+            # path handles the numeric-only common case
+            out = mapper.transform(mat)
+            return out.astype(np.uint8 if mapper.max_bin <= 255
+                              else np.uint16)
         if mapper.max_bin <= 255:
             from ...native import bin_columns_u8
             return bin_columns_u8(mat, mapper.upper_bounds, mapper.max_bin)

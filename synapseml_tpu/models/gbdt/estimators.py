@@ -89,6 +89,11 @@ class GBDTParams(Params):
         default=False)
     maxConflictRate = FloatParam(doc="EFB allowed conflict fraction",
                                  default=0.0)
+    categoricalSlotIndexes = ListParam(
+        doc="feature-vector slots holding category codes "
+            "(categoricalSlotIndexes parity, params/LightGBMParams.scala): "
+            "binned in target-statistic order so bin-range splits act as "
+            "category-subset splits")
     checkpointDir = StringParam(
         doc="iteration-checkpoint directory: training saves the partial "
             "booster every checkpointInterval iterations and a re-fit "
@@ -135,6 +140,8 @@ class GBDTParams(Params):
             top_k=self.topK,
             enable_bundle=self.enableBundle,
             max_conflict_rate=self.maxConflictRate,
+            categorical_feature=[int(i) for i in self.categoricalSlotIndexes]
+            if self.get("categoricalSlotIndexes") else None,
         )
         for k, v in extra.items():
             if hasattr(cfg, k):
