@@ -671,6 +671,42 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     else:
         X = np.ascontiguousarray(X, np.float32)
         n, F = X.shape
+
+    # distributed lambdarank: pack WHOLE groups onto shards up front (the
+    # reference's query-rows-share-a-partition rule); rows permute into
+    # per-shard slabs padded to a common length, lambdas stay shard-local
+    lr_pack = None
+    if config.objective == "lambdarank" and mesh is not None:
+        if group is None:
+            raise ValueError("lambdarank requires group sizes (groupCol)")
+        if source is not None:
+            raise NotImplementedError(
+                "streamed + distributed lambdarank is not supported; "
+                "materialize the ranking frame")
+        if config.parallelism != "data_parallel":
+            raise NotImplementedError(
+                "distributed lambdarank runs data_parallel (whole groups "
+                "per shard)")
+        from .pallas_hist import hist_pad_multiple
+        from .ranking import pack_groups_for_shards
+        _shards = mesh.shape[DATA_AXIS]
+        _B = config.max_bin + 1
+        _unit = (hist_pad_multiple()
+                 if (jax.default_backend() == "tpu" and _B <= 512
+                     and _B % 8 == 0) else 1)
+        perm, _sq, _smask, _L = pack_groups_for_shards(
+            np.asarray(group), _shards, _unit, max_group_size=128)
+        _valid = (perm >= 0)
+        pc = np.maximum(perm, 0)
+        X = X[pc]
+        X[~_valid] = np.nan        # pads must not shift the bin quantiles
+        y = np.asarray(y)[pc] * _valid
+        sw = (np.asarray(sample_weight, np.float32)[pc]
+              if sample_weight is not None
+              else np.ones(len(pc), np.float32))
+        sample_weight = (sw * _valid).astype(np.float32)
+        n = len(X)
+        lr_pack = (_sq, _smask, _L, _valid)
     K = config.num_class if config.objective in ("multiclass", "multiclassova") else 1
     feature_names = list(feature_names) if feature_names else [f"f{i}" for i in range(F)]
     rng = np.random.default_rng(config.seed)
@@ -930,18 +966,21 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if config.objective == "lambdarank":
         if group is None:
             raise ValueError("lambdarank requires group sizes (groupCol)")
-        if mesh is not None:
-            raise NotImplementedError(
-                "distributed lambdarank requires whole groups per shard; "
-                "train single-shard (the reference similarly requires a "
-                "query's rows to share a partition)")
-        from .ranking import build_group_index, make_lambdarank_objective
-        qidx, qmask = build_group_index(np.asarray(group))
-        objective_fn = make_lambdarank_objective(
-            qidx, qmask, labels_np, n_rows=n + pad, sigma=1.0,
-            max_position=config.max_position,
-            label_gain=np.asarray(config.label_gain, np.float32)
-            if config.label_gain else None)
+        from .ranking import (build_group_index, make_lambdarank_objective,
+                              make_lambdarank_objective_sharded)
+        lg_arr = (np.asarray(config.label_gain, np.float32)
+                  if config.label_gain else None)
+        if lr_pack is not None:
+            _sq, _smask, _L, _ = lr_pack
+            objective_fn = make_lambdarank_objective_sharded(
+                _sq, _smask, n_rows_local=_L, axis_name=DATA_AXIS,
+                sigma=1.0, max_position=config.max_position,
+                label_gain=lg_arr)
+        else:
+            qidx, qmask = build_group_index(np.asarray(group))
+            objective_fn = make_lambdarank_objective(
+                qidx, qmask, n_rows=n + pad, sigma=1.0,
+                max_position=config.max_position, label_gain=lg_arr)
     elif K == 1:
         # cached factory -> stable function identity, so the _make_step
         # cache hits across train() calls even with objective kwargs
@@ -1013,6 +1052,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
     rf_denominator = 0
     bag = np.ones(N, np.float32)
+    if lr_pack is not None:
+        bag = lr_pack[3].astype(np.float32)     # pad rows interspersed
     if pad:
         bag[n:] = 0.0
     # tunnel/PCIe round trips dominate small-step training: dart, per-iter

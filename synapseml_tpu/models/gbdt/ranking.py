@@ -2,16 +2,22 @@
 
 The reference delegates 'lambdarank' to native LightGBM and only handles
 group-column plumbing (reference: LightGBMRanker.scala; groupCol cast in
-LightGBMBase.scala prepareDataframe).  Here the pairwise lambda computation
-is a jitted padded-group kernel:
+LightGBMBase.scala prepareDataframe), with the constraint that a query's
+rows share a partition.  Here the pairwise lambda computation is a jitted
+padded-group kernel:
 
 rows are laid out group-contiguously and padded into a (num_groups,
 max_group_size) index grid; each objective call computes all pairwise
 lambdas within groups (O(Q·D²), vectorized on the VPU) and scatters
 grad/hess back to flat rows.  Groups larger than ``max_group_size`` are
-truncated (LightGBM similarly truncates via truncation_level).  Like the
-reference — which requires a query's rows to share a partition — the
-distributed path requires whole groups per shard.
+truncated (LightGBM similarly truncates via truncation_level).
+
+Distributed training mirrors the reference's partition rule: whole groups
+pack onto shards (greedy largest-first onto the lightest shard,
+:func:`pack_groups_for_shards`), each shard's slab pads to a common row
+count, and the shard-aware objective selects its own group grid by
+``lax.axis_index`` inside ``shard_map`` — lambdas never cross shards, the
+histogram psum is the only communication.
 """
 
 from __future__ import annotations
@@ -21,6 +27,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def build_group_index(group_sizes: np.ndarray,
@@ -38,24 +45,56 @@ def build_group_index(group_sizes: np.ndarray,
     return qidx.astype(np.int32), (qidx >= 0)
 
 
-def make_lambdarank_objective(qidx: np.ndarray, mask: np.ndarray,
-                              labels: np.ndarray, n_rows: int,
-                              sigma: float = 1.0,
-                              max_position: int = 10,
-                              label_gain: Optional[np.ndarray] = None):
-    """Build (scores, labels, weights) -> (grad, hess) closing over the
-    group structure. NDCG-weighted pairwise lambdas (LambdaMART)."""
-    qidx_j = jnp.asarray(qidx)
-    mask_j = jnp.asarray(mask, jnp.float32)
-    safe_idx = jnp.maximum(qidx_j, 0)
-    lab = jnp.asarray(labels, jnp.float32)[safe_idx] * mask_j      # (Q, D)
-    if label_gain is None:
-        gains = (2.0 ** lab - 1.0) * mask_j
-    else:
-        lg = jnp.asarray(label_gain, jnp.float32)
-        gains = lg[jnp.clip(lab.astype(jnp.int32), 0, len(label_gain) - 1)] * mask_j
+def pack_groups_for_shards(group_sizes: np.ndarray, shards: int,
+                           row_unit: int = 1, max_group_size: int = 128):
+    """Assign WHOLE groups to shards and lay rows out slab-contiguously.
 
-    # max DCG per group (ideal ordering, truncated at max_position)
+    Greedy balance: largest group first onto the lightest shard; each
+    shard's slab pads to the common length L (a multiple of ``row_unit``,
+    the pallas chunk when active).  Returns
+    ``(perm, stacked_qidx, stacked_mask, L)`` where ``perm`` (shards·L,)
+    holds original row indices (-1 ⇒ pad row) and ``stacked_qidx``
+    (shards, Qmax, D) indexes each shard's LOCAL rows.
+    """
+    sizes = np.asarray(group_sizes, np.int64)
+    if sizes.max() > max_group_size:
+        pass                       # oversized groups truncate in the grid
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    order = np.argsort(-sizes, kind="stable")
+    shard_groups: list = [[] for _ in range(shards)]
+    shard_rows = np.zeros(shards, np.int64)
+    for g in order:
+        s = int(np.argmin(shard_rows))
+        shard_groups[s].append(int(g))
+        shard_rows[s] += sizes[g]
+    L = int(-(-max(int(shard_rows.max()), 1) // row_unit) * row_unit)
+    D = min(int(sizes.max()), max_group_size)
+    Qmax = max(len(gs) for gs in shard_groups) or 1
+
+    perm = np.full(shards * L, -1, np.int64)
+    qidx = np.full((shards, Qmax, D), -1, np.int64)
+    for s, gs in enumerate(shard_groups):
+        pos = 0
+        for qi, g in enumerate(sorted(gs)):    # stable within-shard order
+            gsz = int(sizes[g])
+            take = min(gsz, D)
+            perm[s * L + pos: s * L + pos + gsz] = \
+                np.arange(starts[g], starts[g] + gsz)
+            qidx[s, qi, :take] = pos + np.arange(take)
+            pos += gsz
+    return perm, qidx.astype(np.int32), (qidx >= 0), L
+
+
+def _lambda_grads(scores, labels, safe_idx, mask, n_rows, sigma,
+                  max_position, label_gain):
+    """Pairwise NDCG-weighted lambdas for one (Q, D) group grid."""
+    lab = labels[safe_idx] * mask                                   # (Q, D)
+    if label_gain is None:
+        gains = (2.0 ** lab - 1.0) * mask
+    else:
+        gains = label_gain[jnp.clip(lab.astype(jnp.int32), 0,
+                                    len(label_gain) - 1)] * mask
+
     D = lab.shape[1]
     sorted_gains = -jnp.sort(-gains, axis=1)
     disc_ideal = 1.0 / jnp.log2(jnp.arange(2, D + 2, dtype=jnp.float32))
@@ -63,35 +102,76 @@ def make_lambdarank_objective(qidx: np.ndarray, mask: np.ndarray,
     max_dcg = jnp.sum(sorted_gains * disc_ideal * trunc, axis=1)    # (Q,)
     inv_max_dcg = jnp.where(max_dcg > 0, 1.0 / max_dcg, 0.0)
 
-    def objective(scores, _labels, weights):
-        s = scores[safe_idx]
-        s = jnp.where(mask_j > 0, s, -jnp.inf)                      # (Q, D)
-        # positions must be a strict permutation even under tied scores
-        # (double argsort; ties broken by index) or ΔNDCG degenerates to 0
-        order = jnp.argsort(-s, axis=1, stable=True)
-        rank = jnp.argsort(order, axis=1, stable=True).astype(jnp.float32)
-        disc = jnp.where(mask_j > 0, 1.0 / jnp.log2(rank + 2.0), 0.0)
+    s = scores[safe_idx]
+    # pad slots take a large FINITE negative: -inf would make the pad-pad
+    # differences NaN and NaN·0 poisons the masked pairwise products
+    s = jnp.where(mask > 0, s, -1e9)                                # (Q, D)
+    # positions must be a strict permutation even under tied scores
+    # (double argsort; ties broken by index) or ΔNDCG degenerates to 0
+    order = jnp.argsort(-s, axis=1, stable=True)
+    rank = jnp.argsort(order, axis=1, stable=True).astype(jnp.float32)
+    disc = jnp.where(mask > 0, 1.0 / jnp.log2(rank + 2.0), 0.0)
 
-        diff_s = s[:, :, None] - s[:, None, :]                      # s_i - s_j
-        rho = jax.nn.sigmoid(-sigma * diff_s)
-        delta_disc = jnp.abs(disc[:, :, None] - disc[:, None, :])
-        delta_gain = jnp.abs(gains[:, :, None] - gains[:, None, :])
-        delta_ndcg = delta_disc * delta_gain * inv_max_dcg[:, None, None]
+    diff_s = s[:, :, None] - s[:, None, :]                          # s_i - s_j
+    rho = jax.nn.sigmoid(-sigma * diff_s)
+    delta_disc = jnp.abs(disc[:, :, None] - disc[:, None, :])
+    delta_gain = jnp.abs(gains[:, :, None] - gains[:, None, :])
+    delta_ndcg = delta_disc * delta_gain * inv_max_dcg[:, None, None]
 
-        pair_valid = (mask_j[:, :, None] * mask_j[:, None, :])
-        sij = (lab[:, :, None] > lab[:, None, :]).astype(jnp.float32) * pair_valid
+    pair_valid = (mask[:, :, None] * mask[:, None, :])
+    sij = (lab[:, :, None] > lab[:, None, :]).astype(jnp.float32) * pair_valid
 
-        lam = -sigma * rho * delta_ndcg * sij                       # i better than j
-        hess_pair = sigma * sigma * rho * (1.0 - rho) * delta_ndcg * sij
+    lam = -sigma * rho * delta_ndcg * sij                           # i beats j
+    hess_pair = sigma * sigma * rho * (1.0 - rho) * delta_ndcg * sij
 
-        grad_grid = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
-        hess_grid = jnp.sum(hess_pair, axis=2) + jnp.sum(hess_pair, axis=1)
+    grad_grid = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
+    hess_grid = jnp.sum(hess_pair, axis=2) + jnp.sum(hess_pair, axis=1)
 
-        grad = jnp.zeros(n_rows, jnp.float32).at[safe_idx.ravel()].add(
-            (grad_grid * mask_j).ravel())
-        hess = jnp.zeros(n_rows, jnp.float32).at[safe_idx.ravel()].add(
-            (hess_grid * mask_j).ravel())
-        hess = jnp.maximum(hess, 1e-9)
+    grad = jnp.zeros(n_rows, jnp.float32).at[safe_idx.ravel()].add(
+        (grad_grid * mask).ravel())
+    hess = jnp.zeros(n_rows, jnp.float32).at[safe_idx.ravel()].add(
+        (hess_grid * mask).ravel())
+    return grad, jnp.maximum(hess, 1e-9)
+
+
+def make_lambdarank_objective(qidx: np.ndarray, mask: np.ndarray,
+                              n_rows: int,
+                              sigma: float = 1.0,
+                              max_position: int = 10,
+                              label_gain: Optional[np.ndarray] = None):
+    """(scores, labels, weights) -> (grad, hess) over one group grid."""
+    qidx_j = jnp.asarray(qidx)
+    mask_j = jnp.asarray(mask, jnp.float32)
+    safe_idx = jnp.maximum(qidx_j, 0)
+    lg = None if label_gain is None else jnp.asarray(label_gain, jnp.float32)
+
+    def objective(scores, labels, weights):
+        grad, hess = _lambda_grads(scores, labels, safe_idx, mask_j, n_rows,
+                                   sigma, max_position, lg)
+        return grad * weights, hess * weights
+
+    return objective
+
+
+def make_lambdarank_objective_sharded(stacked_qidx: np.ndarray,
+                                      stacked_mask: np.ndarray,
+                                      n_rows_local: int,
+                                      axis_name: str,
+                                      sigma: float = 1.0,
+                                      max_position: int = 10,
+                                      label_gain: Optional[np.ndarray] = None):
+    """Shard-aware variant for use INSIDE ``shard_map``: each rank selects
+    its own (Qmax, D) group grid by ``lax.axis_index`` and computes lambdas
+    over its local rows only (groups never span shards by construction of
+    :func:`pack_groups_for_shards`)."""
+    sq = jnp.asarray(np.maximum(stacked_qidx, 0))      # (S, Q, D)
+    sm = jnp.asarray(stacked_mask, jnp.float32)
+    lg = None if label_gain is None else jnp.asarray(label_gain, jnp.float32)
+
+    def objective(scores, labels, weights):
+        i = lax.axis_index(axis_name)
+        grad, hess = _lambda_grads(scores, labels, sq[i], sm[i],
+                                   n_rows_local, sigma, max_position, lg)
         return grad * weights, hess * weights
 
     return objective
