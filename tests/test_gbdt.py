@@ -778,3 +778,23 @@ def test_distributed_lambdarank_matches_single_device():
     n_model = ndcg_at(5)(y, scores, sizes)
     n_random = ndcg_at(5)(y, rng.normal(size=n), sizes)
     assert n_model > n_random + 0.1
+
+
+def test_checkpoint_resume_on_mesh(tmp_path):
+    """Checkpoint/resume composes with data-parallel training."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = binary_data(n=1600)
+    ck = str(tmp_path / "mesh_ck")
+    mesh = data_parallel_mesh(8)
+
+    def cfg(iters):
+        return BoostingConfig(objective="binary", num_iterations=iters,
+                              num_leaves=7, min_data_in_leaf=5)
+
+    full, _ = train(X, y, cfg(8), mesh=mesh)
+    train(X, y, cfg(4), mesh=mesh, checkpoint_dir=ck, checkpoint_interval=2)
+    resumed, _ = train(X, y, cfg(8), mesh=mesh, checkpoint_dir=ck,
+                       checkpoint_interval=2)
+    assert resumed.num_trees == 8
+    np.testing.assert_allclose(full.predict_margin(X),
+                               resumed.predict_margin(X), atol=1e-4)

@@ -98,3 +98,20 @@ def test_categorical_composes_with_efb():
     b, _ = train(X, y, cfg)
     assert b.bundler is not None and b.bin_mapper.has_categorical
     assert auc(y, b.predict_margin(X)) > 0.9
+
+
+def test_categorical_streaming_value_order(tmp_path):
+    """Streamed sources order categorical bins by value (no aligned label
+    sample); training still learns and streams bit-identically to an
+    in-memory run with the same mapper semantics."""
+    from synapseml_tpu.io import ChunkedColumnSource, write_matrix
+
+    X, y = cat_data(n=3000, seed=3)
+    p = str(tmp_path / "c.smlc")
+    write_matrix(p, np.column_stack([X, y.astype(np.float32)]))
+    src = ChunkedColumnSource(p, label_col=X.shape[1], chunk_rows=777)
+    cfg = BoostingConfig(objective="binary", num_iterations=10, num_leaves=15,
+                         min_data_in_leaf=5, categorical_feature=[0, 1])
+    b, _ = train(src, None, cfg)
+    assert b.bin_mapper.has_categorical
+    assert auc(y, b.predict_margin(X)) > 0.85
