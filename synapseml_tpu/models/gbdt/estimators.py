@@ -406,7 +406,8 @@ class GBDTRanker(GBDTParams, Estimator):
                      valid_ds[self.weightCol].astype(np.float32)
                      if self.weightCol else None)
         booster, history = train(
-            X, y, cfg, sample_weight=w, valid=valid, mesh=None,
+            X, y, cfg, sample_weight=w, valid=valid,
+            mesh=self._mesh(len(X)),   # whole groups pack onto shards
             group=counts, valid_group=vgroups)
         model = GBDTRankerModel(
             boosterModel=booster,

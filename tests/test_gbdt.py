@@ -798,3 +798,20 @@ def test_checkpoint_resume_on_mesh(tmp_path):
     assert resumed.num_trees == 8
     np.testing.assert_allclose(full.predict_margin(X),
                                resumed.predict_margin(X), atol=1e-4)
+
+
+def test_ranker_estimator_sharded():
+    """GBDTRanker rides the mesh now that distributed lambdarank exists."""
+    rng = np.random.default_rng(9)
+    Q, D = 48, 12
+    X = rng.normal(size=(Q * D, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    qid = np.repeat(np.arange(Q), D)
+    ds = Dataset({"features": list(X), "label": y, "query": qid})
+    m1 = GBDTRanker(numIterations=8, numLeaves=7, minDataInLeaf=3,
+                    groupCol="query", numShards=1).fit(ds)
+    m8 = GBDTRanker(numIterations=8, numLeaves=7, minDataInLeaf=3,
+                    groupCol="query", numShards=8).fit(ds)
+    a = np.asarray(m1.transform(ds)["prediction"])
+    b = np.asarray(m8.transform(ds)["prediction"])
+    np.testing.assert_allclose(a, b, atol=1e-4)
