@@ -60,17 +60,23 @@ def _to_numpy(t) -> np.ndarray:
 def _read_safetensors(path: str) -> Dict[str, np.ndarray]:
     from safetensors import safe_open
     out = {}
+    failed = []
     with safe_open(path, framework="np") as f:
         for k in f.keys():
             try:
                 out[k] = f.get_tensor(k)
             except (TypeError, ValueError):
-                pass
-    if out:
+                failed.append(k)
+    if not failed:
         return out
-    # bf16 tensors can defeat the numpy framework; fall back to flax
-    from safetensors.flax import load_file
-    return {k: _to_numpy(v) for k, v in load_file(path).items()}
+    # bf16 tensors defeat the numpy framework; load the failures (and only
+    # the failures) through flax so a mixed-dtype checkpoint never returns
+    # a silently partial dict (load_into_params(strict=False) downstream
+    # would keep random init for the missing leaves).
+    with safe_open(path, framework="flax") as f:
+        for k in failed:
+            out[k] = _to_numpy(f.get_tensor(k))
+    return out
 
 
 def _read_torch(path: str) -> Dict[str, np.ndarray]:

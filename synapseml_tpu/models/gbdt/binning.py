@@ -60,9 +60,12 @@ class BinMapper:
             col = features[:, j]
             if j in cat:
                 vals, bins = cat[j]
+                if len(vals) == 0:       # all-NaN fit sample: empty LUT
+                    out[:, j] = MISSING_BIN
+                    continue
                 idx = np.searchsorted(vals, col)
                 idx_c = np.minimum(idx, len(vals) - 1)
-                hit = (len(vals) > 0) & (vals[idx_c] == col)
+                hit = vals[idx_c] == col
                 out[:, j] = np.where(hit, bins[idx_c], MISSING_BIN)
                 continue
             # searchsorted over this feature's bounds; bin ids are 1-based

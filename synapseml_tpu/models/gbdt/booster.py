@@ -895,14 +895,30 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if source is not None:
         # micro-batch push (StreamingPartitionTask analogue): each chunk is
         # binned and shipped independently; the full matrix exists only on
-        # DEVICE, assembled by one concatenate — host peak stays O(chunk)
-        dev_chunks = [put_bins(bin_eff(cx))
-                      for cx, _, _ in source.iter_chunks()]
-        if pad:
+        # DEVICE, assembled by one concatenate — host peak stays O(chunk).
+        # Row-sharded uploads require a row count divisible by the shard
+        # count, so a host-side carry re-chunks arbitrary chunk_rows/tail
+        # sizes to shard multiples; the remainder merges into the pad block
+        # (n + pad is a shard multiple by construction, so the combined
+        # tail always divides evenly).
+        bin_dt = np.uint8 if mapper.max_bin <= 255 else np.uint16
+        dev_chunks = []
+        carry = None
+        for cx, _, _ in source.iter_chunks():
+            b = bin_eff(cx)
+            if carry is not None and len(carry):
+                b = np.concatenate([carry, b])
+            keep = len(b) - len(b) % row_shards
+            carry = b[keep:].copy()    # view would pin the whole chunk
+            if keep:
+                dev_chunks.append(put_bins(b[:keep]))
+        tail_rows = (len(carry) if carry is not None else 0) + pad
+        if tail_rows:
             pad_f = bundler.num_bundles if bundler is not None else F
-            dev_chunks.append(put_bins(np.zeros(
-                (pad, pad_f),
-                np.uint8 if mapper.max_bin <= 255 else np.uint16)))
+            tail = np.zeros((tail_rows, pad_f), bin_dt)
+            if carry is not None and len(carry):
+                tail[:len(carry)] = carry
+            dev_chunks.append(put_bins(tail))
         if len(dev_chunks) > 1:
             stacked = jax.jit(lambda *cs: jnp.concatenate(cs))(*dev_chunks)
         else:

@@ -73,14 +73,20 @@ def build_bert_classifier(state_dict: Dict[str, np.ndarray],
     x = layer_norm(x, "embeddings.LayerNorm")
 
     # additive attention mask (B, 1, 1, S): (1 - mask) * -1e9
+    one = init("one", np.float32(1.0))
     m4 = b.node("Unsqueeze", [mask, init("axes11", np.array([1, 2], np.int64))])
-    neg = b.node("Mul", [b.node("Sub", [init("one", np.float32(1.0)), m4]),
+    neg = b.node("Mul", [b.node("Sub", [one, m4]),
                          init("negbig", np.float32(-1e9))])
 
     perm_heads = [0, 2, 1, 3]
     shape_split = init("shape_split",
                        np.array([0, seq_len, num_heads, d_head], np.int64))
     shape_merge = init("shape_merge", np.array([0, seq_len, d_model], np.int64))
+    # erf-expanded gelu constants: standard ONNX only defines the Gelu op
+    # from opset 20, so this opset-17 graph spells 0.5*x*(1+erf(x/sqrt(2)))
+    # in primitives and stays valid for external runtimes
+    half = init("gelu_half", np.float32(0.5))
+    sqrt2 = init("gelu_sqrt2", np.float32(np.sqrt(2.0)))
     for i in range(num_layers):
         p = f"encoder.layer.{i}."
 
@@ -101,7 +107,11 @@ def build_bert_classifier(state_dict: Dict[str, np.ndarray],
         att = linear(ctx, p + "attention.output.dense", "attout")
         x = layer_norm(b.node("Add", [att, x]),
                        p + "attention.output.LayerNorm")
-        h = b.node("Gelu", [linear(x, p + "intermediate.dense", "ffup")])
+        ff = linear(x, p + "intermediate.dense", "ffup")
+        h = b.node("Mul", [
+            b.node("Mul", [ff, half]),
+            b.node("Add", [one,
+                           b.node("Erf", [b.node("Div", [ff, sqrt2])])])])
         h = linear(h, p + "output.dense", "ffdown")
         x = layer_norm(b.node("Add", [h, x]), p + "output.LayerNorm")
 

@@ -297,3 +297,22 @@ def test_wordpiece_tokenizer(tmp_path):
     assert mask[0][:7].all() and not mask[0][7:].any()
     assert ids[1][1] == 3                      # [UNK]
     assert tok.decode(ids[:1]) == ["the unbreakable break"]
+
+
+def test_read_checkpoint_mixed_dtype_safetensors(tmp_path):
+    """A checkpoint mixing f32 and bf16 tensors must return EVERY key: the
+    numpy safetensors framework rejects bf16 per-tensor, and a silently
+    partial dict would leave random init in the imported model."""
+    import jax.numpy as jnp
+    from safetensors.flax import save_file as save_flax
+    from synapseml_tpu.models.dl.checkpoints import read_checkpoint
+
+    a32 = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b16 = jnp.asarray(np.ones((3, 2), np.float32) * 0.5, jnp.bfloat16)
+    save_flax({"dense.f32": jnp.asarray(a32), "dense.bf16": b16},
+              str(tmp_path / "mixed.safetensors"))
+    got = read_checkpoint(str(tmp_path / "mixed.safetensors"))
+    assert set(got) == {"dense.f32", "dense.bf16"}
+    np.testing.assert_allclose(got["dense.f32"], a32)
+    np.testing.assert_allclose(np.asarray(got["dense.bf16"], np.float32),
+                               0.5 * np.ones((3, 2)))

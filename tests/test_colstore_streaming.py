@@ -79,6 +79,23 @@ def test_streaming_train_sharded_mesh(store):
                                b1.predict_margin(probe), atol=1e-4)
 
 
+def test_streaming_indivisible_chunks_on_mesh(store):
+    """chunk_rows that doesn't divide the shard count (and an uneven tail)
+    must re-chunk through the host-side carry instead of crashing
+    device_put — parity with the in-memory model is unchanged."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    path, X, y = store
+    # 7_001 % 8 != 0 and 60_000 % 7_001 != 0: every upload needs the carry
+    src = ChunkedColumnSource(path, label_col=8, chunk_rows=7_001)
+    cfg = BoostingConfig(objective="binary", num_iterations=4, num_leaves=7,
+                         min_data_in_leaf=5)
+    b8, _ = train(src, None, cfg, mesh=data_parallel_mesh(8))
+    b1, _ = train(X, y, cfg)
+    probe = X[:2048]
+    np.testing.assert_allclose(b8.predict_margin(probe),
+                               b1.predict_margin(probe), atol=1e-4)
+
+
 def test_iter_batches_shapes_and_shuffle(store):
     path, X, y = store
     src = ChunkedColumnSource(path, label_col=8, chunk_rows=10_000)

@@ -115,3 +115,16 @@ def test_categorical_streaming_value_order(tmp_path):
     b, _ = train(src, None, cfg)
     assert b.bin_mapper.has_categorical
     assert auc(y, b.predict_margin(X)) > 0.85
+
+
+def test_categorical_all_nan_feature_empty_lut():
+    """A categorical column that is entirely NaN in the fit sample yields an
+    empty LUT; transform must route every row to the missing bin instead of
+    indexing into the empty value array."""
+    X, y = cat_data(n=800)
+    X = np.column_stack([X, np.full(len(X), np.nan, np.float32)])
+    cfg = BoostingConfig(objective="binary", num_iterations=4, num_leaves=7,
+                         min_data_in_leaf=5,
+                         categorical_feature=[0, 1, X.shape[1] - 1])
+    b, _ = train(X, y, cfg)
+    assert np.isfinite(b.predict_margin(X[:64])).all()
