@@ -36,6 +36,11 @@ BERT_SEQ = 128
 GBDT_ROWS = 1_000_000
 GBDT_FEATURES = 28
 GBDT_ITERS = 100          # LightGBM's default num_iterations
+GBDT_MAX_BIN = 63         # the TPU fast path (LightGBM's own GPU default);
+                          # AUC-parity with max_bin=255 is pinned by the
+                          # fixture suite, and the CPU anchor is measured
+                          # bin-count-insensitive (±2%) so the comparison
+                          # does not tilt the anchor
 ANCHOR_ITERS = 10         # anchor runs fewer iters; rate is per-iteration
 
 #: peak dense bf16 FLOPs/s by device kind (public spec sheets)
@@ -105,35 +110,48 @@ def bench_bert():
     return sps_chip, mfu, n_params
 
 
+def _gbdt_labels(rng, X):
+    """Shared label concept for train AND holdout — a single formula so the
+    holdout AUC guard cannot silently diverge from the training task."""
+    return (X[:, 0] * 2 - X[:, 1] + X[:, 2] * X[:, 3]
+            + rng.normal(scale=0.5, size=len(X)) > 0).astype(np.float64)
+
+
 def _gbdt_data():
     rng = np.random.default_rng(0)
     X = rng.normal(size=(GBDT_ROWS, GBDT_FEATURES)).astype(np.float32)
-    y = (X[:, 0] * 2 - X[:, 1] + X[:, 2] * X[:, 3]
-         + rng.normal(scale=0.5, size=GBDT_ROWS) > 0).astype(np.float64)
-    return X, y
+    return X, _gbdt_labels(rng, X)
 
 
 def bench_gbdt(X, y):
     from synapseml_tpu.models.gbdt import BoostingConfig, train
+    from synapseml_tpu.models.gbdt.metrics import auc
 
-    cfg = BoostingConfig(objective="binary", num_iterations=2, num_leaves=31)
+    cfg = BoostingConfig(objective="binary", num_iterations=2, num_leaves=31,
+                         max_bin=GBDT_MAX_BIN)
     t0 = time.perf_counter()
     train(X, y, cfg)                                  # compile + 2 iters
     warm = time.perf_counter() - t0
 
     cfg = BoostingConfig(objective="binary", num_iterations=GBDT_ITERS,
-                         num_leaves=31)
+                         num_leaves=31, max_bin=GBDT_MAX_BIN)
     # best of two measured runs: the shared chip's co-tenant load can slow
     # a single window 3x (the BERT bench medians 3 windows for the same
     # reason)
-    best = (0.0, 0.0)
+    best = (0.0, 0.0, None)
     for _ in range(2):
         t0 = time.perf_counter()
         booster, _ = train(X, y, cfg)
         dt = time.perf_counter() - t0
         best = max(best, (GBDT_ITERS / dt,
-                          booster.measures.iterations_per_sec()))
-    return best[0], best[1], warm
+                          booster.measures.iterations_per_sec(), booster),
+                   key=lambda t: t[0])
+    # model quality on a fresh holdout from the same generator — guards the
+    # speed number against a silently degenerate model
+    rng = np.random.default_rng(7)
+    Xh = rng.normal(size=(100_000, GBDT_FEATURES)).astype(np.float32)
+    auc_h = float(auc(_gbdt_labels(rng, Xh), best[2].predict_margin(Xh)))
+    return best[0], best[1], warm, auc_h
 
 
 def bench_gbdt_anchor(X, y):
@@ -151,6 +169,9 @@ def bench_gbdt_anchor(X, y):
     def run(iters):
         clf = HistGradientBoostingClassifier(
             max_iter=iters, max_leaf_nodes=31, max_bins=255,
+            # measured on this host: max_bins=64 fits at the same rate
+            # (4.95 vs 5.02 it/s amortized) — CPU histogram cost is O(N)
+            # per feature, so the TPU run's max_bin=63 doesn't tilt this
             early_stopping=False, validation_fraction=None)
         t0 = time.perf_counter()
         clf.fit(X, y)
@@ -232,12 +253,15 @@ def main():
 
     gbdt_ips = gbdt_steady = None
     anchor_ips = anchor_cores = None
+    gbdt_auc = None
     try:
         X, y = _gbdt_data()
-        gbdt_ips, gbdt_steady, gbdt_warm = bench_gbdt(X, y)
-        print(f"[secondary] GBDT @1Mx{GBDT_FEATURES}: {gbdt_ips:.2f} iters/sec "
+        gbdt_ips, gbdt_steady, gbdt_warm, gbdt_auc = bench_gbdt(X, y)
+        print(f"[secondary] GBDT @1Mx{GBDT_FEATURES} max_bin={GBDT_MAX_BIN}: "
+              f"{gbdt_ips:.2f} iters/sec "
               f"full-wall ({gbdt_steady:.2f} steady-state, warmup "
-              f"{gbdt_warm:.1f}s)", file=sys.stderr)
+              f"{gbdt_warm:.1f}s, holdout AUC {gbdt_auc:.4f})",
+              file=sys.stderr)
     except Exception as e:  # secondary must not break the primary metric
         print(f"[secondary] GBDT bench failed: {e}", file=sys.stderr)
     try:
@@ -260,6 +284,8 @@ def main():
         "gbdt_iters_per_sec": round(gbdt_ips, 3) if gbdt_ips else None,
         "gbdt_steady_iters_per_sec": (round(gbdt_steady, 3)
                                       if gbdt_steady else None),
+        "gbdt_max_bin": GBDT_MAX_BIN,
+        "gbdt_holdout_auc": round(gbdt_auc, 4) if gbdt_auc else None,
         "gbdt_anchor_iters_per_sec": (round(anchor_ips, 3)
                                       if anchor_ips else None),
         "resnet50_onnx_imgs_per_sec": (round(resnet_ips, 1)

@@ -385,6 +385,40 @@ class Booster:
 # training
 # --------------------------------------------------------------------------
 
+def _step_factory_args(config: "BoostingConfig", K: int, mesh, featpar: bool,
+                       use_pallas, objective_fn=None):
+    """The exact ``_make_step`` (args, kwargs) — built in ONE place so the
+    warm-compile thread and the training loop hit the same lru_cache entry
+    (any drift would silently compile a program that is never used).
+    ``objective_fn`` overrides the cached-factory objective (lambdarank)."""
+    if objective_fn is None and K == 1 and config.objective != "lambdarank":
+        obj_kwargs = {}
+        if config.objective in ("huber", "quantile"):
+            obj_kwargs["alpha"] = config.alpha
+        elif config.objective == "fair":
+            obj_kwargs["c"] = config.fair_c
+        elif config.objective == "tweedie":
+            obj_kwargs["rho"] = config.tweedie_variance_power
+        # cached factory -> stable function identity, so the _make_step
+        # cache hits across train() calls even with objective kwargs
+        objective_fn = _objective_with_kwargs(
+            config.objective, tuple(sorted(obj_kwargs.items())))
+    is_rf = config.boosting_type == "rf"
+    use_bagging = (config.bagging_fraction < 1.0
+                   and (is_rf or config.bagging_freq > 0))
+    args = (config.growth_params(), objective_fn, K,
+            1.0 if is_rf else config.learning_rate, mesh,
+            config.boosting_type == "goss",
+            config.top_rate, config.other_rate)
+    kwargs = dict(ova=(config.objective == "multiclassova"),
+                  use_pallas=use_pallas,
+                  growth_policy=config.growth_policy,
+                  feature_parallel=featpar,
+                  bagging_fraction=(config.bagging_fraction
+                                    if use_bagging else 1.0))
+    return args, kwargs
+
+
 @functools.lru_cache(maxsize=None)
 def _objective_with_kwargs(name, kwargs_items):
     """Objective + frozen kwargs as a STABLE function object, so the
@@ -771,13 +805,23 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         base_margin = None
 
     # -- padding + device placement ---------------------------------------
-    # pallas kernel constraints: VMEM one-hot scratch 8*B*CHUNK*2 bytes must
-    # fit (B<=512) and B must be sublane-aligned; otherwise scatter fallback
+    # pallas kernel constraints: B must be sublane-aligned and the one-hot
+    # working set must fit VMEM; otherwise scatter fallback
     B_total = config.max_bin + 1
-    use_pallas = (jax.default_backend() == "tpu"
-                  and B_total <= 512 and B_total % 8 == 0)
+    pallas_candidate = (jax.default_backend() == "tpu"
+                        and B_total <= 512 and B_total % 8 == 0)
     shards = mesh.shape[DATA_AXIS] if mesh is not None else 1
     featpar = config.parallelism == "feature_parallel" and mesh is not None
+    use_pallas = pallas_candidate
+    uses_fused = (config.growth_policy == "depthwise" and not featpar
+                  and config.parallelism != "voting_parallel")
+    if pallas_candidate and uses_fused:
+        # the fused route+hist kernel keeps its whole accumulator VMEM-
+        # resident, which scales with F — wide matrices fall back to the
+        # scatter path (EFB re-gates on the bundled width below)
+        from .pallas_hist import fused_geometry
+        use_pallas = fused_geometry(
+            F, B_total, default_n_slots(config.num_leaves)) is not None
     if featpar and config.boosting_type == "dart":
         raise NotImplementedError(
             "feature_parallel + dart: dart rescoring traverses binned "
@@ -790,7 +834,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # for the pallas chunk, features pad to the rank count
     row_shards = 1 if featpar else shards
     pad_unit = row_shards
-    if use_pallas:
+    if pallas_candidate:       # pad for the kernel even if EFB re-gates
         from .pallas_hist import hist_pad_multiple
         pad_unit = row_shards * hist_pad_multiple()
     Fp = F
@@ -818,6 +862,40 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         sh = replicated(mesh) if featpar else batch_sharding(mesh, len(shape))
         return jax.jit(lambda: jnp.full(shape, fill, jnp.float32),
                        out_shardings=sh)()
+
+    # -- compile/transfer overlap ------------------------------------------
+    # the jitted step's first compile (cold: tens of seconds, warm cache:
+    # seconds) and the host-side binning + u8 upload are independent; warm
+    # the step on a helper thread with zero-dummies of the final shapes so
+    # the wall clock pays max(compile, binning+upload), not the sum.
+    # _make_step is lru-cached, so the real construction below returns the
+    # SAME jitted callable the thread compiled.  Restricted to the plain
+    # single-device path (sharded dummies would need placement logic, and
+    # EFB/lambdarank only learn their shapes after binning).
+    _warm_thread = None
+    if (use_pallas and mesh is None and K == 1 and not config.enable_bundle
+            and config.objective != "lambdarank" and n >= 200_000):
+        _wargs, _wkw = _step_factory_args(config, K, mesh, featpar,
+                                          use_pallas)
+        _wstep = _make_step(*_wargs, **_wkw)
+        _w_ub_cols = mapper.upper_bounds.shape[1]
+
+        def _warm_compile():
+            try:
+                zf32 = functools.partial(jnp.zeros, dtype=jnp.float32)
+                out = _wstep(jnp.zeros((F, N), jnp.int32), zf32(N), zf32(N),
+                             jnp.ones(N, jnp.float32), (jnp.ones(N, jnp.float32),
+                             jax.random.PRNGKey(0)), jnp.ones(F, bool),
+                             jax.random.PRNGKey(1),
+                             jnp.zeros((F, _w_ub_cols), jnp.float32),
+                             jnp.full(F, config.max_bin + 1, jnp.int32))
+                jax.block_until_ready(out[1])
+            except Exception:
+                pass           # warming is best-effort; the loop compiles
+
+        import threading as _threading
+        _warm_thread = _threading.Thread(target=_warm_compile, daemon=True)
+        _warm_thread.start()
 
     # host-bin to the narrowest integer type (native multithreaded search)
     # and upcast/transpose on device: ships 1-2 bytes/cell instead of 4 —
@@ -859,6 +937,14 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 mapper.num_bins, max_total_bins=config.max_bin + 1,
                 max_conflict_rate=config.max_conflict_rate)
 
+    if (bundler is not None and pallas_candidate and uses_fused
+            and not use_pallas):
+        # bundling shrank the feature axis: the fused kernel may fit now
+        from .pallas_hist import fused_geometry
+        use_pallas = fused_geometry(
+            bundler.num_bundles, B_total,
+            default_n_slots(config.num_leaves)) is not None
+
     def bin_eff(mat):
         b = bin_host(mat)
         return bundler.transform(b) if bundler is not None else b
@@ -892,47 +978,45 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             return out
         return jax.jit(fn)(stacked_dev)
 
+    # micro-batch push (StreamingPartitionTask analogue) for BOTH sources:
+    # each chunk is binned and shipped independently (device_put is async,
+    # so chunk k's bytes ride the tunnel while chunk k+1 bins on the host —
+    # the fixed cost pays ~max(binning, upload) instead of their sum); the
+    # full matrix exists only on DEVICE, assembled by one concatenate, so
+    # streamed host peak stays O(chunk).  Row-sharded uploads require a row
+    # count divisible by the shard count: a host-side carry re-chunks
+    # arbitrary chunk/tail sizes to shard multiples, and the remainder
+    # merges into the pad block (n + pad is a shard multiple by
+    # construction, so the combined tail always divides evenly).
     if source is not None:
-        # micro-batch push (StreamingPartitionTask analogue): each chunk is
-        # binned and shipped independently; the full matrix exists only on
-        # DEVICE, assembled by one concatenate — host peak stays O(chunk).
-        # Row-sharded uploads require a row count divisible by the shard
-        # count, so a host-side carry re-chunks arbitrary chunk_rows/tail
-        # sizes to shard multiples; the remainder merges into the pad block
-        # (n + pad is a shard multiple by construction, so the combined
-        # tail always divides evenly).
-        bin_dt = np.uint8 if mapper.max_bin <= 255 else np.uint16
-        dev_chunks = []
-        carry = None
-        for cx, _, _ in source.iter_chunks():
-            b = bin_eff(cx)
-            if carry is not None and len(carry):
-                b = np.concatenate([carry, b])
-            keep = len(b) - len(b) % row_shards
-            carry = b[keep:].copy()    # view would pin the whole chunk
-            if keep:
-                dev_chunks.append(put_bins(b[:keep]))
-        tail_rows = (len(carry) if carry is not None else 0) + pad
-        if tail_rows:
-            pad_f = bundler.num_bundles if bundler is not None else F
-            tail = np.zeros((tail_rows, pad_f), bin_dt)
-            if carry is not None and len(carry):
-                tail[:len(carry)] = carry
-            dev_chunks.append(put_bins(tail))
-        if len(dev_chunks) > 1:
-            stacked = jax.jit(lambda *cs: jnp.concatenate(cs))(*dev_chunks)
-        else:
-            stacked = dev_chunks[0]
-        bins_t = finish_bins(stacked)
-        del dev_chunks, stacked
+        chunk_iter = (cx for cx, _, _ in source.iter_chunks())
     else:
-        binned_small = bin_eff(X)
-        if pad:
-            binned_small = np.concatenate(
-                [binned_small,
-                 np.zeros((pad, binned_small.shape[1]), binned_small.dtype)])
-        bins_t = finish_bins(put_bins(binned_small))
-        del binned_small
+        crows = max(row_shards, 131_072 // row_shards * row_shards)
+        chunk_iter = (X[lo:lo + crows] for lo in range(0, n, crows))
+    bin_dt = np.uint8 if mapper.max_bin <= 255 else np.uint16
+    dev_chunks = []
+    carry = None
+    for cx in chunk_iter:
+        b = bin_eff(cx)
+        if carry is not None and len(carry):
+            b = np.concatenate([carry, b])
+        keep = len(b) - len(b) % row_shards
+        carry = b[keep:].copy()    # view would pin the whole chunk
+        if keep:
+            dev_chunks.append(put_bins(b[:keep]))
+    tail_rows = (len(carry) if carry is not None else 0) + pad
+    if tail_rows:
+        pad_f = bundler.num_bundles if bundler is not None else F
+        tail = np.zeros((tail_rows, pad_f), bin_dt)
+        if carry is not None and len(carry):
+            tail[:len(carry)] = carry
+        dev_chunks.append(put_bins(tail))
+    if len(dev_chunks) > 1:
+        stacked = jax.jit(lambda *cs: jnp.concatenate(cs))(*dev_chunks)
+    else:
+        stacked = dev_chunks[0]
+    bins_t = finish_bins(stacked)
+    del dev_chunks, stacked
     measures.binning_s += _time.perf_counter() - _t_bin2
     labels = put(labels_np, 1)
     if sample_weight is None and not w_scaled:
@@ -972,13 +1056,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         num_bins = jax.device_put(num_bins, fp_sh1)
 
     # -- objective ---------------------------------------------------------
-    obj_kwargs = {}
-    if config.objective in ("huber", "quantile"):
-        obj_kwargs["alpha"] = config.alpha
-    elif config.objective == "fair":
-        obj_kwargs["c"] = config.fair_c
-    elif config.objective == "tweedie":
-        obj_kwargs["rho"] = config.tweedie_variance_power
+    objective_fn = None            # non-lambdarank: _step_factory_args builds it
     if config.objective == "lambdarank":
         if group is None:
             raise ValueError("lambdarank requires group sizes (groupCol)")
@@ -997,34 +1075,18 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             objective_fn = make_lambdarank_objective(
                 qidx, qmask, n_rows=n + pad, sigma=1.0,
                 max_position=config.max_position, label_gain=lg_arr)
-    elif K == 1:
-        # cached factory -> stable function identity, so the _make_step
-        # cache hits across train() calls even with objective kwargs
-        objective_fn = _objective_with_kwargs(
-            config.objective, tuple(sorted(obj_kwargs.items())))
-    else:
-        objective_fn = None
-
     is_rf = config.boosting_type == "rf"
     is_dart = config.boosting_type == "dart"
     use_goss = config.boosting_type == "goss"
     lr = 1.0 if is_rf else config.learning_rate
 
-    p = config.growth_params()
-    use_bagging = (config.bagging_fraction < 1.0
-                   and (is_rf or config.bagging_freq > 0))
+    _sargs, _skw = _step_factory_args(config, K, mesh, featpar, use_pallas,
+                                      objective_fn=objective_fn)
     # lambdarank's objective closes over per-dataset arrays: a cache entry
     # would both never hit again and pin the arrays — bypass the cache
     make = (_make_step.__wrapped__ if config.objective == "lambdarank"
             else _make_step)
-    step = make(p, objective_fn, K, lr, mesh, use_goss,
-                      config.top_rate, config.other_rate,
-                      ova=(config.objective == "multiclassova"),
-                      use_pallas=use_pallas,
-                      growth_policy=config.growth_policy,
-                      feature_parallel=featpar,
-                      bagging_fraction=(config.bagging_fraction
-                                        if use_bagging else 1.0))
+    step = make(*_sargs, **_skw)
 
     # -- validation setup (validationIndicatorCol analogue) ----------------
     have_valid = valid is not None
@@ -1087,6 +1149,9 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     rf_reset_scores = None
     # leaf-wise depth is bounded by num_leaves-1 splits; never truncate
     depth_hint = max(2, config.num_leaves)
+
+    if _warm_thread is not None:
+        _warm_thread.join()
 
     for it in range(config.num_iterations):
         # bagging (bagging_fraction/freq semantics): the mask is drawn on
@@ -1218,8 +1283,14 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # ONE transfer per field (T, K, M) — per-stack downloads pay a tunnel/PCIe
     # round trip each, which dominates small-tree training
     if pending_stacks:
-        all_fields = [np.asarray(a) for a in
-                      stack_trees([t for t, _ in pending_stacks])]
+        # one jitted computation for ALL fields: stacking field-by-field in
+        # eager ops compiles 11 tiny XLA programs (~13 s on a cold cache);
+        # a single fused stack compiles once
+        stacked = jax.jit(
+            lambda ts: Tree(*[jnp.stack([getattr(t, f) for t in ts])
+                              for f in Tree._fields]))(
+            [t for t, _ in pending_stacks])
+        all_fields = [np.asarray(a) for a in stacked]
         for i, (_, per_class_weights) in enumerate(pending_stacks):
             for k in range(K):
                 trees.append(Tree(*[a[i, k] for a in all_fields]))

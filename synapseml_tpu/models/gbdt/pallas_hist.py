@@ -38,6 +38,47 @@ FEAT_TILE = 8
 VALS = 8
 
 
+def _tile_for(total_bins: int):
+    """(features-per-step, rows-per-chunk) for the one-hot scratch.
+
+    The scratch is (FT·B, chunk) bf16 and must fit VMEM (~16 MB/core)
+    alongside the resident (Fp·B, S·8) f32 accumulator.  Wider feature
+    tiles and chunks amortize the per-grid-step overhead (~8 µs/step on
+    v5e) — at B=64 the geometry (32, 2048) runs the 1M×28 level pass in
+    ~10.5 ms vs ~27 ms for the round-2 (8, 1024) geometry."""
+    if total_bins <= 64:
+        return 32, 2048
+    if total_bins <= 128:
+        return 16, 2048
+    if total_bins <= 256:
+        return 8, 2048
+    return 8, 1024
+
+
+#: VMEM budget for kernel working sets (~16 MB/core minus block slack)
+_VMEM_BUDGET = 13 * 1024 * 1024
+
+
+def fused_geometry(num_features: int, total_bins: int, n_slots: int):
+    """(ft, chunk) for the fused route+hist kernel, or None if no geometry
+    fits VMEM.  Unlike the per-tile nodes kernel, the fused kernel's
+    accumulator is fully resident (routing is computed once per chunk, so
+    the grid runs chunk-major and every feature tile must stay hot) — its
+    footprint scales with F, and wide matrices must shrink the chunk or
+    fall back to the scatter path."""
+    ft, chunk = _tile_for(total_bins)
+    VN = n_slots * SLOT_LANES
+    while chunk >= 1024:
+        Fp = -(-num_features // ft) * ft
+        need = (ft * total_bins * chunk * 2       # one-hot scratch
+                + Fp * total_bins * VN * 4        # resident accumulator
+                + 2 * chunk * VN * 2)             # vn scratch + vals block
+        if need <= _VMEM_BUDGET:
+            return ft, chunk
+        chunk //= 2
+    return None
+
+
 def _hist_kernel(bins_ref, vals_ref, out_ref, oh_ref):
     """Grid (F//8, N//CHUNK). bins block (8, C); vals block (C, 8) bf16;
     out block (1, 8·B, 8) f32 revisited across the chunk dim."""
@@ -133,33 +174,40 @@ def hist_pad_multiple() -> int:
 SLOT_LANES = 8
 
 
-def _hist_nodes_kernel(bins_ref, slot_ref, vals_ref, out_ref, oh_ref, vn_ref):
-    """Grid (F//FEAT_TILE, N//CHUNK).  bins block (8, C) int32; slot block
-    (1, C) int32 (row's node slot, -1 = no slot); vals block (C, 8) bf16;
-    out block (1, 8·B, S·8) f32 revisited across the chunk dim."""
-    c = pl.program_id(1)
+def _make_hist_nodes_kernel(ft: int):
+    def kernel(bins_ref, slot_ref, vals_ref, out_ref, oh_ref):
+        """Grid (F//ft, N//chunk) — c fastest.  bins block (ft, C) int32;
+        slot block (1, C) int32 (row's node slot, -1 = no slot); vals block
+        (C, S·8) bf16 pre-tiled; out block (1, ft·B, S·8) f32 revisited
+        across the chunk dim — per-TILE residency keeps VMEM use
+        F-independent (a fully resident accumulator scales with F and
+        stops compiling near F≈60 at B=256)."""
+        c = pl.program_id(1)
 
-    @pl.when(c == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
+        @pl.when(c == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
 
-    C = bins_ref.shape[1]
-    B = out_ref.shape[1] // FEAT_TILE
-    S = vn_ref.shape[1] // SLOT_LANES
-    iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
-    for f in range(FEAT_TILE):
-        b = bins_ref[f, :]
-        oh_ref[f * B:(f + 1) * B, :] = (iota_b == b[None, :]).astype(jnp.bfloat16)
-    sid = slot_ref[0, :]
-    vals = vals_ref[...]
-    for j in range(S):
-        # minor-dim insertion must happen on a 32-bit type (Mosaic limit)
-        m = (sid == j).astype(jnp.float32)[:, None].astype(jnp.bfloat16)
-        vn_ref[:, j * SLOT_LANES:(j + 1) * SLOT_LANES] = vals * m
-    contrib = lax.dot_general(oh_ref[...], vn_ref[...],
-                              (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-    out_ref[...] += contrib[None]
+        C = bins_ref.shape[1]
+        B = oh_ref.shape[0] // ft
+        S = vals_ref.shape[1] // SLOT_LANES
+        iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
+        for k in range(ft):
+            b = bins_ref[k, :]
+            oh_ref[k * B:(k + 1) * B, :] = (iota_b == b[None, :]).astype(
+                jnp.bfloat16)
+        # slot-masked value matrix in ONE wide compare against the lane's
+        # slot index — the round-2 loop of S narrow 8-lane writes cost more
+        # than the matmul it fed
+        sid = slot_ref[0, :]
+        lane_j = lax.broadcasted_iota(
+            jnp.int32, (C, S * SLOT_LANES), 1) // SLOT_LANES
+        vn = vals_ref[...] * (sid[:, None] == lane_j).astype(jnp.bfloat16)
+        contrib = lax.dot_general(oh_ref[...], vn,
+                                  (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        out_ref[...] += contrib[None]
+    return kernel
 
 
 def prep_hist_vals(grad: jnp.ndarray, hess: jnp.ndarray,
@@ -190,31 +238,30 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 
     """→ (n_slots, F, B, 3) float32 [grad, hess, count] histograms."""
     F, N = bins_t.shape
     B = total_bins
-    assert N % CHUNK == 0, f"N={N} must be a multiple of {CHUNK}"
+    ft, chunk = _tile_for(B)
+    assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
 
-    Fp = ((F + FEAT_TILE - 1) // FEAT_TILE) * FEAT_TILE
+    Fp = ((F + ft - 1) // ft) * ft
     if Fp != F:
         bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+    vals_lanes = jnp.tile(vals, (1, n_slots))          # (N, S·8)
+    VN = n_slots * SLOT_LANES
 
     out = pl.pallas_call(
-        _hist_nodes_kernel,
-        grid=(Fp // FEAT_TILE, N // CHUNK),
+        _make_hist_nodes_kernel(ft),
+        grid=(Fp // ft, N // chunk),
         in_specs=[
-            pl.BlockSpec((FEAT_TILE, CHUNK), lambda f, c: (f, c)),
-            pl.BlockSpec((1, CHUNK), lambda f, c: (0, c)),
-            pl.BlockSpec((CHUNK, SLOT_LANES), lambda f, c: (c, 0)),
+            pl.BlockSpec((ft, chunk), lambda f, c: (f, c)),
+            pl.BlockSpec((1, chunk), lambda f, c: (0, c)),
+            pl.BlockSpec((chunk, VN), lambda f, c: (c, 0)),
         ],
-        out_specs=pl.BlockSpec((1, FEAT_TILE * B, n_slots * SLOT_LANES),
-                               lambda f, c: (f, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct(
-            (Fp // FEAT_TILE, FEAT_TILE * B, n_slots * SLOT_LANES), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((FEAT_TILE * B, CHUNK), jnp.bfloat16),
-                        pltpu.VMEM((CHUNK, n_slots * SLOT_LANES), jnp.bfloat16)],
+        out_specs=pl.BlockSpec((1, ft * B, VN), lambda f, c: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp // ft, ft * B, VN), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.bfloat16)],
         interpret=interpret,
-    )(bins_t, slot[None, :], vals)
+    )(bins_t, slot[None, :], vals_lanes)
 
-    # (F/8, 8·B, S·8) → (F, B, S, 8) → (S, F, B, 3)
-    out = out.reshape(Fp // FEAT_TILE, FEAT_TILE, B, n_slots, SLOT_LANES)
+    # (F/ft, ft·B, S·8) → (F, B, S, 8) → (S, F, B, 3)
     out = out.reshape(Fp, B, n_slots, SLOT_LANES)[:F]
     out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)
     gsum = out[..., 0] + out[..., 1]
@@ -230,108 +277,125 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 
 # selected splits to every row (new node id + histogram slot) and (2) build
 # the left-child histograms.  As separate kernels each scans the matrix
 # once; fused, the grid runs chunk-major (f innermost) so each chunk's
-# routing is computed ONCE at f==0 — from a full-F view of the same bins
-# array — and the per-chunk slot assignment + node-masked value matrix stay
-# in VMEM for the F/8 histogram steps that follow.  The histogram
-# accumulator is a single constant-index output block (F/8, 8B, S·8 ≈ 4 MB)
+# routing is computed ONCE at f==0 and the node-masked value matrix stays
+# in VMEM for the F/ft histogram steps that follow.  The histogram
+# accumulator is a single constant-index output block (F/ft, ft·B, S·8)
 # resident in VMEM for the whole launch.
+#
+# Round-3 surgery (each measured on v5e @ 1M×28): the split features'
+# bin rows arrive PRE-GATHERED as a (S, N) matrix (jnp.take on the feature
+# axis — a contiguous row copy) so the kernel indexes them statically —
+# the former in-kernel ``pl.dslice(feat_ref[j], 1)`` dynamic sublane read
+# cost more than the histogram matmul it fed; the slot-masked value matrix
+# is one wide lane-iota compare instead of S narrow 8-lane writes; and the
+# (ft, chunk) geometry widens with small B (``_tile_for``).  Together:
+# 27 ms → 10.5 ms per level pass at max_bin=63.
 
 
-def _fused_route_hist_kernel(leaf_ref, feat_ref, thr_ref, lid_ref, rid_ref,
-                             bins_full_ref, bins_ref, nid_ref, vals_ref,
-                             newid_ref, out_ref, oh_ref, vn_ref, slot_ref):
-    """Grid (N//CHUNK, F//FEAT_TILE) — f fastest.  bins_full block (F, C)
-    (routing view), bins block (8, C) (histogram tile), nid (1, C),
-    vals (C, 8) bf16; outputs: newid (1, C) and the resident histogram
-    accumulator (F//8, 8B, S·8) f32."""
-    c = pl.program_id(0)
-    f = pl.program_id(1)
+def _make_fused_kernel(ft: int):
+    def kernel(leaf_ref, thr_ref, lid_ref, rid_ref,
+               sel_ref, bins_ref, nid_ref, vals_ref,
+               newid_ref, out_ref, oh_ref, vn_ref):
+        """Grid (N//chunk, F//ft) — f fastest.  sel block (S, C) int32 (the
+        split features' bin rows), bins block (ft, C) (histogram tile),
+        nid (1, C), vals (C, S·8) bf16 pre-tiled; outputs: newid (1, C) and
+        the resident histogram accumulator (F//ft, ft·B, S·8) f32."""
+        c = pl.program_id(0)
+        f = pl.program_id(1)
 
-    @pl.when((c == 0) & (f == 0))
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
+        @pl.when((c == 0) & (f == 0))
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
 
-    C = bins_ref.shape[1]
-    B = oh_ref.shape[0] // FEAT_TILE
-    S = vn_ref.shape[1] // SLOT_LANES
+        C = bins_ref.shape[1]
+        B = oh_ref.shape[0] // ft
+        S = vn_ref.shape[1] // SLOT_LANES
 
-    @pl.when(f == 0)
-    def _route():
-        nid = nid_ref[0, :]
-        new = nid
-        bslot = jnp.full_like(nid, -1)
-        for j in range(S):
-            xb = bins_full_ref[pl.dslice(feat_ref[j], 1), :][0]
-            inleaf = nid == leaf_ref[j]
-            gl = xb <= thr_ref[j]
-            new = jnp.where(inleaf, jnp.where(gl, lid_ref[j], rid_ref[j]),
-                            new)
-            bslot = jnp.where(inleaf & gl, j, bslot)
-        newid_ref[0, :] = new
-        slot_ref[0, :] = bslot
-        vals = vals_ref[...]
-        for j in range(S):
-            m = (bslot == j).astype(jnp.float32)[:, None].astype(jnp.bfloat16)
-            vn_ref[:, j * SLOT_LANES:(j + 1) * SLOT_LANES] = vals * m
+        @pl.when(f == 0)
+        def _route():
+            nid = nid_ref[0, :]
+            new = nid
+            bslot = jnp.full_like(nid, -1)
+            for j in range(S):
+                inleaf = nid == leaf_ref[j]
+                gl = sel_ref[j, :] <= thr_ref[j]
+                new = jnp.where(inleaf,
+                                jnp.where(gl, lid_ref[j], rid_ref[j]), new)
+                bslot = jnp.where(inleaf & gl, j, bslot)
+            newid_ref[0, :] = new
+            lane_j = lax.broadcasted_iota(
+                jnp.int32, (C, S * SLOT_LANES), 1) // SLOT_LANES
+            vn_ref[...] = vals_ref[...] * (bslot[:, None] == lane_j).astype(
+                jnp.bfloat16)
 
-    iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
-    for ft in range(FEAT_TILE):
-        b = bins_ref[ft, :]
-        oh_ref[ft * B:(ft + 1) * B, :] = (iota_b == b[None, :]).astype(jnp.bfloat16)
-    contrib = lax.dot_general(oh_ref[...], vn_ref[...],
-                              (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-    out_ref[f, :, :] += contrib
+        iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
+        for k in range(ft):
+            b = bins_ref[k, :]
+            oh_ref[k * B:(k + 1) * B, :] = (iota_b == b[None, :]).astype(
+                jnp.bfloat16)
+        contrib = lax.dot_general(oh_ref[...], vn_ref[...],
+                                  (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        out_ref[f, :, :] += contrib
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots", "total_bins",
                                              "interpret"))
-def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 0
+def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
                           node_id: jnp.ndarray,  # (N,) int32
                           leaf: jnp.ndarray,     # (S,) int32 leaf being split
                           feat: jnp.ndarray,     # (S,) int32 split feature
                           thr_bin: jnp.ndarray,  # (S,) int32 bin (<= goes left)
                           l_id: jnp.ndarray,     # (S,) int32 left child id
                           r_id: jnp.ndarray,     # (S,) int32 right child id
-                          vals: jnp.ndarray,     # (N, 8) bf16 prep_hist_vals
+                          vals: jnp.ndarray,     # (N, S·8) bf16 tiled
                           n_slots: int,
                           total_bins: int,
                           interpret: bool = False):
-    """One pass: → (new_node_id (N,), hists (n_slots, F, B, 3))."""
+    """One pass: → (new_node_id (N,), hists (n_slots, F, B, 3)).
+
+    ``vals`` is :func:`prep_hist_vals` output tiled to (N, n_slots·8) —
+    the caller tiles ONCE per tree, not per wave."""
     F, N = bins_t.shape
     B = total_bins
-    assert N % CHUNK == 0, f"N={N} must be a multiple of {CHUNK}"
-    Fp = ((F + FEAT_TILE - 1) // FEAT_TILE) * FEAT_TILE
+    geo = fused_geometry(F, B, n_slots)
+    assert geo is not None, (
+        f"fused kernel does not fit VMEM at F={F}, B={B}, S={n_slots}; "
+        "the caller must gate on fused_geometry(...)")
+    ft, chunk = geo
+    assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
+    Fp = ((F + ft - 1) // ft) * ft
     if Fp != F:
         bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+    sel = jnp.take(bins_t, feat, axis=0)               # (S, N) row copy
     VN = n_slots * SLOT_LANES
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(N // CHUNK, Fp // FEAT_TILE),
+        num_scalar_prefetch=4,
+        grid=(N // chunk, Fp // ft),
         in_specs=[
-            pl.BlockSpec((Fp, CHUNK), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((FEAT_TILE, CHUNK), lambda c, f, *_: (f, c)),
-            pl.BlockSpec((1, CHUNK), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((CHUNK, SLOT_LANES), lambda c, f, *_: (c, 0)),
+            pl.BlockSpec((n_slots, chunk), lambda c, f, *_: (0, c)),
+            pl.BlockSpec((ft, chunk), lambda c, f, *_: (f, c)),
+            pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
+            pl.BlockSpec((chunk, VN), lambda c, f, *_: (c, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, CHUNK), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((Fp // FEAT_TILE, FEAT_TILE * B, VN),
+            pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
+            pl.BlockSpec((Fp // ft, ft * B, VN),
                          lambda c, f, *_: (0, 0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((FEAT_TILE * B, CHUNK), jnp.bfloat16),
-                        pltpu.VMEM((CHUNK, VN), jnp.bfloat16),
-                        pltpu.VMEM((1, CHUNK), jnp.int32)],
+        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.bfloat16),
+                        pltpu.VMEM((chunk, VN), jnp.bfloat16)],
     )
     new_id, out = pl.pallas_call(
-        _fused_route_hist_kernel,
+        _make_fused_kernel(ft),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((1, N), jnp.int32),
                    jax.ShapeDtypeStruct(
-                       (Fp // FEAT_TILE, FEAT_TILE * B, VN), jnp.float32)],
+                       (Fp // ft, ft * B, VN), jnp.float32)],
         interpret=interpret,
-    )(leaf, feat, thr_bin, l_id, r_id,
-      bins_t, bins_t, node_id[None, :], vals)
+    )(leaf, thr_bin, l_id, r_id,
+      sel, bins_t, node_id[None, :], vals)
 
     out = out.reshape(Fp, B, n_slots, SLOT_LANES)[:F]
     out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)

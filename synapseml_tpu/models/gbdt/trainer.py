@@ -391,7 +391,8 @@ def _build_hist_nodes(bins_t, flat_bins, vals8, grad, hess, mask, slot,
                       n_slots, F, B, use_pallas):
     if use_pallas:
         from .pallas_hist import build_hist_nodes_pallas
-        return build_hist_nodes_pallas(bins_t, slot, vals8, n_slots, B)
+        return build_hist_nodes_pallas(bins_t, slot, vals8, n_slots, B,
+                                       interpret=(use_pallas == "interpret"))
     return _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot,
                                  n_slots, F, B)
 
@@ -439,6 +440,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         return lax.psum(x, axis_name) if axis_name else x
 
     vals8 = prep_hist_vals(grad, hess, row_valid) if use_pallas else None
+    # tiled to the kernel's (N, S·8) lane layout ONCE per tree — tiling
+    # per wave would re-materialize a (N, 128) bf16 array every level
+    vals_tiled = jnp.tile(vals8, (1, S)) if use_pallas else None
     flat_bins = None
     if not use_pallas:
         flat_bins = bins_t + (jnp.arange(F, dtype=jnp.int32) * B)[:, None]
@@ -509,9 +513,31 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         # chunk's routing once and keeps it in VMEM for the histogram tiles
         if use_pallas:
             from .pallas_hist import route_and_hist_pallas
-            new_node_id, l_hists = route_and_hist_pallas(
-                bins_t, s["node_id"], parents, s["best_feat"][parents],
-                s["best_bin"][parents], l_ids, r_ids, vals8, S, B)
+
+            def fused_wave(_):
+                return route_and_hist_pallas(
+                    bins_t, s["node_id"], parents, s["best_feat"][parents],
+                    s["best_bin"][parents], l_ids, r_ids, vals_tiled, S, B,
+                    interpret=(use_pallas == "interpret"))
+
+            def route_only(_):
+                # this wave fills the leaf budget: its child histograms can
+                # never feed another split, so skip the one-hot pass (one of
+                # five full-data passes per 31-leaf tree) and route in plain
+                # XLA from the gathered split-feature rows.  Child pick
+                # stats (sum_g/h/c) come from the parent pick, not from
+                # these histograms, so zeros are safe.
+                sel = jnp.take(bins_t, s["best_feat"][parents], axis=0)
+                inleaf = s["node_id"][None, :] == parents[:, None]   # (S, N)
+                gl = sel <= s["best_bin"][parents][:, None]
+                new = (jnp.sum(jnp.where(inleaf & gl, l_ids[:, None], 0), 0)
+                       + jnp.sum(jnp.where(inleaf & ~gl, r_ids[:, None], 0), 0)
+                       + jnp.where(jnp.any(inleaf, 0), 0, s["node_id"]))
+                return new, jnp.zeros((S, F, B, 3), jnp.float32)
+
+            leaves_after = (s["num_nodes"] + 1) // 2 + n_valid
+            new_node_id, l_hists = lax.cond(leaves_after >= L,
+                                            route_only, fused_wave, None)
             l_hists = ar(l_hists)
         else:
             slot_of_leaf = jnp.full(M, -1, jnp.int32).at[parents].set(
