@@ -20,11 +20,15 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _block_attn(q, k, v, key_mask, m, l, o, scale):
+def _block_attn(q, k, v, key_mask, m, l, o, scale, p_for_values=None):
     """One K/V block's contribution with online softmax.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); key_mask: (B, Sk) bool;
     m/l: (B, H, Sq) fp32 running max / normalizer; o: (B, Sq, H, D) fp32.
+    ``p_for_values`` optionally transforms the un-normalized probs before
+    the value matmul ONLY (the normalizer stays transform-free) — the hook
+    blockwise attention uses for probs-dropout, so train- and eval-time
+    attention share this one softmax update.
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if key_mask is not None:
@@ -35,7 +39,8 @@ def _block_attn(q, k, v, key_mask, m, l, o, scale):
     correction = jnp.exp(m - new_m)
     p = jnp.exp(logits - new_m[..., None])                    # (B,H,Sq,Sk)
     new_l = l * correction + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    pv_p = p if p_for_values is None else p_for_values(p)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", pv_p, v.astype(jnp.float32))
     new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
     return new_m, new_l, new_o
 

@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 #: logical→mesh axis mapping used by pjit sharding: fan-out dims ride the
 #: tensor-parallel axis, everything else is replicated.
@@ -55,6 +56,11 @@ class TransformerConfig:
     num_classes: int = 2
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    #: "auto" — einsum attention for short sequences (the S² logits of a
+    #: 128-token batch are cheap and XLA fuses them well), blockwise
+    #: online-softmax beyond _BLOCKWISE_MIN_SEQ so the logits never
+    #: materialize at O(S²); "einsum"/"blockwise" force a path
+    attention_impl: str = "auto"
     #: rematerialize each encoder block's activations in the backward pass
     #: (jax.checkpoint): activation memory drops from O(layers) to O(1)
     #: blocks for ~1/3 extra FLOPs — the knob that fits longer sequences /
@@ -88,6 +94,69 @@ def _dense(features, kernel_axes, name, dtype, use_bias=True):
         name=name)
 
 
+#: sequence length above which "auto" switches to blockwise attention
+_BLOCKWISE_MIN_SEQ = 1024
+#: K/V block width for the blockwise scan
+_BLOCK_K = 512
+
+
+def _blockwise_attention(q, k, v, mask, scale, dropout_rate, deterministic,
+                         dropout_rng, block_k=_BLOCK_K):
+    """Exact attention as an online-softmax scan over K/V blocks — the
+    ring-attention inner step (ring_attention.py:_block_attn) run
+    single-device: peak memory is O(S·block_k) instead of the einsum
+    path's O(S²) materialized logits, which is what makes 16k–32k token
+    sequences fit one chip.  Attention-probs dropout is applied per block
+    (fold_in on the block index), matching the einsum path's semantics
+    with a different — equally valid — random stream.
+
+    q/k/v: (B, S, H, D); mask: (B, S) key mask or None."""
+    from .ring_attention import _block_attn
+
+    B, S, H, D = q.shape
+    nb = -(-S // block_k)
+    pad = nb * block_k - S
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        mask = (jnp.pad(mask, ((0, 0), (0, pad))) if mask is not None
+                else jnp.pad(jnp.ones((B, S), bool), ((0, 0), (0, pad))))
+    kb = jnp.moveaxis(k.reshape(B, nb, block_k, H, D), 1, 0)
+    vb = jnp.moveaxis(v.reshape(B, nb, block_k, H, D), 1, 0)
+    mb = (jnp.moveaxis(mask.reshape(B, nb, block_k), 1, 0)
+          if mask is not None else None)
+    drop = dropout_rate > 0.0 and not deterministic
+
+    def body(carry, inp):
+        m, l, o = carry
+        if mb is not None:
+            i, kv, vv, km = inp
+        else:
+            i, kv, vv = inp
+            km = None
+        thin = None
+        if drop:
+            # dropout hits the un-normalized probs on the VALUE path only;
+            # the normalizer stays dropout-free, matching the einsum
+            # path's nn.Dropout(softmax(logits)) semantics
+            def thin(p):
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(dropout_rng, i),
+                    1.0 - dropout_rate, p.shape)
+                return jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        m, l, o = _block_attn(q, kv, vv, km, m, l, o, scale,
+                              p_for_values=thin)
+        return (m, l, o), None
+
+    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    xs = (jnp.arange(nb), kb, vb) + ((mb,) if mb is not None else ())
+    (m, l, o), _ = jax.lax.scan(body, (m0, l0, o0), xs)
+    out = o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
 class SelfAttention(nn.Module):
     cfg: TransformerConfig
 
@@ -118,6 +187,17 @@ class SelfAttention(nn.Module):
                     "ring_attention.py ring_attention() for the wrapper); "
                     "for GSPMD sequence parallelism instead, shard the "
                     "batch over (data, seq) and leave this flag off") from e
+        elif cfg.attention_impl not in ("auto", "einsum", "blockwise"):
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r}: expected 'auto', "
+                "'einsum', or 'blockwise'")
+        elif (cfg.attention_impl == "blockwise"
+              or (cfg.attention_impl == "auto" and S >= _BLOCKWISE_MIN_SEQ)):
+            rng = (self.make_rng("dropout")
+                   if cfg.dropout_rate > 0.0 and not deterministic else None)
+            out = _blockwise_attention(q, k, v, mask,
+                                       1.0 / float(np.sqrt(d_head)),
+                                       cfg.dropout_rate, deterministic, rng)
         else:
             scale = 1.0 / jnp.sqrt(d_head).astype(cfg.dtype)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

@@ -2,7 +2,7 @@
 reference's only LLM surface is remote OpenAI calls,
 cognitive/.../openai/OpenAI.scala:246)."""
 
-from .generate import generate, sample_logits
+from .generate import cast_params, generate, sample_logits
 from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
                     causal_lm_loss, init_cache, llama_from_pretrained,
@@ -12,6 +12,6 @@ from .stage import LLMTransformer
 __all__ = [
     "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LLMTransformer",
     "LlamaConfig", "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss",
-    "generate", "init_cache", "llama_from_pretrained",
+    "cast_params", "generate", "init_cache", "llama_from_pretrained",
     "rope_frequencies", "sample_logits",
 ]

@@ -313,3 +313,128 @@ def test_deep_text_classifier_remat_flag():
     model = clf.fit(ds)
     out = model.transform(ds)
     assert "prediction" in out.columns
+
+
+def test_blockwise_attention_matches_einsum():
+    """Blockwise online-softmax attention (the long-sequence path) equals
+    the einsum path, including a ragged key mask and a sequence length
+    that doesn't divide the K block."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.dl.transformer import (TextEncoder,
+                                                     TransformerConfig)
+
+    cfg = TransformerConfig.tiny(num_classes=3)
+    cfg_b = dataclasses.replace(cfg, attention_impl="blockwise")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 70))
+    mask = np.ones((2, 70), bool)
+    mask[1, 40:] = False
+    m_e = TextEncoder(cfg)
+    m_b = TextEncoder(cfg_b)
+    variables = jax.jit(m_e.init)(jax.random.PRNGKey(0),
+                                  jnp.asarray(ids), jnp.asarray(mask))
+    out_e = m_e.apply(variables, jnp.asarray(ids), jnp.asarray(mask))
+    out_b = m_b.apply(variables, jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out_e, np.float32),
+                               np.asarray(out_b, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_blockwise_dropout_trains_and_is_deterministic():
+    """The blockwise path's per-block probs dropout produces a valid
+    training step: same key -> identical loss, different key -> different
+    loss (the stream is real)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.dl.transformer import (TextEncoder,
+                                                     TransformerConfig)
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(num_classes=2),
+                              attention_impl="blockwise", dropout_rate=0.2)
+    m = TextEncoder(cfg)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 33)))
+    mask = jnp.ones((2, 33), bool)
+    variables = jax.jit(m.init)(jax.random.PRNGKey(0), ids, mask)
+
+    def fwd(key):
+        return np.asarray(m.apply(variables, ids, mask,
+                                  deterministic=False,
+                                  rngs={"dropout": key}), np.float32)
+
+    a = fwd(jax.random.PRNGKey(7))
+    b = fwd(jax.random.PRNGKey(7))
+    c = fwd(jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(a, b)
+    assert np.abs(a - c).max() > 1e-6
+
+
+def test_rbg_dropout_key_deterministic_step():
+    """DLTrainer's rbg dropout re-wrap: same dropout_key -> bit-identical
+    step results (per-step reproducibility survives the impl change)."""
+    import jax
+
+    from synapseml_tpu.models.dl.training import _rbg_key
+
+    k = _rbg_key(jax.random.PRNGKey(3))
+    a = jax.random.bernoulli(k, 0.5, (64,))
+    b = jax.random.bernoulli(_rbg_key(jax.random.PRNGKey(3)), 0.5, (64,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # typed keys re-wrap too
+    k2 = _rbg_key(jax.random.key(3))
+    assert jax.random.bernoulli(k2, 0.5, (8,)).shape == (8,)
+
+
+def test_blockwise_attention_multiblock_scan_carry():
+    """block_k smaller than S forces multiple scan steps, pinning the
+    online-softmax carry (cross-block max, normalizer rescale, output
+    correction) that a single-block call never exercises."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.dl.transformer import _blockwise_attention
+
+    rng = np.random.default_rng(9)
+    B, S, H, D = 2, 70, 4, 16
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+    mask = np.ones((B, S), bool)
+    mask[1, 50:] = False
+    scale = 1.0 / np.sqrt(D)
+
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = np.where(np.asarray(mask)[:, None, None, :], logits, -1e30)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", probs, np.asarray(v))
+
+    for bk in (16, 32, 512):        # 5 blocks, 3 blocks, single block
+        out = _blockwise_attention(q, k, v, jnp.asarray(mask), scale,
+                                   0.0, True, None, block_k=bk)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4, err_msg=f"block_k={bk}")
+
+
+def test_attention_impl_validated():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from synapseml_tpu.models.dl.transformer import (TextEncoder,
+                                                     TransformerConfig)
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), attention_impl="flash")
+    m = TextEncoder(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="attention_impl"):
+        m.init(jax.random.PRNGKey(0), ids, jnp.ones((1, 8), bool))
