@@ -232,17 +232,21 @@ def bench_llm():
     # 128 rows, so step time is K·N-bound and tokens/s scales ~linearly
     # with batch until M≈128 — batching, not kernel work, is the TPU's
     # decode-throughput lever
-    rates = {}
+    rates = {8: None, 32: None}
     for B in (8, 32):
-        ids = rng.integers(0, cfg.vocab_size, (B, P))
-        generate(model, variables, ids, max_new_tokens=NEW)  # compile
-        best = 0.0
-        for _ in range(2):
-            t0 = time.perf_counter()
-            out = generate(model, variables, ids, max_new_tokens=NEW)
-            best = max(best, B * NEW / (time.perf_counter() - t0))
-        assert out.shape == (B, NEW)
-        rates[B] = best
+        try:
+            ids = rng.integers(0, cfg.vocab_size, (B, P))
+            generate(model, variables, ids, max_new_tokens=NEW)  # compile
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = generate(model, variables, ids, max_new_tokens=NEW)
+                best = max(best, B * NEW / (time.perf_counter() - t0))
+            assert out.shape == (B, NEW)
+            rates[B] = best
+        except Exception as e:    # keep the batch-8 number if B=32 OOMs
+            print(f"[secondary] LLM decode batch {B} failed: {e}",
+                  file=sys.stderr)
     return rates[8], rates[32]
 
 

@@ -100,9 +100,23 @@ class BoostingConfig:
     #: bin-range splits act as category-subset splits; such models predict
     #: through bin space (no raw-threshold semantics)
     categorical_feature: Optional[List[int]] = None
+    #: per-feature monotone direction {-1, 0, +1} (monotoneConstraints,
+    #: params/LightGBMParams.scala:168-183): +1 forces predictions
+    #: non-decreasing in the feature, -1 non-increasing.  Implemented
+    #: method: "basic" (LightGBM's default) — violating splits discarded,
+    #: child outputs clamped by bounds propagated down the tree
+    monotone_constraints: Optional[List[int]] = None
+    monotone_constraints_method: str = "basic"
+    #: gain penalization for constrained-feature splits near the root
+    #: (monotonePenalty, BaseTrainParams.scala:128-130): 1 forbids them at
+    #: the root, larger values reach deeper
+    monotone_penalty: float = 0.0
     pass_through: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def growth_params(self) -> GrowthParams:
+        mono = None
+        if self.monotone_constraints and any(self.monotone_constraints):
+            mono = tuple(int(c) for c in self.monotone_constraints)
         return GrowthParams(
             num_leaves=self.num_leaves,
             max_depth=self.max_depth,
@@ -113,6 +127,8 @@ class BoostingConfig:
             min_gain_to_split=self.min_gain_to_split,
             total_bins=self.max_bin + 1,
             voting_k=self.top_k if self.parallelism == "voting_parallel" else 0,
+            monotone_constraints=mono,
+            monotone_penalty=float(self.monotone_penalty),
         )
 
 
@@ -705,6 +721,34 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     else:
         X = np.ascontiguousarray(X, np.float32)
         n, F = X.shape
+
+    if config.monotone_constraints and any(config.monotone_constraints):
+        if config.monotone_constraints_method != "basic":
+            raise NotImplementedError(
+                f"monotone_constraints_method="
+                f"{config.monotone_constraints_method!r}: only 'basic' "
+                "(LightGBM's default) is implemented; the 'intermediate'/"
+                "'advanced' refinements relax different splits and would "
+                "silently change semantics")
+        if len(config.monotone_constraints) != F:
+            raise ValueError(
+                f"monotone_constraints has "
+                f"{len(config.monotone_constraints)} entries for {F} "
+                "features")
+        if any(int(c) not in (-1, 0, 1) for c in config.monotone_constraints):
+            raise ValueError("monotone_constraints entries must be -1, 0, "
+                             "or 1")
+        if config.enable_bundle:
+            raise NotImplementedError(
+                "monotone_constraints + enable_bundle: bundled columns mix "
+                "original features, so per-feature output bounds cannot "
+                "apply")
+        cats = set(config.categorical_feature or [])
+        if any(int(c) != 0 and i in cats
+               for i, c in enumerate(config.monotone_constraints)):
+            raise ValueError("monotone constraints on categorical features "
+                             "are not meaningful (category-subset splits "
+                             "have no direction)")
 
     # distributed lambdarank: pack WHOLE groups onto shards up front (the
     # reference's query-rows-share-a-partition rule); rows permute into

@@ -101,6 +101,19 @@ class GBDTParams(Params):
             "reference's numBatches warm start)")
     checkpointInterval = IntParam(doc="save every N boosting iterations "
                                       "(0 = off)", default=0)
+    monotoneConstraints = ListParam(
+        doc="per-feature monotone direction {-1, 0, 1} "
+            "(monotoneConstraints parity, params/LightGBMParams.scala:"
+            "168-183): 1 forces predictions non-decreasing in the "
+            "feature, -1 non-increasing")
+    monotoneConstraintsMethod = StringParam(
+        doc="constraint enforcement method (monotoneConstraintsMethod); "
+            "'basic' is implemented", default="basic",
+        allowed=("basic", "intermediate", "advanced"))
+    monotonePenalty = FloatParam(
+        doc="gain penalization for constrained-feature splits near the "
+            "root (monotonePenalty): 1 forbids them at the root",
+        default=0.0)
     passThroughArgs = DictParam(doc="extra engine params (ParamsStringBuilder "
                                     "pass-through analogue)")
     predictDisableShapeCheck = BoolParam(doc="skip feature-count check at "
@@ -142,6 +155,10 @@ class GBDTParams(Params):
             max_conflict_rate=self.maxConflictRate,
             categorical_feature=[int(i) for i in self.categoricalSlotIndexes]
             if self.get("categoricalSlotIndexes") else None,
+            monotone_constraints=[int(c) for c in self.monotoneConstraints]
+            if self.get("monotoneConstraints") else None,
+            monotone_constraints_method=self.monotoneConstraintsMethod,
+            monotone_penalty=self.monotonePenalty,
         )
         for k, v in extra.items():
             if hasattr(cfg, k):

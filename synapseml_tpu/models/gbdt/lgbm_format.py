@@ -165,7 +165,20 @@ def booster_to_lgbm_string(booster) -> str:
         header.append("average_output")
     body = "\n\n".join(blocks)
     header.append("tree_sizes=" + " ".join(str(len(b) + 1) for b in blocks))
-    return "\n".join(header) + "\n\n" + body + "\nend of trees\n"
+    out = "\n".join(header) + "\n\n" + body + "\nend of trees\n"
+    mono = booster.config.monotone_constraints
+    if mono and any(mono):
+        # LightGBM-style parameters section so constrained models survive
+        # the round trip (LightGBM emits the full config here; we carry
+        # the monotone settings, the ones that change predict semantics)
+        out += ("\nparameters:\n"
+                "[monotone_constraints: "
+                + ",".join(str(int(c)) for c in mono) + "]\n"
+                "[monotone_constraints_method: "
+                + booster.config.monotone_constraints_method + "]\n"
+                f"[monotone_penalty: {booster.config.monotone_penalty}]\n"
+                "end of parameters\n")
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -290,10 +303,26 @@ def booster_from_lgbm_string(s: str):
     trees = [_tree_from_block(p, max_leaves) for p in parsed]
 
     objective = str(obj["objective"])
+    mkw = {}
+    mtc = re.search(r"\[monotone_constraints:\s*([^\]]*)\]", s)
+    if mtc and mtc.group(1).strip():
+        vals = [int(v) for v in re.split(r"[,\s]+", mtc.group(1).strip())
+                if v]
+        if any(vals):
+            mkw["monotone_constraints"] = vals
+    mmeth = re.search(r"\[monotone_constraints_method:\s*([^\]]*)\]", s)
+    if mmeth and mmeth.group(1).strip():
+        mkw["monotone_constraints_method"] = mmeth.group(1).strip()
+    mpen = re.search(r"\[monotone_penalty:\s*([^\]]*)\]", s)
+    if mpen:
+        try:
+            mkw["monotone_penalty"] = float(mpen.group(1))
+        except ValueError:
+            pass
     cfg = BoostingConfig(objective=objective,
                          boosting_type="rf" if is_rf else "gbdt",
                          num_class=K if K > 1 else 1,
-                         num_leaves=max(max_leaves, 2))
+                         num_leaves=max(max_leaves, 2), **mkw)
     mapper = BinMapper(upper_bounds=np.full((F, 255), np.inf, np.float32),
                        num_bins=np.ones(F, np.int32), max_bin=255)
     return Booster(trees=trees,

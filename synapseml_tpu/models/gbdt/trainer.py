@@ -49,6 +49,14 @@ class GrowthParams(NamedTuple):
     min_gain_to_split: float = 0.0
     total_bins: int = 256             # B (incl. missing bin 0)
     voting_k: int = 0                 # >0: voting-parallel with this top-k
+    #: per-feature {-1, 0, +1} (None: unconstrained) — LightGBM's
+    #: ``monotone_constraints`` (params/LightGBMParams.scala:168-183);
+    #: the "basic" method: violating splits are discarded, child outputs
+    #: are clamped to bounds propagated down the tree
+    monotone_constraints: Optional[Tuple[int, ...]] = None
+    #: gain penalization for splits on constrained features near the root
+    #: (LightGBM ``monotone_penalty``, BaseTrainParams.scala:128-130)
+    monotone_penalty: float = 0.0
 
 
 class Tree(NamedTuple):
@@ -104,20 +112,64 @@ def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas):
     return hist.at[flat_bins].add(upd)
 
 
+def _mono_penalty_factor(node_depth, penalty: float):
+    """LightGBM's ComputeMonotoneSplitGainPenalty: 1 forbids constrained
+    splits at the root, higher values reach deeper."""
+    eps = 1e-10
+    d = node_depth.astype(jnp.float32)
+    if penalty <= 1.0:
+        fac = 1.0 - penalty / jnp.exp2(d) + eps
+    else:
+        fac = 1.0 - jnp.exp2(jnp.float32(penalty) - 1.0 - d) + eps
+    return jnp.where(jnp.float32(penalty) >= d + 1.0, eps, fac)
+
+
+def _obj2(g, h, w, l1, l2):
+    """2× the objective reduction at leaf output ``w`` — equals
+    :func:`_leaf_score` when ``w`` is the unclamped optimum, so constrained
+    gains degrade exactly to the unconstrained formula when no bound
+    binds."""
+    return -(2.0 * g * w + (h + l2) * w * w + 2.0 * l1 * jnp.abs(w))
+
+
 def _gain_matrix(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
-                 node_depth, p: GrowthParams):
+                 node_depth, p: GrowthParams, node_lo=None, node_hi=None,
+                 mono_c=None):
     """Split-gain matrix (F, B) with invalid candidates at -inf, plus the
     cumulative left sums (F, B, 3) the winner's child stats read from.
 
     Split at bin b sends bins<=b left, b ∈ [0, B-2].
+
+    With ``mono_c`` ((F,) int32 in {-1,0,1}) and the node's output bounds
+    ``node_lo``/``node_hi``, gains come from CLAMPED child outputs, splits
+    whose clamped outputs violate the feature's direction are discarded,
+    and constrained-feature gains are penalized by depth
+    (``monotone_penalty``) — the LightGBM "basic" method.
     """
     F, B, _ = hist.shape
     cum = jnp.cumsum(hist, axis=1)                   # (F, B, 3)
     gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
     gr, hr, cr = sum_g - gl, sum_h - hl, sum_c - cl
-    gain = (_leaf_score(gl, hl, p.lambda_l1, p.lambda_l2)
-            + _leaf_score(gr, hr, p.lambda_l1, p.lambda_l2)
-            - _leaf_score(sum_g, sum_h, p.lambda_l1, p.lambda_l2))
+    if mono_c is None:
+        gain = (_leaf_score(gl, hl, p.lambda_l1, p.lambda_l2)
+                + _leaf_score(gr, hr, p.lambda_l1, p.lambda_l2)
+                - _leaf_score(sum_g, sum_h, p.lambda_l1, p.lambda_l2))
+    else:
+        wl = jnp.clip(_leaf_output(gl, hl, p.lambda_l1, p.lambda_l2),
+                      node_lo, node_hi)
+        wr = jnp.clip(_leaf_output(gr, hr, p.lambda_l1, p.lambda_l2),
+                      node_lo, node_hi)
+        wp = jnp.clip(_leaf_output(sum_g, sum_h, p.lambda_l1, p.lambda_l2),
+                      node_lo, node_hi)
+        gain = (_obj2(gl, hl, wl, p.lambda_l1, p.lambda_l2)
+                + _obj2(gr, hr, wr, p.lambda_l1, p.lambda_l2)
+                - _obj2(sum_g, sum_h, wp, p.lambda_l1, p.lambda_l2))
+        cvec = mono_c[:, None]
+        viol = (((cvec == 1) & (wl > wr)) | ((cvec == -1) & (wl < wr)))
+        gain = jnp.where(viol, -jnp.inf, gain)
+        if p.monotone_penalty > 0.0:
+            fac = _mono_penalty_factor(node_depth, p.monotone_penalty)
+            gain = jnp.where(cvec != 0, gain * fac, gain)
     bins_idx = jnp.arange(B)[None, :]
     valid = ((cl >= p.min_data_in_leaf) & (cr >= p.min_data_in_leaf)
              & (hl >= p.min_sum_hessian_in_leaf)
@@ -131,11 +183,13 @@ def _gain_matrix(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
 
 
 def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
-                node_depth, p: GrowthParams):
+                node_depth, p: GrowthParams, node_lo=None, node_hi=None,
+                mono_c=None):
     """Best (gain, feature, bin, left-sums) from a node histogram (F, B, 3)."""
     F, B, _ = hist.shape
     gain, cum = _gain_matrix(hist, sum_g, sum_h, sum_c, num_bins,
-                             feature_mask, node_depth, p)
+                             feature_mask, node_depth, p, node_lo, node_hi,
+                             mono_c)
     flat = jnp.argmax(gain)
     bf, bb = flat // B, flat % B
     bgain = gain[bf, bb]
@@ -143,9 +197,33 @@ def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
         cum[bf, bb, 0], cum[bf, bb, 1], cum[bf, bb, 2]
 
 
+def _mono_vec(p: GrowthParams, F: int):
+    """(F,) int32 constraint vector padded/truncated to the feature count
+    this grower sees (pallas feature padding adds unconstrained columns),
+    or None when unconstrained."""
+    if p.monotone_constraints is None or not any(p.monotone_constraints):
+        return None
+    c = tuple(p.monotone_constraints)[:F]
+    c = c + (0,) * (F - len(c))
+    return jnp.asarray(c, jnp.int32)
+
+
+def _mono_child_bounds(cf, lo, hi, wl, wr):
+    """Child output bounds after splitting on a feature with constraint
+    ``cf`` (basic method): the clamped child outputs' midpoint caps the
+    violating side; unconstrained split features pass bounds through."""
+    mid = 0.5 * (wl + wr)
+    l_lo = jnp.where(cf == -1, jnp.maximum(lo, mid), lo)
+    l_hi = jnp.where(cf == 1, jnp.minimum(hi, mid), hi)
+    r_lo = jnp.where(cf == 1, jnp.maximum(lo, mid), lo)
+    r_hi = jnp.where(cf == -1, jnp.minimum(hi, mid), hi)
+    return l_lo, l_hi, r_lo, r_hi
+
+
 def _best_split_voting(local_hist, sum_g, sum_h, sum_c, num_bins,
                        feature_mask, node_depth, p: GrowthParams,
-                       axis_name: str):
+                       axis_name: str, node_lo=None, node_hi=None,
+                       mono_c=None):
     """Voting-parallel split selection (LightGBM ``voting_parallel`` / the
     PV-Tree algorithm; reference surfaces it as the ``parallelism`` param,
     params/LightGBMParams.scala:25, topK LightGBMBase.scala:251).
@@ -165,7 +243,8 @@ def _best_split_voting(local_hist, sum_g, sum_h, sum_c, num_bins,
     # sums live in every feature's bins; feature 0 spans all rows)
     lsum = jnp.sum(local_hist[0], axis=0)            # (3,)
     lgain, _ = _gain_matrix(local_hist, lsum[0], lsum[1], lsum[2],
-                            num_bins, feature_mask, node_depth, p)
+                            num_bins, feature_mask, node_depth, p,
+                            node_lo, node_hi, mono_c)
     per_feat = jnp.max(lgain, axis=1)                # (F,)
     _, local_top = lax.top_k(per_feat, k)
     votes = jnp.zeros(F, jnp.float32).at[local_top].add(
@@ -182,7 +261,9 @@ def _best_split_voting(local_hist, sum_g, sum_h, sum_c, num_bins,
     # (3) aggregate only the voted features; pick the global best among them
     glob = lax.psum(local_hist[sel], axis_name)      # (sel_n, B, 3)
     ggain, cum = _gain_matrix(glob, sum_g, sum_h, sum_c, num_bins[sel],
-                              feature_mask[sel], node_depth, p)
+                              feature_mask[sel], node_depth, p,
+                              node_lo, node_hi,
+                              None if mono_c is None else mono_c[sel])
     flat = jnp.argmax(ggain)
     bi, bb = flat // B, flat % B
     return ggain[bi, bb], sel[bi], bb.astype(jnp.int32), \
@@ -217,18 +298,19 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     # features inside _best_split_voting; full data-parallel psums every
     # histogram as it is built
     voting = p.voting_k > 0 and axis_name is not None
+    mono_c = _mono_vec(p, F)
 
     def ar(x):
         return lax.psum(x, axis_name) if (axis_name and not voting) else x
 
     if voting:
-        def pick(hist3, g, h, c, depth):
+        def pick(hist3, g, h, c, depth, lo, hi):
             return _best_split_voting(hist3, g, h, c, num_bins, feature_mask,
-                                      depth, p, axis_name)
+                                      depth, p, axis_name, lo, hi, mono_c)
     else:
-        def pick(hist3, g, h, c, depth):
+        def pick(hist3, g, h, c, depth, lo, hi):
             return _best_split(hist3, g, h, c, num_bins, feature_mask,
-                               depth, p)
+                               depth, p, lo, hi, mono_c)
 
     flat_bins = None
     if not use_pallas:
@@ -266,10 +348,13 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         right_child=jnp.full(M, -1, jnp.int32),
         num_nodes=jnp.ones((), jnp.int32),
         next_slot=jnp.ones((), jnp.int32),
+        node_lo=jnp.full(M, -jnp.inf, jnp.float32),
+        node_hi=jnp.full(M, jnp.inf, jnp.float32),
     )
 
     bg, bf_, bb, bgl, bhl, bcl = pick(root_hist, root_g, root_h, root_c,
-                                      jnp.zeros((), jnp.int32))
+                                      jnp.zeros((), jnp.int32),
+                                      -jnp.inf, jnp.inf)
     state["best_gain"] = state["best_gain"].at[0].set(bg)
     state["best_feat"] = state["best_feat"].at[0].set(bf_)
     state["best_bin"] = state["best_bin"].at[0].set(bb)
@@ -302,10 +387,21 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         rg, rh, rc = s["sum_g"][leaf] - lg, s["sum_h"][leaf] - lh, s["sum_c"][leaf] - lc
         cdepth = s["depth"][leaf] + 1
 
+        p_lo, p_hi = s["node_lo"][leaf], s["node_hi"][leaf]
+        if mono_c is None:
+            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
+        else:
+            wl = jnp.clip(_leaf_output(lg, lh, p.lambda_l1, p.lambda_l2),
+                          p_lo, p_hi)
+            wr = jnp.clip(_leaf_output(rg, rh, p.lambda_l1, p.lambda_l2),
+                          p_lo, p_hi)
+            l_lo, l_hi, r_lo, r_hi = _mono_child_bounds(
+                mono_c[feat], p_lo, p_hi, wl, wr)
+
         lbg, lbf, lbb, lbgl, lbhl, lbcl = pick(
-            l_hist.reshape(F, B, 3), lg, lh, lc, cdepth)
+            l_hist.reshape(F, B, 3), lg, lh, lc, cdepth, l_lo, l_hi)
         rbg, rbf, rbb, rbgl, rbhl, rbcl = pick(
-            r_hist.reshape(F, B, 3), rg, rh, rc, cdepth)
+            r_hist.reshape(F, B, 3), rg, rh, rc, cdepth, r_lo, r_hi)
 
         thr = jnp.where(sbin >= 1, upper_bounds[feat, jnp.maximum(sbin - 1, 0)],
                         -jnp.inf)
@@ -334,6 +430,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
             right_child=s["right_child"].at[leaf].set(r_id),
             num_nodes=s["num_nodes"] + 2,
             next_slot=s["next_slot"] + 1,
+            node_lo=s["node_lo"].at[l_id].set(l_lo).at[r_id].set(r_lo),
+            node_hi=s["node_hi"].at[l_id].set(l_hi).at[r_id].set(r_hi),
         )
 
     def body(_, s):
@@ -343,8 +441,11 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
 
     state = lax.fori_loop(0, L - 1, body, state)
 
-    node_value = learning_rate * _leaf_output(state["sum_g"], state["sum_h"],
-                                              p.lambda_l1, p.lambda_l2)
+    node_value = _leaf_output(state["sum_g"], state["sum_h"],
+                              p.lambda_l1, p.lambda_l2)
+    if mono_c is not None:
+        node_value = jnp.clip(node_value, state["node_lo"], state["node_hi"])
+    node_value = learning_rate * node_value
     leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
 
     tree = Tree(split_feature=state["split_feature"],
@@ -451,9 +552,11 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         return ar(_build_hist_nodes(bins_t, flat_bins, vals8, grad, hess,
                                     row_valid, slot, S, F, B, use_pallas))
 
+    mono_c = _mono_vec(p, F)
     pick = functools.partial(_best_split, num_bins=num_bins,
-                             feature_mask=feature_mask, p=p)
-    vpick = jax.vmap(lambda h, g, hh, c, d: pick(h, g, hh, c, node_depth=d))
+                             feature_mask=feature_mask, p=p, mono_c=mono_c)
+    vpick = jax.vmap(lambda h, g, hh, c, d, lo, hi: pick(
+        h, g, hh, c, node_depth=d, node_lo=lo, node_hi=hi))
 
     # root: one batched pass with every row in slot 0
     root_hist = build(jnp.zeros(N, jnp.int32))[0]          # (F, B, 3)
@@ -463,7 +566,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     zi = jnp.zeros(M, jnp.int32)
     zf = jnp.zeros(M, jnp.float32)
     bg, bf_, bb, bgl, bhl, bcl = pick(root_hist, root_g, root_h, root_c,
-                                      node_depth=jnp.zeros((), jnp.int32))
+                                      node_depth=jnp.zeros((), jnp.int32),
+                                      node_lo=-jnp.inf, node_hi=jnp.inf)
     state = dict(
         node_id=jnp.zeros(N, jnp.int32),
         hist=jnp.zeros((L + 2, F * B, 3), jnp.float32).at[0].set(
@@ -486,6 +590,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         right_child=jnp.full(M, -1, jnp.int32),
         num_nodes=jnp.ones((), jnp.int32),
         next_slot=jnp.ones((), jnp.int32),
+        node_lo=jnp.full(M, -jnp.inf, jnp.float32),
+        node_hi=jnp.full(M, jnp.inf, jnp.float32),
     )
 
     def cond(s):
@@ -565,13 +671,27 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         rc = s["sum_c"][parents] - lc
         cdepth = s["depth"][parents] + 1
 
+        p_lo, p_hi = s["node_lo"][parents], s["node_hi"][parents]   # (S,)
+        if mono_c is None:
+            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
+        else:
+            wl = jnp.clip(_leaf_output(lg, lh, p.lambda_l1, p.lambda_l2),
+                          p_lo, p_hi)
+            wr = jnp.clip(_leaf_output(rg, rh, p.lambda_l1, p.lambda_l2),
+                          p_lo, p_hi)
+            l_lo, l_hi, r_lo, r_hi = _mono_child_bounds(
+                mono_c[s["best_feat"][parents]], p_lo, p_hi, wl, wr)
+        c_lo = jnp.concatenate([l_lo, r_lo])
+        c_hi = jnp.concatenate([l_hi, r_hi])
+
         child_hists = jnp.concatenate(
             [l_flat.reshape(S, F, B, 3), r_flat.reshape(S, F, B, 3)])
         cg = jnp.concatenate([lg, rg])
         ch = jnp.concatenate([lh, rh])
         cc = jnp.concatenate([lc, rc])
         cd = jnp.concatenate([cdepth, cdepth])
-        cbg, cbf, cbb, cbgl, cbhl, cbcl = vpick(child_hists, cg, ch, cc, cd)
+        cbg, cbf, cbb, cbgl, cbhl, cbcl = vpick(child_hists, cg, ch, cc, cd,
+                                                c_lo, c_hi)
 
         cids = jnp.concatenate([l_ids, r_ids])           # (2S,)
         thr = jnp.where(s["best_bin"][parents] >= 1,
@@ -604,6 +724,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             right_child=s["right_child"].at[parents].set(r_ids),
             num_nodes=s["num_nodes"] + 2 * n_valid,
             next_slot=s["next_slot"] + n_valid,
+            node_lo=s["node_lo"].at[cids].set(c_lo),
+            node_hi=s["node_hi"].at[cids].set(c_hi),
         )
         # the junk row absorbed every masked-out write; scrub it
         out["active"] = out["active"].at[JUNK].set(False)
@@ -615,8 +737,11 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
 
     state = lax.while_loop(cond, wave, state)
 
-    node_value = learning_rate * _leaf_output(state["sum_g"], state["sum_h"],
-                                              p.lambda_l1, p.lambda_l2)
+    node_value = _leaf_output(state["sum_g"], state["sum_h"],
+                              p.lambda_l1, p.lambda_l2)
+    if mono_c is not None:
+        node_value = jnp.clip(node_value, state["node_lo"], state["node_hi"])
+    node_value = learning_rate * node_value
     leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
     tree = Tree(split_feature=state["split_feature"],
                 split_bin=state["split_bin"],
@@ -687,14 +812,23 @@ def grow_tree_feature_parallel(
         return _build_hist_nodes(bins_t, flat_bins, vals8, grad, hess,
                                  row_valid, slot, S, FL, B, use_pallas)
 
-    def pick_local(hist, g, h, c, depth):
-        return _best_split(hist, g, h, c, num_bins, feature_mask, depth, p)
+    # constraints come from the static tuple in p, so the GLOBAL vector is
+    # available on every rank; each rank's gain pass slices its own span
+    n_ranks = lax.axis_size(axis_name)
+    mono_global = _mono_vec(p, FL * n_ranks)
+    mono_local = (None if mono_global is None else
+                  lax.dynamic_slice(mono_global, (rank * FL,), (FL,)))
 
-    def global_pick(hist_s, g, h, c, depth):
+    def pick_local(hist, g, h, c, depth, lo, hi):
+        return _best_split(hist, g, h, c, num_bins, feature_mask, depth, p,
+                           lo, hi, mono_local)
+
+    def global_pick(hist_s, g, h, c, depth, lo, hi):
         """Per-node: local best over this rank's features, then a tiny
         all-gather picks the winner; returns global feature ids and the
         owner's raw-value threshold."""
-        bg, bf_, bb, bgl, bhl, bcl = pick_local(hist_s, g, h, c, depth)
+        bg, bf_, bb, bgl, bhl, bcl = pick_local(hist_s, g, h, c, depth,
+                                                lo, hi)
         thr = jnp.where(bb >= 1, upper_bounds[bf_, jnp.maximum(bb - 1, 0)],
                         -jnp.inf)
         packed = jnp.stack([bg, (rank * FL + bf_).astype(jnp.float32),
@@ -714,7 +848,8 @@ def grow_tree_feature_parallel(
     zi = jnp.zeros(M, jnp.int32)
     zf = jnp.zeros(M, jnp.float32)
     bg, bf_, bb, bgl, bhl, bcl, bthr = global_pick(
-        root_hist, root_g, root_h, root_c, jnp.zeros((), jnp.int32))
+        root_hist, root_g, root_h, root_c, jnp.zeros((), jnp.int32),
+        -jnp.inf, jnp.inf)
     state = dict(
         node_id=jnp.zeros(N, jnp.int32),
         hist=jnp.zeros((L + 2, FL * B, 3), jnp.float32).at[0].set(
@@ -738,6 +873,8 @@ def grow_tree_feature_parallel(
         right_child=jnp.full(M, -1, jnp.int32),
         num_nodes=jnp.ones((), jnp.int32),
         next_slot=jnp.ones((), jnp.int32),
+        node_lo=jnp.full(M, -jnp.inf, jnp.float32),
+        node_hi=jnp.full(M, jnp.inf, jnp.float32),
     )
 
     def cond(s):
@@ -795,13 +932,26 @@ def grow_tree_feature_parallel(
         rc = s["sum_c"][parents] - lc
         cdepth = s["depth"][parents] + 1
 
+        p_lo, p_hi = s["node_lo"][parents], s["node_hi"][parents]   # (S,)
+        if mono_global is None:
+            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
+        else:
+            wl = jnp.clip(_leaf_output(lg, lh, p.lambda_l1, p.lambda_l2),
+                          p_lo, p_hi)
+            wr = jnp.clip(_leaf_output(rg, rh, p.lambda_l1, p.lambda_l2),
+                          p_lo, p_hi)
+            l_lo, l_hi, r_lo, r_hi = _mono_child_bounds(
+                mono_global[wf], p_lo, p_hi, wl, wr)
+        c_lo = jnp.concatenate([l_lo, r_lo])
+        c_hi = jnp.concatenate([l_hi, r_hi])
+
         child_hists = jnp.concatenate(
             [l_flat.reshape(S, FL, B, 3), r_flat.reshape(S, FL, B, 3)])
         cg = jnp.concatenate([lg, rg])
         ch = jnp.concatenate([lh, rh])
         cc = jnp.concatenate([lc, rc])
         cd = jnp.concatenate([cdepth, cdepth])
-        vg = jax.vmap(global_pick)(child_hists, cg, ch, cc, cd)
+        vg = jax.vmap(global_pick)(child_hists, cg, ch, cc, cd, c_lo, c_hi)
         cbg, cbf, cbb, cbgl, cbhl, cbcl, cbthr = vg
 
         cids = jnp.concatenate([l_ids, r_ids])
@@ -831,6 +981,8 @@ def grow_tree_feature_parallel(
             right_child=s["right_child"].at[parents].set(r_ids),
             num_nodes=s["num_nodes"] + 2 * n_valid,
             next_slot=s["next_slot"] + n_valid,
+            node_lo=s["node_lo"].at[cids].set(c_lo),
+            node_hi=s["node_hi"].at[cids].set(c_hi),
         )
         out["active"] = out["active"].at[JUNK].set(False)
         out["best_gain"] = out["best_gain"].at[JUNK].set(-jnp.inf)
@@ -841,8 +993,11 @@ def grow_tree_feature_parallel(
 
     state = lax.while_loop(cond, wave, state)
 
-    node_value = learning_rate * _leaf_output(state["sum_g"], state["sum_h"],
-                                              p.lambda_l1, p.lambda_l2)
+    node_value = _leaf_output(state["sum_g"], state["sum_h"],
+                              p.lambda_l1, p.lambda_l2)
+    if mono_global is not None:
+        node_value = jnp.clip(node_value, state["node_lo"], state["node_hi"])
+    node_value = learning_rate * node_value
     leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
     tree = Tree(split_feature=state["split_feature"],
                 split_bin=state["split_bin"],

@@ -1,0 +1,182 @@
+"""Monotone constraints (LightGBM ``monotone_constraints``, the "basic"
+method — reference param surface: params/LightGBMParams.scala:168-183,
+rendered at params/BaseTrainParams.scala:128-130).
+
+The constrained model must be PROVABLY monotone: sweeping a constrained
+feature with everything else fixed can never move the margin the wrong
+way, for any base point.  The synthetic task has real non-monotone
+structure (sin bumps) so the unconstrained model provably violates —
+otherwise the monotone assertion would be vacuous.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.models.gbdt import Booster, BoostingConfig, train
+
+
+def mono_data(n=4000, seed=0, F=4):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, (n, F)).astype(np.float32)
+    # x0/x1 trend + strong sin wiggles: the derivative changes sign, so an
+    # unconstrained fit MUST violate monotonicity to reach its loss
+    y = (1.2 * X[:, 0] + 1.5 * np.sin(3 * X[:, 0])
+         - 1.0 * X[:, 1] + 1.2 * np.sin(4 * X[:, 1])
+         + 0.3 * X[:, 2] ** 2
+         + rng.normal(0, 0.3, n))
+    return X, y.astype(np.float64)
+
+
+def sweep_margins(booster, feat, n_base=16, n_grid=48, seed=3):
+    """(n_base, n_grid) margins as feature ``feat`` sweeps low→high."""
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(-2, 2, (n_base, 4)).astype(np.float32)
+    grid = np.linspace(-2.2, 2.2, n_grid, dtype=np.float32)
+    probes = np.repeat(base, n_grid, axis=0)
+    probes[:, feat] = np.tile(grid, n_base)
+    return booster.predict_margin(probes).reshape(n_base, n_grid)
+
+
+def max_violation(m, direction):
+    d = np.diff(m, axis=1) * direction
+    return float(-np.minimum(d, 0).min())
+
+
+CONS = [1, -1, 0, 0]
+
+
+@pytest.mark.parametrize("policy", ["depthwise", "lossguide"])
+def test_monotone_constraints_enforced(policy):
+    X, y = mono_data()
+    kw = dict(objective="regression", num_iterations=30, num_leaves=15,
+              min_data_in_leaf=5, growth_policy=policy)
+    b_free, _ = train(X, y, BoostingConfig(**kw))
+    b_mono, _ = train(X, y, BoostingConfig(monotone_constraints=CONS, **kw))
+
+    # the task is genuinely non-monotone: unconstrained model violates
+    assert max_violation(sweep_margins(b_free, 0), +1) > 1e-3
+    # constrained model: zero violations in both directions
+    assert max_violation(sweep_margins(b_mono, 0), +1) <= 1e-6
+    assert max_violation(sweep_margins(b_mono, 1), -1) <= 1e-6
+    # and it still learns the trend (monotone fit beats the mean)
+    resid = y - b_mono.predict_margin(X)
+    assert float(np.mean(resid ** 2)) < 0.5 * float(np.var(y))
+
+
+def test_zero_constraints_exact_parity():
+    """All-zero constraints compile to the unconstrained program: bit-equal
+    models."""
+    X, y = mono_data(n=2000, seed=1)
+    kw = dict(objective="regression", num_iterations=8, num_leaves=15,
+              min_data_in_leaf=5)
+    b_none, _ = train(X, y, BoostingConfig(**kw))
+    b_zero, _ = train(X, y, BoostingConfig(monotone_constraints=[0, 0, 0, 0],
+                                           **kw))
+    np.testing.assert_array_equal(b_none.predict_margin(X[:512]),
+                                  b_zero.predict_margin(X[:512]))
+
+
+def test_monotone_penalty_pushes_constrained_splits_down():
+    """monotone_penalty=1 forbids constrained-feature splits at the root
+    (LightGBM semantics: penalty >= depth+1 → gain ~ 0)."""
+    X, y = mono_data(seed=2)
+    kw = dict(objective="regression", num_iterations=1, num_leaves=7,
+              min_data_in_leaf=5)
+    b0, _ = train(X, y, BoostingConfig(monotone_constraints=CONS, **kw))
+    b1, _ = train(X, y, BoostingConfig(monotone_constraints=CONS,
+                                       monotone_penalty=1.0, **kw))
+    root_free = int(np.asarray(b0.trees[0].split_feature)[0])
+    root_pen = int(np.asarray(b1.trees[0].split_feature)[0])
+    assert root_free in (0, 1)        # x0/x1 carry the signal
+    assert root_pen not in (0, 1)     # penalized away from the root
+
+
+def test_monotone_binary_objective():
+    X, y = mono_data(seed=4)
+    yb = (y > np.median(y)).astype(np.float64)
+    cfg = BoostingConfig(objective="binary", num_iterations=20, num_leaves=15,
+                         min_data_in_leaf=5, monotone_constraints=CONS)
+    b, _ = train(X, yb, cfg)
+    assert max_violation(sweep_margins(b, 0), +1) <= 1e-6
+    assert max_violation(sweep_margins(b, 1), -1) <= 1e-6
+
+
+def test_monotone_lgbm_format_roundtrip():
+    X, y = mono_data(n=2000, seed=5)
+    cfg = BoostingConfig(objective="regression", num_iterations=6,
+                         num_leaves=15, min_data_in_leaf=5,
+                         monotone_constraints=CONS, monotone_penalty=0.5)
+    b, _ = train(X, y, cfg)
+    s = b.to_string()
+    assert "[monotone_constraints: 1,-1,0,0]" in s
+    b2 = Booster.from_string(s)
+    assert list(b2.config.monotone_constraints) == CONS
+    assert b2.config.monotone_penalty == 0.5
+    np.testing.assert_allclose(b.predict_margin(X[:512]),
+                               b2.predict_margin(X[:512]), atol=1e-5)
+    # the monotone parameters survive a SECOND round trip too
+    b3 = Booster.from_string(b2.to_string())
+    assert list(b3.config.monotone_constraints) == CONS
+    np.testing.assert_allclose(b.predict_margin(X[:512]),
+                               b3.predict_margin(X[:512]), atol=1e-5)
+
+
+def test_monotone_on_mesh_matches_single_device():
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = mono_data(n=4096, seed=6)
+    cfg = BoostingConfig(objective="regression", num_iterations=6,
+                         num_leaves=15, min_data_in_leaf=5,
+                         monotone_constraints=CONS)
+    b1, _ = train(X, y, cfg)
+    b8, _ = train(X, y, cfg, mesh=data_parallel_mesh(8))
+    np.testing.assert_allclose(b8.predict_margin(X[:1024]),
+                               b1.predict_margin(X[:1024]), atol=1e-4)
+    assert max_violation(sweep_margins(b8, 0), +1) <= 1e-6
+
+
+def test_monotone_feature_parallel():
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = mono_data(n=4096, seed=7)
+    cfg = BoostingConfig(objective="regression", num_iterations=5,
+                         num_leaves=15, min_data_in_leaf=5,
+                         monotone_constraints=CONS,
+                         parallelism="feature_parallel")
+    b, _ = train(X, y, cfg, mesh=data_parallel_mesh(8))
+    assert max_violation(sweep_margins(b, 0), +1) <= 1e-6
+    assert max_violation(sweep_margins(b, 1), -1) <= 1e-6
+
+
+def test_monotone_validation_errors():
+    X, y = mono_data(n=500)
+    with pytest.raises(ValueError, match="entries"):
+        train(X, y, BoostingConfig(objective="regression", num_iterations=1,
+                                   monotone_constraints=[1, -1]))
+    with pytest.raises(ValueError, match="-1, 0, or 1"):
+        train(X, y, BoostingConfig(objective="regression", num_iterations=1,
+                                   monotone_constraints=[2, 0, 0, 0]))
+    with pytest.raises(NotImplementedError, match="intermediate"):
+        train(X, y, BoostingConfig(
+            objective="regression", num_iterations=1,
+            monotone_constraints=CONS,
+            monotone_constraints_method="intermediate"))
+    with pytest.raises(NotImplementedError, match="enable_bundle"):
+        train(X, y, BoostingConfig(objective="regression", num_iterations=1,
+                                   monotone_constraints=CONS,
+                                   enable_bundle=True))
+    with pytest.raises(ValueError, match="categorical"):
+        train(X, y, BoostingConfig(objective="regression", num_iterations=1,
+                                   monotone_constraints=CONS,
+                                   categorical_feature=[0]))
+
+
+def test_monotone_estimator_params():
+    from synapseml_tpu import Dataset
+    from synapseml_tpu.models.gbdt import GBDTRegressor
+    X, y = mono_data(n=2000, seed=8)
+    ds = Dataset({"features": X, "label": y})
+    model = GBDTRegressor(numIterations=10, numLeaves=15, minDataInLeaf=5,
+                          monotoneConstraints=[1, -1, 0, 0]).fit(ds)
+    b = model.booster
+    assert max_violation(sweep_margins(b, 0), +1) <= 1e-6
