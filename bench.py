@@ -255,9 +255,10 @@ def main():
     llm_tps = llm_tps32 = None
     try:
         llm_tps, llm_tps32 = bench_llm()
-        print(f"[secondary] Llama-1B decode: {llm_tps:.0f} tokens/s/chip "
-              f"(batch 8), {llm_tps32:.0f} tokens/s/chip (batch 32 serving)",
-              file=sys.stderr)
+        b8 = f"{llm_tps:.0f}" if llm_tps else "failed"
+        b32 = f"{llm_tps32:.0f}" if llm_tps32 else "failed"
+        print(f"[secondary] Llama-1B decode: {b8} tokens/s/chip (batch 8), "
+              f"{b32} tokens/s/chip (batch 32 serving)", file=sys.stderr)
     except Exception as e:
         print(f"[secondary] LLM bench failed: {e}", file=sys.stderr)
 

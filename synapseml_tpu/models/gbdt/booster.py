@@ -244,16 +244,23 @@ class Booster:
 
         Returns (n, F+1) for single-output models, (n, K*(F+1)) for
         multiclass (last slot of each block = bias)."""
-        if self.bundler is not None or self.bin_mapper.has_categorical:
+        if self.bundler is not None:
             raise NotImplementedError(
-                "predict_contrib needs raw-threshold trees: EFB-bundled "
-                "and categorical models split in bin space — train with "
-                "enable_bundle=False and without categorical_feature for "
+                "predict_contrib on EFB-bundled models: a bundled split "
+                "partitions several original features' bins at once, so "
+                "exact per-original-feature attribution is not defined "
+                "for these trees — train with enable_bundle=False for "
                 "attributions")
+        # categorical models split in BIN space (target-ordered category
+        # bins); SHAP runs over the binned matrix with split_bin routing —
+        # exact, since binning is a per-feature transform
+        bin_space = self.bin_mapper.has_categorical
         from .shap import has_cover_counts, tree_shap_values
         if not approximate and has_cover_counts(self):
-            return tree_shap_values(self, features)
+            return tree_shap_values(self, features, bin_space=bin_space)
         features = np.ascontiguousarray(features, np.float32)
+        if bin_space:
+            features = self.bin_mapper.transform(features).astype(np.float32)
         n = features.shape[0]
         F = self.bin_mapper.num_features
         out = np.zeros((n, self.num_class, F + 1), np.float64)
@@ -274,8 +281,13 @@ class Booster:
                     break
                 f = np.maximum(feat, 0)
                 x = features[rows, f]
-                go_left = np.where(np.isnan(x), t.default_left[cur],
-                                   x <= t.threshold[cur])
+                if bin_space:
+                    go_left = x <= np.asarray(t.split_bin)[cur]
+                else:
+                    miss = np.isnan(x) | (np.asarray(t.missing_zero)[cur]
+                                          & (np.abs(x) <= 1e-35))
+                    go_left = np.where(miss, t.default_left[cur],
+                                       x <= t.threshold[cur])
                 nxt = np.where(go_left, t.left_child[cur], t.right_child[cur])
                 nxt = np.where(internal, nxt, cur)
                 delta = (nv[nxt] - nv[cur]) * w
@@ -373,7 +385,10 @@ class Booster:
                            np.ones(len(td["leaf_value"]), bool)), bool),
                 node_count=np.asarray(
                     td.get("node_count",
-                           np.zeros(len(td["leaf_value"]))), np.float32)))
+                           np.zeros(len(td["leaf_value"]))), np.float32),
+                missing_zero=np.asarray(
+                    td.get("missing_zero",
+                           np.zeros(len(td["leaf_value"]), bool)), bool)))
         bundler = (FeatureBundler.from_dict(d["bundler"])
                    if d.get("bundler") else None)
         return Booster(trees, d["tree_class"], d["tree_weights"], d["num_class"],

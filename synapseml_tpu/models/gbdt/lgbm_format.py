@@ -39,6 +39,7 @@ from .binning import BinMapper
 #: decision_type flags (LightGBM: include/LightGBM/tree.h semantics)
 _CATEGORICAL_MASK = 1
 _DEFAULT_LEFT_MASK = 2
+_MISSING_TYPE_ZERO = 1 << 2
 _MISSING_TYPE_NAN = 2 << 2
 
 
@@ -93,9 +94,11 @@ def _tree_block(tree, weight: float, bias: float, index: int,
     leaf_vals = [float(tree.node_value[n]) * weight + bias for n in leaves]
     if len(internal):
         dl = np.asarray(tree.default_left[:n_nodes])
+        mz = np.asarray(tree.missing_zero[:n_nodes])
 
         def dtype_of(n):
-            return (_DEFAULT_LEFT_MASK if dl[n] else 0) | _MISSING_TYPE_NAN
+            missing = _MISSING_TYPE_ZERO if mz[n] else _MISSING_TYPE_NAN
+            return (_DEFAULT_LEFT_MASK if dl[n] else 0) | missing
 
         lines += [
             "split_feature=" + " ".join(str(int(tree.split_feature[n]))
@@ -213,6 +216,7 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
     leaf_value = np.zeros(M, np.float32)
     default_left = np.ones(M, bool)
     node_count = np.zeros(M, np.float32)
+    missing_zero = np.zeros(M, bool)
 
     def arr(key, dtype, n, default=None):
         if key not in fields:
@@ -242,13 +246,9 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
         # LightGBM float default) keeps the stored default direction.  For
         # None, LightGBM coerces NaN input to 0.0 — emulated exactly by
         # routing NaN where 0.0 would compare.  Zero missing (0.0 itself
-        # treated as missing) has no Tree representation — reject loudly
-        # rather than mispredict.
+        # treated as missing, |x| <= kZeroThreshold) rides the per-node
+        # ``missing_zero`` flag on Tree.
         mtype = (dt >> 2) & 3
-        if np.any(mtype == 1):
-            raise ValueError(
-                "missing_type=Zero splits are not supported (only "
-                "NaN/None-missing models import exactly)")
 
         def map_child(c: int) -> int:
             return int(c) if c >= 0 else n_int + (~int(c))
@@ -265,6 +265,7 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
                 default_left[j] = bool(0.0 <= th[j])
             else:
                 default_left[j] = bool(dt[j] & _DEFAULT_LEFT_MASK)
+                missing_zero[j] = mtype[j] == 1
     for l in range(n_leaves):
         node_value[n_int + l] = lv[l]
         leaf_value[n_int + l] = lv[l]
@@ -277,7 +278,8 @@ def _tree_from_block(fields: Dict[str, str], max_leaves: int):
                 leaf_value=leaf_value, node_value=node_value,
                 num_nodes=np.asarray(n_int + n_leaves, np.int32),
                 default_left=default_left,
-                node_count=node_count)
+                node_count=node_count,
+                missing_zero=missing_zero)
 
 
 def booster_from_lgbm_string(s: str):

@@ -25,7 +25,8 @@ import numpy as np
 
 
 def _tree_shap_row(split_feature, threshold, left, right, default_left,
-                   node_count, leaf_value, x, phi, scale):
+                   node_count, leaf_value, x, phi, scale,
+                   missing_zero=None):
     """Exact TreeSHAP for one row on one tree; adds into ``phi`` (F+1,)."""
 
     def extend(m: List[List[float]], pz: float, po: float, pi: int):
@@ -78,7 +79,10 @@ def _tree_shap_row(split_feature, threshold, left, right, default_left,
                 phi[int(m[i][0])] += w * (m[i][2] - m[i][1]) * v
             return
         xv = x[f]
-        go_left = bool(default_left[node]) if np.isnan(xv) \
+        miss = np.isnan(xv) or (missing_zero is not None
+                                and bool(missing_zero[node])
+                                and abs(xv) <= 1e-35)
+        go_left = bool(default_left[node]) if miss \
             else bool(xv <= threshold[node])
         hot = int(left[node]) if go_left else int(right[node])
         cold = int(right[node]) if go_left else int(left[node])
@@ -99,13 +103,20 @@ def _expected_value(node_count, leaf_mask, leaf_value) -> float:
     return float(np.sum(node_count[leaf_mask] * leaf_value[leaf_mask]) / root)
 
 
-def tree_shap_values(booster, features: np.ndarray) -> np.ndarray:
+def tree_shap_values(booster, features: np.ndarray,
+                     bin_space: bool = False) -> np.ndarray:
     """Exact per-feature contributions + bias for every row.
 
     Returns (n, F+1) for single-output models, (n, K·(F+1)) for multiclass
     (last slot of each block = the expected value / bias) — the
-    featuresShap output shape."""
+    featuresShap output shape.
+
+    ``bin_space``: route by ``split_bin`` over the BINNED feature matrix
+    (categorical models split in bin space; the bin mapper's transform is
+    applied here, so callers always pass raw features)."""
     features = np.ascontiguousarray(features, np.float32)
+    if bin_space:
+        features = booster.bin_mapper.transform(features).astype(np.float32)
     n = features.shape[0]
     F = booster.bin_mapper.num_features
     K = booster.num_class
@@ -117,7 +128,8 @@ def tree_shap_values(booster, features: np.ndarray) -> np.ndarray:
             w = w / max(sum(1 for c in booster.tree_class if c == k), 1)
         nn = int(t.num_nodes)
         sf = np.asarray(t.split_feature[:nn])
-        thr = np.asarray(t.threshold[:nn])
+        thr = np.asarray(t.split_bin[:nn], np.float32) if bin_space \
+            else np.asarray(t.threshold[:nn])
         lc = np.asarray(t.left_child[:nn])
         rc = np.asarray(t.right_child[:nn])
         dl = np.asarray(t.default_left[:nn])
@@ -125,9 +137,10 @@ def tree_shap_values(booster, features: np.ndarray) -> np.ndarray:
         nc = np.asarray(t.node_count[:nn], np.float64)
         lv = np.asarray(t.node_value[:nn], np.float64)
         out[:, k, F] += _expected_value(nc, leaf_mask, lv) * w
+        mz = None if bin_space else np.asarray(t.missing_zero[:nn])
         for r in range(n):
             _tree_shap_row(sf, thr, lc, rc, dl, nc, lv,
-                           features[r], out[r, k], w)
+                           features[r], out[r, k], w, missing_zero=mz)
     out[:, :, F] += booster.init_score[:K][None, :]
     if K == 1:
         return out[:, 0, :]

@@ -70,11 +70,15 @@ class Tree(NamedTuple):
     leaf_value: jnp.ndarray           # (MAX_NODES,) f32 (already shrunk)
     node_value: jnp.ndarray           # (MAX_NODES,) f32 output at every node
     num_nodes: jnp.ndarray            # () int32
-    default_left: jnp.ndarray         # (MAX_NODES,) bool — NaN routing per
-                                      # node (training always emits True;
-                                      # imported LightGBM models may not)
+    default_left: jnp.ndarray         # (MAX_NODES,) bool — missing routing
+                                      # per node (training always emits
+                                      # True; imported models may not)
     node_count: jnp.ndarray           # (MAX_NODES,) f32 — rows covering
                                       # each node (TreeSHAP cover weights)
+    missing_zero: jnp.ndarray         # (MAX_NODES,) bool — LightGBM
+                                      # missing_type=Zero: |x|<=1e-35 (and
+                                      # NaN) routes by default_left at this
+                                      # node; training emits all-False
 
 
 def max_nodes(num_leaves: int) -> int:
@@ -218,6 +222,20 @@ def _mono_child_bounds(cf, lo, hi, wl, wr):
     r_lo = jnp.where(cf == 1, jnp.maximum(lo, mid), lo)
     r_hi = jnp.where(cf == -1, jnp.minimum(hi, mid), hi)
     return l_lo, l_hi, r_lo, r_hi
+
+
+def _mono_node_bounds(mono_cf, p_lo, p_hi, lg, lh, rg, rh, p):
+    """One split's child bounds: pass-through when unconstrained
+    (``mono_cf`` None), else clamp the children's leaf outputs to the
+    parent bounds and cap the violating side at their midpoint — the ONE
+    place the basic-method propagation lives for all three growers."""
+    if mono_cf is None:
+        return p_lo, p_hi, p_lo, p_hi
+    wl = jnp.clip(_leaf_output(lg, lh, p.lambda_l1, p.lambda_l2),
+                  p_lo, p_hi)
+    wr = jnp.clip(_leaf_output(rg, rh, p.lambda_l1, p.lambda_l2),
+                  p_lo, p_hi)
+    return _mono_child_bounds(mono_cf, p_lo, p_hi, wl, wr)
 
 
 def _best_split_voting(local_hist, sum_g, sum_h, sum_c, num_bins,
@@ -388,15 +406,9 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         cdepth = s["depth"][leaf] + 1
 
         p_lo, p_hi = s["node_lo"][leaf], s["node_hi"][leaf]
-        if mono_c is None:
-            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
-        else:
-            wl = jnp.clip(_leaf_output(lg, lh, p.lambda_l1, p.lambda_l2),
-                          p_lo, p_hi)
-            wr = jnp.clip(_leaf_output(rg, rh, p.lambda_l1, p.lambda_l2),
-                          p_lo, p_hi)
-            l_lo, l_hi, r_lo, r_hi = _mono_child_bounds(
-                mono_c[feat], p_lo, p_hi, wl, wr)
+        l_lo, l_hi, r_lo, r_hi = _mono_node_bounds(
+            None if mono_c is None else mono_c[feat],
+            p_lo, p_hi, lg, lh, rg, rh, p)
 
         lbg, lbf, lbb, lbgl, lbhl, lbcl = pick(
             l_hist.reshape(F, B, 3), lg, lh, lc, cdepth, l_lo, l_hi)
@@ -458,7 +470,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
                 node_value=node_value,
                 num_nodes=state["num_nodes"],
                 default_left=jnp.ones(M, jnp.bool_),
-                node_count=state["sum_c"])
+                node_count=state["sum_c"],
+                missing_zero=jnp.zeros(M, jnp.bool_))
     return tree, state["node_id"]
 
 
@@ -672,15 +685,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         cdepth = s["depth"][parents] + 1
 
         p_lo, p_hi = s["node_lo"][parents], s["node_hi"][parents]   # (S,)
-        if mono_c is None:
-            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
-        else:
-            wl = jnp.clip(_leaf_output(lg, lh, p.lambda_l1, p.lambda_l2),
-                          p_lo, p_hi)
-            wr = jnp.clip(_leaf_output(rg, rh, p.lambda_l1, p.lambda_l2),
-                          p_lo, p_hi)
-            l_lo, l_hi, r_lo, r_hi = _mono_child_bounds(
-                mono_c[s["best_feat"][parents]], p_lo, p_hi, wl, wr)
+        l_lo, l_hi, r_lo, r_hi = _mono_node_bounds(
+            None if mono_c is None else mono_c[s["best_feat"][parents]],
+            p_lo, p_hi, lg, lh, rg, rh, p)
         c_lo = jnp.concatenate([l_lo, r_lo])
         c_hi = jnp.concatenate([l_hi, r_hi])
 
@@ -753,7 +760,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 node_value=node_value,
                 num_nodes=state["num_nodes"],
                 default_left=jnp.ones(M, jnp.bool_),
-                node_count=state["sum_c"])
+                node_count=state["sum_c"],
+                missing_zero=jnp.zeros(M, jnp.bool_))
     return tree, state["node_id"]
 
 
@@ -933,15 +941,9 @@ def grow_tree_feature_parallel(
         cdepth = s["depth"][parents] + 1
 
         p_lo, p_hi = s["node_lo"][parents], s["node_hi"][parents]   # (S,)
-        if mono_global is None:
-            l_lo, l_hi, r_lo, r_hi = p_lo, p_hi, p_lo, p_hi
-        else:
-            wl = jnp.clip(_leaf_output(lg, lh, p.lambda_l1, p.lambda_l2),
-                          p_lo, p_hi)
-            wr = jnp.clip(_leaf_output(rg, rh, p.lambda_l1, p.lambda_l2),
-                          p_lo, p_hi)
-            l_lo, l_hi, r_lo, r_hi = _mono_child_bounds(
-                mono_global[wf], p_lo, p_hi, wl, wr)
+        l_lo, l_hi, r_lo, r_hi = _mono_node_bounds(
+            None if mono_global is None else mono_global[wf],
+            p_lo, p_hi, lg, lh, rg, rh, p)
         c_lo = jnp.concatenate([l_lo, r_lo])
         c_hi = jnp.concatenate([l_hi, r_hi])
 
@@ -1009,7 +1011,8 @@ def grow_tree_feature_parallel(
                 node_value=node_value,
                 num_nodes=state["num_nodes"],
                 default_left=jnp.ones(M, jnp.bool_),
-                node_count=state["sum_c"])
+                node_count=state["sum_c"],
+                missing_zero=jnp.zeros(M, jnp.bool_))
     return tree, state["node_id"]
 
 
@@ -1080,7 +1083,11 @@ def predict_raw_features(features, trees_stacked: Tree, depth_bound: int):
             is_leaf = feat < 0
             f = jnp.maximum(feat, 0)
             x = features[rows, f]
-            go_left = jnp.where(jnp.isnan(x), t.default_left[node],
+            # LightGBM kZeroThreshold: missing_type=Zero treats |x|<=1e-35
+            # (and NaN, which it coerces to 0) as missing
+            missing = jnp.isnan(x) | (t.missing_zero[node]
+                                      & (jnp.abs(x) <= 1e-35))
+            go_left = jnp.where(missing, t.default_left[node],
                                 x <= t.threshold[node])
             child = jnp.where(go_left, t.left_child[node], t.right_child[node])
             return jnp.where(is_leaf, node, child)

@@ -896,3 +896,59 @@ def test_depthwise_pallas_interpret_full_parity():
         np.testing.assert_allclose(np.asarray(getattr(t_x, f)),
                                    np.asarray(getattr(t_p, f)),
                                    rtol=1e-4, atol=1e-4, err_msg=f)
+
+
+def test_lgbm_import_missing_type_zero():
+    """missing_type=Zero (decision_type bits 2-3 = 1): |x| <= 1e-35 and NaN
+    route by the stored default direction, everything else by threshold —
+    LightGBM's zero_as_missing semantics, previously rejected."""
+    # decision_type = ZERO(1<<2) | default_left(2) = 6 ... default RIGHT = 4
+    model = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=regression
+feature_names=a b
+feature_infos=[-10:10] [-10:10]
+tree_sizes=300
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=10 5
+threshold=-0.5 1.0
+decision_type=6 4
+left_child=1 -1
+right_child=-3 -2
+leaf_value=1 2 4
+leaf_weight=0 0 0
+leaf_count=0 0 0
+internal_value=0 0
+internal_weight=0 0
+internal_count=0 0
+is_linear=0
+shrinkage=0.1
+
+end of trees
+"""
+    b = Booster.from_string(model)
+    # node0: a<=-0.5 -> node1, else leaf2=4; a==0/NaN missing -> LEFT (dt=6)
+    # node1: b<=1.0 -> leaf0=1, else leaf1=2; b==0/NaN missing -> RIGHT (dt=4)
+    X = np.array([
+        [-1.0, 0.5],    # a left by threshold, b<=1 -> 1
+        [0.0, 0.5],     # a ZERO-missing -> default LEFT; b -> 1
+        [0.0, 0.0],     # a missing left; b ZERO-missing -> default RIGHT: 2
+        [np.nan, 5.0],  # NaN also missing under Zero -> left; b>1 -> 2
+        [1e-40, 3.0],   # |a|<=1e-35 counts as zero-missing -> left; b>1 -> 2
+        [0.3, 0.0],     # a > -0.5 by comparison -> leaf2 = 4
+    ], np.float32)
+    np.testing.assert_allclose(b.predict_margin(X),
+                               [1.0, 1.0, 2.0, 2.0, 2.0, 4.0], atol=1e-6)
+    # export keeps the Zero bits: a re-imported copy predicts identically
+    b2 = Booster.from_string(b.to_string())
+    np.testing.assert_allclose(b2.predict_margin(X), b.predict_margin(X),
+                               atol=1e-6)
+    assert "decision_type=6 4" in b.to_string()

@@ -61,9 +61,11 @@ def test_categorical_unseen_category_and_roundtrip():
     b2 = Booster.from_dict(b.to_dict())
     np.testing.assert_allclose(b.predict_margin(X[:256]),
                                b2.predict_margin(X[:256]), atol=1e-6)
-    # raw-threshold surfaces reject loudly
-    with pytest.raises(NotImplementedError, match="categorical"):
-        b.predict_contrib(X[:4])
+    # SHAP runs in bin space for categorical models; additivity is exact
+    contrib = b.predict_contrib(X[:16])
+    np.testing.assert_allclose(contrib.sum(1), b.predict_margin(X[:16]),
+                               rtol=1e-4, atol=1e-4)
+    # raw-threshold export still rejects loudly
     with pytest.raises(NotImplementedError):
         b.to_string()
 
@@ -128,3 +130,54 @@ def test_categorical_all_nan_feature_empty_lut():
                          categorical_feature=[0, 1, X.shape[1] - 1])
     b, _ = train(X, y, cfg)
     assert np.isfinite(b.predict_margin(X[:64])).all()
+
+
+def test_categorical_shap_matches_brute_force():
+    """Bin-space TreeSHAP on a categorical model equals subset-enumeration
+    Shapley over the binned representation — exact, not Saabas."""
+    import itertools
+    import math
+
+    X, y = cat_data(n=800, seed=11)
+    cfg = BoostingConfig(objective="binary", num_iterations=3, num_leaves=7,
+                         min_data_in_leaf=10, categorical_feature=[0, 1])
+    b, _ = train(X, y, cfg)
+    F = X.shape[1]
+    probe = X[:4]
+    binned = b.bin_mapper.transform(probe).astype(np.float32)
+
+    def cond_exp(xb, S):
+        total = float(b.init_score[0])
+        for i, t in enumerate(b.trees):
+            w = b.tree_weights[i]
+
+            def rec(j):
+                f = int(t.split_feature[j])
+                if f < 0:
+                    return float(t.node_value[j])
+                if f in S:
+                    go_left = xb[f] <= float(t.split_bin[j])
+                    return rec(int(t.left_child[j]) if go_left
+                               else int(t.right_child[j]))
+                cl = float(t.node_count[int(t.left_child[j])])
+                cr = float(t.node_count[int(t.right_child[j])])
+                return (cl * rec(int(t.left_child[j]))
+                        + cr * rec(int(t.right_child[j]))) / max(cl + cr,
+                                                                 1e-12)
+
+            total += rec(0) * w
+        return total
+
+    contrib = b.predict_contrib(probe)
+    for r in range(len(probe)):
+        phi = np.zeros(F + 1)
+        phi[F] = cond_exp(binned[r], frozenset())
+        for f in range(F):
+            rest = [g for g in range(F) if g != f]
+            for k in range(F):
+                for S in itertools.combinations(rest, k):
+                    wgt = (math.factorial(k) * math.factorial(F - k - 1)
+                           / math.factorial(F))
+                    phi[f] += wgt * (cond_exp(binned[r], frozenset(S) | {f})
+                                     - cond_exp(binned[r], frozenset(S)))
+        np.testing.assert_allclose(contrib[r], phi, rtol=1e-4, atol=1e-5)
