@@ -257,6 +257,14 @@ class TestMultiPipelineServer:
                 "prediction", np.asarray(ds["x"], np.float64) * self.factor)
 
     def test_two_apis_routed_concurrently_with_latency(self):
+        """64-way concurrent load across 2 APIs: the asyncio listener (one
+        IO loop, no per-request threads) keeps the tail interactive.  The
+        client is a single-threaded asyncio harness — a 16-thread urllib
+        client on the 1-core CI host measures its own GIL thrash (p99
+        ~450-900 ms) rather than the server, whose tail is ~20-40 ms."""
+        import asyncio
+        import time as _time
+
         from synapseml_tpu.serving import MultiPipelineServer
         parse = lambda r: {"x": float(r.json()["x"])}  # noqa: E731
         srv = MultiPipelineServer({
@@ -265,34 +273,86 @@ class TestMultiPipelineServer:
             "/triple": {"model": self._Scale(factor=3.0),
                         "input_parser": parse},
         })
+        host, port = srv.server.address
         try:
-            import concurrent.futures
-            import time as _time
-            import urllib.request
-
-            def call(i):
+            async def call(i):
                 api = "/double" if i % 2 == 0 else "/triple"
                 t0 = _time.perf_counter()
-                req = urllib.request.Request(
-                    srv.url_for(api), data=json.dumps({"x": i}).encode(),
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=10) as resp:
-                    out = json.loads(resp.read())
-                return i, out["prediction"], _time.perf_counter() - t0
+                reader, writer = await asyncio.open_connection(host, port)
+                body = json.dumps({"x": i}).encode()
+                req = (f"POST {api} HTTP/1.1\r\nHost: x\r\n"
+                       "Content-Type: application/json\r\n"
+                       f"Content-Length: {len(body)}\r\n"
+                       "Connection: close\r\n\r\n").encode() + body
+                writer.write(req)
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(), 10)
+                writer.close()
+                status = int(data.split(b" ", 2)[1])
+                payload = json.loads(data.split(b"\r\n\r\n", 1)[1])
+                return i, status, payload["prediction"], \
+                    _time.perf_counter() - t0
 
-            n = 64
-            with concurrent.futures.ThreadPoolExecutor(16) as pool:
-                results = list(pool.map(call, range(n)))
-            lat = sorted(r[2] for r in results)
-            for i, pred, _ in results:
+            async def run():
+                return await asyncio.gather(*[call(i) for i in range(64)])
+
+            results = asyncio.run(run())
+            lat = sorted(r[3] for r in results)
+            for i, status, pred, _ in results:
+                assert status == 200
                 expected = i * 2.0 if i % 2 == 0 else i * 3.0
                 assert pred == expected, (i, pred)
             p50 = lat[len(lat) // 2]
             p99 = lat[int(len(lat) * 0.99)]
-            # routed batched serving stays interactive under concurrency
-            assert p50 < 1.0 and p99 < 5.0, (p50, p99)
-            print(f"[serving load] n={n} p50={p50 * 1e3:.1f}ms "
+            # the round-2 review bar: p99 under 200 ms at this exact load
+            assert p50 < 0.1 and p99 < 0.2, (p50, p99)
+            print(f"[serving load] n=64 p50={p50 * 1e3:.1f}ms "
                   f"p99={p99 * 1e3:.1f}ms")
+        finally:
+            srv.close()
+
+    def test_queue_wait_shedding_bounds_tail(self):
+        """max_queue_wait_s: requests that sat queued beyond the bound are
+        shed with 503 instead of serving stale — under overload the tail
+        is bounded by (wait bound + one transform), not the queue depth."""
+        import concurrent.futures
+        import urllib.error
+        import urllib.request
+
+        from synapseml_tpu.serving import MultiPipelineServer
+
+        class Slow(Transformer):
+            def _transform(self, ds):
+                time.sleep(0.25)
+                return ds.with_column(
+                    "prediction", np.asarray(ds["x"], np.float64))
+
+        srv = MultiPipelineServer({
+            "/slow": {"model": Slow(),
+                      "input_parser": lambda r: {"x": float(r.json()["x"])},
+                      "batch_size": 1, "num_workers": 1,
+                      "max_queue_wait_s": 0.3},
+        })
+        try:
+            def call(i):
+                req = urllib.request.Request(
+                    srv.url_for("/slow"), data=json.dumps({"x": i}).encode())
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        return resp.status, time.perf_counter() - t0
+                except urllib.error.HTTPError as e:
+                    return e.code, time.perf_counter() - t0
+
+            with concurrent.futures.ThreadPoolExecutor(10) as pool:
+                results = list(pool.map(call, range(10)))
+            codes = [c for c, _ in results]
+            # a 10-deep queue at 0.25s/item would take 2.5s serially; the
+            # 0.3s wait bound sheds the deep tail with 503
+            assert codes.count(200) >= 1
+            assert codes.count(503) >= 4, codes
+            worst = max(t for _, t in results)
+            assert worst < 1.5, worst
         finally:
             srv.close()
 
