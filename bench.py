@@ -188,8 +188,11 @@ def bench_gbdt_anchor(X, y):
 
 
 def bench_resnet50():
-    """ResNet-50 ONNX batch inference img/s/chip (BASELINE config #2;
-    reference path: ONNXModel.scala:242-251 over ONNX Runtime CUDA)."""
+    """ResNet-50 ONNX batch inference img/s/chip at f32 and bf16
+    (BASELINE config #2; reference path: ONNXModel.scala:242-251 over ONNX
+    Runtime CUDA — bf16 plays the reduced-precision execution-provider
+    role).  60 dispatches amortize the tunnel round trip; the readback is
+    the only true barrier."""
     from synapseml_tpu.models.onnx.zoo import build_resnet50
 
     import jax.numpy as jnp
@@ -197,17 +200,23 @@ def bench_resnet50():
     from synapseml_tpu.models.onnx.runner import compile_onnx
 
     model_bytes, _ = build_resnet50(num_classes=1000, seed=0)
-    bs, steps = 32, 8
+    bs, steps = 32, 60
     x = np.random.default_rng(0).normal(size=(bs, 3, 224, 224)).astype(np.float32)
-    fn = compile_onnx(model_bytes)
     x_dev = jnp.asarray(x)                       # exclude the host->device
-    out = fn(data=x_dev)                         # link (dev tunnel ~20MB/s)
-    np.asarray(out["logits"][0, :1])             # true barrier (readback)
-    t0 = time.perf_counter()
-    for _ in range(steps):
+    rates = {}                                   # link (dev tunnel ~20MB/s)
+    for label, dt in (("f32", None), ("bf16", jnp.bfloat16)):
+        fn = compile_onnx(model_bytes, dtype=dt)
         out = fn(data=x_dev)
-    np.asarray(out["logits"][0, :1])
-    return bs * steps / (time.perf_counter() - t0)
+        np.asarray(out["logits"][0, :1])         # true barrier (readback)
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(data=x_dev)
+            np.asarray(out["logits"][0, :1])
+            best = max(best, bs * steps / (time.perf_counter() - t0))
+        rates[label] = best
+    return rates["f32"], rates["bf16"]
 
 
 def bench_llm():
@@ -262,11 +271,12 @@ def main():
     except Exception as e:
         print(f"[secondary] LLM bench failed: {e}", file=sys.stderr)
 
-    resnet_ips = None
+    resnet_ips = resnet_bf16_ips = None
     try:
-        resnet_ips = bench_resnet50()
+        resnet_ips, resnet_bf16_ips = bench_resnet50()
         print(f"[secondary] ResNet-50 ONNX batch inference: "
-              f"{resnet_ips:.1f} img/s/chip", file=sys.stderr)
+              f"{resnet_ips:.1f} img/s/chip f32, "
+              f"{resnet_bf16_ips:.1f} img/s/chip bf16", file=sys.stderr)
     except Exception as e:
         print(f"[secondary] ResNet-50 bench failed: {e}", file=sys.stderr)
 
@@ -309,6 +319,8 @@ def main():
                                       if anchor_ips else None),
         "resnet50_onnx_imgs_per_sec": (round(resnet_ips, 1)
                                        if resnet_ips else None),
+        "resnet50_onnx_bf16_imgs_per_sec": (round(resnet_bf16_ips, 1)
+                                            if resnet_bf16_ips else None),
         "llama1b_decode_tokens_per_sec": (round(llm_tps, 1)
                                           if llm_tps else None),
         "llama1b_decode_b32_tokens_per_sec": (round(llm_tps32, 1)

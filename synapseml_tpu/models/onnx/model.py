@@ -121,10 +121,15 @@ class ONNXModel(Model):
         """
         precision = ("float32" if self.get_or_default("dtype") == "float32"
                      else "bfloat16")
+        # bfloat16 also casts the WEIGHTS (constant-folded once under jit):
+        # without it, f32 initializers keep convs/matmuls on the
+        # full-precision path regardless of input dtype
+        eval_dtype = (jnp.bfloat16
+                      if self.get_or_default("dtype") == "bfloat16" else None)
 
         def run(inputs: Dict[str, Any]) -> Dict[str, Any]:
             with jax.default_matmul_precision(precision):
-                out = evaluate(graph, inputs, fetch_names)
+                out = evaluate(graph, inputs, fetch_names, dtype=eval_dtype)
             post: Dict[str, Any] = {k: jnp.asarray(v) for k, v in out.items()}
             for src, dst in softmax_of.items():
                 post[dst] = jax.nn.softmax(jnp.asarray(out[src]), axis=-1)

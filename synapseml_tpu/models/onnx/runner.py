@@ -20,13 +20,27 @@ from .ops import OpCall, lower
 
 
 def evaluate(graph: Graph, inputs: Dict[str, Any],
-             outputs: Optional[Sequence[str]] = None) -> Dict[str, Any]:
-    """Evaluate ``graph`` on ``inputs`` (traceable: call under jit)."""
+             outputs: Optional[Sequence[str]] = None,
+             dtype: Optional[Any] = None) -> Dict[str, Any]:
+    """Evaluate ``graph`` on ``inputs`` (traceable: call under jit).
+
+    ``dtype`` (e.g. ``jnp.bfloat16``): float weights AND float inputs are
+    cast to it, so matmuls/convs run the reduced-precision MXU path with
+    XLA's f32 accumulation — the role the GPU execution provider's fp16
+    mode plays in the reference's ORT stack (ONNXRuntime.scala:46-56).
+    Under jit the weight casts constant-fold once into the executable."""
+    def _c(v):
+        if dtype is not None and np.issubdtype(np.asarray(v).dtype
+                                               if not hasattr(v, "dtype")
+                                               else v.dtype, np.floating):
+            return jnp.asarray(v, dtype)
+        return v
+
     env: Dict[str, Any] = {}
     for k, v in graph.initializers.items():
-        env[k] = v
+        env[k] = _c(v)
     for k, v in inputs.items():
-        env[k] = v
+        env[k] = _c(v)
     missing = [n for n in graph.input_names if n not in env]
     if missing:
         raise KeyError(f"missing graph inputs: {missing}")
@@ -39,7 +53,10 @@ def evaluate(graph: Graph, inputs: Dict[str, Any],
         results = lower(call)
         for name, val in zip(node.outputs, results):
             if name:
-                env[name] = val
+                # keep every float tensor at the reduced precision: ops
+                # that internally upcast (epsilon math, reductions) would
+                # otherwise leak f32 into downstream convs/matmuls
+                env[name] = _c(val)
     missing_out = [o for o in wanted if o not in env]
     if missing_out:
         raise KeyError(f"graph values not produced: {missing_out}")
@@ -49,13 +66,15 @@ def evaluate(graph: Graph, inputs: Dict[str, Any],
 class OnnxFunction:
     """A compiled ONNX graph: ``fn(**inputs) -> dict`` with jit caching."""
 
-    def __init__(self, graph: Graph, outputs: Optional[Sequence[str]] = None):
+    def __init__(self, graph: Graph, outputs: Optional[Sequence[str]] = None,
+                 dtype: Optional[Any] = None):
         self.graph = graph
         self.input_names = graph.input_names
         self.output_names = list(outputs) if outputs else graph.output_names
+        self.dtype = dtype
 
         def _run(inputs: Dict[str, Any]) -> Dict[str, Any]:
-            out = evaluate(self.graph, inputs, self.output_names)
+            out = evaluate(self.graph, inputs, self.output_names, dtype=dtype)
             return {k: jnp.asarray(v) for k, v in out.items()}
 
         self._jitted = jax.jit(_run)
@@ -74,6 +93,7 @@ class OnnxFunction:
 
 
 def compile_onnx(source: Union[str, bytes, Graph],
-                 outputs: Optional[Sequence[str]] = None) -> OnnxFunction:
+                 outputs: Optional[Sequence[str]] = None,
+                 dtype: Optional[Any] = None) -> OnnxFunction:
     graph = source if isinstance(source, Graph) else load_graph(source)
-    return OnnxFunction(graph, outputs)
+    return OnnxFunction(graph, outputs, dtype=dtype)

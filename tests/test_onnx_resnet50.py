@@ -144,3 +144,62 @@ def test_resnet50_image_featurizer_headless():
     feats = np.stack(list(out["features"]))
     assert feats.shape == (2, 2048)
     assert np.isfinite(feats).all()
+
+
+def test_onnx_bf16_execution_tolerance():
+    """compile_onnx(dtype=bfloat16) casts weights AND activations to bf16
+    (f32 MXU accumulation stays): outputs track the f32 path within
+    reduced-precision tolerance and top-1 decisions agree."""
+    import jax.numpy as jnp
+
+    from transformers import BertConfig, BertForSequenceClassification
+
+    from synapseml_tpu.models.onnx.runner import compile_onnx
+    from synapseml_tpu.models.onnx.zoo import build_bert_classifier
+
+    cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, num_labels=3,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = BertForSequenceClassification(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    mb = build_bert_classifier(sd, num_layers=2, num_heads=4, seq_len=10)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 120, (4, 10)).astype(np.int64)
+    mask = np.ones((4, 10), np.float32)
+    out32 = np.asarray(compile_onnx(mb)(input_ids=ids,
+                                        attention_mask=mask)["logits"],
+                       np.float32)
+    fn16 = compile_onnx(mb, dtype=jnp.bfloat16)
+    out16 = np.asarray(fn16(input_ids=ids, attention_mask=mask)["logits"],
+                       np.float32)
+    assert (out32.argmax(1) == out16.argmax(1)).all()
+    np.testing.assert_allclose(out16, out32, rtol=5e-2, atol=5e-2)
+
+
+def test_onnx_model_dtype_bfloat16_transform():
+    """ONNXModel(dtype='bfloat16') runs the Dataset path end to end."""
+    from synapseml_tpu import Dataset
+    from synapseml_tpu.models.onnx import ONNXModel
+    from synapseml_tpu.models.onnx.zoo import build_resnet50
+
+    model_bytes, _ = build_resnet50(num_classes=10, seed=0)
+    rng = np.random.default_rng(1)
+    imgs = rng.normal(size=(4, 3, 224, 224)).astype(np.float32)
+    ds = Dataset({"image": list(imgs)})
+    m32 = (ONNXModel(model_bytes).set_feed_dict({"data": "image"})
+           .set_fetch_dict({"out": "logits"}))
+    m16 = (ONNXModel(model_bytes, dtype="bfloat16")
+           .set_feed_dict({"data": "image"})
+           .set_fetch_dict({"out": "logits"}))
+    o32 = np.stack([np.asarray(v, np.float32)
+                    for v in m32.transform(ds)["out"]])
+    o16 = np.stack([np.asarray(v, np.float32)
+                    for v in m16.transform(ds)["out"]])
+    assert (o32.argmax(1) == o16.argmax(1)).all()
+    # random-weight logits span ±600: bound the error against the output
+    # SCALE (per-element rtol penalizes near-zero logits meaninglessly)
+    rel = np.abs(o16 - o32).max() / np.abs(o32).max()
+    assert rel < 2e-2, rel
