@@ -105,6 +105,46 @@ def cast_params(variables: Any, dtype=jnp.bfloat16) -> Any:
     return jax.tree.map(cast, variables)
 
 
+def quantize_int8(variables: Any) -> Any:
+    """Weight-only int8 quantization of every Dense kernel (per-output-
+    channel symmetric scales): the param tree for a model built with
+    ``weight_quant="int8"``.
+
+    Serving HBM halves again vs bf16 — Llama-3-8B drops from ~16 GB bf16
+    to ~8.6 GB (int8 projections + bf16 embeddings/norms), which is what
+    fits the 8B config on ONE 16 GB v5e chip with KV cache and activation
+    headroom.  ``nn.Partitioned`` metadata carries over (scales shard on
+    the kernel's output axis), so TP serving quantizes the same way."""
+    import flax.linen as nn
+
+    def walk(d):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                if "kernel" in v:
+                    w = v["kernel"]
+                    meta = None
+                    if isinstance(w, nn.Partitioned):
+                        meta, w = w.names, w.value
+                    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+                    scale = jnp.maximum(absmax / 127.0, 1e-12)
+                    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                                 -127, 127).astype(jnp.int8)
+                    if meta is not None:
+                        q = nn.Partitioned(q, names=meta)
+                        scale = nn.Partitioned(scale, names=(meta[-1],))
+                    rest = {kk: vv for kk, vv in v.items() if kk != "kernel"}
+                    out[k] = {"kernel_q": q, "scale": scale, **walk(rest)}
+                else:
+                    out[k] = walk(v)
+            else:
+                out[k] = v
+        return out
+
+    return {k: (walk(v) if isinstance(v, dict) else v)
+            for k, v in variables.items()}
+
+
 def generate(model: LlamaModel, variables: Any, prompt_ids,
              max_new_tokens: int = 32, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 1.0,

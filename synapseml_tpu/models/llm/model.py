@@ -49,6 +49,12 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
+    #: "int8": Dense layers read int8 weights with per-output-channel
+    #: scales (weight-only quantization; dequant AFTER the matmul, which
+    #: commutes with the contraction) — halves serving HBM again vs bf16,
+    #: the knob that fits 8B-class models on one 16 GB chip.  Pair with
+    #: :func:`synapseml_tpu.models.llm.quantize_int8`
+    weight_quant: str = "none"
 
     @property
     def d_head(self) -> int:
@@ -108,7 +114,31 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
     return out.astype(x.dtype)
 
 
-def _dense(features, axes, name, dtype):
+class QuantDense(nn.Module):
+    """int8 weight-only Dense: per-output-channel scales applied AFTER the
+    matmul (a per-column scale commutes with the contraction), so the MXU
+    consumes the int8 weights cast to compute dtype tile-by-tile — no
+    dequantized copy is ever materialized in HBM."""
+    features: int
+    axes: Tuple[str, ...]
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        kq = self.param("kernel_q", nn.with_partitioning(
+            nn.initializers.zeros_init(), self.axes),
+            (x.shape[-1], self.features), jnp.int8)
+        scale = self.param("scale", nn.with_partitioning(
+            nn.initializers.ones_init(), (self.axes[-1],)),
+            (self.features,), jnp.float32)
+        y = jax.lax.dot_general(x, kq.astype(self.dtype),
+                                (((x.ndim - 1,), (0,)), ((), ())))
+        return y * scale.astype(self.dtype)
+
+
+def _dense(features, axes, name, dtype, quant: str = "none"):
+    if quant == "int8":
+        return QuantDense(features, axes, dtype, name=name)
     return nn.Dense(features, use_bias=False, dtype=dtype, name=name,
                     kernel_init=nn.with_partitioning(
                         nn.initializers.truncated_normal(0.02), axes))
@@ -131,9 +161,12 @@ class CausalAttention(nn.Module):
         cfg = self.cfg
         B, S, _ = x.shape
         H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.d_head
-        q = _dense(H * D, ("embed", "heads"), "q_proj", cfg.dtype)(x)
-        k = _dense(KV * D, ("embed", "kv"), "k_proj", cfg.dtype)(x)
-        v = _dense(KV * D, ("embed", "kv"), "v_proj", cfg.dtype)(x)
+        q = _dense(H * D, ("embed", "heads"), "q_proj", cfg.dtype,
+                   cfg.weight_quant)(x)
+        k = _dense(KV * D, ("embed", "kv"), "k_proj", cfg.dtype,
+                   cfg.weight_quant)(x)
+        v = _dense(KV * D, ("embed", "kv"), "v_proj", cfg.dtype,
+                   cfg.weight_quant)(x)
         q = apply_rope(q.reshape(B, S, H, D), positions, cfg.rope_theta)
         k = apply_rope(k.reshape(B, S, KV, D), positions, cfg.rope_theta)
         v = v.reshape(B, S, KV, D)
@@ -167,7 +200,7 @@ class CausalAttention(nn.Module):
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v_att)
         out = out.reshape(B, S, H * D)
         out = _dense(cfg.d_model, ("heads", "embed"), "o_proj",
-                     cfg.dtype)(out)
+                     cfg.dtype, cfg.weight_quant)(out)
         return out, new_cache
 
 
@@ -182,10 +215,13 @@ class DecoderBlock(nn.Module):
             h, positions, cache, cache_index)
         x = x + a
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_mlp")(x)
-        gate = _dense(cfg.d_ff, ("embed", "mlp"), "gate_proj", cfg.dtype)(h)
-        up = _dense(cfg.d_ff, ("embed", "mlp"), "up_proj", cfg.dtype)(h)
+        gate = _dense(cfg.d_ff, ("embed", "mlp"), "gate_proj", cfg.dtype,
+                      cfg.weight_quant)(h)
+        up = _dense(cfg.d_ff, ("embed", "mlp"), "up_proj", cfg.dtype,
+                    cfg.weight_quant)(h)
         h = nn.silu(gate) * up                                  # SwiGLU
-        h = _dense(cfg.d_model, ("mlp", "embed"), "down_proj", cfg.dtype)(h)
+        h = _dense(cfg.d_model, ("mlp", "embed"), "down_proj", cfg.dtype,
+                   cfg.weight_quant)(h)
         return x + h, new_cache
 
 
@@ -218,7 +254,7 @@ class LlamaModel(nn.Module):
             logits = embed.attend(x.astype(jnp.float32))
         else:
             logits = _dense(cfg.vocab_size, ("embed", "vocab"), "lm_head",
-                            jnp.float32)(x)
+                            jnp.float32, cfg.weight_quant)(x)
         logits = logits.astype(jnp.float32)
         if cache is not None:
             return logits, new_caches

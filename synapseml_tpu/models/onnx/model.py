@@ -162,7 +162,8 @@ class ONNXModel(Model):
                      if src in fetch}
 
         key = (id(graph), tuple(fetch_names), tuple(sorted(softmax_of.items())),
-               tuple(sorted(argmax_of.items())))
+               tuple(sorted(argmax_of.items())),
+               self.get_or_default("dtype"))
         cache = self._get_cache()
         if key not in cache:
             cache[key] = self._build_fn(graph, fetch_names,

@@ -89,7 +89,8 @@ class OnnxFunction:
 
     def trace(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         """Traceable call for embedding in larger jitted programs."""
-        return evaluate(self.graph, inputs, self.output_names)
+        return evaluate(self.graph, inputs, self.output_names,
+                        dtype=self.dtype)
 
 
 def compile_onnx(source: Union[str, bytes, Graph],
