@@ -7,7 +7,9 @@ from .http import (HTTPClient, HTTPRequestData, HTTPResponseData,
                    StringOutputParser,
                    SimpleHTTPTransformer)
 from .binary import BinaryFileReader, read_binary_files
-from .colstore import ChunkedColumnSource, csv_to_colstore, write_matrix
+from .colstore import (ChunkedColumnSource, SparseChunkedSource,
+                       csv_to_colstore, dense_to_csr, write_csr,
+                       write_matrix)
 from .image import decode_image, read_images
 from .powerbi import PowerBIResponseError, PowerBIWriter
 
@@ -16,6 +18,7 @@ __all__ = [
     "CustomInputParser", "CustomOutputParser", "JSONInputParser",
     "JSONOutputParser", "StringOutputParser", "SimpleHTTPTransformer",
     "BinaryFileReader", "read_binary_files", "decode_image", "read_images",
-    "ChunkedColumnSource", "csv_to_colstore", "write_matrix",
+    "ChunkedColumnSource", "SparseChunkedSource", "csv_to_colstore",
+    "dense_to_csr", "write_csr", "write_matrix",
     "PowerBIWriter", "PowerBIResponseError",
 ]

@@ -24,6 +24,19 @@ import numpy as np
 _HEADER_BYTES = 4 + 4 + 8 + 8       # magic, version, rows, cols
 
 
+def _balanced_range(lo: int, hi: int, index: int,
+                    count: int) -> Tuple[int, int]:
+    """Host ``index``'s contiguous slice of [lo, hi) under the balanced
+    placement rule (first ``n % count`` shards carry one extra row —
+    ClusterUtil.getNumRowsPerPartition): ONE definition shared by the
+    dense and sparse sources so nested sharding stays consistent."""
+    if not 0 <= index < count:
+        raise ValueError(f"shard index {index} outside [0, {count})")
+    base, extra = divmod(hi - lo, count)
+    s = lo + index * base + min(index, extra)
+    return s, s + base + (1 if index < extra else 0)
+
+
 def _open_colstore(path: str) -> Tuple[np.memmap, int, int]:
     with open(path, "rb") as f:
         if f.read(4) != b"SMLC":
@@ -81,12 +94,7 @@ class ChunkedColumnSource:
         (deterministic balanced split: first ``rows % count`` shards carry
         one extra row — the same rule every host computes locally, no
         rendezvous required)."""
-        if not 0 <= index < count:
-            raise ValueError(f"shard index {index} outside [0, {count})")
-        n = self.num_rows
-        base, extra = divmod(n, count)
-        lo = self._lo + index * base + min(index, extra)
-        hi = lo + base + (1 if index < extra else 0)
+        lo, hi = _balanced_range(self._lo, self._hi, index, count)
         return ChunkedColumnSource(
             self.path, self.feature_cols, self.label_col, self.weight_col,
             self.chunk_rows, row_range=(lo, hi))
@@ -190,3 +198,181 @@ def csv_to_colstore(csv_path: str, out_path: str,
     mat, names = read_csv_matrix(csv_path, delim)
     write_colstore(out_path, mat)
     return mat.shape[0], names
+
+
+# --------------------------------------------------------------------------
+# sparse (CSR) out-of-core source
+# --------------------------------------------------------------------------
+
+_SPARSE_HEADER = 4 + 4 + 8 + 8 + 8 + 1 + 1   # magic, ver, rows, cols, nnz,
+                                             # has_label, has_weight
+
+
+def write_csr(path: str, indptr: np.ndarray, indices: np.ndarray,
+              data: np.ndarray, num_cols: int,
+              labels: Optional[np.ndarray] = None,
+              weights: Optional[np.ndarray] = None) -> None:
+    """Write a CSR matrix as an SMLS sparse store.
+
+    Layout: header | indptr int64 (rows+1) | indices int32 (nnz) |
+    data f32 (nnz) | labels f32 (rows)? | weights f32 (rows)?.  Row-major
+    CSR keeps any row RANGE contiguous in indices/data, which is what
+    makes ``shard``/chunk reads O(chunk nnz).
+    """
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices, np.int32)
+    data = np.asarray(data, np.float32)
+    rows = len(indptr) - 1
+    if rows < 0:
+        raise ValueError("indptr must have at least one entry")
+    if len(indices) != len(data) or int(indptr[-1]) != len(data):
+        raise ValueError(
+            f"inconsistent CSR: len(indices)={len(indices)}, "
+            f"len(data)={len(data)}, indptr[-1]={int(indptr[-1])}")
+    if int(indptr[0]) != 0 or np.any(np.diff(indptr) < 0):
+        raise ValueError("indptr must start at 0 and be non-decreasing")
+    if len(indices) and (indices.min() < 0 or indices.max() >= num_cols):
+        raise ValueError("column index out of range")
+    for name, arr in (("labels", labels), ("weights", weights)):
+        if arr is not None and len(arr) != rows:
+            raise ValueError(f"{name} has {len(arr)} entries for "
+                             f"{rows} rows")
+    with open(path, "wb") as f:
+        f.write(b"SMLS")
+        f.write(np.uint32(1).tobytes())
+        f.write(np.int64(rows).tobytes())
+        f.write(np.int64(num_cols).tobytes())
+        f.write(np.int64(len(data)).tobytes())
+        f.write(np.uint8(0 if labels is None else 1).tobytes())
+        f.write(np.uint8(0 if weights is None else 1).tobytes())
+        f.write(indptr.tobytes())
+        f.write(indices.tobytes())
+        f.write(data.tobytes())
+        if labels is not None:
+            f.write(np.asarray(labels, np.float32).tobytes())
+        if weights is not None:
+            f.write(np.asarray(weights, np.float32).tobytes())
+
+
+class SparseChunkedSource:
+    """CSR micro-batch source with the same protocol as
+    :class:`ChunkedColumnSource` (``num_rows``/``num_features``/
+    ``iter_chunks``/``sample_rows``/``read_labels``/``read_weights``/
+    ``shard``), so GBDT streaming train consumes it unchanged.
+
+    The reference streams sparse micro-batches into the shared native
+    dataset (reference: StreamingPartitionTask.scala:264
+    ``pushMicroBatches`` sparse path over LGBM_DatasetPushRowsByCSR...).
+    Here each chunk densifies ONLY its own rows (O(chunk_rows · F) host,
+    memset + nnz scatter) before binning and EFB bundling — the FULL
+    matrix never exists densely on the host, which is the point for
+    one-hot matrices whose dense form is hundreds of times their nnz.
+    """
+
+    def __init__(self, path: str, chunk_rows: int = 65_536,
+                 _range: Optional[Tuple[int, int]] = None):
+        self.path = path
+        self.chunk_rows = int(chunk_rows)
+        with open(path, "rb") as f:
+            if f.read(4) != b"SMLS":
+                raise IOError(f"{path}: not an SMLS sparse store")
+            np.frombuffer(f.read(4), np.uint32)
+            self._rows_total = int(np.frombuffer(f.read(8), np.int64)[0])
+            self._cols = int(np.frombuffer(f.read(8), np.int64)[0])
+            self._nnz = int(np.frombuffer(f.read(8), np.int64)[0])
+            self._has_label = bool(np.frombuffer(f.read(1), np.uint8)[0])
+            self._has_weight = bool(np.frombuffer(f.read(1), np.uint8)[0])
+        off = _SPARSE_HEADER
+        self._indptr = np.memmap(path, np.int64, "r", offset=off,
+                                 shape=(self._rows_total + 1,))
+        off += (self._rows_total + 1) * 8
+        self._indices = np.memmap(path, np.int32, "r", offset=off,
+                                  shape=(self._nnz,))
+        off += self._nnz * 4
+        self._data = np.memmap(path, np.float32, "r", offset=off,
+                               shape=(self._nnz,))
+        off += self._nnz * 4
+        self._labels = None
+        if self._has_label:
+            self._labels = np.memmap(path, np.float32, "r", offset=off,
+                                     shape=(self._rows_total,))
+            off += self._rows_total * 4
+        self._weights = None
+        if self._has_weight:
+            self._weights = np.memmap(path, np.float32, "r", offset=off,
+                                      shape=(self._rows_total,))
+        self._lo, self._hi = _range or (0, self._rows_total)
+
+    @property
+    def num_rows(self) -> int:
+        return self._hi - self._lo
+
+    @property
+    def num_features(self) -> int:
+        return self._cols
+
+    def shard(self, index: int, count: int) -> "SparseChunkedSource":
+        """Contiguous row-range restriction for host ``index`` of
+        ``count`` — nests: sharding a shard subdivides ITS range."""
+        lo, hi = _balanced_range(self._lo, self._hi, index, count)
+        return SparseChunkedSource(self.path, self.chunk_rows,
+                                   _range=(lo, hi))
+
+    def _dense_rows(self, row_idx: np.ndarray) -> np.ndarray:
+        """Densify an arbitrary row set: memset + one scatter of its nnz."""
+        out = np.zeros((len(row_idx), self._cols), np.float32)
+        starts = self._indptr[row_idx]
+        ends = self._indptr[row_idx + 1]
+        for i, (s, e) in enumerate(zip(starts, ends)):
+            out[i, self._indices[s:e]] = self._data[s:e]
+        return out
+
+    def _dense_range(self, lo: int, hi: int) -> np.ndarray:
+        """Densify a contiguous row range with ONE vectorized scatter over
+        the range's nnz slice (no per-row python loop)."""
+        out = np.zeros((hi - lo, self._cols), np.float32)
+        s, e = int(self._indptr[lo]), int(self._indptr[hi])
+        if e > s:
+            counts = np.diff(self._indptr[lo:hi + 1]).astype(np.int64)
+            rows = np.repeat(np.arange(hi - lo), counts)
+            out[rows, self._indices[s:e]] = self._data[s:e]
+        return out
+
+    def iter_chunks(self) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray],
+                                            Optional[np.ndarray]]]:
+        for lo in range(self._lo, self._hi, self.chunk_rows):
+            hi = min(lo + self.chunk_rows, self._hi)
+            y = (np.asarray(self._labels[lo:hi], np.float32)
+                 if self._labels is not None else None)
+            w = (np.asarray(self._weights[lo:hi], np.float32)
+                 if self._weights is not None else None)
+            yield self._dense_range(lo, hi), y, w
+
+    def read_labels(self) -> Optional[np.ndarray]:
+        if self._labels is None:
+            return None
+        return np.asarray(self._labels[self._lo:self._hi], np.float32)
+
+    def read_weights(self) -> Optional[np.ndarray]:
+        if self._weights is None:
+            return None
+        return np.asarray(self._weights[self._lo:self._hi], np.float32)
+
+    def sample_rows(self, k: int, seed: int = 0) -> np.ndarray:
+        n = self.num_rows
+        if n <= k:
+            return self._dense_range(self._lo, self._hi)
+        rng = np.random.default_rng(seed)
+        idx = np.sort(rng.choice(n, k, replace=False)) + self._lo
+        return self._dense_rows(idx)
+
+
+def dense_to_csr(matrix: np.ndarray):
+    """(indptr, indices, data) of a dense matrix — test/convert helper."""
+    matrix = np.asarray(matrix, np.float32)
+    mask = matrix != 0.0
+    counts = mask.sum(axis=1)
+    indptr = np.zeros(len(matrix) + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    rows, cols = np.nonzero(mask)
+    return indptr, cols.astype(np.int32), matrix[rows, cols]
