@@ -19,6 +19,8 @@ from .pygen import generate_pyi
 from .rgen import generate_r
 from .dotnetgen import generate_dotnet
 from .docgen import generate_docs
+from .testgen import generate_pytests
+from .validate import validate_all
 
 
 def generate_all(out_dir: str) -> dict:
@@ -29,10 +31,11 @@ def generate_all(out_dir: str) -> dict:
     return {
         "pyi": generate_pyi(stages, os.path.join(out_dir, "python")),
         "r": generate_r(stages, os.path.join(out_dir, "R")),
-        "dotnet": generate_dotnet(stages, os.path.join(out_dir, "dotnet")),
+        "cs": generate_dotnet(stages, os.path.join(out_dir, "dotnet")),
         "docs": generate_docs(stages, os.path.join(out_dir, "docs")),
     }
 
 
 __all__ = ["discover_stages", "load_all_modules", "generate_all",
-           "generate_pyi", "generate_r", "generate_dotnet", "generate_docs"]
+           "generate_pyi", "generate_r", "generate_dotnet",
+           "generate_docs", "generate_pytests", "validate_all"]

@@ -1,0 +1,190 @@
+"""Generated-binding validation: every artifact is executed or
+structurally cross-checked against the live stage registry.
+
+The reference mechanically TESTS its generated wrappers (reference:
+core/src/test/scala/com/microsoft/azure/synapse/ml/core/test/fuzzing/
+Fuzzing.scala:263,428 emit Python/R/.NET test files from the same
+TestObjects; sbt ``testgen``, project/CodegenPlugin.scala:63).  Round 2's
+wrappers were write-only — syntactically broken output kept the suite
+green.  These validators close that: ``.pyi`` stubs must compile, R and
+C# wrappers must parse structurally AND agree with the real classes'
+param surfaces (names, setters, import paths), so a generator regression
+fails the suite.
+
+No R interpreter or .NET SDK ships in the build image, so R/C# checks
+are structural (delimiter balance, declaration extraction) plus registry
+cross-checks — which is exactly the class of breakage a generator can
+introduce (wrong names, wrong defaults, unbalanced emission, stale
+import paths).
+"""
+
+from __future__ import annotations
+
+import importlib
+import re
+from typing import Dict, Iterable, List
+
+from .common import public_params
+from .dotnetgen import _cs_name
+from .rgen import _snake
+
+
+class GeneratedArtifactError(AssertionError):
+    """A generated binding failed validation."""
+
+
+def _check_balanced(src: str, path: str, pairs: str = "(){}[]",
+                    comment: str = "#") -> None:
+    # doc comments carry prose (apostrophes, smileys) — strip them so the
+    # tracker only sees code
+    src = "\n".join(line for line in src.splitlines()
+                    if not line.lstrip().startswith(comment))
+    openers = {pairs[i]: pairs[i + 1] for i in range(0, len(pairs), 2)}
+    closers = {v: k for k, v in openers.items()}
+    stack: List[str] = []
+    in_str = None
+    prev = ""
+    for ch in src:
+        if in_str:
+            if ch == in_str and prev != "\\":
+                in_str = None
+        elif ch in "\"'":
+            in_str = ch
+        elif ch in openers:
+            stack.append(ch)
+        elif ch in closers:
+            if not stack or stack.pop() != closers[ch]:
+                raise GeneratedArtifactError(
+                    f"{path}: unbalanced {ch!r}")
+        prev = ch
+    if stack:
+        raise GeneratedArtifactError(f"{path}: unclosed {stack[-1]!r}")
+
+
+def validate_pyi(paths: Iterable[str]) -> int:
+    """Compile every stub — a stub that does not compile is broken."""
+    n = 0
+    for path in paths:
+        src = open(path).read()
+        compile(src, path, "exec")
+        n += 1
+    return n
+
+
+_R_FUNC_RE = re.compile(
+    r"^(sml_[a-z0-9_]+) <- function\((.*)\) \{$", re.MULTILINE)
+
+
+def _r_arg_names(arglist: str) -> List[str]:
+    """Argument names from an R formal list, respecting quoted defaults
+    (a default like \"(a, b)\" must not split the list)."""
+    names, depth, in_str, start = [], 0, None, 0
+    prev = ""
+
+    def take(segment: str) -> None:
+        seg = segment.strip()
+        if seg:
+            names.append(seg.split("=")[0].strip())
+
+    for i, ch in enumerate(arglist):
+        if in_str:
+            if ch == in_str and prev != "\\":
+                in_str = None
+        elif ch in "\"'":
+            in_str = ch
+        elif ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            take(arglist[start:i])
+            start = i + 1
+        prev = ch
+    take(arglist[start:])
+    return names
+_R_IMPORT_RE = re.compile(r'reticulate::import\("([^"]+)"\)')
+_R_CALL_RE = re.compile(r"do\.call\(mod\$([A-Za-z0-9_]+),")
+
+
+def validate_r(paths: Iterable[str], stages: Dict[str, type]) -> int:
+    """Structural + registry cross-check of the R wrappers.
+
+    Every stage must have exactly one constructor function whose argument
+    NAMES equal the stage's public params in order, whose
+    ``reticulate::import`` target is an importable module holding the
+    class, and whose file balances its delimiters."""
+    by_fname = {"sml_" + _snake(cls.__name__): cls
+                for cls in stages.values()}
+    seen = set()
+    for path in paths:
+        src = open(path).read()
+        _check_balanced(src, path)
+        funcs = _R_FUNC_RE.findall(src)
+        imports = _R_IMPORT_RE.findall(src)
+        calls = _R_CALL_RE.findall(src)
+        if not funcs:
+            raise GeneratedArtifactError(f"{path}: no constructor functions")
+        if len(funcs) != len(imports) or len(funcs) != len(calls):
+            raise GeneratedArtifactError(
+                f"{path}: {len(funcs)} functions vs {len(imports)} imports "
+                f"vs {len(calls)} constructor calls")
+        for (fname, args), module, clsname in zip(funcs, imports, calls):
+            cls = by_fname.get(fname)
+            if cls is None:
+                raise GeneratedArtifactError(
+                    f"{path}: {fname} matches no registered stage")
+            expected = [p.name for p in public_params(cls)]
+            got = _r_arg_names(args)
+            if got != expected:
+                raise GeneratedArtifactError(
+                    f"{path}: {fname} args {got} != params {expected}")
+            mod = importlib.import_module(module)
+            if getattr(mod, clsname, None) is not cls:
+                raise GeneratedArtifactError(
+                    f"{path}: {fname} constructs {module}.{clsname}, which "
+                    "is not the registered class")
+            seen.add(fname)
+    missing = set(by_fname) - seen
+    if missing:
+        raise GeneratedArtifactError(
+            f"stages without R wrappers: {sorted(missing)[:5]}...")
+    return len(seen)
+
+
+def validate_dotnet(paths: Iterable[str], stages: Dict[str, type]) -> int:
+    """Structural + registry cross-check of the C# wrappers: every stage
+    class extends PythonStage with its module/qualname constructor and one
+    typed setter per param; the runtime base class ships alongside."""
+    sources = {p: open(p).read() for p in paths}
+    joined = "\n".join(sources.values())
+    for path, src in sources.items():
+        _check_balanced(src, path, "{}()", comment="//")
+    if "public abstract class PythonStage" not in joined:
+        raise GeneratedArtifactError(
+            "the PythonStage runtime base is missing from the generated "
+            "output — wrappers would not compile")
+    for cls in stages.values():
+        decl = f"public class {cls.__name__} : PythonStage"
+        if decl not in joined:
+            raise GeneratedArtifactError(
+                f"missing C# class for {cls.__name__}")
+        ctor = f'base("{cls.__module__}", "{cls.__qualname__}")'
+        if ctor not in joined:
+            raise GeneratedArtifactError(
+                f"{cls.__name__}: constructor does not reference "
+                f"{cls.__module__}.{cls.__qualname__}")
+        for p in public_params(cls):
+            setter = f"public {cls.__name__} Set{_cs_name(p.name)}("
+            if setter not in joined:
+                raise GeneratedArtifactError(
+                    f"{cls.__name__}: missing setter for param {p.name}")
+    return len(stages)
+
+
+def validate_all(outputs: Dict[str, List[str]],
+                 stages: Dict[str, type]) -> Dict[str, int]:
+    return {
+        "pyi": validate_pyi(outputs["pyi"]),
+        "r": validate_r(outputs["r"], stages),
+        "cs": validate_dotnet(outputs["cs"], stages),
+    }

@@ -42,6 +42,8 @@ SLOW_MODULES = {"test_examples"}
 #: individual tests > ~4 s on the 8-device CPU mesh (from --durations)
 SLOW_TESTS = {
     "test_resume_matches_uninterrupted",
+    "test_generated_suite_passes",
+    "test_generated_suite_catches_stub_drift",
     "test_deep_text_classifier_moe",
     "test_tp_matches_dp_training",
     "test_deep_vision_classifier_learns",
