@@ -210,7 +210,7 @@ class TestMechanicalTestgen:
         r = subprocess.run(
             [sys.executable, "-m", "pytest", d, "-q", "-x",
              "-p", "no:cacheprovider"],
-            capture_output=True, text=True, timeout=500,
+            capture_output=True, text=True, timeout=900,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
 
@@ -243,7 +243,7 @@ class TestMechanicalTestgen:
         r = subprocess.run(
             [sys.executable, "-m", "pytest", str(d), "-q",
              "-p", "no:cacheprovider"],
-            capture_output=True, text=True, timeout=500,
+            capture_output=True, text=True, timeout=900,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert r.returncode != 0
         assert "drifted" in r.stdout
