@@ -192,6 +192,52 @@ class FeatureBundler:
         own = self.owner[bundle]
         return int(own[min(max(bundled_bin, 0), len(own) - 1)])
 
+    def route_tables(self, num_bins: np.ndarray, total_bins: int) -> dict:
+        """Static arrays that make EFB invisible to the growers (the
+        LightGBM scheme: bundling compresses HISTOGRAM construction, but
+        split search and the trees stay in ORIGINAL feature space).
+
+        Per original feature ``f`` (all ``(F,)`` int32):
+        - ``col``: the bundled column holding f,
+        - ``lo``/``hi``: f's bundled-bin range is ``(lo, hi]`` — a row
+          outside it has f at its default bin (``lo`` doubles as the rank
+          base for thresholds),
+        - ``default_bin``: f's default original bin.
+
+        ``gather_src`` ((F, B) int32) maps the ORIGINAL histogram cell
+        (f, b) to a flat index into the bundled histogram, with ``-2``
+        marking f's default bin (mass = node total − Σ other bins — rows
+        whose f is default sit at bundled bin 0 OR inside other features'
+        ranges) and ``-1`` marking out-of-range bins (zero).
+
+        An original split (f, b) routes from the bundled column as::
+
+            in_range = (xb > lo[f]) & (xb <= hi[f])
+            go_left  = in_range ? xb <= lo[f] + rank(b) : default_bin[f] <= b
+
+        with ``rank(b) = b + (b < default_bin[f])`` (the skip-default rank
+        the transform assigns) — monotone in b, so one threshold suffices.
+        """
+        F = self.n_features
+        col = self.bundle_of.astype(np.int32)
+        lo = self.offset_of.astype(np.int32)
+        hi = (self.offset_of + num_bins[:F].astype(np.int32)).astype(np.int32)
+        gather = np.full((F, total_bins), -1, np.int64)
+        Bb = total_bins                       # bundled hists share the width
+        for f in range(F):
+            d = int(self.default_bin[f])
+            for b in range(int(num_bins[f]) + 1):
+                if b >= total_bins:
+                    break
+                if b == d:
+                    gather[f, b] = -2
+                else:
+                    rank = b + (1 if b < d else 0)
+                    gather[f, b] = int(col[f]) * Bb + int(lo[f]) + rank
+        return {"col": col, "lo": lo, "hi": hi,
+                "default_bin": self.default_bin.astype(np.int32),
+                "gather_src": gather}
+
     def to_dict(self) -> dict:
         return {"bundle_of": self.bundle_of.tolist(),
                 "offset_of": self.offset_of.tolist(),

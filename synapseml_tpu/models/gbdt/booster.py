@@ -90,9 +90,12 @@ class BoostingConfig:
     #: (LightGBM's exact growth order).  voting_parallel implies lossguide.
     growth_policy: str = "depthwise"
     #: exclusive feature bundling: merge rarely-co-nonzero (binned)
-    #: features into shared columns — the sparse/one-hot densification
-    #: strategy (LightGBM enable_bundle).  Bundled models predict through
-    #: bin space; LightGBM-format export and TreeSHAP are unavailable.
+    #: features into shared HISTOGRAM columns — the sparse/one-hot
+    #: densification strategy (LightGBM enable_bundle).  Bundling only
+    #: compresses histogram construction; split search, routing, and the
+    #: trees stay in ORIGINAL feature space, so predict/SHAP/LightGBM
+    #: export/monotone constraints all work unchanged (dart and
+    #: voting_parallel are the exceptions and reject loudly).
     enable_bundle: bool = False
     max_conflict_rate: float = 0.0
     #: feature indexes holding category codes (categoricalSlotIndexes,
@@ -184,12 +187,13 @@ class Booster:
         n = features.shape[0]
         depth = self.depth_bound()
         bundled = None
-        if self.bundler is not None or self.bin_mapper.has_categorical:
-            # EFB/categorical models split in bin space: bin (and bundle),
-            # then traverse by split_bin instead of raw thresholds
+        if self.bin_mapper.has_categorical:
+            # categorical models split in (ORIGINAL) bin space: bin, then
+            # traverse by split_bin instead of raw thresholds.  EFB models
+            # need nothing special — bundling only compresses histogram
+            # construction; their trees live in original feature space
+            # with raw thresholds (the LightGBM scheme)
             binned = self.bin_mapper.transform(features)
-            if self.bundler is not None:
-                binned = self.bundler.transform(binned)
             bundled = jnp.asarray(binned.astype(np.int32))
         outs, leaves = [], []
         for k in range(self.num_class):
@@ -244,16 +248,10 @@ class Booster:
 
         Returns (n, F+1) for single-output models, (n, K*(F+1)) for
         multiclass (last slot of each block = bias)."""
-        if self.bundler is not None:
-            raise NotImplementedError(
-                "predict_contrib on EFB-bundled models: a bundled split "
-                "partitions several original features' bins at once, so "
-                "exact per-original-feature attribution is not defined "
-                "for these trees — train with enable_bundle=False for "
-                "attributions")
         # categorical models split in BIN space (target-ordered category
         # bins); SHAP runs over the binned matrix with split_bin routing —
-        # exact, since binning is a per-feature transform
+        # exact, since binning is a per-feature transform.  EFB models
+        # need nothing special: their trees live in original feature space
         bin_space = self.bin_mapper.has_categorical
         from .shap import has_cover_counts, tree_shap_values
         if not approximate and has_cover_counts(self):
@@ -309,8 +307,6 @@ class Booster:
             internal = np.nonzero(np.asarray(t.split_feature) >= 0)[0]
             for node in internal:
                 f = int(t.split_feature[node])
-                if self.bundler is not None:
-                    f = self.bundler.owner_of_split(f, int(t.split_bin[node]))
                 w = (1.0 if importance_type == "split"
                      else float(t.split_gain[node]))
                 out[f] += w
@@ -319,7 +315,7 @@ class Booster:
     # -- serialization -----------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         return {
-            "version": 1,
+            "version": 2,
             "num_class": self.num_class,
             "objective": self.objective,
             "init_score": self.init_score.tolist(),
@@ -346,11 +342,11 @@ class Booster:
         """LightGBM text model format (saveToString parity,
         LightGBMBooster.scala:272-284) — loadable by any LightGBM runtime.
         The JSON form (:meth:`to_dict`) remains the internal format."""
-        if self.bundler is not None or self.bin_mapper.has_categorical:
+        if self.bin_mapper.has_categorical:
             raise NotImplementedError(
-                "EFB-bundled/categorical models have no LightGBM text "
-                "representation here (splits live in bin space); persist "
-                "via save()/to_dict()")
+                "categorical models have no LightGBM text representation "
+                "here (splits live in bin space); persist via "
+                "save()/to_dict()")
         from .lgbm_format import booster_to_lgbm_string
         return booster_to_lgbm_string(self)
 
@@ -389,6 +385,12 @@ class Booster:
                 missing_zero=np.asarray(
                     td.get("missing_zero",
                            np.zeros(len(td["leaf_value"]), bool)), bool)))
+        if d.get("bundler") and int(d.get("version", 1)) < 2:
+            raise ValueError(
+                "this EFB model was saved by a pre-round-3 build whose "
+                "bundled trees split BUNDLED columns; round 3 stores "
+                "original-feature trees (the LightGBM scheme) — re-train "
+                "the model")
         bundler = (FeatureBundler.from_dict(d["bundler"])
                    if d.get("bundler") else None)
         return Booster(trees, d["tree_class"], d["tree_weights"], d["num_class"],
@@ -471,7 +473,8 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
     """Build the jitted one-iteration step.
 
     step(binned, scores, labels, weights, (base_bag, bag_key),
-         feature_mask, key, upper_bounds, num_bins) -> (trees, new_scores)
+         feature_mask, key, upper_bounds, num_bins, bundle_map)
+      -> (trees, new_scores)
 
     Bagging happens ON DEVICE: ``base_bag`` is the constant pad-row mask
     and the per-iteration row subsample is drawn from ``bag_key`` when
@@ -510,7 +513,7 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
         return jnp.where(topset, 1.0, jnp.where(rest_keep, amp, 0.0)) * bag
 
     def one_step(bins_t, scores, labels, weights, bag_in, feature_mask,
-                 key, upper_bounds, num_bins):
+                 key, upper_bounds, num_bins, bundle_map=None):
         base_bag, bag_key = bag_in
         if bagging_fraction < 1.0:
             # feature-parallel replicates rows: every rank must draw the
@@ -530,7 +533,8 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                 rv = goss_weights(jnp.abs(grad), bag_mask, key)
             tree, node_id = grower(bins_t, grad, hess, rv, feature_mask,
                                    upper_bounds, num_bins, learning_rate,
-                                   p, axis, use_pallas)
+                                   p, axis, use_pallas,
+                                   bundle_map=bundle_map)
             new_scores = scores + tree.leaf_value[node_id]
             trees.append(tree)
         else:
@@ -550,7 +554,8 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                                       jax.random.fold_in(key, k))
                 tree, node_id = grower(bins_t, grad[:, k], hess[:, k], rv,
                                        feature_mask, upper_bounds, num_bins,
-                                       learning_rate, p, axis, use_pallas)
+                                       learning_rate, p, axis, use_pallas,
+                                       bundle_map=bundle_map)
                 new_scores = new_scores.at[:, k].add(tree.leaf_value[node_id])
                 trees.append(tree)
         return stack_trees(trees), new_scores
@@ -565,14 +570,15 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                     P(), P(), P(),                         # scores/labels/w
                     (P(), P()),                            # (base_bag, key)
                     P(DATA_AXIS), P(),                     # fmask/key
-                    P(DATA_AXIS, None), P(DATA_AXIS))      # bounds/nbins
+                    P(DATA_AXIS, None), P(DATA_AXIS),      # bounds/nbins
+                    P())                                   # bundle_map (n/a)
         out_specs = (P(), P())                             # all replicated
     else:
         in_specs = (P(None, DATA_AXIS),                    # bins_t (F, N)
                     P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None),
                     P(DATA_AXIS), P(DATA_AXIS),            # labels/weights
                     (P(DATA_AXIS), P()),                   # (base_bag, bag_key)
-                    P(), P(), P(), P())                    # fmask/key/bounds/nbins
+                    P(), P(), P(), P(), P())   # fmask/key/bounds/nbins/bundle
         out_specs = (P(),                                  # trees replicated
                      P(DATA_AXIS) if ndim_scores == 1 else P(DATA_AXIS, None))
     return jax.jit(jax.shard_map(one_step, mesh=mesh, in_specs=in_specs,
@@ -723,6 +729,17 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             config = dataclasses.replace(
                 config, num_iterations=config.num_iterations - done)
             init_model = resumed
+    if config.enable_bundle:
+        if config.boosting_type == "dart":
+            raise NotImplementedError(
+                "enable_bundle + dart: dart rescoring traverses the "
+                "BUNDLED device matrix, but EFB trees live in original "
+                "feature space; use gbdt/goss/rf")
+        if config.parallelism == "voting_parallel":
+            raise NotImplementedError(
+                "enable_bundle + voting_parallel: feature votes are "
+                "per original feature but voting aggregates bundled "
+                "histogram columns; use data_parallel")
     source = X if hasattr(X, "iter_chunks") else None
     if source is not None:
         n, F = source.num_rows, source.num_features
@@ -753,11 +770,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         if any(int(c) not in (-1, 0, 1) for c in config.monotone_constraints):
             raise ValueError("monotone_constraints entries must be -1, 0, "
                              "or 1")
-        if config.enable_bundle:
-            raise NotImplementedError(
-                "monotone_constraints + enable_bundle: bundled columns mix "
-                "original features, so per-feature output bounds cannot "
-                "apply")
         cats = set(config.categorical_feature or [])
         if any(int(c) != 0 and i in cats
                for i, c in enumerate(config.monotone_constraints)):
@@ -947,7 +959,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                              jax.random.PRNGKey(0)), jnp.ones(F, bool),
                              jax.random.PRNGKey(1),
                              jnp.zeros((F, _w_ub_cols), jnp.float32),
-                             jnp.full(F, config.max_bin + 1, jnp.int32))
+                             jnp.full(F, config.max_bin + 1, jnp.int32),
+                             None)
                 jax.block_until_ready(out[1])
             except Exception:
                 pass           # warming is best-effort; the loop compiles
@@ -1091,15 +1104,19 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     else:
         scores = dev_fill(float(init_sc[0]), (N,) if K == 1 else (N, K))
     init_scores_dev = scores            # rf resets to this every iteration
+    # split search, thresholds and trees live in ORIGINAL feature space
+    # even under EFB (bundling only compresses histogram construction —
+    # the LightGBM scheme), so bounds/bin counts are always the mapper's
+    ub_np = mapper.upper_bounds
+    nb_np = mapper.num_bins
+    bundle_map_dev = None
     if bundler is not None:
-        # bundle thresholds live in bin space: raw-value bounds are moot
-        # (predict traverses split_bin); content bins exclude bundled bin 0
-        ub_np = np.zeros((bundler.num_bundles, mapper.upper_bounds.shape[1]),
-                         np.float32)
-        nb_np = (bundler.num_bins - 1).astype(np.int32)
-    else:
-        ub_np = mapper.upper_bounds
-        nb_np = mapper.num_bins
+        bm = bundler.route_tables(mapper.num_bins, B_total)
+        bundle_map_dev = {k: jnp.asarray(v.astype(np.int32))
+                          for k, v in bm.items()}
+        if mesh is not None:
+            bundle_map_dev = {k: jax.device_put(v, replicated(mesh))
+                              for k, v in bundle_map_dev.items()}
     if Fp != F:                         # padded features: 1 bin, never split
         ub_np = np.concatenate(
             [ub_np, np.full((Fp - F, ub_np.shape[1]), np.inf, np.float32)])
@@ -1153,7 +1170,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         Xv, yv, wv = valid
         Xv = np.ascontiguousarray(Xv, np.float32)
         binned_v = jnp.asarray(np.ascontiguousarray(
-            bin_eff(Xv).astype(np.int32).T))
+            bin_host(Xv).astype(np.int32).T))
         yv = (np.asarray(yv) > 0).astype(np.float32) if config.objective == "binary" \
             else np.asarray(yv, np.float32)
         # contributions accumulate separately from the init margin so rf can
@@ -1175,8 +1192,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             metric_fn, larger_better = metrics_mod.METRICS.get(
                 metric_name, metrics_mod.METRICS["l2"])
 
-    F_eff = bundler.num_bundles if bundler is not None else F
-    Fp_eff = F_eff if bundler is not None else Fp
+
     measures.data_prep_s = _time.perf_counter() - _t_prep
     _t_train = _time.perf_counter()
     trees: List[Tree] = []
@@ -1219,13 +1235,13 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         bag_key = jax.random.fold_in(bag_root_key,
                                      it // max(config.bagging_freq, 1))
         if config.feature_fraction < 1.0:
-            k = max(1, int(round(F_eff * config.feature_fraction)))
-            feature_mask = np.zeros(Fp_eff, bool)  # padded features stay off
-            feature_mask[rng.choice(F_eff, k, replace=False)] = True
+            k = max(1, int(round(F * config.feature_fraction)))
+            feature_mask = np.zeros(Fp, bool)  # padded features stay off
+            feature_mask[rng.choice(F, k, replace=False)] = True
             fmask_dev = None
         elif fmask_dev is None:
-            feature_mask = np.zeros(Fp_eff, bool)
-            feature_mask[:F_eff] = True
+            feature_mask = np.zeros(Fp, bool)
+            feature_mask[:F] = True
         if fmask_dev is None:
             fmask_dev = jnp.asarray(feature_mask)
             if featpar:
@@ -1245,7 +1261,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         key = jax.random.PRNGKey(config.seed * 100003 + it)
         tstack, new_scores = step(bins_t, scores, labels, weights,
                                   (base_bag_dev, bag_key), fmask_dev,
-                                  key, upper_bounds, num_bins)
+                                  key, upper_bounds, num_bins,
+                                  bundle_map_dev)
         if eager_host:
             new_trees = [Tree(*[np.asarray(a[k]) for a in tstack])
                          for k in range(K)]

@@ -83,9 +83,9 @@ class GBDTParams(Params):
     topK = IntParam(doc="voting-parallel top features per shard", default=20)
     enableBundle = BoolParam(
         doc="exclusive feature bundling: merge rarely-co-nonzero features "
-            "into shared histogram columns (sparse/one-hot densification; "
-            "LightGBM enable_bundle). Bundled models predict via bin "
-            "space; SHAP and LightGBM-format export are unavailable",
+            "into shared HISTOGRAM columns (sparse/one-hot densification; "
+            "LightGBM enable_bundle). Trees stay in original feature "
+            "space, so predict/SHAP/export work unchanged",
         default=False)
     maxConflictRate = FloatParam(doc="EFB allowed conflict fraction",
                                  default=0.0)

@@ -293,13 +293,21 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 
 
 
 def _make_fused_kernel(ft: int):
-    def kernel(leaf_ref, thr_ref, lid_ref, rid_ref,
+    def kernel(leaf_ref, t1_ref, rlo_ref, rhi_ref, dflt_ref,
+               lid_ref, rid_ref,
                sel_ref, bins_ref, nid_ref, vals_ref,
                newid_ref, out_ref, oh_ref, vn_ref):
         """Grid (N//chunk, F//ft) — f fastest.  sel block (S, C) int32 (the
-        split features' bin rows), bins block (ft, C) (histogram tile),
+        split columns' bin rows), bins block (ft, C) (histogram tile),
         nid (1, C), vals (C, S·8) bf16 pre-tiled; outputs: newid (1, C) and
-        the resident histogram accumulator (F//ft, ft·B, S·8) f32."""
+        the resident histogram accumulator (F//ft, ft·B, S·8) f32.
+
+        The routing condition is the UNIVERSAL form
+        ``in (rlo, rhi] ? x <= t1 : dflt``: plain splits pass
+        rlo=-1/rhi=B so it degrades to ``x <= t1``; EFB splits pass the
+        original feature's bundled range so an ORIGINAL-feature split
+        routes straight off the bundled column (binning.py
+        FeatureBundler.route_tables)."""
         c = pl.program_id(0)
         f = pl.program_id(1)
 
@@ -318,7 +326,13 @@ def _make_fused_kernel(ft: int):
             bslot = jnp.full_like(nid, -1)
             for j in range(S):
                 inleaf = nid == leaf_ref[j]
-                gl = sel_ref[j, :] <= thr_ref[j]
+                xb = sel_ref[j, :]
+                in_range = (xb > rlo_ref[j]) & (xb <= rhi_ref[j])
+                # select over int32: Mosaic rejects broadcasting the i1
+                # SCALAR default into a vector select
+                gl = jnp.where(in_range,
+                               (xb <= t1_ref[j]).astype(jnp.int32),
+                               dflt_ref[j]) != 0
                 new = jnp.where(inleaf,
                                 jnp.where(gl, lid_ref[j], rid_ref[j]), new)
                 bslot = jnp.where(inleaf & gl, j, bslot)
@@ -345,8 +359,11 @@ def _make_fused_kernel(ft: int):
 def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
                           node_id: jnp.ndarray,  # (N,) int32
                           leaf: jnp.ndarray,     # (S,) int32 leaf being split
-                          feat: jnp.ndarray,     # (S,) int32 split feature
-                          thr_bin: jnp.ndarray,  # (S,) int32 bin (<= goes left)
+                          sel_col: jnp.ndarray,  # (S,) int32 routing column
+                          t1: jnp.ndarray,       # (S,) int32 in-range thr
+                          rlo: jnp.ndarray,      # (S,) int32 range (rlo, rhi]
+                          rhi: jnp.ndarray,      # (S,) int32
+                          dflt: jnp.ndarray,     # (S,) int32 out-of-range dir
                           l_id: jnp.ndarray,     # (S,) int32 left child id
                           r_id: jnp.ndarray,     # (S,) int32 right child id
                           vals: jnp.ndarray,     # (N, S·8) bf16 tiled
@@ -355,8 +372,11 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
                           interpret: bool = False):
     """One pass: → (new_node_id (N,), hists (n_slots, F, B, 3)).
 
-    ``vals`` is :func:`prep_hist_vals` output tiled to (N, n_slots·8) —
-    the caller tiles ONCE per tree, not per wave."""
+    Routing per slot: rows of column ``sel_col`` go left iff
+    ``x in (rlo, rhi] ? x <= t1 : dflt`` — plain splits pass rlo=-1,
+    rhi=B, t1=split_bin; EFB passes the bundled range of the ORIGINAL
+    feature being split.  ``vals`` is :func:`prep_hist_vals` output tiled
+    to (N, n_slots·8) — the caller tiles ONCE per tree, not per wave."""
     F, N = bins_t.shape
     B = total_bins
     geo = fused_geometry(F, B, n_slots)
@@ -368,10 +388,10 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
     Fp = ((F + ft - 1) // ft) * ft
     if Fp != F:
         bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
-    sel = jnp.take(bins_t, feat, axis=0)               # (S, N) row copy
+    sel = jnp.take(bins_t, sel_col, axis=0)            # (S, N) row copy
     VN = n_slots * SLOT_LANES
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=7,
         grid=(N // chunk, Fp // ft),
         in_specs=[
             pl.BlockSpec((n_slots, chunk), lambda c, f, *_: (0, c)),
@@ -394,7 +414,7 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
                    jax.ShapeDtypeStruct(
                        (Fp // ft, ft * B, VN), jnp.float32)],
         interpret=interpret,
-    )(leaf, thr_bin, l_id, r_id,
+    )(leaf, t1, rlo, rhi, dflt, l_id, r_id,
       sel, bins_t, node_id[None, :], vals)
 
     out = out.reshape(Fp, B, n_slots, SLOT_LANES)[:F]

@@ -300,12 +300,18 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
               p: GrowthParams,
               axis_name: Optional[str] = None,
               use_pallas: bool = False,
+              bundle_map: Optional[dict] = None,
               ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one tree; returns (tree, per-row leaf node ids).
 
     When ``axis_name`` is set the function must run inside shard_map over
     that axis; histograms and root stats are psum'd so every rank grows the
     identical tree from its row shard.
+
+    ``bundle_map`` (EFB): ``bins_t`` holds BUNDLED columns but split
+    search, routing and the emitted tree all live in ORIGINAL feature
+    space — histograms unbundle before each pick, splits route through
+    :func:`_slot_route_params`.
     """
     F, N = bins_t.shape
     B = p.total_bins
@@ -316,10 +322,19 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     # features inside _best_split_voting; full data-parallel psums every
     # histogram as it is built
     voting = p.voting_k > 0 and axis_name is not None
-    mono_c = _mono_vec(p, F)
+    assert not (voting and bundle_map is not None), \
+        "voting_parallel + EFB is rejected at the train() surface"
+    F_search = num_bins.shape[0]           # ORIGINAL feature count
+    mono_c = _mono_vec(p, F_search)
 
     def ar(x):
         return lax.psum(x, axis_name) if (axis_name and not voting) else x
+
+    def unb(hist3, g, h, c):
+        if bundle_map is None:
+            return hist3
+        return _unbundle_hists(hist3, bundle_map["gather_src"],
+                               jnp.stack([g, h, c], -1))
 
     if voting:
         def pick(hist3, g, h, c, depth, lo, hi):
@@ -327,8 +342,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
                                       depth, p, axis_name, lo, hi, mono_c)
     else:
         def pick(hist3, g, h, c, depth, lo, hi):
-            return _best_split(hist3, g, h, c, num_bins, feature_mask,
-                               depth, p, lo, hi, mono_c)
+            return _best_split(unb(hist3, g, h, c), g, h, c, num_bins,
+                               feature_mask, depth, p, lo, hi, mono_c)
 
     flat_bins = None
     if not use_pallas:
@@ -388,7 +403,9 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         r_id = s["num_nodes"] + 1
 
         in_leaf = s["node_id"] == leaf
-        go_left = bins_t[feat, :] <= sbin
+        col_s, t1_s, lo_s, hi_s, df_s = _slot_route_params(
+            feat, sbin, B, bundle_map)
+        go_left = _route_left(bins_t[col_s, :], t1_s, lo_s, hi_s, df_s)
         new_node_id = jnp.where(in_leaf, jnp.where(go_left, l_id, r_id),
                                 s["node_id"])
 
@@ -511,6 +528,55 @@ def _build_hist_nodes(bins_t, flat_bins, vals8, grad, hess, mask, slot,
                                  n_slots, F, B)
 
 
+def _slot_route_params(feat, tbin, B, bundle_map):
+    """Universal routing params for splits chosen on ORIGINAL features.
+
+    Returns (col, t1, rlo, rhi, dflt): rows of column ``col`` go left iff
+    ``x in (rlo, rhi] ? x <= t1 : dflt``.  Plain training routes the
+    feature's own column with the full range, so the condition degrades to
+    ``x <= tbin``; under EFB the split feature's BUNDLED range maps the
+    original-bin threshold onto the bundled column (rank(b) = b +
+    (b < default) — binning.py FeatureBundler.route_tables), and
+    out-of-range rows (feature at its default bin) take the default-bin
+    direction.  One formula, so the pallas kernel and every XLA routing
+    path stay identical between plain and EFB training."""
+    if bundle_map is None:
+        return (feat, tbin, jnp.full_like(feat, -1),
+                jnp.full_like(feat, B), jnp.ones_like(feat))
+    col = bundle_map["col"][feat]
+    lo = bundle_map["lo"][feat]
+    hi = bundle_map["hi"][feat]
+    d = bundle_map["default_bin"][feat]
+    t1 = lo + tbin + (tbin < d).astype(tbin.dtype)
+    dflt = (d <= tbin).astype(jnp.int32)
+    return col, t1, lo, hi, dflt
+
+
+def _route_left(xb, t1, rlo, rhi, dflt):
+    in_range = (xb > rlo) & (xb <= rhi)
+    return jnp.where(in_range, xb <= t1, dflt != 0)
+
+
+def _unbundle_hists(hists, gather_src, tot):
+    """Bundled histograms (..., Fb, Bb, 3) → ORIGINAL-feature histograms
+    (..., F, B, 3) by static gather; a feature's DEFAULT bin carries the
+    residual node mass (rows default in f sit at bundled bin 0 or inside
+    other features' ranges).  Exact for exclusive bundles — which is why
+    EFB training grows the BIT-IDENTICAL tree to unbundled training while
+    the data pass stays compressed (the LightGBM scheme: EFB accelerates
+    histogram construction, trees never leave original feature space).
+
+    ``tot``: node totals (..., 3) [grad, hess, count]."""
+    lead = hists.shape[:-3]
+    F, B = gather_src.shape
+    flat = hists.reshape(lead + (-1, 3))
+    V = jnp.take(flat, jnp.maximum(gather_src, 0).reshape(-1), axis=-2)
+    V = V.reshape(lead + (F, B, 3))
+    V = jnp.where((gather_src >= 0)[..., None], V, 0.0)
+    resid = tot[..., None, None, :] - jnp.sum(V, axis=-2, keepdims=True)
+    return jnp.where((gather_src == -2)[..., None], resid, V)
+
+
 def default_n_slots(num_leaves: int) -> int:
     """Node slots per wave: 16 slots × 8 value channels = the full 128-lane
     MXU tile; fewer when the leaf budget is smaller."""
@@ -531,6 +597,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                         axis_name: Optional[str] = None,
                         use_pallas: bool = False,
                         n_slots: int = 16,
+                        bundle_map: Optional[dict] = None,
                         ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one tree wave-by-wave; returns (tree, per-row leaf node ids).
 
@@ -565,7 +632,15 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         return ar(_build_hist_nodes(bins_t, flat_bins, vals8, grad, hess,
                                     row_valid, slot, S, F, B, use_pallas))
 
-    mono_c = _mono_vec(p, F)
+    F_search = num_bins.shape[0]           # ORIGINAL feature count
+    mono_c = _mono_vec(p, F_search)
+
+    def unb(hists, g, h, c):
+        if bundle_map is None:
+            return hists
+        return _unbundle_hists(hists, bundle_map["gather_src"],
+                               jnp.stack([g, h, c], -1))
+
     pick = functools.partial(_best_split, num_bins=num_bins,
                              feature_mask=feature_mask, p=p, mono_c=mono_c)
     vpick = jax.vmap(lambda h, g, hh, c, d, lo, hi: pick(
@@ -578,7 +653,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
 
     zi = jnp.zeros(M, jnp.int32)
     zf = jnp.zeros(M, jnp.float32)
-    bg, bf_, bb, bgl, bhl, bcl = pick(root_hist, root_g, root_h, root_c,
+    bg, bf_, bb, bgl, bhl, bcl = pick(unb(root_hist, root_g, root_h, root_c),
+                                      root_g, root_h, root_c,
                                       node_depth=jnp.zeros((), jnp.int32),
                                       node_lo=-jnp.inf, node_hi=jnp.inf)
     state = dict(
@@ -630,25 +706,28 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         # row) and build every selected leaf's left-child histogram in ONE
         # pass over the binned matrix — the fused kernel computes each
         # chunk's routing once and keeps it in VMEM for the histogram tiles
+        r_col, r_t1, r_lo, r_hi, r_df = _slot_route_params(
+            s["best_feat"][parents], s["best_bin"][parents], B, bundle_map)
         if use_pallas:
             from .pallas_hist import route_and_hist_pallas
 
             def fused_wave(_):
                 return route_and_hist_pallas(
-                    bins_t, s["node_id"], parents, s["best_feat"][parents],
-                    s["best_bin"][parents], l_ids, r_ids, vals_tiled, S, B,
+                    bins_t, s["node_id"], parents, r_col, r_t1, r_lo,
+                    r_hi, r_df, l_ids, r_ids, vals_tiled, S, B,
                     interpret=(use_pallas == "interpret"))
 
             def route_only(_):
                 # this wave fills the leaf budget: its child histograms can
                 # never feed another split, so skip the one-hot pass (one of
                 # five full-data passes per 31-leaf tree) and route in plain
-                # XLA from the gathered split-feature rows.  Child pick
+                # XLA from the gathered split-column rows.  Child pick
                 # stats (sum_g/h/c) come from the parent pick, not from
                 # these histograms, so zeros are safe.
-                sel = jnp.take(bins_t, s["best_feat"][parents], axis=0)
+                sel = jnp.take(bins_t, r_col, axis=0)
                 inleaf = s["node_id"][None, :] == parents[:, None]   # (S, N)
-                gl = sel <= s["best_bin"][parents][:, None]
+                gl = _route_left(sel, r_t1[:, None], r_lo[:, None],
+                                 r_hi[:, None], r_df[:, None])
                 new = (jnp.sum(jnp.where(inleaf & gl, l_ids[:, None], 0), 0)
                        + jnp.sum(jnp.where(inleaf & ~gl, r_ids[:, None], 0), 0)
                        + jnp.where(jnp.any(inleaf, 0), 0, s["node_id"]))
@@ -662,9 +741,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             slot_of_leaf = jnp.full(M, -1, jnp.int32).at[parents].set(
                 jnp.where(valid, jidx, -1))
             rslot = slot_of_leaf[s["node_id"]]           # (N,)
-            feat_r = s["best_feat"][s["node_id"]]
-            bin_r = s["best_bin"][s["node_id"]]
-            go_left = bins_t[feat_r, rows] <= bin_r
+            safe = jnp.maximum(rslot, 0)
+            go_left = _route_left(bins_t[r_col[safe], rows], r_t1[safe],
+                                  r_lo[safe], r_hi[safe], r_df[safe])
             new_node_id = jnp.where(
                 rslot >= 0,
                 jnp.where(go_left, l_ids[rslot], r_ids[rslot]),
@@ -697,8 +776,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         ch = jnp.concatenate([lh, rh])
         cc = jnp.concatenate([lc, rc])
         cd = jnp.concatenate([cdepth, cdepth])
-        cbg, cbf, cbb, cbgl, cbhl, cbcl = vpick(child_hists, cg, ch, cc, cd,
-                                                c_lo, c_hi)
+        cbg, cbf, cbb, cbgl, cbhl, cbcl = vpick(
+            unb(child_hists, cg, ch, cc), cg, ch, cc, cd, c_lo, c_hi)
 
         cids = jnp.concatenate([l_ids, r_ids])           # (2S,)
         thr = jnp.where(s["best_bin"][parents] >= 1,
@@ -793,6 +872,7 @@ def grow_tree_feature_parallel(
         axis_name: str,
         use_pallas: bool = False,
         n_slots: int = 16,
+        bundle_map: Optional[dict] = None,    # EFB+featpar rejected upstream
 ) -> Tuple[Tree, jnp.ndarray]:
     """Depth-level growth with the FEATURE axis sharded over ``axis_name``.
 
@@ -802,6 +882,8 @@ def grow_tree_feature_parallel(
     """
     from .pallas_hist import prep_hist_vals
 
+    assert bundle_map is None, \
+        "feature_parallel + EFB is rejected at the train() surface"
     FL, N = bins_t.shape
     B = p.total_bins
     L = p.num_leaves

@@ -79,11 +79,14 @@ def test_efb_serialization_and_importance():
     assert fi.shape == (X.shape[1],)
     informative = fi[:10].sum() + fi[10:20].sum() + fi[60]
     assert informative > fi.sum() * 0.5
-    # unsupported surfaces fail loudly
-    with pytest.raises(NotImplementedError, match="bundle"):
-        b.to_string()
-    with pytest.raises(NotImplementedError, match="bundle"):
-        b.predict_contrib(X[:4])
+    # round 3: EFB trees live in ORIGINAL feature space, so the LightGBM
+    # text format and TreeSHAP both work on bundled models
+    b3 = Booster.from_string(b.to_string())
+    np.testing.assert_allclose(b.predict_margin(X[:256]),
+                               b3.predict_margin(X[:256]), atol=1e-5)
+    contrib = b.predict_contrib(X[:16])
+    np.testing.assert_allclose(contrib.sum(1), b.predict_margin(X[:16]),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_efb_distributed_and_valid():
@@ -130,3 +133,63 @@ def test_efb_streaming_matches_in_memory(tmp_path):
     assert b_stream.bundler.num_bundles == b_mem.bundler.num_bundles
     np.testing.assert_allclose(b_stream.predict_margin(X[:512]),
                                b_mem.predict_margin(X[:512]), atol=1e-5)
+
+
+def test_efb_bit_identical_to_unbundled():
+    """THE faithful-EFB property (the LightGBM scheme): bundling only
+    compresses histogram construction.  With exclusive bundles the
+    reconstructed per-feature histograms are EXACT, so enable_bundle=True
+    grows the identical trees — same splits, same thresholds, same
+    predictions — and SHAP matches the unbundled model's SHAP."""
+    X, y = onehot_data(n=2500)
+    for policy in ("depthwise", "lossguide"):
+        kw = dict(objective="binary", num_iterations=8, num_leaves=15,
+                  min_data_in_leaf=5, growth_policy=policy)
+        b_plain, _ = train(X, y, BoostingConfig(**kw))
+        b_efb, _ = train(X, y, BoostingConfig(enable_bundle=True, **kw))
+        assert b_efb.bundler is not None
+        for t_p, t_e in zip(b_plain.trees, b_efb.trees):
+            np.testing.assert_array_equal(
+                np.asarray(t_p.split_feature), np.asarray(t_e.split_feature),
+                err_msg=policy)
+            # split_bin may flip across an EMPTY bin (the residual
+            # subtraction resolves float gain ties differently); routing
+            # and therefore predictions stay exactly equal
+            assert int(np.abs(np.asarray(t_p.split_bin)
+                              - np.asarray(t_e.split_bin)).max()) <= 1
+        # leaf values see the bundled path's different f32 summation
+        # order (gather + residual subtraction), so equality is to
+        # accumulation noise, not bitwise
+        np.testing.assert_allclose(b_plain.predict_margin(X[:512]),
+                                   b_efb.predict_margin(X[:512]), atol=1e-3)
+        np.testing.assert_allclose(b_plain.predict_contrib(X[:8]),
+                                   b_efb.predict_contrib(X[:8]),
+                                   rtol=2e-3, atol=1e-3)
+
+
+def test_efb_composes_with_monotone():
+    """EFB trees are original-feature trees, so per-feature monotone
+    constraints now apply under bundling."""
+    rng = np.random.default_rng(3)
+    n = 3000
+    codes = rng.integers(0, 30, n)
+    onehot = (codes[:, None] == np.arange(30)[None, :]).astype(np.float32)
+    xm = rng.uniform(-2, 2, n).astype(np.float32)
+    X = np.column_stack([xm, onehot])
+    y = (1.0 * xm + 1.3 * np.sin(3 * xm)
+         + np.isin(codes, [1, 5, 9]) * 1.0
+         + rng.normal(0, 0.3, n))
+    cons = [1] + [0] * 30
+    cfg = BoostingConfig(objective="regression", num_iterations=20,
+                         num_leaves=15, min_data_in_leaf=5,
+                         enable_bundle=True, monotone_constraints=cons)
+    b, _ = train(X, y.astype(np.float64), cfg)
+    assert b.bundler is not None
+    base = np.zeros((8, 31), np.float32)
+    base[:, 1 + rng.integers(0, 30, 8)] = 1.0
+    grid = np.linspace(-2.2, 2.2, 48, dtype=np.float32)
+    probes = np.repeat(base, 48, axis=0)
+    probes[:, 0] = np.tile(grid, 8)
+    m = b.predict_margin(probes).reshape(8, 48)
+    viol = float(-np.minimum(np.diff(m, axis=1), 0).min())
+    assert viol <= 1e-6, viol

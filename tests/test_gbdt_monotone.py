@@ -161,10 +161,6 @@ def test_monotone_validation_errors():
             objective="regression", num_iterations=1,
             monotone_constraints=CONS,
             monotone_constraints_method="intermediate"))
-    with pytest.raises(NotImplementedError, match="enable_bundle"):
-        train(X, y, BoostingConfig(objective="regression", num_iterations=1,
-                                   monotone_constraints=CONS,
-                                   enable_bundle=True))
     with pytest.raises(ValueError, match="categorical"):
         train(X, y, BoostingConfig(objective="regression", num_iterations=1,
                                    monotone_constraints=CONS,
