@@ -79,25 +79,28 @@ def fused_geometry(num_features: int, total_bins: int, n_slots: int):
     return None
 
 
-def _hist_kernel(bins_ref, vals_ref, out_ref, oh_ref):
-    """Grid (F//8, N//CHUNK). bins block (8, C); vals block (C, 8) bf16;
-    out block (1, 8·B, 8) f32 revisited across the chunk dim."""
-    c = pl.program_id(1)
+def _make_plain_hist_kernel(ft: int):
+    def kernel(bins_ref, vals_ref, out_ref, oh_ref):
+        """Grid (F//ft, N//chunk). bins block (ft, C); vals block (C, 8)
+        bf16; out block (1, ft·B, 8) f32 revisited across the chunk dim."""
+        c = pl.program_id(1)
 
-    @pl.when(c == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
+        @pl.when(c == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
 
-    C = bins_ref.shape[1]
-    B = out_ref.shape[1] // FEAT_TILE
-    iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
-    for f in range(FEAT_TILE):
-        b = bins_ref[f, :]
-        oh_ref[f * B:(f + 1) * B, :] = (iota_b == b[None, :]).astype(jnp.bfloat16)
-    contrib = lax.dot_general(oh_ref[...], vals_ref[...],
-                              (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-    out_ref[...] += contrib[None]
+        C = bins_ref.shape[1]
+        B = out_ref.shape[1] // ft
+        iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
+        for f in range(ft):
+            b = bins_ref[f, :]
+            oh_ref[f * B:(f + 1) * B, :] = (iota_b == b[None, :]).astype(
+                jnp.bfloat16)
+        contrib = lax.dot_general(oh_ref[...], vals_ref[...],
+                                  (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        out_ref[...] += contrib[None]
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("total_bins", "interpret"))
@@ -110,7 +113,8 @@ def build_hist_pallas(bins_t: jnp.ndarray,    # (F, N) int32, N % CHUNK == 0
     """→ (F, B, 3) float32 [grad, hess, count] histogram."""
     F, N = bins_t.shape
     B = total_bins
-    assert N % CHUNK == 0, f"N={N} must be a multiple of {CHUNK}"
+    ft, chunk = _tile_for(B)
+    assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
     g = grad * mask
     h = hess * mask
     count = (mask > 0).astype(jnp.float32)
@@ -123,21 +127,21 @@ def build_hist_pallas(bins_t: jnp.ndarray,    # (F, N) int32, N % CHUNK == 0
     vals = jnp.stack([g_hi, g_lo, h_hi, h_lo,
                       count.astype(jnp.bfloat16), z, z, z], axis=-1)  # (N, 8)
 
-    Fp = ((F + FEAT_TILE - 1) // FEAT_TILE) * FEAT_TILE
+    Fp = ((F + ft - 1) // ft) * ft
     if Fp != F:
         bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
 
     out = pl.pallas_call(
-        _hist_kernel,
-        grid=(Fp // FEAT_TILE, N // CHUNK),
+        _make_plain_hist_kernel(ft),
+        grid=(Fp // ft, N // chunk),
         in_specs=[
-            pl.BlockSpec((FEAT_TILE, CHUNK), lambda f, c: (f, c)),
-            pl.BlockSpec((CHUNK, VALS), lambda f, c: (c, 0)),
+            pl.BlockSpec((ft, chunk), lambda f, c: (f, c)),
+            pl.BlockSpec((chunk, VALS), lambda f, c: (c, 0)),
         ],
-        out_specs=pl.BlockSpec((1, FEAT_TILE * B, VALS), lambda f, c: (f, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((Fp // FEAT_TILE, FEAT_TILE * B, VALS),
+        out_specs=pl.BlockSpec((1, ft * B, VALS), lambda f, c: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp // ft, ft * B, VALS),
                                        jnp.float32),
-        scratch_shapes=[pltpu.VMEM((FEAT_TILE * B, CHUNK), jnp.bfloat16)],
+        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.bfloat16)],
         interpret=interpret,
     )(bins_t, vals)
 
