@@ -135,11 +135,11 @@ def bench_gbdt(X, y):
 
     cfg = BoostingConfig(objective="binary", num_iterations=GBDT_ITERS,
                          num_leaves=31, max_bin=GBDT_MAX_BIN)
-    # best of two measured runs: the shared chip's co-tenant load can slow
-    # a single window 3x (the BERT bench medians 3 windows for the same
-    # reason)
+    # best of three measured runs: the shared chip's co-tenant load can
+    # slow a single window 3x (the BERT bench medians 3 windows for the
+    # same reason)
     best = (0.0, 0.0, None)
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         booster, _ = train(X, y, cfg)
         dt = time.perf_counter() - t0
