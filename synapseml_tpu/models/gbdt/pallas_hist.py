@@ -151,10 +151,14 @@ def build_hist_pallas(bins_t: jnp.ndarray,    # (F, N) int32, N % CHUNK == 0
     return jnp.stack([gsum, hsum, out[:, :, 4]], axis=-1)   # (F, B, 3)
 
 
+#: rows pad to this multiple so every kernel geometry's grid divides
+#: evenly (the largest chunk any _tile_for geometry uses is 2048; 8192
+#: keeps headroom and costs ≤0.8% padding at 1M rows)
+PAD_MULTIPLE = 8192
+
+
 def hist_pad_multiple() -> int:
-    # rows pad to the ROUTING chunk (the larger of the two kernels' chunks)
-    # so both grids divide evenly; ≤0.8% waste at 1M rows
-    return ROUTE_CHUNK
+    return PAD_MULTIPLE
 
 
 # --------------------------------------------------------------------------
@@ -426,73 +430,3 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
     gsum = out[..., 0] + out[..., 1]
     hsum = out[..., 2] + out[..., 3]
     return new_id[0], jnp.stack([gsum, hsum, out[..., 4]], axis=-1)
-
-
-# --------------------------------------------------------------------------
-# row routing kernel (depth-level growth)
-# --------------------------------------------------------------------------
-#
-# Applying a wave's splits in plain XLA costs several full-N passes (node→slot
-# gather, per-row feature gather — the latter lowers to a ~160 ms random
-# gather at 1M×28 — plus select chains).  This kernel fuses the whole wave
-# routing into one pass over the binned matrix: for each of the S selected
-# leaves (scalar-prefetched metadata) it tests membership + split direction
-# and emits the new per-row node id and the row's histogram slot (slot j if
-# the row goes LEFT under split j, else -1).
-
-
-#: rows per routing grid step — routing has no VMEM-hungry scratch, so a
-#: big chunk amortizes per-step grid overhead (8× fewer steps than CHUNK)
-ROUTE_CHUNK = 8192
-
-
-def _route_kernel(leaf_ref, feat_ref, thr_ref, lid_ref, rid_ref,
-                  bins_ref, nid_ref, newid_ref, bslot_ref):
-    """Grid (N//ROUTE_CHUNK,).  bins block (F, C); nid block (1, C) int32."""
-    nid = nid_ref[0, :]
-    new = nid
-    bslot = jnp.full_like(nid, -1)
-    S = leaf_ref.shape[0]
-    for j in range(S):
-        xb = bins_ref[pl.dslice(feat_ref[j], 1), :][0]
-        inleaf = nid == leaf_ref[j]
-        gl = xb <= thr_ref[j]
-        new = jnp.where(inleaf, jnp.where(gl, lid_ref[j], rid_ref[j]), new)
-        bslot = jnp.where(inleaf & gl, j, bslot)
-    newid_ref[0, :] = new
-    bslot_ref[0, :] = bslot
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def route_rows_pallas(bins_t: jnp.ndarray,     # (F, N) int32, N % CHUNK == 0
-                      node_id: jnp.ndarray,    # (N,) int32
-                      leaf: jnp.ndarray,       # (S,) int32 leaf being split
-                      feat: jnp.ndarray,       # (S,) int32 split feature
-                      thr_bin: jnp.ndarray,    # (S,) int32 split bin (<= goes left)
-                      l_id: jnp.ndarray,       # (S,) int32 left-child node id
-                      r_id: jnp.ndarray,       # (S,) int32 right-child node id
-                      interpret: bool = False):
-    """→ (new_node_id (N,) int32, bslot (N,) int32 in [-1, S))."""
-    F, N = bins_t.shape
-    rc = ROUTE_CHUNK if N % ROUTE_CHUNK == 0 else CHUNK
-    assert N % rc == 0, f"N={N} must be a multiple of {rc}"
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(N // rc,),
-        in_specs=[
-            pl.BlockSpec((F, rc), lambda c, *_: (0, c)),
-            pl.BlockSpec((1, rc), lambda c, *_: (0, c)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, rc), lambda c, *_: (0, c)),
-            pl.BlockSpec((1, rc), lambda c, *_: (0, c)),
-        ],
-    )
-    new_id, bslot = pl.pallas_call(
-        _route_kernel,
-        grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((1, N), jnp.int32),
-                   jax.ShapeDtypeStruct((1, N), jnp.int32)],
-        interpret=interpret,
-    )(leaf, feat, thr_bin, l_id, r_id, bins_t, node_id[None, :])
-    return new_id[0], bslot[0]

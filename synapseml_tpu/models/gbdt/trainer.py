@@ -706,15 +706,15 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         # row) and build every selected leaf's left-child histogram in ONE
         # pass over the binned matrix — the fused kernel computes each
         # chunk's routing once and keeps it in VMEM for the histogram tiles
-        r_col, r_t1, r_lo, r_hi, r_df = _slot_route_params(
+        rt_col, rt_t1, rt_lo, rt_hi, rt_df = _slot_route_params(
             s["best_feat"][parents], s["best_bin"][parents], B, bundle_map)
         if use_pallas:
             from .pallas_hist import route_and_hist_pallas
 
             def fused_wave(_):
                 return route_and_hist_pallas(
-                    bins_t, s["node_id"], parents, r_col, r_t1, r_lo,
-                    r_hi, r_df, l_ids, r_ids, vals_tiled, S, B,
+                    bins_t, s["node_id"], parents, rt_col, rt_t1, rt_lo,
+                    rt_hi, rt_df, l_ids, r_ids, vals_tiled, S, B,
                     interpret=(use_pallas == "interpret"))
 
             def route_only(_):
@@ -724,10 +724,10 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 # XLA from the gathered split-column rows.  Child pick
                 # stats (sum_g/h/c) come from the parent pick, not from
                 # these histograms, so zeros are safe.
-                sel = jnp.take(bins_t, r_col, axis=0)
+                sel = jnp.take(bins_t, rt_col, axis=0)
                 inleaf = s["node_id"][None, :] == parents[:, None]   # (S, N)
-                gl = _route_left(sel, r_t1[:, None], r_lo[:, None],
-                                 r_hi[:, None], r_df[:, None])
+                gl = _route_left(sel, rt_t1[:, None], rt_lo[:, None],
+                                 rt_hi[:, None], rt_df[:, None])
                 new = (jnp.sum(jnp.where(inleaf & gl, l_ids[:, None], 0), 0)
                        + jnp.sum(jnp.where(inleaf & ~gl, r_ids[:, None], 0), 0)
                        + jnp.where(jnp.any(inleaf, 0), 0, s["node_id"]))
@@ -742,8 +742,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 jnp.where(valid, jidx, -1))
             rslot = slot_of_leaf[s["node_id"]]           # (N,)
             safe = jnp.maximum(rslot, 0)
-            go_left = _route_left(bins_t[r_col[safe], rows], r_t1[safe],
-                                  r_lo[safe], r_hi[safe], r_df[safe])
+            go_left = _route_left(bins_t[rt_col[safe], rows], rt_t1[safe],
+                                  rt_lo[safe], rt_hi[safe], rt_df[safe])
             new_node_id = jnp.where(
                 rslot >= 0,
                 jnp.where(go_left, l_ids[rslot], r_ids[rslot]),

@@ -642,35 +642,6 @@ def test_node_batched_hist_matches_scatter():
     np.testing.assert_allclose(out_p, out_x, rtol=1e-4, atol=1e-4)
 
 
-def test_route_kernel_matches_xla():
-    """Pallas row-routing kernel (interpret) vs the plain formulation."""
-    import jax.numpy as jnp
-    from synapseml_tpu.models.gbdt.pallas_hist import route_rows_pallas
-
-    rng = np.random.default_rng(4)
-    N, F, S = 2048, 6, 4
-    bins_t = rng.integers(0, 64, (F, N)).astype(np.int32)
-    node_id = rng.integers(0, 8, N).astype(np.int32)
-    leaf = np.array([1, 3, 5, 61], np.int32)      # last = junk, matches no row... 61>7
-    feat = rng.integers(0, F, S).astype(np.int32)
-    thr = rng.integers(0, 64, S).astype(np.int32)
-    l_id = np.array([10, 12, 14, 61], np.int32)
-    r_id = np.array([11, 13, 15, 61], np.int32)
-    new_id, bslot = route_rows_pallas(
-        jnp.asarray(bins_t), jnp.asarray(node_id), jnp.asarray(leaf),
-        jnp.asarray(feat), jnp.asarray(thr), jnp.asarray(l_id),
-        jnp.asarray(r_id), interpret=True)
-    # reference formulation
-    exp_id = node_id.copy()
-    exp_slot = np.full(N, -1, np.int32)
-    for j in range(S):
-        inleaf = node_id == leaf[j]
-        gl = bins_t[feat[j], :] <= thr[j]
-        exp_id = np.where(inleaf, np.where(gl, l_id[j], r_id[j]), exp_id)
-        exp_slot = np.where(inleaf & gl, j, exp_slot)
-    np.testing.assert_array_equal(np.asarray(new_id), exp_id)
-    np.testing.assert_array_equal(np.asarray(bslot), exp_slot)
-
 
 def test_pallas_hist_matches_scatter():
     """Pallas kernel (interpret mode) vs the scatter path — same histograms."""
