@@ -44,6 +44,8 @@ def discover_stages() -> Dict[str, type]:
             continue
         if cls.__name__.startswith("_"):
             continue  # private helper bases
+        if not cls.__module__.startswith("synapseml_tpu."):
+            continue  # stages defined in tests/user code are not ours to wrap
         out[qual] = cls
     return out
 
