@@ -28,9 +28,32 @@ class ClusterConfig:
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
     init_timeout_s: float = 300.0
+    #: force a backend ("cpu" for the simulated multi-host slice; None keeps
+    #: the ambient platform — on a TPU pod the runtime picks the TPU backend)
+    platform: Optional[str] = None
+    #: virtual devices per process (CPU backend only; a TPU host's chip
+    #: count is fixed by hardware)
+    local_device_count: Optional[int] = None
 
 
 _initialized = False
+
+
+def _configure_backend(cfg: ClusterConfig) -> None:
+    """Apply platform/device-count config BEFORE the JAX backend exists.
+
+    The CPU backend only joins cross-process collectives when its gloo
+    implementation is selected at client-creation time, so this must run
+    before anything touches ``jax.devices()``.  The image's sitecustomize
+    force-registers the TPU tunnel platform, hence the explicit
+    ``jax_platforms`` override rather than the JAX_PLATFORMS env var.
+    """
+    if cfg.platform is not None:
+        jax.config.update("jax_platforms", cfg.platform)
+    if cfg.platform == "cpu":
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if cfg.local_device_count:
+            jax.config.update("jax_num_cpu_devices", cfg.local_device_count)
 
 
 def initialize_cluster(config: Optional[ClusterConfig] = None,
@@ -47,6 +70,7 @@ def initialize_cluster(config: Optional[ClusterConfig] = None,
     if cfg.coordinator_address is None and cfg.num_processes in (None, 1):
         _initialized = True   # single host: nothing to rendezvous
         return
+    _configure_backend(cfg)
     delay = base_delay_s
     last: Optional[BaseException] = None
     for attempt in range(max_retries):

@@ -1,0 +1,140 @@
+"""Local multi-process launcher — the driver half of the rendezvous.
+
+The reference's driver opens a ServerSocket, waits for every worker task to
+phone home with ``status:host:port:partition:executor``, then broadcasts the
+machine list so the native ring can form (reference:
+lightgbm/src/main/scala/com/microsoft/azure/synapse/ml/lightgbm/
+NetworkManager.scala:294-440).  The TPU analogue needs no machine list —
+``jax.distributed.initialize`` against a coordinator address gives every
+process the global device table — so the driver's remaining job is exactly
+what this module does: pick the coordinator endpoint, start one OS process
+per host, watch them, and collect their results.
+
+This is how multi-host tests and the distributed-serving harness execute for
+real on one machine: N processes x M virtual CPU devices per process form a
+genuine cross-process mesh (gloo collectives), the same code path a multi-host
+TPU pod takes (PJRT collectives over ICI/DCN).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+#: marker the worker prints in front of its JSON result line
+RESULT_MARKER = "SMLMP_RESULT:"
+
+
+def find_free_port() -> int:
+    """Ask the kernel for a free TCP port (the driver's ServerSocket bind,
+    NetworkManager.scala:299 — there the socket is kept open; here the
+    coordinator re-binds it immediately so a race is possible but unlikely)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class WorkerFailure(RuntimeError):
+    """A worker exited non-zero or produced no result."""
+
+    def __init__(self, msg: str, logs: Dict[int, str]):
+        super().__init__(msg + "\n" + "\n".join(
+            f"--- rank {r} log (tail) ---\n{t[-4000:]}" for r, t in logs.items()))
+        self.logs = logs
+
+
+def run_on_local_cluster(task: str,
+                         n_processes: int = 2,
+                         devices_per_process: int = 2,
+                         task_args: Any = None,
+                         timeout_s: float = 300.0,
+                         env_extra: Optional[Dict[str, str]] = None,
+                         ) -> List[Any]:
+    """Run ``module:function`` on a real N-process JAX cluster; return the
+    per-rank results (rank order).
+
+    Each rank is an OS process that rendezvouses through
+    ``initialize_cluster`` (parallel/distributed.py) against a localhost
+    coordinator, sees the global ``n_processes * devices_per_process``-device
+    table, and runs ``function(task_args)`` with collectives live across
+    process boundaries.  The function must return something JSON-serializable.
+
+    This mirrors the reference driver's role in every local multi-task test
+    (NetworkManager.scala:294-340): spawn workers, hand them the coordinator,
+    wait, surface failures with worker logs attached.
+    """
+    port = find_free_port()
+    coordinator = f"127.0.0.1:{port}"
+    procs: List[subprocess.Popen] = []
+    logs: Dict[int, str] = {}
+    args_json = json.dumps(task_args)
+    pythonpath = os.pathsep.join(
+        [p for p in sys.path if p and os.path.isdir(p)])
+    try:
+        for rank in range(n_processes):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env.update({
+                "SMLTPU_COORDINATOR": coordinator,
+                "SMLTPU_NUM_PROCESSES": str(n_processes),
+                "SMLTPU_PROCESS_ID": str(rank),
+                "SMLTPU_PLATFORM": "cpu",
+                "SMLTPU_LOCAL_DEVICES": str(devices_per_process),
+                "SMLTPU_TASK": task,
+                "SMLTPU_TASK_ARGS": args_json,
+                "PYTHONPATH": pythonpath,
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "synapseml_tpu.parallel.worker"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env))
+        # drain every rank's pipe on its own thread: a rank that fills the
+        # OS pipe buffer mid-collective would otherwise deadlock the whole
+        # cluster, and on failure we want EVERY rank's log, not just the
+        # first one waited on
+        readers = []
+        for rank, p in enumerate(procs):
+            t = threading.Thread(
+                target=lambda r=rank, pr=p: logs.__setitem__(
+                    r, pr.stdout.read() or ""),
+                daemon=True)
+            t.start()
+            readers.append(t)
+        deadline = time.monotonic() + timeout_s
+        timed_out = []
+        for rank, p in enumerate(procs):
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                timed_out.append(rank)
+        if timed_out:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for t in readers:
+            t.join(timeout=10.0)
+        if timed_out:
+            raise WorkerFailure(
+                f"ranks {timed_out} timed out after {timeout_s:.0f}s", logs)
+        results: List[Any] = []
+        for rank, p in enumerate(procs):
+            if p.returncode != 0:
+                raise WorkerFailure(
+                    f"rank {rank} exited {p.returncode}", logs)
+            lines = [ln for ln in logs[rank].splitlines()
+                     if ln.startswith(RESULT_MARKER)]
+            if not lines:
+                raise WorkerFailure(f"rank {rank} produced no result", logs)
+            results.append(json.loads(lines[-1][len(RESULT_MARKER):]))
+        return results
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -1,0 +1,66 @@
+"""Cluster bring-up self-check — prove the rendezvous actually works.
+
+The reference validates its ring during bring-up: every worker phones home,
+the driver broadcasts the machine list, and ``LGBM_NetworkInit`` fails
+loudly when a peer is unreachable (NetworkManager.scala:182-205,294-440).
+The TPU analogue below is run on EVERY rank of a freshly initialized
+cluster and returns facts that only come out right when the rendezvous is
+real: the global device table (with owning process per device), a
+deterministic partition placement computed independently on each rank, and
+a cross-process ``psum`` whose result requires data from every process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def cluster_report(args: Any = None) -> Dict[str, Any]:
+    """Return rendezvous evidence from this rank (JSON-serializable)."""
+    n_partitions = int((args or {}).get("n_partitions", 12))
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), (DATA_AXIS,))
+
+    # deterministic placement, computed independently per rank: every rank
+    # must derive the identical partition->device map from the global table
+    from .placement import place_partitions
+    pm = place_partitions(n_partitions, mesh)
+    placement = {str(p): r for p, r in sorted(pm.partition_to_rank.items())}
+
+    # cross-process psum: shard i carries value i; the sum over all shards
+    # is only correct when every process's devices contribute
+    n = len(devs)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    garr = jax.make_array_from_callback(
+        (n,), sharding,
+        lambda idx: np.asarray([idx[0].start or 0], dtype=np.float32))
+    from .collectives import all_gather, psum, shard_map_over
+    summed = jax.jit(shard_map_over(mesh, P(DATA_AXIS), P(DATA_AXIS))(psum))(garr)
+    local = [float(np.asarray(s.data)[0]) for s in summed.addressable_shards]
+
+    # a second collective with direction: all_gather preserves order, so the
+    # result also proves the device order is the same global order everywhere.
+    # out_specs keeps the device axis so no replication proof is needed:
+    # each shard of the (n*n,) result holds the full gathered order
+    gathered = jax.jit(shard_map_over(mesh, P(DATA_AXIS), P(DATA_AXIS))(
+        lambda x: all_gather(x, tiled=True)))(garr)
+    gathered_host = [float(v) for v in
+                     np.asarray(jax.device_get(gathered.addressable_shards[0].data))]
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(devs),
+        "local_devices": len(jax.local_devices()),
+        "device_table": [[d.id, d.process_index] for d in devs],
+        "placement": placement,
+        "psum_local": local,
+        "psum_expected": float(sum(range(n))),
+        "all_gather": gathered_host,
+    }

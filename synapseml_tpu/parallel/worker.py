@@ -1,0 +1,52 @@
+"""Worker entry point for the multi-process launcher.
+
+One OS process per cluster rank: configure the backend BEFORE it
+initializes, rendezvous through ``initialize_cluster``, run the task, print
+the JSON result behind a marker the driver greps for.  This is the worker
+half of the reference's handshake (NetworkManager.scala:123-169 — there the
+worker phones the driver's ServerSocket and blocks on the machine-list
+reply; here ``jax.distributed.initialize`` is both legs).
+
+Run as ``python -m synapseml_tpu.parallel.worker`` with the SMLTPU_* env
+set by ``launcher.run_on_local_cluster``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+
+
+def main() -> int:
+    coordinator = os.environ["SMLTPU_COORDINATOR"]
+    n_procs = int(os.environ["SMLTPU_NUM_PROCESSES"])
+    rank = int(os.environ["SMLTPU_PROCESS_ID"])
+    platform = os.environ.get("SMLTPU_PLATFORM") or None
+    local_devices = int(os.environ.get("SMLTPU_LOCAL_DEVICES", "0")) or None
+    task = os.environ["SMLTPU_TASK"]
+    task_args = json.loads(os.environ.get("SMLTPU_TASK_ARGS", "null"))
+
+    from synapseml_tpu.parallel.distributed import (ClusterConfig,
+                                                    initialize_cluster,
+                                                    shutdown_cluster)
+    initialize_cluster(ClusterConfig(
+        coordinator_address=coordinator,
+        num_processes=n_procs,
+        process_id=rank,
+        platform=platform,
+        local_device_count=local_devices,
+    ))
+
+    mod_name, fn_name = task.split(":", 1)
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    result = fn(task_args)
+    # marker line is the contract with launcher.run_on_local_cluster
+    print("SMLMP_RESULT:" + json.dumps(result), flush=True)
+    shutdown_cluster()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

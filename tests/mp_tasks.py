@@ -1,0 +1,48 @@
+"""Task functions executed on real multi-process clusters by the launcher.
+
+Imported by ``synapseml_tpu.parallel.worker`` subprocesses (the tests dir
+rides the propagated sys.path).  Every function takes one JSON-decoded arg
+and returns something JSON-serializable.
+"""
+
+import hashlib
+
+import numpy as np
+
+
+def _binary_data(n=2000, f=12, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logits = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logits + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def gbdt_fit_digest(args):
+    """Fit a GBDT over ALL global devices; return a bit-exact model digest.
+
+    Run on a 1-process x 4-device cluster and a 2-process x 2-device cluster,
+    the digests must be identical: the SPMD program is the same, only the
+    process boundary moves (the reference's useSingleDatasetMode=false
+    multi-worker parity, LightGBMBase.scala).
+    """
+    import jax
+    from synapseml_tpu.models.gbdt.booster import BoostingConfig, train
+    from synapseml_tpu.parallel import data_parallel_mesh
+
+    args = args or {}
+    X, y = _binary_data(n=int(args.get("n", 2000)))
+    mesh = data_parallel_mesh(len(jax.devices()))
+    cfg = BoostingConfig(objective="binary", num_iterations=6,
+                         num_leaves=15, min_data_in_leaf=5)
+    booster, _ = train(X, y, cfg, mesh=mesh)
+    text = booster.to_string()
+    margins = booster.predict_margin(X[:16])
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "model_md5": hashlib.md5(text.encode()).hexdigest(),
+        "model_len": len(text),
+        "margins": [round(float(m), 6) for m in np.asarray(margins).ravel()],
+    }
