@@ -37,10 +37,11 @@ GBDT_ROWS = 1_000_000
 GBDT_FEATURES = 28
 GBDT_ITERS = 100          # LightGBM's default num_iterations
 GBDT_MAX_BIN = 63         # the TPU fast path (LightGBM's own GPU default);
-                          # AUC-parity with max_bin=255 is pinned by the
-                          # fixture suite, and the CPU anchor is measured
-                          # bin-count-insensitive (±2%) so the comparison
-                          # does not tilt the anchor
+                          # the bench ALSO measures max_bin=255 (LightGBM's
+                          # CPU default) and anchors at BOTH 255 and 64
+                          # bins, so every ratio is same-config and
+                          # self-contained in the emitted JSON
+                          # (vs_baseline = 63-bin TPU / 64-bin anchor)
 ANCHOR_ITERS = 10         # anchor runs fewer iters; rate is per-iteration
 
 #: peak dense bf16 FLOPs/s by device kind (public spec sheets)
@@ -123,64 +124,65 @@ def _gbdt_data():
     return X, _gbdt_labels(rng, X)
 
 
-def bench_gbdt(X, y):
+def bench_gbdt(X, y, max_bin=GBDT_MAX_BIN):
     from synapseml_tpu.models.gbdt import BoostingConfig, train
     from synapseml_tpu.models.gbdt.metrics import auc
 
     cfg = BoostingConfig(objective="binary", num_iterations=2, num_leaves=31,
-                         max_bin=GBDT_MAX_BIN)
+                         max_bin=max_bin)
     t0 = time.perf_counter()
     train(X, y, cfg)                                  # compile + 2 iters
     warm = time.perf_counter() - t0
 
     cfg = BoostingConfig(objective="binary", num_iterations=GBDT_ITERS,
-                         num_leaves=31, max_bin=GBDT_MAX_BIN)
-    # best of three measured runs: the shared chip's co-tenant load can
-    # slow a single window 3x (the BERT bench medians 3 windows for the
-    # same reason)
-    best = (0.0, 0.0, None)
+                         num_leaves=31, max_bin=max_bin)
+    train(X, y, cfg)     # compile the scanned whole-run program off-window
+    # MEDIAN of three measured runs (same estimator as the BERT windows and
+    # the CPU anchor): robust to one contended window on the shared chip
+    # without the upward bias of a max
+    runs = []
     for _ in range(3):
         t0 = time.perf_counter()
         booster, _ = train(X, y, cfg)
         dt = time.perf_counter() - t0
-        best = max(best, (GBDT_ITERS / dt,
-                          booster.measures.iterations_per_sec(), booster),
-                   key=lambda t: t[0])
+        runs.append((GBDT_ITERS / dt,
+                     booster.measures.iterations_per_sec(), booster))
+    full, steady, booster = sorted(runs, key=lambda t: t[0])[1]
     # model quality on a fresh holdout from the same generator — guards the
     # speed number against a silently degenerate model
     rng = np.random.default_rng(7)
     Xh = rng.normal(size=(100_000, GBDT_FEATURES)).astype(np.float32)
-    auc_h = float(auc(_gbdt_labels(rng, Xh), best[2].predict_margin(Xh)))
-    return best[0], best[1], warm, auc_h
+    auc_h = float(auc(_gbdt_labels(rng, Xh), booster.predict_margin(Xh)))
+    return full, steady, warm, auc_h
 
 
-def bench_gbdt_anchor(X, y):
+def bench_gbdt_anchor(X, y, max_bins=255):
     """Same-host CPU anchor: sklearn's HistGradientBoosting (a LightGBM-
     style C++/OpenMP histogram GBDT) on the identical task/shape.
 
-    Two short runs separate the engine's fixed cost (binning etc.) from its
+    Two run sizes separate the engine's fixed cost (binning etc.) from its
     per-iteration cost, then both are amortized over the SAME GBDT_ITERS
     the TPU run uses — otherwise the anchor's fixed cost would be spread
-    over fewer iterations and the vs_baseline ratio would be inflated."""
+    over fewer iterations and the vs_baseline ratio would be inflated.
+    Measured at ``max_bins`` so BOTH anchor configs (255 and 64) appear in
+    the emitted JSON — the TPU-vs-anchor comparison is self-contained
+    instead of resting on a comment's claimed bin-insensitivity."""
     import os
+    import statistics
 
     from sklearn.ensemble import HistGradientBoostingClassifier
 
     def run(iters):
         clf = HistGradientBoostingClassifier(
-            max_iter=iters, max_leaf_nodes=31, max_bins=255,
-            # measured on this host: max_bins=64 fits at the same rate
-            # (4.95 vs 5.02 it/s amortized) — CPU histogram cost is O(N)
-            # per feature, so the TPU run's max_bin=63 doesn't tilt this
+            max_iter=iters, max_leaf_nodes=31, max_bins=max_bins,
             early_stopping=False, validation_fraction=None)
         t0 = time.perf_counter()
         clf.fit(X, y)
         return time.perf_counter() - t0
 
-    # the shared host is noisy and the fixed/per-iter differencing
-    # amplifies it: take the best of two runs of each size
-    t_small = min(run(2), run(2))
-    t_big = min(run(ANCHOR_ITERS), run(ANCHOR_ITERS))
+    # median-of-3 per run size: same estimator as every TPU window
+    t_small = statistics.median(run(2) for _ in range(3))
+    t_big = statistics.median(run(ANCHOR_ITERS) for _ in range(3))
     per_iter = max((t_big - t_small) / (ANCHOR_ITERS - 2), 1e-9)
     fixed = max(t_small - 2 * per_iter, 0.0)
     ips_at_bench_iters = GBDT_ITERS / (fixed + GBDT_ITERS * per_iter)
@@ -324,7 +326,8 @@ def main():
         print(f"[secondary] ResNet-50 bench failed: {e}", file=sys.stderr)
 
     gbdt_ips = gbdt_steady = None
-    anchor_ips = anchor_cores = None
+    gbdt_ips255 = gbdt_steady255 = gbdt_auc255 = None
+    anchor_ips = anchor_ips64 = anchor_cores = None
     gbdt_auc = None
     try:
         X, y = _gbdt_data()
@@ -338,9 +341,22 @@ def main():
         print(f"[secondary] GBDT bench failed: {e}", file=sys.stderr)
     try:
         if gbdt_ips is not None:
-            anchor_ips, anchor_cores = bench_gbdt_anchor(X, y)
+            gbdt_ips255, gbdt_steady255, _, gbdt_auc255 = bench_gbdt(
+                X, y, max_bin=255)
+            print(f"[secondary] GBDT @1Mx{GBDT_FEATURES} max_bin=255: "
+                  f"{gbdt_ips255:.2f} iters/sec full-wall "
+                  f"({gbdt_steady255:.2f} steady-state, holdout AUC "
+                  f"{gbdt_auc255:.4f})", file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] GBDT max_bin=255 bench failed: {e}",
+              file=sys.stderr)
+    try:
+        if gbdt_ips is not None:
+            anchor_ips, anchor_cores = bench_gbdt_anchor(X, y, max_bins=255)
+            anchor_ips64, _ = bench_gbdt_anchor(X, y, max_bins=64)
             print(f"[anchor] sklearn HistGradientBoosting same host "
-                  f"({anchor_cores} cores): {anchor_ips:.2f} iters/sec",
+                  f"({anchor_cores} cores): {anchor_ips:.2f} iters/sec "
+                  f"@255 bins, {anchor_ips64:.2f} @64 bins",
                   file=sys.stderr)
     except Exception as e:
         print(f"[anchor] failed: {e}", file=sys.stderr)
@@ -349,8 +365,8 @@ def main():
         "metric": "DeepTextClassifier BERT-base fine-tune throughput per chip",
         "value": round(bert_sps, 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": (round(gbdt_ips / anchor_ips, 3)
-                        if gbdt_ips and anchor_ips else None),
+        "vs_baseline": (round(gbdt_ips / anchor_ips64, 3)
+                        if gbdt_ips and anchor_ips64 else None),
         "mfu": round(mfu, 4),
         "bert_params": n_params,
         "gbdt_iters_per_sec": round(gbdt_ips, 3) if gbdt_ips else None,
@@ -358,8 +374,16 @@ def main():
                                       if gbdt_steady else None),
         "gbdt_max_bin": GBDT_MAX_BIN,
         "gbdt_holdout_auc": round(gbdt_auc, 4) if gbdt_auc else None,
+        "gbdt_iters_per_sec_255": (round(gbdt_ips255, 3)
+                                   if gbdt_ips255 else None),
+        "gbdt_steady_iters_per_sec_255": (round(gbdt_steady255, 3)
+                                          if gbdt_steady255 else None),
+        "gbdt_holdout_auc_255": (round(gbdt_auc255, 4)
+                                 if gbdt_auc255 else None),
         "gbdt_anchor_iters_per_sec": (round(anchor_ips, 3)
                                       if anchor_ips else None),
+        "gbdt_anchor_iters_per_sec_64bins": (round(anchor_ips64, 3)
+                                             if anchor_ips64 else None),
         "resnet50_onnx_imgs_per_sec": (round(resnet_ips, 1)
                                        if resnet_ips else None),
         "resnet50_onnx_bf16_imgs_per_sec": (round(resnet_bf16_ips, 1)

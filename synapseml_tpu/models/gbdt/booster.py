@@ -452,6 +452,58 @@ def _step_factory_args(config: "BoostingConfig", K: int, mesh, featpar: bool,
     return args, kwargs
 
 
+#: iterations per scanned dispatch — the whole-run loop runs as
+#: ceil(T / SCAN_CHUNK) dispatches of ONE compiled program (the chunk
+#: length is static but the iteration offset is a traced operand, so the
+#: program is independent of num_iterations and the compile cache hits
+#: across runs of any length).  25 divides LightGBM's default 100.
+SCAN_CHUNK = 25
+
+
+@functools.lru_cache(maxsize=16)
+def _make_scan(sargs, skw_items, bagging_freq: int,
+               seed: int, is_rf: bool, cache_step: bool = True):
+    """Chunk-of-the-training-run program: ``lax.scan`` over the step.
+
+    The per-iteration Python loop pays ~3 tunnel/PCIe dispatches per tree
+    (fold_in + PRNGKey + step), measured ~36 ms/iteration of pure dispatch
+    tax against a 21 ms on-device step — the scan runs SCAN_CHUNK
+    iterations per dispatch.  Key derivation matches the Python loop
+    exactly (PRNGKey(seed·100003 + it) under 32-bit seeds;
+    fold_in(bag_root, it // bagging_freq)), so scanned and looped training
+    grow identical trees.  Used for the common fire-and-forget path; dart /
+    per-iteration validation / callbacks / checkpoints stay on the Python
+    loop, which needs each tree on the host mid-run.
+    """
+    # lambdarank's objective closes over per-dataset arrays: caching the
+    # step would pin them (same reason train() bypasses _make_step's cache)
+    maker = _make_step if cache_step else _make_step.__wrapped__
+    step = maker(*sargs, **dict(skw_items))
+    freq = max(bagging_freq, 1)
+    seed_base = (seed * 100003) & 0xffffffff
+
+    def run(bins_t, scores, labels, weights, base_bag, bag_root_key,
+            fmask, upper_bounds, num_bins, bundle_map, init_scores, it0):
+        def body(sc, it):
+            bag_key = jax.random.fold_in(bag_root_key, it // freq)
+            key = jax.random.PRNGKey(jnp.uint32(seed_base)
+                                     + it.astype(jnp.uint32))
+            tstack, new_sc = step(bins_t, sc, labels, weights,
+                                  (base_bag, bag_key), fmask, key,
+                                  upper_bounds, num_bins, bundle_map)
+            if is_rf:
+                new_sc = init_scores   # rf: gradients stay at init margin
+            return new_sc, tstack
+        return lax.scan(body, scores, jnp.arange(SCAN_CHUNK) + it0)
+    return jax.jit(run)
+
+
+#: module-level jit (an inline jit(lambda) would recompile every train()):
+#: flattens every chunk's tree stack into one f32 vector for ONE readback
+_pack_flat = jax.jit(lambda cs: jnp.concatenate(
+    [a.astype(jnp.float32).reshape(-1) for ts in cs for a in ts]))
+
+
 @functools.lru_cache(maxsize=None)
 def _objective_with_kwargs(name, kwargs_items):
     """Objective + frozen kwargs as a STABLE function object, so the
@@ -948,19 +1000,44 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             and config.objective != "lambdarank" and n >= 200_000):
         _wargs, _wkw = _step_factory_args(config, K, mesh, featpar,
                                           use_pallas)
-        _wstep = _make_step(*_wargs, **_wkw)
+        # warm the program the run will actually use: the scanned
+        # whole-run program for fire-and-forget fits, else the one-step
+        _w_scan_ok = (not (config.boosting_type == "dart" or valid is not None
+                           or callbacks
+                           or (checkpoint_dir and checkpoint_interval > 0))
+                      and config.feature_fraction >= 1.0
+                      and config.num_iterations >= SCAN_CHUNK)
+        if _w_scan_ok:
+            _wrun = _make_scan(_wargs, tuple(sorted(_wkw.items())),
+                               config.bagging_freq, config.seed,
+                               config.boosting_type == "rf")
+        else:
+            _wstep = _make_step(*_wargs, **_wkw)
         _w_ub_cols = mapper.upper_bounds.shape[1]
 
         def _warm_compile():
             try:
                 zf32 = functools.partial(jnp.zeros, dtype=jnp.float32)
-                out = _wstep(jnp.zeros((F, N), jnp.int32), zf32(N), zf32(N),
-                             jnp.ones(N, jnp.float32), (jnp.ones(N, jnp.float32),
-                             jax.random.PRNGKey(0)), jnp.ones(F, bool),
-                             jax.random.PRNGKey(1),
-                             jnp.zeros((F, _w_ub_cols), jnp.float32),
-                             jnp.full(F, config.max_bin + 1, jnp.int32),
-                             None)
+                _cargs = (jnp.zeros((F, N), jnp.int32), zf32(N), zf32(N),
+                          jnp.ones(N, jnp.float32))
+                _ctail = (jnp.ones(F, bool),
+                          jnp.zeros((F, _w_ub_cols), jnp.float32),
+                          jnp.full(F, config.max_bin + 1, jnp.int32),
+                          None)
+                if _w_scan_ok:
+                    # a real (junk-data) call: only the dispatch path
+                    # populates jit's executable cache, and one SCAN_CHUNK
+                    # of empty trees is ~1 s of device time overlapped
+                    # with binning
+                    out = _wrun(*_cargs, jnp.ones(N, jnp.float32),
+                                jax.random.PRNGKey(0), _ctail[0], _ctail[1],
+                                _ctail[2], _ctail[3], zf32(N),
+                                jnp.zeros((), jnp.int32))
+                else:
+                    out = _wstep(*_cargs, (jnp.ones(N, jnp.float32),
+                                 jax.random.PRNGKey(0)), _ctail[0],
+                                 jax.random.PRNGKey(1), _ctail[1],
+                                 _ctail[2], _ctail[3])
                 jax.block_until_ready(out[1])
             except Exception:
                 pass           # warming is best-effort; the loop compiles
@@ -1219,6 +1296,11 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     pending_stacks: List[Tuple[Tree, List[float]]] = []
     base_bag_dev = jnp.asarray(bag)     # pad-row mask, uploaded once
     bag_root_key = jax.random.PRNGKey(config.bagging_seed)
+    # fire-and-forget runs collapse the whole boosting loop into ONE
+    # on-device lax.scan dispatch (_make_scan) — per-iteration Python
+    # dispatch costs ~36 ms/tree through the tunnel; feature_fraction
+    # draws its mask from the host rng each iteration so it stays looped
+    use_scan = not eager_host and config.feature_fraction >= 1.0
 
     fmask_dev = None
     rf_reset_scores = None
@@ -1228,7 +1310,61 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if _warm_thread is not None:
         _warm_thread.join()
 
-    for it in range(config.num_iterations):
+    scan_start = 0          # iterations handled by scanned dispatches
+    n_scan_chunks = config.num_iterations // SCAN_CHUNK if use_scan else 0
+    if n_scan_chunks:
+        feature_mask = np.zeros(Fp, bool)
+        feature_mask[:F] = True
+        fmask_dev = jnp.asarray(feature_mask)
+        if featpar:
+            fmask_dev = jax.device_put(
+                fmask_dev, NamedSharding(mesh, P(DATA_AXIS)))
+        if config.objective == "lambdarank":
+            scan_fn = _make_scan.__wrapped__(
+                _sargs, tuple(sorted(_skw.items())),
+                config.bagging_freq, config.seed, is_rf, cache_step=False)
+        else:
+            scan_fn = _make_scan(_sargs, tuple(sorted(_skw.items())),
+                                 config.bagging_freq, config.seed, is_rf)
+        chunk_stacks = []
+        sc = scores
+        for ci in range(n_scan_chunks):
+            sc, tstacks = scan_fn(
+                bins_t, sc, labels, weights, base_bag_dev, bag_root_key,
+                fmask_dev, upper_bounds, num_bins, bundle_map_dev,
+                init_scores_dev if is_rf else scores,
+                jnp.asarray(ci * SCAN_CHUNK, jnp.int32))
+            chunk_stacks.append(tstacks)
+            if ci == 0:
+                # first dispatch returns once compiled; execution is async
+                # until the download below
+                measures.compile_s = _time.perf_counter() - _t_train
+        # ONE readback for every tree of every chunk: per-field np.asarray
+        # pays a full tunnel round trip each (11 fields x chunks ~ seconds);
+        # tree ints fit f32 exactly (ids < 2^7, counts <= N < 2^24)
+        flat = np.asarray(_pack_flat(chunk_stacks))
+        off = 0
+        host_stacks = []
+        for ts in chunk_stacks:
+            fields = []
+            for a in ts:
+                n_el = int(np.prod(a.shape))
+                fields.append(flat[off:off + n_el].reshape(a.shape)
+                              .astype(np.dtype(a.dtype)))
+                off += n_el
+            host_stacks.append(fields)
+        for all_fields in host_stacks:
+            for i in range(SCAN_CHUNK):
+                for k in range(K):
+                    trees.append(Tree(*[a[i, k] for a in all_fields]))
+                    tree_class.append(k)
+                    tree_weights.append(1.0)
+        if is_rf:
+            rf_denominator = n_scan_chunks * SCAN_CHUNK
+        scores = sc
+        scan_start = n_scan_chunks * SCAN_CHUNK
+
+    for it in range(scan_start, config.num_iterations):
         # bagging (bagging_fraction/freq semantics): the mask is drawn on
         # device from this key; reusing a key across freq iterations
         # reproduces the persist-until-refresh behavior
@@ -1258,7 +1394,9 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                                                depth_hint) * tree_weights[d]
                 scores = _sub_scores(scores, contrib, tree_class[d], K)
 
-        key = jax.random.PRNGKey(config.seed * 100003 + it)
+        # mask to 32 bits so looped and scanned runs derive identical keys
+        # even under jax_enable_x64 (the scan's seed_base is masked too)
+        key = jax.random.PRNGKey((config.seed * 100003 + it) & 0xffffffff)
         tstack, new_scores = step(bins_t, scores, labels, weights,
                                   (base_bag_dev, bag_key), fmask_dev,
                                   key, upper_bounds, num_bins,

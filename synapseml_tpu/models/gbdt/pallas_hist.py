@@ -9,11 +9,18 @@ features' one-hot bin matrices directly in transposed "tall" layout
     hist_tile += OH(f·B+b, c) · vals(c, v)      # (2048, C) x (C, 8)
 
 The tall M dimension keeps the MXU rows busy (M=8-style layouts lower
-~10× slower on Mosaic).  Gradients/hessians ride in bf16 hi/lo split pairs
-(exact reconstruction to ~f32) so the dot runs single-pass bf16.
+~10× slower on Mosaic).
 
-Measured on v5e-1 @ 1M×28×256 bins: ~80 ms per histogram vs ~260 ms
-scatter — and the whole-tree cost drops from ~8 s to ~2.5 s.
+Round-4 formulation: the matmul runs **int8 × int8 → int32**.  The
+one-hot is exact in int8, and gradients/hessians are quantized to THREE
+balanced base-128 int8 limbs each (signed digits in [-64, 63], range
+±2^20 on a per-tree max-|value| scale), so the histogram accumulates
+EXACT integer sums of 21-bit-quantized values — quantization noise
+~max|g|·2^-21·sqrt(count) per bin, below the old bf16 hi/lo pair's error.
+Why: the kernel was measured VMEM-bandwidth-bound on the one-hot operand
+(bf16 @ B=256: 15.1 ms per 1M×28 level pass at ~70% MXU peak; int8 one-hot
+halves that traffic → 10.5 ms; B=64: 7.9 → 6.1 ms).  Lanes per slot:
+[g0 g1 g2 h0 h1 h2 count pad].
 
 This is the TPU-native equivalent of LightGBM's C++ histogram construction
 (reference: the native code behind LGBM_BoosterUpdateOneIter,
@@ -34,18 +41,48 @@ from jax.experimental.pallas import tpu as pltpu
 CHUNK = 1024
 #: features per grid step (Pallas sublane granularity for the bins block)
 FEAT_TILE = 8
-#: value channels: g_hi, g_lo, h_hi, h_lo, count, 3×pad
+#: value channels: g limbs ×3, h limbs ×3, count, pad
 VALS = 8
+
+#: largest magnitude representable in 3 balanced base-128 digits
+#: (63 + 63·128 + 63·16384)
+_Q_MAX = 1_040_447.0
+
+
+def _limbs(q: jnp.ndarray):
+    """int32 quantized value → 3 balanced base-128 int32 digits in [-64, 63]."""
+    d0 = ((q + 64) & 127) - 64
+    q1 = (q - d0) >> 7                 # exact: (q - d0) divisible by 128
+    d1 = ((q1 + 64) & 127) - 64
+    d2 = (q1 - d1) >> 7                # in [-64, 63] after the clip in _quant
+    return d0, d1, d2
+
+
+def _quant(v: jnp.ndarray, scale: jnp.ndarray):
+    q = jnp.clip(jnp.round(v / scale), -_Q_MAX, _Q_MAX).astype(jnp.int32)
+    return _limbs(q)
+
+
+def _reconstruct(out: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """int32 limb histogram (..., 8) → (..., 3) f32 [grad, hess, count].
+
+    Limb sums can exceed 2^24, so each converts to f32 BEFORE combining
+    (relative 2^-24 rounding, same class as the f32 adds the old bf16
+    hi/lo pair paid)."""
+    o = out.astype(jnp.float32)
+    g = scales[0] * (o[..., 0] + 128.0 * o[..., 1] + 16384.0 * o[..., 2])
+    h = scales[1] * (o[..., 3] + 128.0 * o[..., 4] + 16384.0 * o[..., 5])
+    return jnp.stack([g, h, o[..., 6]], axis=-1)
 
 
 def _tile_for(total_bins: int):
-    """(features-per-step, rows-per-chunk) for the one-hot scratch.
+    """(max features-per-step, rows-per-chunk) for the one-hot scratch.
 
-    The scratch is (FT·B, chunk) bf16 and must fit VMEM (~16 MB/core)
-    alongside the resident (Fp·B, S·8) f32 accumulator.  Wider feature
-    tiles and chunks amortize the per-grid-step overhead (~8 µs/step on
-    v5e) — at B=64 the geometry (32, 2048) runs the 1M×28 level pass in
-    ~10.5 ms vs ~27 ms for the round-2 (8, 1024) geometry."""
+    The scratch is (ft·B, chunk) int8 and must fit VMEM (~16 MB/core)
+    alongside the resident (Fp·B, S·8) int32 accumulator.  Wider feature
+    tiles and chunks amortize the per-grid-step overhead — at B=64 the
+    (≤32, 2048) int8 geometry runs the 1M×28 level pass in ~2.3 ms vs
+    ~27 ms for the round-2 (8, 1024) bf16 geometry."""
     if total_bins <= 64:
         return 32, 2048
     if total_bins <= 128:
@@ -53,6 +90,22 @@ def _tile_for(total_bins: int):
     if total_bins <= 256:
         return 8, 2048
     return 8, 1024
+
+
+def _feat_tile(num_features: int, cap: int) -> int:
+    """Features per grid step: minimize feature padding, then maximize the
+    tile.  The bins input is reshaped (G, ft, N) with block (1, ft, chunk)
+    — legal for ANY ft because the block's second dim equals the array dim
+    — so ft need not be a sublane multiple, and 28 features at B=256 run
+    with ZERO junk feature rows in the matmul (ft=7) instead of the 12.5%
+    a pad-to-8 layout wastes."""
+    best = None
+    for ft in range(1, cap + 1):
+        pad = -(-num_features // ft) * ft - num_features
+        key = (pad, -ft)
+        if best is None or key < best:
+            best = key
+    return -best[1]
 
 
 #: VMEM budget for kernel working sets (~16 MB/core minus block slack)
@@ -66,89 +119,34 @@ def fused_geometry(num_features: int, total_bins: int, n_slots: int):
     the grid runs chunk-major and every feature tile must stay hot) — its
     footprint scales with F, and wide matrices must shrink the chunk or
     fall back to the scatter path."""
-    ft, chunk = _tile_for(total_bins)
+    cap, chunk = _tile_for(total_bins)
+    ft = _feat_tile(num_features, cap)
     VN = n_slots * SLOT_LANES
     while chunk >= 1024:
         Fp = -(-num_features // ft) * ft
-        need = (ft * total_bins * chunk * 2       # one-hot scratch
-                + Fp * total_bins * VN * 4        # resident accumulator
-                + 2 * chunk * VN * 2)             # vn scratch + vals block
+        need = (ft * total_bins * chunk * 1       # one-hot scratch (int8)
+                + Fp * total_bins * VN * 4        # resident accumulator (i32)
+                + 2 * chunk * VN * 1)             # vn scratch + vals (int8)
         if need <= _VMEM_BUDGET:
             return ft, chunk
         chunk //= 2
     return None
 
 
-def _make_plain_hist_kernel(ft: int):
-    def kernel(bins_ref, vals_ref, out_ref, oh_ref):
-        """Grid (F//ft, N//chunk). bins block (ft, C); vals block (C, 8)
-        bf16; out block (1, ft·B, 8) f32 revisited across the chunk dim."""
-        c = pl.program_id(1)
-
-        @pl.when(c == 0)
-        def _init():
-            out_ref[...] = jnp.zeros_like(out_ref)
-
-        C = bins_ref.shape[1]
-        B = out_ref.shape[1] // ft
-        iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
-        for f in range(ft):
-            b = bins_ref[f, :]
-            oh_ref[f * B:(f + 1) * B, :] = (iota_b == b[None, :]).astype(
-                jnp.bfloat16)
-        contrib = lax.dot_general(oh_ref[...], vals_ref[...],
-                                  (((1,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        out_ref[...] += contrib[None]
-    return kernel
-
-
-@functools.partial(jax.jit, static_argnames=("total_bins", "interpret"))
-def build_hist_pallas(bins_t: jnp.ndarray,    # (F, N) int32, N % CHUNK == 0
-                      grad: jnp.ndarray,      # (N,) f32
-                      hess: jnp.ndarray,      # (N,) f32
-                      mask: jnp.ndarray,      # (N,) f32 row weight
-                      total_bins: int,
-                      interpret: bool = False) -> jnp.ndarray:
-    """→ (F, B, 3) float32 [grad, hess, count] histogram."""
+def _reshape_feat(bins_t: jnp.ndarray, ft: int):
+    """(F, N) → (G, ft, N) with minimal zero-padding of the feature axis."""
     F, N = bins_t.shape
-    B = total_bins
-    ft, chunk = _tile_for(B)
-    assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
-    g = grad * mask
-    h = hess * mask
-    count = (mask > 0).astype(jnp.float32)
-    # bf16 hi/lo split: hi + lo reconstructs ~f32 precision after the bf16 dot
-    g_hi = g.astype(jnp.bfloat16)
-    g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    h_hi = h.astype(jnp.bfloat16)
-    h_lo = (h - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    z = jnp.zeros_like(count, jnp.bfloat16)
-    vals = jnp.stack([g_hi, g_lo, h_hi, h_lo,
-                      count.astype(jnp.bfloat16), z, z, z], axis=-1)  # (N, 8)
+    G = -(-F // ft)
+    if G * ft != F:
+        bins_t = jnp.pad(bins_t, ((0, G * ft - F), (0, 0)))
+    return bins_t.reshape(G, ft, N), G
 
-    Fp = ((F + ft - 1) // ft) * ft
-    if Fp != F:
-        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
 
-    out = pl.pallas_call(
-        _make_plain_hist_kernel(ft),
-        grid=(Fp // ft, N // chunk),
-        in_specs=[
-            pl.BlockSpec((ft, chunk), lambda f, c: (f, c)),
-            pl.BlockSpec((chunk, VALS), lambda f, c: (c, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, ft * B, VALS), lambda f, c: (f, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((Fp // ft, ft * B, VALS),
-                                       jnp.float32),
-        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.bfloat16)],
-        interpret=interpret,
-    )(bins_t, vals)
-
-    out = out.reshape(Fp, B, VALS)[:F]
-    gsum = out[:, :, 0] + out[:, :, 1]
-    hsum = out[:, :, 2] + out[:, :, 3]
-    return jnp.stack([gsum, hsum, out[:, :, 4]], axis=-1)   # (F, B, 3)
+# (the former single-histogram "plain" kernel is gone: every pallas
+# histogram — including the leaf-wise grower's per-node builds — routes
+# through the node-batched kernel below with per-TREE quantization, so one
+# kernel serves all growers and the quantization scale cannot drift
+# between them)
 
 
 #: rows pad to this multiple so every kernel geometry's grid divides
@@ -184,9 +182,9 @@ SLOT_LANES = 8
 
 def _make_hist_nodes_kernel(ft: int):
     def kernel(bins_ref, slot_ref, vals_ref, out_ref, oh_ref):
-        """Grid (F//ft, N//chunk) — c fastest.  bins block (ft, C) int32;
+        """Grid (G, N//chunk) — c fastest.  bins block (1, ft, C) int32;
         slot block (1, C) int32 (row's node slot, -1 = no slot); vals block
-        (C, S·8) bf16 pre-tiled; out block (1, ft·B, S·8) f32 revisited
+        (C, S·8) int8 pre-tiled; out block (1, ft·B, S·8) int32 revisited
         across the chunk dim — per-TILE residency keeps VMEM use
         F-independent (a fully resident accumulator scales with F and
         stops compiling near F≈60 at B=256)."""
@@ -196,85 +194,90 @@ def _make_hist_nodes_kernel(ft: int):
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
 
-        C = bins_ref.shape[1]
+        C = bins_ref.shape[2]
         B = oh_ref.shape[0] // ft
         S = vals_ref.shape[1] // SLOT_LANES
         iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
         for k in range(ft):
-            b = bins_ref[k, :]
+            b = bins_ref[0, k, :]
             oh_ref[k * B:(k + 1) * B, :] = (iota_b == b[None, :]).astype(
-                jnp.bfloat16)
+                jnp.int8)
         # slot-masked value matrix in ONE wide compare against the lane's
         # slot index — the round-2 loop of S narrow 8-lane writes cost more
         # than the matmul it fed
         sid = slot_ref[0, :]
         lane_j = lax.broadcasted_iota(
             jnp.int32, (C, S * SLOT_LANES), 1) // SLOT_LANES
-        vn = vals_ref[...] * (sid[:, None] == lane_j).astype(jnp.bfloat16)
+        # int8 elementwise multiply fails to legalize in Mosaic
+        # (arith.muli on i8 vectors) — mask via select instead
+        vn = jnp.where(sid[:, None] == lane_j, vals_ref[...],
+                       jnp.zeros_like(vals_ref))
         contrib = lax.dot_general(oh_ref[...], vn,
                                   (((1,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
+                                  preferred_element_type=jnp.int32)
         out_ref[...] += contrib[None]
     return kernel
 
 
 def prep_hist_vals(grad: jnp.ndarray, hess: jnp.ndarray,
-                   mask: jnp.ndarray) -> jnp.ndarray:
-    """Per-row value channels (N, 8) bf16: g/h in hi/lo split pairs (exact
-    ~f32 reconstruction after the bf16 dot) + a count channel.  Hoisted out
-    of the per-level loop: depends only on the iteration's grad/hess/mask."""
+                   mask: jnp.ndarray):
+    """Per-row value channels → ((N, 8) int8 limb matrix, (2,) f32 scales).
+
+    g/h quantize to 3 balanced base-128 int8 digits each on a per-call
+    max-|value| scale (range ±2^20), plus an exact 0/1 count lane.  Hoisted
+    out of the per-level loop: depends only on the iteration's
+    grad/hess/mask."""
     g = grad * mask
     h = hess * mask
-    count = (mask > 0).astype(jnp.float32)
-    g_hi = g.astype(jnp.bfloat16)
-    g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    h_hi = h.astype(jnp.bfloat16)
-    h_lo = (h - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    z = jnp.zeros_like(count, jnp.bfloat16)
-    return jnp.stack([g_hi, g_lo, h_hi, h_lo,
-                      count.astype(jnp.bfloat16), z, z, z], axis=-1)
+    s_g = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30) / _Q_MAX
+    s_h = jnp.maximum(jnp.max(jnp.abs(h)), 1e-30) / _Q_MAX
+    g0, g1, g2 = _quant(g, s_g)
+    h0, h1, h2 = _quant(h, s_h)
+    count = (mask > 0).astype(jnp.int32)
+    z = jnp.zeros_like(count)
+    vals = jnp.stack([g0, g1, g2, h0, h1, h2, count, z],
+                     axis=-1).astype(jnp.int8)
+    return vals, jnp.stack([s_g, s_h])
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_slots", "total_bins", "interpret"))
 def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 0
                             slot: jnp.ndarray,     # (N,) int32 in [-1, n_slots)
-                            vals: jnp.ndarray,     # (N, 8) bf16 from prep_hist_vals
+                            vals: jnp.ndarray,     # (N, 8) int8 limbs
+                            scales: jnp.ndarray,   # (2,) f32 from prep_hist_vals
                             n_slots: int,
                             total_bins: int,
                             interpret: bool = False) -> jnp.ndarray:
     """→ (n_slots, F, B, 3) float32 [grad, hess, count] histograms."""
     F, N = bins_t.shape
     B = total_bins
-    ft, chunk = _tile_for(B)
+    cap, chunk = _tile_for(B)
+    ft = _feat_tile(F, cap)
     assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
 
-    Fp = ((F + ft - 1) // ft) * ft
-    if Fp != F:
-        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
+    bins_r, G = _reshape_feat(bins_t, ft)
     vals_lanes = jnp.tile(vals, (1, n_slots))          # (N, S·8)
     VN = n_slots * SLOT_LANES
 
     out = pl.pallas_call(
         _make_hist_nodes_kernel(ft),
-        grid=(Fp // ft, N // chunk),
+        grid=(G, N // chunk),
         in_specs=[
-            pl.BlockSpec((ft, chunk), lambda f, c: (f, c)),
+            pl.BlockSpec((1, ft, chunk), lambda f, c: (f, 0, c)),
             pl.BlockSpec((1, chunk), lambda f, c: (0, c)),
             pl.BlockSpec((chunk, VN), lambda f, c: (c, 0)),
         ],
         out_specs=pl.BlockSpec((1, ft * B, VN), lambda f, c: (f, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((Fp // ft, ft * B, VN), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.bfloat16)],
+        out_shape=jax.ShapeDtypeStruct((G, ft * B, VN), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.int8)],
         interpret=interpret,
-    )(bins_t, slot[None, :], vals_lanes)
+    )(bins_r, slot[None, :], vals_lanes)
 
-    # (F/ft, ft·B, S·8) → (F, B, S, 8) → (S, F, B, 3)
-    out = out.reshape(Fp, B, n_slots, SLOT_LANES)[:F]
+    # (G, ft·B, S·8) → (F, B, S, 8) → (S, F, B, 3)
+    out = out.reshape(G * ft, B, n_slots, SLOT_LANES)[:F]
     out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)
-    gsum = out[..., 0] + out[..., 1]
-    hsum = out[..., 2] + out[..., 3]
-    return jnp.stack([gsum, hsum, out[..., 4]], axis=-1)
+    return _reconstruct(out, scales)
 
 
 # --------------------------------------------------------------------------
@@ -305,10 +308,10 @@ def _make_fused_kernel(ft: int):
                lid_ref, rid_ref,
                sel_ref, bins_ref, nid_ref, vals_ref,
                newid_ref, out_ref, oh_ref, vn_ref):
-        """Grid (N//chunk, F//ft) — f fastest.  sel block (S, C) int32 (the
-        split columns' bin rows), bins block (ft, C) (histogram tile),
-        nid (1, C), vals (C, S·8) bf16 pre-tiled; outputs: newid (1, C) and
-        the resident histogram accumulator (F//ft, ft·B, S·8) f32.
+        """Grid (N//chunk, G) — f fastest.  sel block (S, C) int32 (the
+        split columns' bin rows), bins block (1, ft, C) (histogram tile),
+        nid (1, C), vals (C, S·8) int8 pre-tiled; outputs: newid (1, C) and
+        the resident histogram accumulator (G, ft·B, S·8) int32.
 
         The routing condition is the UNIVERSAL form
         ``in (rlo, rhi] ? x <= t1 : dflt``: plain splits pass
@@ -323,7 +326,7 @@ def _make_fused_kernel(ft: int):
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
 
-        C = bins_ref.shape[1]
+        C = bins_ref.shape[2]
         B = oh_ref.shape[0] // ft
         S = vn_ref.shape[1] // SLOT_LANES
 
@@ -347,17 +350,20 @@ def _make_fused_kernel(ft: int):
             newid_ref[0, :] = new
             lane_j = lax.broadcasted_iota(
                 jnp.int32, (C, S * SLOT_LANES), 1) // SLOT_LANES
-            vn_ref[...] = vals_ref[...] * (bslot[:, None] == lane_j).astype(
-                jnp.bfloat16)
+            # select, not multiply: arith.muli on i8 vectors fails to
+            # legalize in Mosaic
+            vn_ref[...] = jnp.where(bslot[:, None] == lane_j,
+                                    vals_ref[...],
+                                    jnp.zeros_like(vals_ref))
 
         iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
         for k in range(ft):
-            b = bins_ref[k, :]
+            b = bins_ref[0, k, :]
             oh_ref[k * B:(k + 1) * B, :] = (iota_b == b[None, :]).astype(
-                jnp.bfloat16)
+                jnp.int8)
         contrib = lax.dot_general(oh_ref[...], vn_ref[...],
                                   (((1,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
+                                  preferred_element_type=jnp.int32)
         out_ref[f, :, :] += contrib
     return kernel
 
@@ -374,7 +380,8 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
                           dflt: jnp.ndarray,     # (S,) int32 out-of-range dir
                           l_id: jnp.ndarray,     # (S,) int32 left child id
                           r_id: jnp.ndarray,     # (S,) int32 right child id
-                          vals: jnp.ndarray,     # (N, S·8) bf16 tiled
+                          vals: jnp.ndarray,     # (N, S·8) int8 limbs tiled
+                          scales: jnp.ndarray,   # (2,) f32 from prep_hist_vals
                           n_slots: int,
                           total_bins: int,
                           interpret: bool = False):
@@ -393,40 +400,35 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
         "the caller must gate on fused_geometry(...)")
     ft, chunk = geo
     assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
-    Fp = ((F + ft - 1) // ft) * ft
-    if Fp != F:
-        bins_t = jnp.pad(bins_t, ((0, Fp - F), (0, 0)))
     sel = jnp.take(bins_t, sel_col, axis=0)            # (S, N) row copy
+    bins_r, G = _reshape_feat(bins_t, ft)
     VN = n_slots * SLOT_LANES
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=7,
-        grid=(N // chunk, Fp // ft),
+        grid=(N // chunk, G),
         in_specs=[
             pl.BlockSpec((n_slots, chunk), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((ft, chunk), lambda c, f, *_: (f, c)),
+            pl.BlockSpec((1, ft, chunk), lambda c, f, *_: (f, 0, c)),
             pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
             pl.BlockSpec((chunk, VN), lambda c, f, *_: (c, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((Fp // ft, ft * B, VN),
+            pl.BlockSpec((G, ft * B, VN),
                          lambda c, f, *_: (0, 0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.bfloat16),
-                        pltpu.VMEM((chunk, VN), jnp.bfloat16)],
+        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.int8),
+                        pltpu.VMEM((chunk, VN), jnp.int8)],
     )
     new_id, out = pl.pallas_call(
         _make_fused_kernel(ft),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((1, N), jnp.int32),
-                   jax.ShapeDtypeStruct(
-                       (Fp // ft, ft * B, VN), jnp.float32)],
+                   jax.ShapeDtypeStruct((G, ft * B, VN), jnp.int32)],
         interpret=interpret,
     )(leaf, t1, rlo, rhi, dflt, l_id, r_id,
-      sel, bins_t, node_id[None, :], vals)
+      sel, bins_r, node_id[None, :], vals)
 
-    out = out.reshape(Fp, B, n_slots, SLOT_LANES)[:F]
+    out = out.reshape(G * ft, B, n_slots, SLOT_LANES)[:F]
     out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)
-    gsum = out[..., 0] + out[..., 1]
-    hsum = out[..., 2] + out[..., 3]
-    return new_id[0], jnp.stack([gsum, hsum, out[..., 4]], axis=-1)
+    return new_id[0], _reconstruct(out, scales)

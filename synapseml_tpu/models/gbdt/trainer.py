@@ -98,17 +98,29 @@ def _leaf_output(g, h, l1, l2):
     return -_soft_threshold(g, l1) / (h + l2 + 1e-32)
 
 
-def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas):
+def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas,
+                vals8=None, scales=None):
     """Histogram for masked rows → (F*B, 3) f32 [grad, hess, count].
 
     ``mask`` is the row weight (bag/GOSS amplification); the count channel
     counts rows with mask>0 exactly once so GOSS amplification never
     inflates leaf counts.  On TPU the Pallas MXU kernel builds it
     (pallas_hist.py); elsewhere an XLA scatter-add over the precomputed
-    flattened bin ids ``flat_bins`` (F, N)."""
+    flattened bin ids ``flat_bins`` (F, N).
+
+    ``vals8``/``scales``: per-TREE int8 limb quantization from
+    :func:`prep_hist_vals` (already weighted by the tree's row mask).
+    Passing them keeps the quantization scale identical across every
+    histogram of the tree — node-local scales would round differently
+    from the depthwise grower's global scale and flip near-tie splits;
+    ``mask`` then only selects node membership."""
     if use_pallas:
-        from .pallas_hist import build_hist_pallas
-        return build_hist_pallas(bins_t, grad, hess, mask, B).reshape(F * B, 3)
+        from .pallas_hist import build_hist_nodes_pallas
+        assert vals8 is not None, "pallas path requires per-tree vals8/scales"
+        slot = jnp.where(mask > 0, 0, -1).astype(jnp.int32)
+        return build_hist_nodes_pallas(
+            bins_t, slot, vals8, scales, 1, B,
+            interpret=(use_pallas == "interpret"))[0].reshape(F * B, 3)
     count = (mask > 0).astype(jnp.float32)
     upd = jnp.stack([grad * mask, hess * mask, count], axis=-1)           # (N,3)
     upd = jnp.broadcast_to(upd[None, :, :], (F,) + upd.shape)             # (F,N,3)
@@ -151,7 +163,13 @@ def _gain_matrix(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
     (``monotone_penalty``) — the LightGBM "basic" method.
     """
     F, B, _ = hist.shape
-    cum = jnp.cumsum(hist, axis=1)                   # (F, B, 3)
+    # prefix sums over the bin axis via log-depth associative scan:
+    # jnp.cumsum lowers to an O(B^2)-work reduce-window on TPU (~13 ms/tree
+    # of split search at B=256), and a triangular-matmul formulation
+    # reassociates sums differently per batch shape, so the two growers'
+    # near-tie splits diverge — the scan's fixed pairwise tree is both
+    # O(B log B) and batch-shape-independent
+    cum = lax.associative_scan(jnp.add, hist, axis=1)    # (F, B, 3)
     gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
     gr, hr, cr = sum_g - gl, sum_h - hl, sum_c - cl
     if mono_c is None:
@@ -346,12 +364,17 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
                                feature_mask, depth, p, lo, hi, mono_c)
 
     flat_bins = None
+    vals8 = scales = None
     if not use_pallas:
         flat_bins = bins_t + (jnp.arange(F, dtype=jnp.int32) * B)[:, None]
+    else:
+        from .pallas_hist import prep_hist_vals
+        vals8, scales = prep_hist_vals(grad, hess, row_valid)
 
     # root
     root_hist = ar(_build_hist(bins_t, flat_bins, grad, hess,
-                               row_valid, F, B, use_pallas)).reshape(F, B, 3)
+                               row_valid, F, B, use_pallas,
+                               vals8, scales)).reshape(F, B, 3)
     root_stats = jnp.sum(root_hist[0], axis=0)
     if voting:
         root_stats = lax.psum(root_stats, axis_name)
@@ -412,7 +435,7 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         # left child hist by one device pass, right by subtraction
         lmask = (new_node_id == l_id).astype(jnp.float32) * row_valid
         l_hist = ar(_build_hist(bins_t, flat_bins, grad, hess, lmask, F, B,
-                                use_pallas))
+                                use_pallas, vals8, scales))
         parent_slot = s["slot"][leaf]
         r_hist = s["hist"][parent_slot] - l_hist
         r_slot = s["next_slot"]
@@ -518,11 +541,12 @@ def _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot, n_slots, F, B):
     return hist.reshape(n_slots + 1, F, B, 3)[:n_slots]
 
 
-def _build_hist_nodes(bins_t, flat_bins, vals8, grad, hess, mask, slot,
-                      n_slots, F, B, use_pallas):
+def _build_hist_nodes(bins_t, flat_bins, vals8, scales, grad, hess, mask,
+                      slot, n_slots, F, B, use_pallas):
     if use_pallas:
         from .pallas_hist import build_hist_nodes_pallas
-        return build_hist_nodes_pallas(bins_t, slot, vals8, n_slots, B,
+        return build_hist_nodes_pallas(bins_t, slot, vals8, scales, n_slots,
+                                       B,
                                        interpret=(use_pallas == "interpret"))
     return _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot,
                                  n_slots, F, B)
@@ -620,17 +644,19 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     def ar(x):
         return lax.psum(x, axis_name) if axis_name else x
 
-    vals8 = prep_hist_vals(grad, hess, row_valid) if use_pallas else None
+    vals8, scales = (prep_hist_vals(grad, hess, row_valid) if use_pallas
+                     else (None, None))
     # tiled to the kernel's (N, S·8) lane layout ONCE per tree — tiling
-    # per wave would re-materialize a (N, 128) bf16 array every level
+    # per wave would re-materialize a (N, 128) int8 array every level
     vals_tiled = jnp.tile(vals8, (1, S)) if use_pallas else None
     flat_bins = None
     if not use_pallas:
         flat_bins = bins_t + (jnp.arange(F, dtype=jnp.int32) * B)[:, None]
 
     def build(slot):
-        return ar(_build_hist_nodes(bins_t, flat_bins, vals8, grad, hess,
-                                    row_valid, slot, S, F, B, use_pallas))
+        return ar(_build_hist_nodes(bins_t, flat_bins, vals8, scales, grad,
+                                    hess, row_valid, slot, S, F, B,
+                                    use_pallas))
 
     F_search = num_bins.shape[0]           # ORIGINAL feature count
     mono_c = _mono_vec(p, F_search)
@@ -646,8 +672,26 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     vpick = jax.vmap(lambda h, g, hh, c, d, lo, hi: pick(
         h, g, hh, c, node_depth=d, node_lo=lo, node_hi=hi))
 
-    # root: one batched pass with every row in slot 0
-    root_hist = build(jnp.zeros(N, jnp.int32))[0]          # (F, B, 3)
+    # root: one batched pass with every row in slot 0.  On the pallas path
+    # this rides the FUSED kernel with a degenerate all-left split of leaf 0
+    # (t1=B → every row left, child id 0 → node ids unchanged): the fused
+    # kernel computes its slot mask once per chunk instead of once per
+    # (feature-tile, chunk) step, measured ~25% faster than the nodes
+    # kernel for the same histograms
+    if use_pallas:
+        from .pallas_hist import fused_geometry, route_and_hist_pallas
+    if use_pallas and fused_geometry(F, B, S) is not None:
+        jv = jnp.full((S,), JUNK, jnp.int32)
+        _, root_hists = route_and_hist_pallas(
+            bins_t, jnp.zeros(N, jnp.int32), jv.at[0].set(0),
+            jnp.zeros(S, jnp.int32), jnp.full((S,), B, jnp.int32),
+            jnp.full((S,), -1, jnp.int32), jnp.full((S,), B, jnp.int32),
+            jnp.ones(S, jnp.int32), jnp.zeros(S, jnp.int32),
+            jnp.zeros(S, jnp.int32), vals_tiled, scales, S, B,
+            interpret=(use_pallas == "interpret"))
+        root_hist = ar(root_hists)[0]                      # (F, B, 3)
+    else:
+        root_hist = build(jnp.zeros(N, jnp.int32))[0]      # (F, B, 3)
     root_stats = jnp.sum(root_hist[0], axis=0)
     root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
 
@@ -714,7 +758,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             def fused_wave(_):
                 return route_and_hist_pallas(
                     bins_t, s["node_id"], parents, rt_col, rt_t1, rt_lo,
-                    rt_hi, rt_df, l_ids, r_ids, vals_tiled, S, B,
+                    rt_hi, rt_df, l_ids, r_ids, vals_tiled, scales, S, B,
                     interpret=(use_pallas == "interpret"))
 
             def route_only(_):
@@ -892,15 +936,16 @@ def grow_tree_feature_parallel(
     JUNK = M - 1
     rank = lax.axis_index(axis_name)
 
-    vals8 = prep_hist_vals(grad, hess, row_valid) if use_pallas else None
+    vals8, scales = (prep_hist_vals(grad, hess, row_valid) if use_pallas
+                     else (None, None))
     flat_bins = None
     if not use_pallas:
         flat_bins = bins_t + (jnp.arange(FL, dtype=jnp.int32) * B)[:, None]
 
     def build(slot):
         # LOCAL histograms only — the defining property of feature-parallel
-        return _build_hist_nodes(bins_t, flat_bins, vals8, grad, hess,
-                                 row_valid, slot, S, FL, B, use_pallas)
+        return _build_hist_nodes(bins_t, flat_bins, vals8, scales, grad,
+                                 hess, row_valid, slot, S, FL, B, use_pallas)
 
     # constraints come from the static tuple in p, so the GLOBAL vector is
     # available on every rank; each rank's gain pass slices its own span

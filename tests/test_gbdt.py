@@ -631,10 +631,11 @@ def test_node_batched_hist_matches_scatter():
     hess = (np.abs(grad) + 0.1).astype(np.float32)
     mask = (rng.random(N) < 0.7).astype(np.float32) * 1.5
     slot = rng.integers(-1, S, N).astype(np.int32)
-    vals = prep_hist_vals(jnp.asarray(grad), jnp.asarray(hess),
-                          jnp.asarray(mask))
+    vals, scales = prep_hist_vals(jnp.asarray(grad), jnp.asarray(hess),
+                                  jnp.asarray(mask))
     out_p = np.asarray(build_hist_nodes_pallas(
-        jnp.asarray(bins_t), jnp.asarray(slot), vals, S, B, interpret=True))
+        jnp.asarray(bins_t), jnp.asarray(slot), vals, scales, S, B,
+        interpret=True))
     flat = bins_t + (np.arange(F, dtype=np.int32) * B)[:, None]
     out_x = np.asarray(_build_hist_nodes_xla(
         jnp.asarray(flat), jnp.asarray(grad), jnp.asarray(hess),
@@ -644,9 +645,11 @@ def test_node_batched_hist_matches_scatter():
 
 
 def test_pallas_hist_matches_scatter():
-    """Pallas kernel (interpret mode) vs the scatter path — same histograms."""
+    """Production pallas histogram path (interpret mode) vs the XLA
+    scatter path — same histograms (the leaf-wise grower's per-node build:
+    per-tree int8 limb quantization + single-slot nodes kernel)."""
     import jax.numpy as jnp
-    from synapseml_tpu.models.gbdt.pallas_hist import build_hist_pallas
+    from synapseml_tpu.models.gbdt.pallas_hist import prep_hist_vals
     from synapseml_tpu.models.gbdt.trainer import _build_hist
 
     rng = np.random.default_rng(0)
@@ -656,9 +659,12 @@ def test_pallas_hist_matches_scatter():
     hess = (np.abs(grad) + 0.1).astype(np.float32)
     mask = (rng.random(N) < 0.7).astype(np.float32) * 1.5   # weighted rows
 
-    out_p = np.asarray(build_hist_pallas(
-        jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
-        jnp.asarray(mask), B, interpret=True))
+    vals8, scales = prep_hist_vals(jnp.asarray(grad), jnp.asarray(hess),
+                                   jnp.asarray(mask))
+    out_p = np.asarray(_build_hist(
+        jnp.asarray(bins_t), None, jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(mask), F, B, use_pallas="interpret",
+        vals8=vals8, scales=scales)).reshape(F, B, 3)
     flat = bins_t + (np.arange(F, dtype=np.int32) * B)[:, None]
     out_s = np.asarray(_build_hist(
         jnp.asarray(bins_t), jnp.asarray(flat), jnp.asarray(grad),
@@ -809,15 +815,16 @@ def test_fused_route_hist_kernel_matches_xla():
     hess = (np.abs(grad) + 0.1).astype(np.float32)
     mask = (rng.random(N) < 0.8).astype(np.float32)
 
-    vals = prep_hist_vals(jnp.asarray(grad), jnp.asarray(hess),
-                          jnp.asarray(mask))
+    vals, scales = prep_hist_vals(jnp.asarray(grad), jnp.asarray(hess),
+                                  jnp.asarray(mask))
     # plain-mode universal routing: full range -> degrades to x <= thr
     new_id, hists = route_and_hist_pallas(
         jnp.asarray(bins_t), jnp.asarray(node_id), jnp.asarray(leaf),
         jnp.asarray(feat), jnp.asarray(thr),
         jnp.full(S, -1, jnp.int32), jnp.full(S, B, jnp.int32),
         jnp.ones(S, jnp.int32), jnp.asarray(l_id),
-        jnp.asarray(r_id), jnp.tile(vals, (1, S)), S, B, interpret=True)
+        jnp.asarray(r_id), jnp.tile(vals, (1, S)), scales, S, B,
+        interpret=True)
 
     exp_id = node_id.copy()
     exp_slot = np.full(N, -1, np.int32)
