@@ -62,6 +62,23 @@ def _chip_peak(device) -> float:
     return best[1] if best else 197e12
 
 
+def _median_window(run_steps, n_windows=3):
+    """Median items/sec over ``n_windows`` timed windows.
+
+    ``run_steps()`` runs one window's steps and returns (n_items, barrier)
+    where calling ``barrier()`` forces a HOST READBACK — on the tunneled
+    platform ``block_until_ready`` can return before device work drains,
+    so a download is the only true barrier.  One place owns this idiom so
+    every bench measures identically."""
+    rates = []
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        n_items, barrier = run_steps()
+        barrier()
+        rates.append(n_items / (time.perf_counter() - t0))
+    return sorted(rates)[len(rates) // 2]
+
+
 def bench_bert():
     import jax
     from synapseml_tpu.models.dl.training import DLTrainer, OptimizerConfig
@@ -89,22 +106,15 @@ def bench_bert():
 
     state, m = step(state, (bi, bm), bl, key)        # compile
     float(np.asarray(m["loss"]))
-    # the tunneled chip is shared: throughput varies with co-tenant load.
-    # Measure three windows and report the median (robust to one
-    # contended window without the upward bias of a max).  Synchronize by
-    # READING BACK the last loss — on the tunneled platform
-    # block_until_ready can return before device work drains, which
-    # silently turns the window into a dispatch-rate measurement (the
-    # round-1 number had exactly this bug); a host download is a true
-    # barrier because the bytes must exist.
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
+
+    def window():
+        nonlocal state
+        m = None
         for _ in range(BERT_STEPS):
             state, m = step(state, (bi, bm), bl, key)
-        float(np.asarray(m["loss"]))
-        rates.append(BERT_STEPS * bs / (time.perf_counter() - t0))
-    sps_chip = sorted(rates)[1] / len(devs)
+        return BERT_STEPS * bs, lambda: float(np.asarray(m["loss"]))
+
+    sps_chip = _median_window(window) / len(devs)
     # standard training-FLOPs accounting: 6 · params · tokens (fwd 2PT, bwd 4PT)
     flops_per_sample = 6.0 * n_params * BERT_SEQ
     mfu = sps_chip * flops_per_sample / _chip_peak(jax.devices()[0])
@@ -171,14 +181,15 @@ def bench_vision():
 
     state, m = compiled(state, (bi,), bl, key)       # warm the executable
     float(np.asarray(m["loss"]))
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
+
+    def window():
+        nonlocal state
+        m = None
         for _ in range(VISION_STEPS):
             state, m = compiled(state, (bi,), bl, key)
-        float(np.asarray(m["loss"]))                 # true barrier
-        rates.append(VISION_STEPS * bs / (time.perf_counter() - t0))
-    sps_chip = sorted(rates)[1] / len(devs)
+        return VISION_STEPS * bs, lambda: float(np.asarray(m["loss"]))
+
+    sps_chip = _median_window(window) / len(devs)
     mfu = (sps_chip * flops_per_sample) / _chip_peak(devs[0])
     return sps_chip, mfu
 

@@ -145,6 +145,148 @@ def quantize_int8(variables: Any) -> Any:
             for k, v in variables.items()}
 
 
+def _ngram_draft(ctx: jnp.ndarray, cur_len: jnp.ndarray, draft_len: int,
+                 ngram: int) -> jnp.ndarray:
+    """Prompt-lookup drafting: find the latest earlier occurrence of the
+    last ``ngram`` tokens in the context and propose the tokens that
+    followed it.  No draft model — the context itself is the draft source
+    (strong on repetitive/structured text, harmless elsewhere because
+    verification keeps greedy output exact).  → (B, draft_len) int32."""
+    B, L = ctx.shape
+    # the trailing n-gram of each sequence
+    tail = jnp.take_along_axis(
+        ctx, jnp.maximum(cur_len[:, None] - ngram + jnp.arange(ngram), 0), 1)
+    # windows[b, p, j] = ctx[b, p + j] for p in [0, L - ngram]
+    windows = jnp.stack([ctx[:, j:L - ngram + 1 + j] for j in range(ngram)],
+                        axis=-1)                       # (B, L-n+1, n)
+    match = jnp.all(windows == tail[:, None, :], axis=-1)
+    p_idx = jnp.arange(L - ngram + 1)[None, :]
+    # the match must END strictly before the tail and have at least one
+    # known continuation token
+    valid = match & (p_idx + ngram < cur_len[:, None])
+    has = jnp.any(valid, axis=1)
+    p_best = jnp.argmax(jnp.where(valid, p_idx, -1), axis=1)   # latest
+    src = p_best[:, None] + ngram + jnp.arange(draft_len)      # (B, K)
+    # clip unknown continuation positions to the last known token
+    src = jnp.minimum(src, cur_len[:, None] - 1)
+    draft = jnp.take_along_axis(ctx, src, 1)
+    last = jnp.take_along_axis(ctx, cur_len[:, None] - 1, 1)
+    return jnp.where(has[:, None], draft,
+                     jnp.broadcast_to(last, draft.shape)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "model", "max_new_tokens", "draft_len", "ngram", "eos_id", "pad_id"))
+def _generate_spec_jit(model: LlamaModel, variables: Any,
+                       prompt_ids: jnp.ndarray, max_new_tokens: int,
+                       draft_len: int, ngram: int,
+                       eos_id: Optional[int], pad_id: int):
+    cfg = model.cfg
+    B, P = prompt_ids.shape
+    K = draft_len
+    L = P + max_new_tokens + K + 2        # ctx/cache capacity with slack
+    cache = init_cache(cfg, B, L)
+
+    ctx = jnp.full((B, L), pad_id, jnp.int32).at[:, :P].set(prompt_ids)
+
+    # prefill the prompt minus its last token (the last token is the first
+    # verify block's "input 0" so its K/V lands there)
+    positions = jnp.broadcast_to(jnp.arange(P - 1)[None, :], (B, P - 1))
+    _, cache = model.apply(variables, prompt_ids[:, :-1],
+                           positions=positions, cache=cache, cache_index=0)
+
+    def cond(s):
+        ctx, cur_len, done, cache, steps, acc, row_steps = s
+        return (~jnp.all(done)) & (steps < max_new_tokens)
+
+    def body(s):
+        ctx, cur_len, done, cache, steps, acc, row_steps = s
+        draft = _ngram_draft(ctx, cur_len, K, ngram)            # (B, K)
+        last = jnp.take_along_axis(ctx, cur_len[:, None] - 1, 1)
+        inputs = jnp.concatenate([last, draft], axis=1)         # (B, K+1)
+        pos = (cur_len - 1)[:, None] + jnp.arange(K + 1)[None, :]
+        logits, new_cache = model.apply(variables, inputs, positions=pos,
+                                        cache=cache,
+                                        cache_index=cur_len - 1)
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # (B, K+1)
+        match = draft == g[:, :K]
+        a = jnp.where(jnp.all(match, axis=1), K,
+                      jnp.argmin(match.astype(jnp.int32), axis=1))  # (B,)
+        n_new = a + 1                            # tokens g[:, 0..a]
+        if eos_id is not None:
+            is_eos = g == eos_id
+            eos_pos = jnp.where(jnp.any(is_eos, axis=1),
+                                jnp.argmax(is_eos, axis=1), K + 1)
+            n_new = jnp.minimum(n_new, eos_pos + 1)
+        n_new = jnp.where(done, 0, n_new)
+        # scatter the accepted tokens g[:, i], i < n_new, at cur_len + i
+        tpos = cur_len[:, None] + jnp.arange(K + 1)[None, :]    # (B, K+1)
+        take = jnp.arange(K + 1)[None, :] < n_new[:, None]
+        oh = (tpos[:, :, None] == jnp.arange(L)[None, None, :]) \
+            & take[:, :, None]                                  # (B,K+1,L)
+        ctx = jnp.where(jnp.any(oh, axis=1), jnp.einsum(
+            "bsl,bs->bl", oh.astype(jnp.int32), g), ctx)
+        if eos_id is not None:
+            done = done | jnp.any((g == eos_id) & take, axis=1)
+        acc = acc + n_new
+        row_steps = row_steps + (n_new > 0).astype(jnp.int32)
+        cur_len = cur_len + n_new
+        # rows that reached their budget are done: keeping them in the
+        # loop would burn full-model forwards and inflate the stats with
+        # tokens the cropped output never shows
+        done = done | (cur_len >= P + max_new_tokens)
+        return (ctx, cur_len, done, new_cache, steps + 1, acc, row_steps)
+
+    done0 = jnp.zeros(B, bool)
+    state = (ctx, jnp.full((B,), P, jnp.int32), done0, cache,
+             jnp.zeros((), jnp.int32), jnp.zeros((B,), jnp.int32),
+             jnp.zeros((B,), jnp.int32))
+    (ctx, cur_len, done, cache, steps, acc,
+     row_steps) = lax.while_loop(cond, body, state)
+    out = ctx[:, P:P + max_new_tokens]
+    # pad everything past each sequence's end (eos freeze)
+    keep = jnp.arange(max_new_tokens)[None, :] < (cur_len - P)[:, None]
+    out = jnp.where(keep, out, pad_id)
+    return out, steps, acc, row_steps
+
+
+def generate_speculative(model: LlamaModel, variables: Any, prompt_ids,
+                         max_new_tokens: int = 32, draft_len: int = 7,
+                         ngram: int = 2, eos_id: Optional[int] = None,
+                         pad_id: int = 0):
+    """Greedy decode with self-speculative (prompt-lookup) drafting.
+
+    Each loop step verifies ``draft_len`` n-gram-drafted tokens in ONE
+    forward of length draft_len+1.  At small batch the per-token matmuls
+    use only B of the MXU's 128 rows, so a (B, K+1)-token verify costs the
+    same as a single-token step — every accepted draft token is a free
+    extra token.  Output is EXACTLY greedy decoding's (verification
+    accepts a draft token only when it equals the model's argmax), so this
+    is a pure serving-throughput lever, not an approximation.
+
+    Returns (tokens (B, max_new_tokens) int32, stats dict with
+    ``steps``/``accepted``/``tokens_per_step``).
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    if prompt_ids.shape[1] < max(ngram, 2):
+        raise ValueError("prompt must be at least ngram tokens long")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    out, steps, acc, row_steps = _generate_spec_jit(
+        model, variables, prompt_ids, int(max_new_tokens), int(draft_len),
+        int(ngram), eos_id, int(pad_id))
+    out = np.asarray(out)
+    acc = np.asarray(acc, np.float64)
+    row_steps = np.maximum(np.asarray(row_steps, np.float64), 1.0)
+    # per-ROW averages: rows finish at different times, and a finished
+    # row must not dilute the rate of rows still decoding
+    tps = float(np.mean(acc / row_steps))
+    stats = {"steps": int(steps), "accepted": int(acc.sum()),
+             "tokens_per_step": tps,
+             "acceptance_rate": max(tps - 1.0, 0.0) / max(int(draft_len), 1)}
+    return out, stats
+
+
 def generate(model: LlamaModel, variables: Any, prompt_ids,
              max_new_tokens: int = 32, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 1.0,

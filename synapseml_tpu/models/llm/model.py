@@ -173,11 +173,28 @@ class CausalAttention(nn.Module):
 
         new_cache = None
         if cache is not None:
-            # write this step's K/V at cache_index, attend over the prefix
-            k_all = jax.lax.dynamic_update_slice(
-                cache["k"], k, (0, cache_index, 0, 0))
-            v_all = jax.lax.dynamic_update_slice(
-                cache["v"], v, (0, cache_index, 0, 0))
+            if jnp.ndim(cache_index) == 0:
+                # write this step's K/V at cache_index, attend over prefix
+                k_all = jax.lax.dynamic_update_slice(
+                    cache["k"], k, (0, cache_index, 0, 0))
+                v_all = jax.lax.dynamic_update_slice(
+                    cache["v"], v, (0, cache_index, 0, 0))
+            else:
+                # PER-SEQUENCE write offsets (B,) — speculative decoding
+                # accepts a different number of tokens per sequence, so
+                # each row writes its S-token block at its own position.
+                # One-hot matmul scatter: exact (single nonzero per sum)
+                # and a few MFLOPs at decode shapes
+                T = cache["k"].shape[1]
+                wpos = cache_index[:, None] + jnp.arange(S)[None, :]
+                oh = (wpos[:, :, None]
+                      == jnp.arange(T)[None, None, :])          # (B, S, T)
+                keep = (~jnp.any(oh, axis=1)).astype(cfg.dtype)  # (B, T)
+                ohd = oh.astype(cfg.dtype)
+                k_all = (cache["k"] * keep[..., None, None]
+                         + jnp.einsum("bst,bskd->btkd", ohd, k))
+                v_all = (cache["v"] * keep[..., None, None]
+                         + jnp.einsum("bst,bskd->btkd", ohd, v))
             new_cache = {"k": k_all, "v": v_all}
             k_att, v_att = k_all, v_all
             T = k_all.shape[1]

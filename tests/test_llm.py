@@ -116,6 +116,46 @@ class TestGeneration:
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(out, ids[:, 5:])
 
+    def test_speculative_equals_greedy(self, tiny_model):
+        """Prompt-lookup speculative decoding is EXACTLY greedy decoding:
+        drafts only survive verification when they equal the model's
+        argmax, so the output must be bit-identical — repetitive and
+        random prompts, several draft lengths."""
+        from synapseml_tpu.models.llm import generate
+        from synapseml_tpu.models.llm.generate import generate_speculative
+
+        cfg, model, variables, _ = tiny_model
+        rng = np.random.default_rng(3)
+        base = rng.integers(1, cfg.vocab_size, 5)
+        prompt = np.concatenate([base, base])[None, :].repeat(3, 0)
+        prompt[1] = rng.integers(1, cfg.vocab_size, 10)   # random row
+        ref = generate(model, variables, prompt, max_new_tokens=12)
+        for K in (3, 7):
+            out, stats = generate_speculative(model, variables, prompt,
+                                              max_new_tokens=12,
+                                              draft_len=K)
+            np.testing.assert_array_equal(ref, out, err_msg=f"draft_len={K}")
+            assert stats["steps"] >= 1
+            assert stats["tokens_per_step"] >= 1.0   # >=1 token per verify
+
+    def test_speculative_eos_matches_greedy(self, tiny_model):
+        """EOS handling under speculation: same truncation + padding as
+        the plain greedy path, even when eos lands mid-draft."""
+        from synapseml_tpu.models.llm import generate
+        from synapseml_tpu.models.llm.generate import generate_speculative
+
+        cfg, model, variables, _ = tiny_model
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(1, cfg.vocab_size, (2, 8)).astype(np.int32)
+        ref = generate(model, variables, prompt, max_new_tokens=10)
+        eos = int(ref[0, 3])                 # force a mid-stream stop
+        ref_e = generate(model, variables, prompt, max_new_tokens=10,
+                         eos_id=eos, pad_id=0)
+        out_e, _ = generate_speculative(model, variables, prompt,
+                                        max_new_tokens=10, eos_id=eos,
+                                        pad_id=0)
+        np.testing.assert_array_equal(ref_e, out_e)
+
     def test_eos_pads_after_stop(self, tiny_model):
         from synapseml_tpu.models.llm import generate
 
