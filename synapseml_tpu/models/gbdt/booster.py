@@ -833,13 +833,10 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # reference's query-rows-share-a-partition rule); rows permute into
     # per-shard slabs padded to a common length, lambdas stay shard-local
     lr_pack = None
+    lr_stream_perm = None
     if config.objective == "lambdarank" and mesh is not None:
         if group is None:
             raise ValueError("lambdarank requires group sizes (groupCol)")
-        if source is not None:
-            raise NotImplementedError(
-                "streamed + distributed lambdarank is not supported; "
-                "materialize the ranking frame")
         if config.parallelism != "data_parallel":
             raise NotImplementedError(
                 "distributed lambdarank runs data_parallel (whole groups "
@@ -855,14 +852,22 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             np.asarray(group), _shards, _unit, max_group_size=128)
         _valid = (perm >= 0)
         pc = np.maximum(perm, 0)
-        X = X[pc]
-        X[~_valid] = np.nan        # pads must not shift the bin quantiles
+        if source is not None:
+            # streamed ranking: labels/weights permute on HOST (tiny);
+            # the binned matrix streams to device in SOURCE order and
+            # permutes into the per-shard group slabs ON DEVICE after
+            # assembly — whole groups land on one shard exactly like the
+            # in-memory path, host memory stays O(chunk)
+            lr_stream_perm = (pc, _valid, n)      # n = source row count
+        else:
+            X = X[pc]
+            X[~_valid] = np.nan    # pads must not shift the bin quantiles
         y = np.asarray(y)[pc] * _valid
         sw = (np.asarray(sample_weight, np.float32)[pc]
               if sample_weight is not None
               else np.ones(len(pc), np.float32))
         sample_weight = (sw * _valid).astype(np.float32)
-        n = len(X)
+        n = len(pc)
         lr_pack = (_sq, _smask, _L, _valid)
     K = config.num_class if config.objective in ("multiclass", "multiclassova") else 1
     feature_names = list(feature_names) if feature_names else [f"f{i}" for i in range(F)]
@@ -1143,6 +1148,10 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         crows = max(row_shards, 131_072 // row_shards * row_shards)
         chunk_iter = (X[lo:lo + crows] for lo in range(0, n, crows))
     bin_dt = np.uint8 if mapper.max_bin <= 255 else np.uint16
+    # streamed ranking permutes AFTER assembly: the stream's own tail pad
+    # only needs shard divisibility for the source row count
+    stream_pad = pad if lr_stream_perm is None \
+        else (-lr_stream_perm[2]) % row_shards
     dev_chunks = []
     carry = None
     for cx in chunk_iter:
@@ -1153,7 +1162,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         carry = b[keep:].copy()    # view would pin the whole chunk
         if keep:
             dev_chunks.append(put_bins(b[:keep]))
-    tail_rows = (len(carry) if carry is not None else 0) + pad
+    tail_rows = (len(carry) if carry is not None else 0) + stream_pad
     if tail_rows:
         pad_f = bundler.num_bundles if bundler is not None else F
         tail = np.zeros((tail_rows, pad_f), bin_dt)
@@ -1166,6 +1175,24 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         stacked = dev_chunks[0]
     bins_t = finish_bins(stacked)
     del dev_chunks, stacked
+    if lr_stream_perm is not None:
+        # device-side whole-group packing: gather source-order columns
+        # into the per-shard slabs; pad slots get the NaN row's bins
+        # (bin 0 per feature, through the bundler when EFB is on) so the
+        # packed matrix is bit-identical to the in-memory path's
+        pc_h, valid_h, _n_src = lr_stream_perm
+        pad_bins = bin_eff(np.full((1, F), np.nan, np.float32))[0]
+        pc_d = jnp.asarray(pc_h.astype(np.int32))
+        valid_d = jnp.asarray(valid_h)
+        pad_d = jnp.asarray(pad_bins.astype(np.int32))
+
+        def _pack(b):
+            out = jnp.where(valid_d[None, :], jnp.take(b, pc_d, axis=1),
+                            pad_d[:, None])
+            if bins_spec is not None:
+                out = jax.lax.with_sharding_constraint(out, bins_spec)
+            return out
+        bins_t = jax.jit(_pack)(bins_t)
     measures.binning_s += _time.perf_counter() - _t_bin2
     labels = put(labels_np, 1)
     if sample_weight is None and not w_scaled:

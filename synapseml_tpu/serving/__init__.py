@@ -1,7 +1,9 @@
 """Model serving (reference: core Spark Serving layer)."""
 
+from .distributed import DistributedServingServer, exchange_routing_table
 from .server import (ApiHandle, MultiPipelineServer, PipelineServer,
                      ServingReply, ServingRequest, ServingServer)
 
-__all__ = ["ApiHandle", "MultiPipelineServer", "PipelineServer",
-           "ServingReply", "ServingRequest", "ServingServer"]
+__all__ = ["ApiHandle", "DistributedServingServer", "MultiPipelineServer",
+           "PipelineServer", "ServingReply", "ServingRequest",
+           "ServingServer", "exchange_routing_table"]

@@ -1,0 +1,116 @@
+"""Distributed serving: one listener per host of a multi-host mesh with a
+shared routing table.
+
+The reference ships one HTTP server per executor JVM and a driver-held
+service registry so a front door can reach every partition's server
+(reference: DistributedHTTPSource.scala:88,203, HTTPSourceV2 ServiceInfo).
+The TPU-native analogue: every PROCESS of the cluster starts a local
+:class:`~synapseml_tpu.serving.server.ServingServer`, and the routing
+table is rendezvoused over the mesh itself — each process contributes its
+``(ip, port)`` through an ``all_gather`` over the data axis, so the same
+collective fabric that carries training gradients also publishes the
+serving topology.  Any rank (or an external balancer) can then route
+requests to every host.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .server import ServingServer
+
+
+def _encode_addr(host: str, port: int) -> Tuple[int, int]:
+    """(ip4 as uint32, port) — what rides the collective."""
+    packed = struct.unpack("!I", socket.inet_aton(socket.gethostbyname(host)))
+    return int(packed[0]), int(port)
+
+
+def _decode_addr(ip_u32: int, port: int) -> Tuple[str, int]:
+    return socket.inet_ntoa(struct.pack("!I", int(ip_u32) & 0xffffffff)), \
+        int(port)
+
+
+def exchange_routing_table(host: str, port: int) -> List[Tuple[str, int]]:
+    """All-gather this process's listener address over the global device
+    mesh → ``[(host, port)]`` indexed by process.  Single-process: the
+    local address alone (no collective)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if jax.process_count() == 1:
+        return [(host, port)]
+    from ..parallel.mesh import DATA_AXIS
+    from ..parallel.collectives import all_gather, shard_map_over
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), (DATA_AXIS,))
+    n = len(devs)
+    ip_u32, port_i = _encode_addr(host, port)
+    # each DEVICE row carries its owning process's (ip, port, process_idx)
+    my_proc = jax.process_index()
+    local = np.array([[ip_u32, port_i, my_proc]] *
+                     jax.local_device_count(), dtype=np.int64)
+    # int32 collective: the ip splits into 16-bit halves (each fits int32
+    # unmasked — masking bit 31 would corrupt addresses >= 128.0.0.0)
+    rows = np.stack([local[:, 0] >> 16, local[:, 0] & 0xffff,
+                     local[:, 1], local[:, 2]], axis=1).astype(np.int32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(DATA_AXIS)), rows, (n, 4))
+    gathered = jax.jit(shard_map_over(mesh, P(DATA_AXIS), P(DATA_AXIS))(
+        lambda x: all_gather(x, tiled=True)))(garr)
+    table_rows = np.asarray(
+        jax.device_get(gathered.addressable_shards[0].data))[:n]
+    by_proc: Dict[int, Tuple[str, int]] = {}
+    for hi, lo, p_port, proc in table_rows:
+        ip = (int(hi) << 16) | (int(lo) & 0xffff)
+        by_proc[int(proc)] = _decode_addr(ip, p_port)
+    return [by_proc[i] for i in sorted(by_proc)]
+
+
+class DistributedServingServer:
+    """One listener on THIS host plus the cluster-wide routing table.
+
+    Start one per process of an initialized cluster; every instance knows
+    every host's listener address (``routing_table``), so requests can be
+    balanced across the whole mesh while each host's pipeline serves its
+    local replica.  Matches the role of one-server-per-executor
+    distributed serving (DistributedHTTPSource.scala:88)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", reply_timeout_s: float = 30.0,
+                 max_queue: int = 1024,
+                 max_body_bytes: int = 16 * 1024 * 1024):
+        self.local = ServingServer(host=host, port=port, api_path=api_path,
+                                   reply_timeout_s=reply_timeout_s,
+                                   max_queue=max_queue,
+                                   max_body_bytes=max_body_bytes)
+        lh, lp = self.local.address
+        self.routing_table = exchange_routing_table(lh, lp)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.local.address
+
+    def url_for_rank(self, rank: int, path: str = "/") -> str:
+        h, p = self.routing_table[rank]
+        path = path.rstrip("/") or "/"
+        return f"http://{h}:{p}{'' if path == '/' else path}"
+
+    # local-API passthroughs
+    def register_api(self, *a, **kw):
+        return self.local.register_api(*a, **kw)
+
+    def get_batch(self, *a, **kw):
+        return self.local.get_batch(*a, **kw)
+
+    def reply(self, *a, **kw):
+        return self.local.reply(*a, **kw)
+
+    def close(self) -> None:
+        self.local.close()

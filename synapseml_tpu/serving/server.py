@@ -151,7 +151,11 @@ class ServingServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout_s: float = 30.0,
-                 max_queue: int = 1024):
+                 max_queue: int = 1024,
+                 max_body_bytes: int = 16 * 1024 * 1024):
+        #: requests larger than this answer 413 and close — an unbounded
+        #: readexactly would let one request allocate arbitrary memory
+        self.max_body_bytes = max_body_bytes
         self.api_path = api_path.rstrip("/") or "/"
         self._apis: Dict[str, ApiHandle] = {}
         self._apis_lock = threading.Lock()
@@ -224,15 +228,24 @@ class ServingServer:
                         break
                     k, _, v = h.decode("latin1").partition(":")
                     headers[k.strip().lower()] = v.strip()
-                try:
-                    length = int(headers.get("content-length", 0) or 0)
-                except ValueError:
-                    writer.write(b"HTTP/1.1 400 Bad Request\r\n"
-                                 b"Content-Length: 0\r\n"
-                                 b"Connection: close\r\n\r\n")
-                    await writer.drain()
-                    break
-                body = await reader.readexactly(length) if length else b""
+                te = headers.get("transfer-encoding", "").lower()
+                if "chunked" in te:
+                    body = await self._read_chunked(reader, writer)
+                    if body is None:       # oversize: 413 already written
+                        break
+                else:
+                    try:
+                        length = int(headers.get("content-length", 0) or 0)
+                    except ValueError:
+                        writer.write(b"HTTP/1.1 400 Bad Request\r\n"
+                                     b"Content-Length: 0\r\n"
+                                     b"Connection: close\r\n\r\n")
+                        await writer.drain()
+                        break
+                    if length > self.max_body_bytes:
+                        await self._write_413(writer)
+                        break
+                    body = await reader.readexactly(length) if length else b""
                 status, rbody, rheaders = await self._dispatch(
                     method, path, headers, body)
                 keep = headers.get("connection", "").lower() != "close"
@@ -244,12 +257,40 @@ class ServingServer:
                     ctype_set = ctype_set or k.lower() == "content-type"
                 if not ctype_set:
                     head.append("Content-Type: application/json")
-                head.append(f"Content-Length: {len(rbody)}")
-                head.append("Connection: " + ("keep-alive" if keep
-                                              else "close"))
-                writer.write(("\r\n".join(head) + "\r\n\r\n")
-                             .encode("latin1") + rbody)
-                await writer.drain()
+                if isinstance(rbody, (bytes, bytearray)):
+                    head.append(f"Content-Length: {len(rbody)}")
+                    head.append("Connection: " + ("keep-alive" if keep
+                                                  else "close"))
+                    writer.write(("\r\n".join(head) + "\r\n\r\n")
+                                 .encode("latin1") + bytes(rbody))
+                    await writer.drain()
+                else:
+                    # streaming reply: an ITERABLE body goes out with
+                    # chunked transfer-encoding (the reference's
+                    # continuous-mode reply stream)
+                    head.append("Transfer-Encoding: chunked")
+                    head.append("Connection: " + ("keep-alive" if keep
+                                                  else "close"))
+                    writer.write(("\r\n".join(head) + "\r\n\r\n")
+                                 .encode("latin1"))
+                    # pull chunks on a worker thread: a generator that
+                    # blocks between yields (live token streams) must not
+                    # stall the event loop for every other connection
+                    it = iter(rbody)
+                    _end = object()
+                    while True:
+                        chunk = await self._loop.run_in_executor(
+                            None, next, it, _end)
+                        if chunk is _end:
+                            break
+                        chunk = bytes(chunk)
+                        if not chunk:
+                            continue
+                        writer.write(f"{len(chunk):x}\r\n".encode("latin1")
+                                     + chunk + b"\r\n")
+                        await writer.drain()
+                    writer.write(b"0\r\n\r\n")
+                    await writer.drain()
                 if not keep:
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError,
@@ -260,6 +301,39 @@ class ServingServer:
                 writer.close()
             except Exception:
                 pass
+
+    async def _write_413(self, writer: asyncio.StreamWriter) -> None:
+        writer.write(b"HTTP/1.1 413 Payload Too Large\r\n"
+                     b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+        await writer.drain()
+
+    async def _read_chunked(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> Optional[bytes]:
+        """Decode a chunked request body (size cap enforced; None ⇒ the
+        connection must close).  Trailer section is consumed and ignored."""
+        parts: List[bytes] = []
+        total = 0
+        while True:
+            line = await reader.readline()
+            if not line:
+                # EOF mid-body: a truncated upload must NOT dispatch as a
+                # complete request (the Content-Length path's
+                # IncompleteReadError equivalent)
+                raise asyncio.IncompleteReadError(b"", None)
+            size = int(line.split(b";")[0].strip() or b"0", 16)
+            if size == 0:
+                break
+            total += size
+            if total > self.max_body_bytes:
+                await self._write_413(writer)
+                return None
+            parts.append(await reader.readexactly(size))
+            await reader.readexactly(2)                # chunk CRLF
+        while True:                                    # trailers
+            t = await reader.readline()
+            if t in (b"\r\n", b"\n", b""):
+                break
+        return b"".join(parts)
 
     async def _dispatch(self, method: str, path: str,
                         headers: Dict[str, str], body: bytes):

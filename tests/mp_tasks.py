@@ -18,6 +18,59 @@ def _binary_data(n=2000, f=12, seed=7):
     return X, y
 
 
+def distributed_serving_roundtrip(args):
+    """Each rank: DistributedServingServer + echo pipeline; rank 0 routes
+    one request to EVERY rank via the gathered routing table."""
+    import json
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from synapseml_tpu.parallel.collectives import psum, shard_map_over
+    from synapseml_tpu.parallel.mesh import DATA_AXIS
+    from synapseml_tpu.serving import DistributedServingServer, ServingReply
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), (DATA_AXIS,))
+
+    def barrier():
+        one = jnp.ones((len(devs),), jnp.float32)
+        out = jax.jit(shard_map_over(mesh, P(DATA_AXIS), P(DATA_AXIS))(
+            psum))(one)
+        assert float(np.asarray(out.addressable_shards[0].data)[0]) == len(devs)
+
+    rank = jax.process_index()
+    srv = DistributedServingServer()
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            for req in srv.get_batch(max_rows=8, timeout_s=0.05):
+                srv.reply(req.id, ServingReply(200, json.dumps(
+                    {"rank": rank, "echo": req.json()["x"]}).encode()))
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    barrier()                      # every rank's listener is up
+    results = []
+    if rank == 0:
+        for r in range(len(srv.routing_table)):
+            body = json.dumps({"x": r * 10}).encode()
+            rep = urllib.request.urlopen(urllib.request.Request(
+                srv.url_for_rank(r), data=body), timeout=10).read()
+            results.append(json.loads(rep))
+    barrier()                      # replies done before any rank closes
+    stop.set()
+    t.join(timeout=5)
+    srv.close()
+    return {"rank": rank,
+            "table": [[h, p] for h, p in srv.routing_table],
+            "results": results}
+
+
 def gbdt_fit_digest(args):
     """Fit a GBDT over ALL global devices; return a bit-exact model digest.
 

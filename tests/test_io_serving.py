@@ -161,6 +161,74 @@ class TestServingServer:
         finally:
             server.close()
 
+    def test_chunked_request_body(self):
+        """Transfer-Encoding: chunked requests decode into the same
+        ServingRequest body a Content-Length request produces (previously
+        a chunked body desynced the keep-alive parser)."""
+        import socket
+        server = ServingServer()
+        try:
+            results = {}
+
+            def client():
+                h, p = server.address
+                s = socket.create_connection((h, p), timeout=10)
+                payload = [b'{"x"', b': 42}']
+                msg = (b"POST / HTTP/1.1\r\nHost: x\r\n"
+                       b"Transfer-Encoding: chunked\r\n\r\n")
+                for c in payload:
+                    msg += f"{len(c):x}\r\n".encode() + c + b"\r\n"
+                msg += b"0\r\n\r\n"
+                s.sendall(msg)
+                results["raw"] = s.recv(65536)
+                s.close()
+
+            t = threading.Thread(target=client)
+            t.start()
+            batch = server.get_batch(max_rows=1, timeout_s=5.0)
+            assert len(batch) == 1
+            assert batch[0].json() == {"x": 42}
+            assert server.reply(batch[0].id, ServingReply(200, b"ok"))
+            t.join(timeout=10)
+            assert b"200" in results["raw"] and results["raw"].endswith(b"ok")
+        finally:
+            server.close()
+
+    def test_oversize_body_413(self):
+        import urllib.error
+        server = ServingServer(max_body_bytes=64)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    server.url, data=b"x" * 100), timeout=5)
+            assert ei.value.code == 413
+        finally:
+            server.close()
+
+    def test_streaming_chunked_reply(self):
+        """An iterable reply body streams out with chunked
+        transfer-encoding; urllib reassembles it transparently."""
+        server = ServingServer()
+        try:
+            results = {}
+
+            def client():
+                req = urllib.request.Request(server.url, data=b'{"x":1}')
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    results["te"] = r.headers.get("Transfer-Encoding")
+                    results["body"] = r.read()
+
+            t = threading.Thread(target=client)
+            t.start()
+            batch = server.get_batch(max_rows=1, timeout_s=5.0)
+            chunks = (bytes([65 + i]) * 4 for i in range(3))
+            assert server.reply(batch[0].id, ServingReply(200, chunks))
+            t.join(timeout=10)
+            assert results["te"] == "chunked"
+            assert results["body"] == b"AAAABBBBCCCC"
+        finally:
+            server.close()
+
     def test_timeout_504(self):
         server = ServingServer(reply_timeout_s=0.2)
         try:

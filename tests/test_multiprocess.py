@@ -63,6 +63,22 @@ def test_gbdt_dp_parity_one_process_vs_two():
     assert single[0]["margins"] == double[0]["margins"]
 
 
+def test_distributed_serving_two_processes():
+    """One listener per host of a 2-process mesh, routing table gathered
+    over the mesh's own collectives; rank 0 routes a request to BOTH
+    hosts and each answers with its own rank; clean drain on close
+    (the DistributedHTTPSource.scala:88,203 analogue executing)."""
+    results = run_on_local_cluster(
+        "mp_tasks:distributed_serving_roundtrip",
+        n_processes=2, devices_per_process=2, timeout_s=420)
+    assert len(results) == 2
+    r0, r1 = results
+    assert r0["table"] == r1["table"] and len(r0["table"]) == 2
+    assert [r["rank"] for r in r0["results"]] == [0, 1]
+    assert [r["echo"] for r in r0["results"]] == [0, 10]
+    assert r1["results"] == []
+
+
 def test_worker_failure_surfaces_logs():
     with pytest.raises(WorkerFailure) as ei:
         run_on_local_cluster("mp_tasks:no_such_task",
