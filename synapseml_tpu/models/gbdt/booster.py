@@ -132,6 +132,7 @@ class BoostingConfig:
             voting_k=self.top_k if self.parallelism == "voting_parallel" else 0,
             monotone_constraints=mono,
             monotone_penalty=float(self.monotone_penalty),
+            monotone_method=self.monotone_constraints_method,
         )
 
 
@@ -188,13 +189,22 @@ class Booster:
         depth = self.depth_bound()
         bundled = None
         if self.bin_mapper.has_categorical:
-            # categorical models split in (ORIGINAL) bin space: bin, then
-            # traverse by split_bin instead of raw thresholds.  EFB models
-            # need nothing special — bundling only compresses histogram
-            # construction; their trees live in original feature space
-            # with raw thresholds (the LightGBM scheme)
-            binned = self.bin_mapper.transform(features)
-            bundled = jnp.asarray(binned.astype(np.int32))
+            if _placeholder_mapper(self.bin_mapper):
+                # imported LightGBM categorical model: numeric bounds are
+                # placeholders so numeric nodes keep RAW thresholds, while
+                # categorical columns map to their (float) bin ids — the
+                # import already rewrote cat thresholds to bin space, so
+                # one uniform x <= thr traversal serves both node kinds
+                features = self._cat_columns_to_bins(features)
+            else:
+                # categorical models split in (ORIGINAL) bin space: bin,
+                # then traverse by split_bin instead of raw thresholds.
+                # EFB models need nothing special — bundling only
+                # compresses histogram construction; their trees live in
+                # original feature space with raw thresholds (the
+                # LightGBM scheme)
+                binned = self.bin_mapper.transform(features)
+                bundled = jnp.asarray(binned.astype(np.int32))
         outs, leaves = [], []
         for k in range(self.num_class):
             stacked = self._stacked_for_class(k, num_iteration)
@@ -218,6 +228,24 @@ class Booster:
         if return_leaves:
             return margin, leaves
         return margin
+
+    def _cat_columns_to_bins(self, features: np.ndarray) -> np.ndarray:
+        """Imported-model hybrid view: categorical columns become their
+        bin ids (floats); numeric columns pass through unchanged.  Unseen
+        categories and NaN land in bin 0, which every bin-space split
+        (bin <= t, t >= 0) sends left — the exported complement-bitset
+        convention's missing direction."""
+        out = features.copy()
+        for f, (vals, bins) in (self.bin_mapper.cat_features or {}).items():
+            col = features[:, f]
+            if len(vals) == 0:
+                out[:, f] = 0.0
+                continue
+            idx = np.searchsorted(vals, col)
+            idx_c = np.minimum(idx, len(vals) - 1)
+            hit = np.asarray(vals)[idx_c] == col
+            out[:, f] = np.where(hit, np.asarray(bins)[idx_c], 0)
+        return out
 
     def predict_leaf(self, features: np.ndarray) -> np.ndarray:
         """Per-tree leaf index (n, num_trees) — predictLeaf analogue
@@ -252,7 +280,14 @@ class Booster:
         # bins); SHAP runs over the binned matrix with split_bin routing —
         # exact, since binning is a per-feature transform.  EFB models
         # need nothing special: their trees live in original feature space
-        bin_space = self.bin_mapper.has_categorical
+        imported_cat = (self.bin_mapper.has_categorical
+                        and _placeholder_mapper(self.bin_mapper))
+        bin_space = self.bin_mapper.has_categorical and not imported_cat
+        if imported_cat:
+            # imported categorical model: hybrid view (cat columns as bin
+            # ids, numeric raw) with thresholds already rewritten at import
+            features = self._cat_columns_to_bins(
+                np.ascontiguousarray(features, np.float32))
         from .shap import has_cover_counts, tree_shap_values
         if not approximate and has_cover_counts(self):
             return tree_shap_values(self, features, bin_space=bin_space)
@@ -341,12 +376,10 @@ class Booster:
     def to_string(self) -> str:
         """LightGBM text model format (saveToString parity,
         LightGBMBooster.scala:272-284) — loadable by any LightGBM runtime.
-        The JSON form (:meth:`to_dict`) remains the internal format."""
-        if self.bin_mapper.has_categorical:
-            raise NotImplementedError(
-                "categorical models have no LightGBM text representation "
-                "here (splits live in bin space); persist via "
-                "save()/to_dict()")
+        Categorical splits export as native bitset thresholds (the
+        complement set with children swapped, so unseen/missing categories
+        route identically); the JSON form (:meth:`to_dict`) remains the
+        internal format."""
         from .lgbm_format import booster_to_lgbm_string
         return booster_to_lgbm_string(self)
 
@@ -638,8 +671,17 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
 
 
 @functools.partial(jax.jit, static_argnames=("depth_bound",))
-def _predict_binned_tree(bins_t, tree: Tree, depth_bound: int):
-    """Leaf values of one tree on (F, N) binned features (dart/valid eval)."""
+def _predict_binned_tree(bins_t, tree: Tree, depth_bound: int,
+                         bundle_map=None, total_bins: int = 1 << 20):
+    """Leaf values of one tree on (F, N) binned features (dart/valid eval).
+
+    ``bundle_map``: when the device matrix is EFB-BUNDLED, trees still
+    live in ORIGINAL feature space — each node's split routes through the
+    same universal form training uses (``x in (rlo, rhi] ? x <= t1 :
+    default``, trainer._slot_route_params), so dart rescoring traverses
+    the bundled matrix exactly."""
+    from .trainer import _route_left, _slot_route_params
+
     N = bins_t.shape[1]
     rows = jnp.arange(N)
 
@@ -647,7 +689,9 @@ def _predict_binned_tree(bins_t, tree: Tree, depth_bound: int):
         feat = tree.split_feature[node]
         is_leaf = feat < 0
         f = jnp.maximum(feat, 0)
-        go_left = bins_t[f, rows] <= tree.split_bin[node]
+        col, t1, rlo, rhi, dflt = _slot_route_params(
+            f, tree.split_bin[node], total_bins, bundle_map)
+        go_left = _route_left(bins_t[col, rows], t1, rlo, rhi, dflt)
         child = jnp.where(go_left, tree.left_child[node], tree.right_child[node])
         return jnp.where(is_leaf, node, child)
 
@@ -782,11 +826,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 config, num_iterations=config.num_iterations - done)
             init_model = resumed
     if config.enable_bundle:
-        if config.boosting_type == "dart":
-            raise NotImplementedError(
-                "enable_bundle + dart: dart rescoring traverses the "
-                "BUNDLED device matrix, but EFB trees live in original "
-                "feature space; use gbdt/goss/rf")
         if config.parallelism == "voting_parallel":
             raise NotImplementedError(
                 "enable_bundle + voting_parallel: feature votes are "
@@ -807,13 +846,19 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         n, F = X.shape
 
     if config.monotone_constraints and any(config.monotone_constraints):
-        if config.monotone_constraints_method != "basic":
+        if config.monotone_constraints_method not in ("basic",
+                                                      "intermediate"):
             raise NotImplementedError(
                 f"monotone_constraints_method="
-                f"{config.monotone_constraints_method!r}: only 'basic' "
-                "(LightGBM's default) is implemented; the 'intermediate'/"
-                "'advanced' refinements relax different splits and would "
-                "silently change semantics")
+                f"{config.monotone_constraints_method!r}: 'basic' and "
+                "'intermediate' are implemented; 'advanced' relaxes "
+                "different splits and would silently change semantics")
+        if (config.monotone_constraints_method == "intermediate"
+                and config.parallelism == "feature_parallel"):
+            raise NotImplementedError(
+                "monotone intermediate + feature_parallel: the whole-tree "
+                "bounds refresh needs every feature's picks re-evaluated "
+                "globally; use data_parallel/voting_parallel or basic")
         if len(config.monotone_constraints) != F:
             raise ValueError(
                 f"monotone_constraints has "
@@ -1418,7 +1463,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             dropped = list(np.nonzero(drop_mask)[0][:config.max_drop])
             for d in dropped:
                 contrib = _predict_binned_tree(bins_t, _to_device_tree(trees[d]),
-                                               depth_hint) * tree_weights[d]
+                                               depth_hint,
+                                               bundle_map_dev, B_total) * tree_weights[d]
                 scores = _sub_scores(scores, contrib, tree_class[d], K)
 
         # mask to 32 bits so looped and scanned runs derive identical keys
@@ -1445,14 +1491,16 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             factor = ndrop / (ndrop + 1)
             for k in range(K):
                 contrib = _predict_binned_tree(bins_t, _to_device_tree(new_trees[k]),
-                                               depth_hint) * new_w
+                                               depth_hint,
+                                               bundle_map_dev, B_total) * new_w
                 scores = _add_scores(scores, contrib, k, K)
             for d in dropped:
                 old_w = tree_weights[d]
                 tree_weights[d] = old_w * factor
                 dropped_weight_changes.append((d, old_w))
                 contrib = _predict_binned_tree(bins_t, _to_device_tree(trees[d]),
-                                               depth_hint) * tree_weights[d]
+                                               depth_hint,
+                                               bundle_map_dev, B_total) * tree_weights[d]
                 scores = _add_scores(scores, contrib, tree_class[d], K)
             weights_new = [new_w] * K
         else:

@@ -57,6 +57,11 @@ class GrowthParams(NamedTuple):
     #: gain penalization for splits on constrained features near the root
     #: (LightGBM ``monotone_penalty``, BaseTrainParams.scala:128-130)
     monotone_penalty: float = 0.0
+    #: "basic" (midpoint bound propagation) | "intermediate" (bounds from
+    #: the opposite sibling SUBTREE's current extreme outputs, recomputed
+    #: over the whole tree each wave — much less constraining, LightGBM's
+    #: recommended upgrade)
+    monotone_method: str = "basic"
 
 
 class Tree(NamedTuple):
@@ -240,6 +245,82 @@ def _mono_child_bounds(cf, lo, hi, wl, wr):
     r_lo = jnp.where(cf == 1, jnp.maximum(lo, mid), lo)
     r_hi = jnp.where(cf == -1, jnp.minimum(hi, mid), hi)
     return l_lo, l_hi, r_lo, r_hi
+
+
+def _intermediate_bounds(split_feature, left_child, right_child,
+                         raw_value, mono_c, n_iters: int = 4):
+    """Intermediate-method bounds: per-node [lo, hi] where a constrained
+    split bounds each child SUBTREE by the opposite subtree's extreme
+    CURRENT output (LightGBM's IntermediateLeafConstraints semantics)
+    instead of the midpoint.  Because clamping values moves the extremes,
+    (bounds ← tree extremes, values ← clip(raw, bounds)) iterates to a
+    fixed point — children always carry higher indices than parents in
+    every grower here, so one backward and one forward scan per round.
+
+    Returns (lo, hi, clamped_value), each (M,)."""
+    M = split_feature.shape[0]
+    JUNK = M                                 # scratch slot for leaf writes
+
+    def one_round(carry, _):
+        val = carry
+        # backward: subtree min/max of current (clamped) outputs
+        smin = jnp.where(left_child < 0, val, jnp.inf)
+        smax = jnp.where(left_child < 0, val, -jnp.inf)
+
+        def back(i, mm):
+            mn, mx = mm
+            j = M - 1 - i
+            l = jnp.maximum(left_child[j], 0)
+            r = jnp.maximum(right_child[j], 0)
+            internal = left_child[j] >= 0
+            mn = mn.at[j].set(jnp.where(internal,
+                                        jnp.minimum(mn[l], mn[r]), mn[j]))
+            mx = mx.at[j].set(jnp.where(internal,
+                                        jnp.maximum(mx[l], mx[r]), mx[j]))
+            return mn, mx
+
+        smin, smax = lax.fori_loop(0, M, back, (smin, smax))
+
+        # forward: bounds flow root → children (scratch slot absorbs leaf
+        # writes)
+        lo = jnp.full(M + 1, -jnp.inf)
+        hi = jnp.full(M + 1, jnp.inf)
+
+        def fwd(j, bounds):
+            lo, hi = bounds
+            lraw, rraw = left_child[j], right_child[j]
+            internal = lraw >= 0
+            l = jnp.where(internal, lraw, JUNK)
+            r = jnp.where(internal, rraw, JUNK)
+            ls, rs = jnp.maximum(lraw, 0), jnp.maximum(rraw, 0)
+            c = jnp.where(internal,
+                          mono_c[jnp.maximum(split_feature[j], 0)], 0)
+            l_lo, l_hi = lo[j], hi[j]
+            r_lo, r_hi = lo[j], hi[j]
+            l_hi = jnp.where(c == 1, jnp.minimum(l_hi, smin[rs]), l_hi)
+            r_lo = jnp.where(c == 1, jnp.maximum(r_lo, smax[ls]), r_lo)
+            l_lo = jnp.where(c == -1, jnp.maximum(l_lo, smax[rs]), l_lo)
+            r_hi = jnp.where(c == -1, jnp.minimum(r_hi, smin[ls]), r_hi)
+            lo = lo.at[l].set(l_lo).at[r].set(r_lo)
+            hi = hi.at[l].set(l_hi).at[r].set(r_hi)
+            # scrub the scratch slot so junk writes never leak
+            return (lo.at[JUNK].set(-jnp.inf), hi.at[JUNK].set(jnp.inf))
+
+        lo, hi = lax.fori_loop(0, M, fwd, (lo, hi))
+        lo, hi = lo[:M], hi[:M]
+        return jnp.clip(raw_value, lo, hi), (lo, hi)
+
+    val, (los, his) = lax.scan(one_round, raw_value, None, length=n_iters)
+    return los[-1], his[-1], val
+
+
+def _refresh_intermediate(s, mono_c, p: "GrowthParams"):
+    """Replace a grower state's node bounds with intermediate-method
+    bounds recomputed over the whole current tree."""
+    raw = _leaf_output(s["sum_g"], s["sum_h"], p.lambda_l1, p.lambda_l2)
+    lo, hi, _ = _intermediate_bounds(s["split_feature"], s["left_child"],
+                                     s["right_child"], raw, mono_c)
+    return dict(s, node_lo=lo, node_hi=hi)
 
 
 def _mono_node_bounds(mono_cf, p_lo, p_hi, lg, lh, rg, rh, p):
@@ -486,17 +567,46 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
             node_hi=s["node_hi"].at[l_id].set(l_hi).at[r_id].set(r_hi),
         )
 
+    def maybe_intermediate_split(s):
+        out = do_split(s)
+        if mono_c is None or p.monotone_method != "intermediate":
+            return out
+        # intermediate: bounds come from the OPPOSITE subtree's extremes
+        # over the whole current tree; the fresh children re-pick under
+        # the refreshed (looser) bounds
+        out = _refresh_intermediate(out, mono_c, p)
+        l_id, r_id = out["num_nodes"] - 2, out["num_nodes"] - 1
+        for cid in (l_id, r_id):
+            chist = out["hist"][out["slot"][cid]].reshape(F, B, 3)
+            cbg, cbf, cbb, cbgl, cbhl, cbcl = pick(
+                chist, out["sum_g"][cid], out["sum_h"][cid],
+                out["sum_c"][cid], out["depth"][cid],
+                out["node_lo"][cid], out["node_hi"][cid])
+            out["best_gain"] = out["best_gain"].at[cid].set(cbg)
+            out["best_feat"] = out["best_feat"].at[cid].set(cbf)
+            out["best_bin"] = out["best_bin"].at[cid].set(cbb)
+            out["best_gl"] = out["best_gl"].at[cid].set(cbgl)
+            out["best_hl"] = out["best_hl"].at[cid].set(cbhl)
+            out["best_cl"] = out["best_cl"].at[cid].set(cbcl)
+        return out
+
     def body(_, s):
         gains = jnp.where(s["active"], s["best_gain"], -jnp.inf)
         can_split = jnp.max(gains) > p.min_gain_to_split
-        return lax.cond(can_split, do_split, lambda x: x, s)
+        return lax.cond(can_split, maybe_intermediate_split, lambda x: x, s)
 
     state = lax.fori_loop(0, L - 1, body, state)
 
     node_value = _leaf_output(state["sum_g"], state["sum_h"],
                               p.lambda_l1, p.lambda_l2)
     if mono_c is not None:
-        node_value = jnp.clip(node_value, state["node_lo"], state["node_hi"])
+        if p.monotone_method == "intermediate":
+            _, _, node_value = _intermediate_bounds(
+                state["split_feature"], state["left_child"],
+                state["right_child"], node_value, mono_c, n_iters=6)
+        else:
+            node_value = jnp.clip(node_value, state["node_lo"],
+                                  state["node_hi"])
     node_value = learning_rate * node_value
     leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
 
@@ -857,6 +967,20 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             node_lo=s["node_lo"].at[cids].set(c_lo),
             node_hi=s["node_hi"].at[cids].set(c_hi),
         )
+        if mono_c is not None and p.monotone_method == "intermediate":
+            # intermediate: bounds from opposite-subtree extremes over the
+            # whole tree; this wave's children re-pick under the refreshed
+            # (looser-than-midpoint) bounds
+            out = _refresh_intermediate(out, mono_c, p)
+            cbg2, cbf2, cbb2, cbgl2, cbhl2, cbcl2 = vpick(
+                unb(child_hists, cg, ch, cc), cg, ch, cc, cd,
+                out["node_lo"][cids], out["node_hi"][cids])
+            out["best_gain"] = out["best_gain"].at[cids].set(cbg2)
+            out["best_feat"] = out["best_feat"].at[cids].set(cbf2)
+            out["best_bin"] = out["best_bin"].at[cids].set(cbb2)
+            out["best_gl"] = out["best_gl"].at[cids].set(cbgl2)
+            out["best_hl"] = out["best_hl"].at[cids].set(cbhl2)
+            out["best_cl"] = out["best_cl"].at[cids].set(cbcl2)
         # the junk row absorbed every masked-out write; scrub it
         out["active"] = out["active"].at[JUNK].set(False)
         out["best_gain"] = out["best_gain"].at[JUNK].set(-jnp.inf)
@@ -870,7 +994,13 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     node_value = _leaf_output(state["sum_g"], state["sum_h"],
                               p.lambda_l1, p.lambda_l2)
     if mono_c is not None:
-        node_value = jnp.clip(node_value, state["node_lo"], state["node_hi"])
+        if p.monotone_method == "intermediate":
+            _, _, node_value = _intermediate_bounds(
+                state["split_feature"], state["left_child"],
+                state["right_child"], node_value, mono_c, n_iters=6)
+        else:
+            node_value = jnp.clip(node_value, state["node_lo"],
+                                  state["node_hi"])
     node_value = learning_rate * node_value
     leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
     tree = Tree(split_feature=state["split_feature"],

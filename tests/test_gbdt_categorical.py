@@ -65,9 +65,9 @@ def test_categorical_unseen_category_and_roundtrip():
     contrib = b.predict_contrib(X[:16])
     np.testing.assert_allclose(contrib.sum(1), b.predict_margin(X[:16]),
                                rtol=1e-4, atol=1e-4)
-    # raw-threshold export still rejects loudly
-    with pytest.raises(NotImplementedError):
-        b.to_string()
+    # LightGBM text export works for categorical models now (bitset
+    # thresholds) — the round-trip test covers exactness
+    assert "num_cat=" in b.to_string()
 
 
 def test_categorical_distributed_parity():
@@ -181,3 +181,75 @@ def test_categorical_shap_matches_brute_force():
                     phi[f] += wgt * (cond_exp(binned[r], frozenset(S) | {f})
                                      - cond_exp(binned[r], frozenset(S)))
         np.testing.assert_allclose(contrib[r], phi, rtol=1e-4, atol=1e-5)
+
+
+def test_categorical_lgbm_text_roundtrip():
+    """Categorical model → LightGBM text (native bitset thresholds) →
+    re-import → IDENTICAL predictions, raw margins and SHAP included.
+    The export writes the complement set with children swapped so
+    unseen/missing categories route the same on both sides; the
+    feature_infos category list carries the target-ordered bin order
+    (previously: NotImplementedError at to_string)."""
+    X, y = cat_data()
+    cfg = BoostingConfig(objective="binary", num_iterations=10,
+                         num_leaves=7, learning_rate=0.3,
+                         min_data_in_leaf=5, categorical_feature=[0, 1])
+    b, _ = train(X, y, cfg)
+    text = b.to_string()
+    assert "num_cat=" in text and "cat_threshold=" in text
+    b2 = Booster.from_string(text)
+    np.testing.assert_allclose(b.predict_margin(X), b2.predict_margin(X),
+                               rtol=1e-5, atol=1e-5)
+    # UNSEEN category codes + NaN route identically (both land in the
+    # missing bin and follow the complement-bitset fallthrough)
+    Xu = X[:64].copy()
+    Xu[:, 0] = 99.0
+    Xu[10:20, 1] = np.nan
+    np.testing.assert_allclose(b.predict_margin(Xu), b2.predict_margin(Xu),
+                               rtol=1e-5, atol=1e-5)
+    # SHAP survives the round trip (covers exported via *_count)
+    s1 = b.predict_contrib(X[:32])
+    s2 = b2.predict_contrib(X[:32])
+    np.testing.assert_allclose(s1, s2, rtol=1e-4, atol=1e-4)
+
+
+def test_foreign_arbitrary_bitset_rejected():
+    """A genuine LightGBM file whose category subset is NOT a contiguous
+    suffix of our target-ordered bins cannot be represented by bin-range
+    routing — rejected with a clear message instead of silently wrong."""
+    model = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=binary sigmoid:1
+feature_names=c0 f1
+feature_infos=0:1:2:3 [-1e+308:1e+308]
+tree_sizes=200
+
+Tree=0
+num_leaves=2
+num_cat=1
+split_feature=0
+split_gain=1
+threshold=0
+decision_type=1
+left_child=-1
+right_child=-2
+cat_boundaries=0 1
+cat_threshold=5
+leaf_value=0.1 -0.1
+leaf_weight=0 0
+leaf_count=10 10
+internal_value=0
+internal_weight=0
+internal_count=20
+is_linear=0
+shrinkage=0.3
+
+end of trees
+"""
+    # bitset 5 = values {0, 2}: bins {1, 3} — not a suffix of {1..4}
+    with pytest.raises(ValueError, match="contiguous suffix"):
+        Booster.from_string(model)

@@ -193,3 +193,26 @@ def test_efb_composes_with_monotone():
     m = b.predict_margin(probes).reshape(8, 48)
     viol = float(-np.minimum(np.diff(m, axis=1), 0).min())
     assert viol <= 1e-6, viol
+
+
+def test_efb_dart_matches_unbundled_dart():
+    """EFB x dart (previously rejected): dart's drop/rescore traverses
+    the BUNDLED device matrix through the universal routing form, so
+    bundled dart grows the same trees and predicts like unbundled dart."""
+    X, y = onehot_data(n=2500)
+    kw = dict(objective="binary", num_iterations=10, num_leaves=15,
+              min_data_in_leaf=5, boosting_type="dart",
+              drop_rate=0.3, skip_drop=0.2, seed=11)
+    b_plain, _ = train(X, y, BoostingConfig(**kw))
+    b_efb, _ = train(X, y, BoostingConfig(enable_bundle=True, **kw))
+    assert b_efb.bundler is not None
+    # identical drop decisions (same host rng seed) + exact bundled
+    # traversal => same tree sequence; predictions equal to accumulation
+    # noise (the bundled histogram's different f32 summation order)
+    for t_p, t_e in zip(b_plain.trees, b_efb.trees):
+        np.testing.assert_array_equal(np.asarray(t_p.split_feature),
+                                      np.asarray(t_e.split_feature))
+    np.testing.assert_allclose(b_plain.predict_margin(X[:512]),
+                               b_efb.predict_margin(X[:512]), atol=2e-3)
+    a = auc(y, b_efb.predict_margin(X))
+    assert a > 0.85, a

@@ -156,10 +156,15 @@ def test_monotone_validation_errors():
     with pytest.raises(ValueError, match="-1, 0, or 1"):
         train(X, y, BoostingConfig(objective="regression", num_iterations=1,
                                    monotone_constraints=[2, 0, 0, 0]))
-    with pytest.raises(NotImplementedError, match="intermediate"):
+    with pytest.raises(NotImplementedError, match="advanced"):
         train(X, y, BoostingConfig(
             objective="regression", num_iterations=1,
             monotone_constraints=CONS,
+            monotone_constraints_method="advanced"))
+    with pytest.raises(NotImplementedError, match="feature_parallel"):
+        train(X, y, BoostingConfig(
+            objective="regression", num_iterations=1,
+            monotone_constraints=CONS, parallelism="feature_parallel",
             monotone_constraints_method="intermediate"))
     with pytest.raises(ValueError, match="categorical"):
         train(X, y, BoostingConfig(objective="regression", num_iterations=1,
@@ -176,3 +181,28 @@ def test_monotone_estimator_params():
                           monotoneConstraints=[1, -1, 0, 0]).fit(ds)
     b = model.booster
     assert max_violation(sweep_margins(b, 0), +1) <= 1e-6
+
+
+@pytest.mark.parametrize("policy", ["depthwise", "lossguide"])
+def test_intermediate_monotone_and_tighter_than_basic(policy):
+    """The intermediate method (LightGBM's recommended upgrade): bounds
+    come from the OPPOSITE subtree's current extremes instead of the
+    split midpoint — provably still monotone under the sweep, and a
+    BETTER fit than basic on the pinned task because the constraint is
+    looser (previously rejected with NotImplementedError)."""
+    X, y = mono_data()
+    kw = dict(objective="regression", num_iterations=30, num_leaves=15,
+              min_data_in_leaf=5, growth_policy=policy,
+              monotone_constraints=CONS)
+    b_basic, _ = train(X, y, BoostingConfig(
+        monotone_constraints_method="basic", **kw))
+    b_inter, _ = train(X, y, BoostingConfig(
+        monotone_constraints_method="intermediate", **kw))
+
+    # still PROVABLY monotone in both constrained directions
+    assert max_violation(sweep_margins(b_inter, 0), +1) <= 1e-6
+    assert max_violation(sweep_margins(b_inter, 1), -1) <= 1e-6
+    # and strictly less constraining: better training fit than basic
+    mse_basic = float(np.mean((b_basic.predict_margin(X) - y) ** 2))
+    mse_inter = float(np.mean((b_inter.predict_margin(X) - y) ** 2))
+    assert mse_inter < mse_basic - 1e-4, (mse_basic, mse_inter)
