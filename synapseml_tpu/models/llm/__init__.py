@@ -2,7 +2,8 @@
 reference's only LLM surface is remote OpenAI calls,
 cognitive/.../openai/OpenAI.scala:246)."""
 
-from .generate import (cast_params, generate, quantize_int8,
+from .generate import (cast_params, generate, generate_speculative,
+                       quantize_int8,
                        sample_logits)
 from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
@@ -13,7 +14,8 @@ from .stage import LLMTransformer
 __all__ = [
     "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LLMTransformer",
     "LlamaConfig", "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss",
-    "cast_params", "generate", "init_cache", "llama_from_pretrained",
+    "cast_params", "generate", "generate_speculative", "init_cache",
+    "llama_from_pretrained",
     "quantize_int8",
     "rope_frequencies", "sample_logits",
 ]
