@@ -11,7 +11,8 @@ import pytest
 EXAMPLES = ["gbdt_classification", "online_learning", "deep_learning",
             "explainability", "serving", "onnx_inference",
             "lightgbm_interop", "streaming_out_of_core",
-            "multi_endpoint_serving"]
+            "multi_endpoint_serving", "multiprocess_cluster",
+            "speculative_decoding"]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
