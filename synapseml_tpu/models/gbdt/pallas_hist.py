@@ -134,12 +134,30 @@ def fused_geometry(num_features: int, total_bins: int, n_slots: int):
 
 
 def _reshape_feat(bins_t: jnp.ndarray, ft: int):
-    """(F, N) → (G, ft, N) with minimal zero-padding of the feature axis."""
+    """(F, N) → (G, ft, N) with minimal zero-padding of the feature axis.
+
+    NOT free on TPU: (G, ft, N) with ft < 8 pads each G-slice to 8
+    sublanes, so XLA materializes a ~224 MB copy at 1M×28.  Callers that
+    run many kernel passes per jit (the growers) must do this ONCE via
+    :func:`prepare_feature_tiles` OUTSIDE their wave loop — inside a
+    ``lax.cond`` branch XLA cannot hoist it, and it re-materializes
+    every wave (~2.7 ms/tree at B=256, measured by profile)."""
     F, N = bins_t.shape
     G = -(-F // ft)
     if G * ft != F:
         bins_t = jnp.pad(bins_t, ((0, G * ft - F), (0, 0)))
     return bins_t.reshape(G, ft, N), G
+
+
+def prepare_feature_tiles(bins_t: jnp.ndarray, total_bins: int,
+                          num_features: int = None) -> jnp.ndarray:
+    """Pre-reshape the (F, N) binned matrix to the kernels' (G, ft, N)
+    tile layout — pass the result as ``bins_t`` to the kernel entry
+    points (they accept either layout, keyed on ndim)."""
+    cap, _ = _tile_for(total_bins)
+    ft = _feat_tile(num_features if num_features is not None
+                    else bins_t.shape[0], cap)
+    return _reshape_feat(bins_t, ft)[0]
 
 
 # (the former single-histogram "plain" kernel is gone: every pallas
@@ -184,7 +202,8 @@ def _make_hist_nodes_kernel(ft: int):
     def kernel(bins_ref, slot_ref, vals_ref, out_ref, oh_ref):
         """Grid (G, N//chunk) — c fastest.  bins block (1, ft, C) int32;
         slot block (1, C) int32 (row's node slot, -1 = no slot); vals block
-        (C, S·8) int8 pre-tiled; out block (1, ft·B, S·8) int32 revisited
+        (C, 8) int8 limbs (the S-fold lane tile happens in-kernel); out
+        block (1, ft·B, S·8) int32 revisited
         across the chunk dim — per-TILE residency keeps VMEM use
         F-independent (a fully resident accumulator scales with F and
         stops compiling near F≈60 at B=256)."""
@@ -196,7 +215,7 @@ def _make_hist_nodes_kernel(ft: int):
 
         C = bins_ref.shape[2]
         B = oh_ref.shape[0] // ft
-        S = vals_ref.shape[1] // SLOT_LANES
+        S = out_ref.shape[2] // SLOT_LANES
         iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
         for k in range(ft):
             b = bins_ref[0, k, :]
@@ -204,14 +223,17 @@ def _make_hist_nodes_kernel(ft: int):
                 jnp.int8)
         # slot-masked value matrix in ONE wide compare against the lane's
         # slot index — the round-2 loop of S narrow 8-lane writes cost more
-        # than the matmul it fed
+        # than the matmul it fed.  The S-fold lane tile happens HERE in
+        # VMEM: a host-side jnp.tile costs a 256 MB layout copy per tree
+        # plus S× the vals DMA traffic
         sid = slot_ref[0, :]
         lane_j = lax.broadcasted_iota(
             jnp.int32, (C, S * SLOT_LANES), 1) // SLOT_LANES
+        tiled = jnp.concatenate([vals_ref[...]] * S, axis=1)
         # int8 elementwise multiply fails to legalize in Mosaic
         # (arith.muli on i8 vectors) — mask via select instead
-        vn = jnp.where(sid[:, None] == lane_j, vals_ref[...],
-                       jnp.zeros_like(vals_ref))
+        vn = jnp.where(sid[:, None] == lane_j, tiled,
+                       jnp.zeros_like(tiled))
         contrib = lax.dot_general(oh_ref[...], vn,
                                   (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.int32)
@@ -240,9 +262,26 @@ def prep_hist_vals(grad: jnp.ndarray, hess: jnp.ndarray,
     return vals, jnp.stack([s_g, s_h])
 
 
+def _bins_tiles(bins_t: jnp.ndarray, total_bins: int) -> tuple:
+    """Normalize the bins input: (F, N) reshapes here (ONE materialized
+    copy — hoist with :func:`prepare_feature_tiles` when calling from a
+    loop); (G, ft, N) passes through.  F is always G·ft: _feat_tile
+    minimizes padding first and ft=1 pads nothing, so the chosen tile
+    always divides the feature count.  → (bins_r, F, G, ft, N)."""
+    cap, _ = _tile_for(total_bins)
+    if bins_t.ndim == 3:
+        G, ft, N = bins_t.shape
+        return bins_t, G * ft, G, ft, N
+    F, N = bins_t.shape
+    ft = _feat_tile(F, cap)
+    bins_r, G = _reshape_feat(bins_t, ft)
+    assert G * ft == F, (G, ft, F)
+    return bins_r, F, G, ft, N
+
+
 @functools.partial(jax.jit,
                    static_argnames=("n_slots", "total_bins", "interpret"))
-def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 0
+def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                             slot: jnp.ndarray,     # (N,) int32 in [-1, n_slots)
                             vals: jnp.ndarray,     # (N, 8) int8 limbs
                             scales: jnp.ndarray,   # (2,) f32 from prep_hist_vals
@@ -250,14 +289,10 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 
                             total_bins: int,
                             interpret: bool = False) -> jnp.ndarray:
     """→ (n_slots, F, B, 3) float32 [grad, hess, count] histograms."""
-    F, N = bins_t.shape
     B = total_bins
-    cap, chunk = _tile_for(B)
-    ft = _feat_tile(F, cap)
+    bins_r, F, G, ft, N = _bins_tiles(bins_t, B)
+    _, chunk = _tile_for(B)
     assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
-
-    bins_r, G = _reshape_feat(bins_t, ft)
-    vals_lanes = jnp.tile(vals, (1, n_slots))          # (N, S·8)
     VN = n_slots * SLOT_LANES
 
     out = pl.pallas_call(
@@ -266,13 +301,13 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % CHUNK == 
         in_specs=[
             pl.BlockSpec((1, ft, chunk), lambda f, c: (f, 0, c)),
             pl.BlockSpec((1, chunk), lambda f, c: (0, c)),
-            pl.BlockSpec((chunk, VN), lambda f, c: (c, 0)),
+            pl.BlockSpec((chunk, VALS), lambda f, c: (c, 0)),
         ],
         out_specs=pl.BlockSpec((1, ft * B, VN), lambda f, c: (f, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((G, ft * B, VN), jnp.int32),
         scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.int8)],
         interpret=interpret,
-    )(bins_r, slot[None, :], vals_lanes)
+    )(bins_r, slot[None, :], vals)
 
     # (G, ft·B, S·8) → (F, B, S, 8) → (S, F, B, 3)
     out = out.reshape(G * ft, B, n_slots, SLOT_LANES)[:F]
@@ -310,7 +345,8 @@ def _make_fused_kernel(ft: int):
                newid_ref, out_ref, oh_ref, vn_ref):
         """Grid (N//chunk, G) — f fastest.  sel block (S, C) int32 (the
         split columns' bin rows), bins block (1, ft, C) (histogram tile),
-        nid (1, C), vals (C, S·8) int8 pre-tiled; outputs: newid (1, C) and
+        nid (1, C), vals (C, 8) int8 limbs (lane-tiled in-kernel);
+        outputs: newid (1, C) and
         the resident histogram accumulator (G, ft·B, S·8) int32.
 
         The routing condition is the UNIVERSAL form
@@ -350,11 +386,12 @@ def _make_fused_kernel(ft: int):
             newid_ref[0, :] = new
             lane_j = lax.broadcasted_iota(
                 jnp.int32, (C, S * SLOT_LANES), 1) // SLOT_LANES
-            # select, not multiply: arith.muli on i8 vectors fails to
-            # legalize in Mosaic
-            vn_ref[...] = jnp.where(bslot[:, None] == lane_j,
-                                    vals_ref[...],
-                                    jnp.zeros_like(vals_ref))
+            # the S-fold lane tile happens here in VMEM (a host-side
+            # jnp.tile costs a 256 MB layout copy per tree); select, not
+            # multiply: arith.muli on i8 vectors fails to legalize
+            tiled = jnp.concatenate([vals_ref[...]] * S, axis=1)
+            vn_ref[...] = jnp.where(bslot[:, None] == lane_j, tiled,
+                                    jnp.zeros_like(tiled))
 
         iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
         for k in range(ft):
@@ -370,38 +407,37 @@ def _make_fused_kernel(ft: int):
 
 @functools.partial(jax.jit, static_argnames=("n_slots", "total_bins",
                                              "interpret"))
-def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
+def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                           node_id: jnp.ndarray,  # (N,) int32
                           leaf: jnp.ndarray,     # (S,) int32 leaf being split
-                          sel_col: jnp.ndarray,  # (S,) int32 routing column
+                          sel: jnp.ndarray,      # (S, N) int32 routing rows
                           t1: jnp.ndarray,       # (S,) int32 in-range thr
                           rlo: jnp.ndarray,      # (S,) int32 range (rlo, rhi]
                           rhi: jnp.ndarray,      # (S,) int32
                           dflt: jnp.ndarray,     # (S,) int32 out-of-range dir
                           l_id: jnp.ndarray,     # (S,) int32 left child id
                           r_id: jnp.ndarray,     # (S,) int32 right child id
-                          vals: jnp.ndarray,     # (N, S·8) int8 limbs tiled
+                          vals: jnp.ndarray,     # (N, 8) int8 limbs
                           scales: jnp.ndarray,   # (2,) f32 from prep_hist_vals
                           n_slots: int,
                           total_bins: int,
                           interpret: bool = False):
     """One pass: → (new_node_id (N,), hists (n_slots, F, B, 3)).
 
-    Routing per slot: rows of column ``sel_col`` go left iff
-    ``x in (rlo, rhi] ? x <= t1 : dflt`` — plain splits pass rlo=-1,
-    rhi=B, t1=split_bin; EFB passes the bundled range of the ORIGINAL
-    feature being split.  ``vals`` is :func:`prep_hist_vals` output tiled
-    to (N, n_slots·8) — the caller tiles ONCE per tree, not per wave."""
-    F, N = bins_t.shape
+    Routing per slot: rows of ``sel`` (the split columns' bin rows,
+    pre-gathered by the caller: ``jnp.take(bins_flat, cols, axis=0)``)
+    go left iff ``x in (rlo, rhi] ? x <= t1 : dflt`` — plain splits pass
+    rlo=-1, rhi=B, t1=split_bin; EFB passes the bundled range of the
+    ORIGINAL feature being split."""
     B = total_bins
+    bins_r, F, G, ft, N = _bins_tiles(bins_t, B)
     geo = fused_geometry(F, B, n_slots)
     assert geo is not None, (
         f"fused kernel does not fit VMEM at F={F}, B={B}, S={n_slots}; "
         "the caller must gate on fused_geometry(...)")
-    ft, chunk = geo
+    ft_geo, chunk = geo
+    assert ft_geo == ft, (ft_geo, ft)
     assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
-    sel = jnp.take(bins_t, sel_col, axis=0)            # (S, N) row copy
-    bins_r, G = _reshape_feat(bins_t, ft)
     VN = n_slots * SLOT_LANES
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=7,
@@ -410,7 +446,7 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) int32, N % chunk == 0
             pl.BlockSpec((n_slots, chunk), lambda c, f, *_: (0, c)),
             pl.BlockSpec((1, ft, chunk), lambda c, f, *_: (f, 0, c)),
             pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((chunk, VN), lambda c, f, *_: (c, 0)),
+            pl.BlockSpec((chunk, VALS), lambda c, f, *_: (c, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),

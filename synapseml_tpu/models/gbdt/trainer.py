@@ -456,14 +456,18 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
 
     flat_bins = None
     vals8 = scales = None
+    bins_pl = bins_t
     if not use_pallas:
         flat_bins = bins_t + (jnp.arange(F, dtype=jnp.int32) * B)[:, None]
     else:
-        from .pallas_hist import prep_hist_vals
+        from .pallas_hist import prep_hist_vals, prepare_feature_tiles
         vals8, scales = prep_hist_vals(grad, hess, row_valid)
+        # (G, ft, N) tile reshape ONCE per tree, not per split (the
+        # reshape materializes a copy; see prepare_feature_tiles)
+        bins_pl = prepare_feature_tiles(bins_t, B, F)
 
     # root
-    root_hist = ar(_build_hist(bins_t, flat_bins, grad, hess,
+    root_hist = ar(_build_hist(bins_pl, flat_bins, grad, hess,
                                row_valid, F, B, use_pallas,
                                vals8, scales)).reshape(F, B, 3)
     root_stats = jnp.sum(root_hist[0], axis=0)
@@ -525,7 +529,7 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
 
         # left child hist by one device pass, right by subtraction
         lmask = (new_node_id == l_id).astype(jnp.float32) * row_valid
-        l_hist = ar(_build_hist(bins_t, flat_bins, grad, hess, lmask, F, B,
+        l_hist = ar(_build_hist(bins_pl, flat_bins, grad, hess, lmask, F, B,
                                 use_pallas, vals8, scales))
         parent_slot = s["slot"][leaf]
         r_hist = s["hist"][parent_slot] - l_hist
@@ -663,6 +667,10 @@ def _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot, n_slots, F, B):
 
 def _build_hist_nodes(bins_t, flat_bins, vals8, scales, grad, hess, mask,
                       slot, n_slots, F, B, use_pallas):
+    """``bins_t`` may be the flat (F, N) matrix OR the pre-reshaped
+    (G, ft, N) tile layout (prepare_feature_tiles, F == G*ft always) —
+    growers hoist the reshape out of their loops because it materializes
+    a copy."""
     if use_pallas:
         from .pallas_hist import build_hist_nodes_pallas
         return build_hist_nodes_pallas(bins_t, slot, vals8, scales, n_slots,
@@ -766,15 +774,19 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
 
     vals8, scales = (prep_hist_vals(grad, hess, row_valid) if use_pallas
                      else (None, None))
-    # tiled to the kernel's (N, S·8) lane layout ONCE per tree — tiling
-    # per wave would re-materialize a (N, 128) int8 array every level
-    vals_tiled = jnp.tile(vals8, (1, S)) if use_pallas else None
     flat_bins = None
+    bins_pl = bins_t
     if not use_pallas:
         flat_bins = bins_t + (jnp.arange(F, dtype=jnp.int32) * B)[:, None]
+    else:
+        # the (G, ft, N) tile reshape materializes a copy (ft < 8 pads
+        # sublanes): done ONCE per tree here — inside the wave loop's
+        # cond XLA re-materializes it every level (~2.7 ms/tree @B=256)
+        from .pallas_hist import prepare_feature_tiles
+        bins_pl = prepare_feature_tiles(bins_t, B, F)
 
     def build(slot):
-        return ar(_build_hist_nodes(bins_t, flat_bins, vals8, scales, grad,
+        return ar(_build_hist_nodes(bins_pl, flat_bins, vals8, scales, grad,
                                     hess, row_valid, slot, S, F, B,
                                     use_pallas))
 
@@ -803,11 +815,12 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     if use_pallas and fused_geometry(F, B, S) is not None:
         jv = jnp.full((S,), JUNK, jnp.int32)
         _, root_hists = route_and_hist_pallas(
-            bins_t, jnp.zeros(N, jnp.int32), jv.at[0].set(0),
-            jnp.zeros(S, jnp.int32), jnp.full((S,), B, jnp.int32),
+            bins_pl, jnp.zeros(N, jnp.int32), jv.at[0].set(0),
+            jnp.take(bins_t, jnp.zeros(S, jnp.int32), axis=0),
+            jnp.full((S,), B, jnp.int32),
             jnp.full((S,), -1, jnp.int32), jnp.full((S,), B, jnp.int32),
             jnp.ones(S, jnp.int32), jnp.zeros(S, jnp.int32),
-            jnp.zeros(S, jnp.int32), vals_tiled, scales, S, B,
+            jnp.zeros(S, jnp.int32), vals8, scales, S, B,
             interpret=(use_pallas == "interpret"))
         root_hist = ar(root_hists)[0]                      # (F, B, 3)
     else:
@@ -877,8 +890,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
 
             def fused_wave(_):
                 return route_and_hist_pallas(
-                    bins_t, s["node_id"], parents, rt_col, rt_t1, rt_lo,
-                    rt_hi, rt_df, l_ids, r_ids, vals_tiled, scales, S, B,
+                    bins_pl, s["node_id"], parents,
+                    jnp.take(bins_t, rt_col, axis=0), rt_t1, rt_lo,
+                    rt_hi, rt_df, l_ids, r_ids, vals8, scales, S, B,
                     interpret=(use_pallas == "interpret"))
 
             def route_only(_):
@@ -1079,12 +1093,16 @@ def grow_tree_feature_parallel(
     vals8, scales = (prep_hist_vals(grad, hess, row_valid) if use_pallas
                      else (None, None))
     flat_bins = None
+    bins_pl = bins_t
     if not use_pallas:
         flat_bins = bins_t + (jnp.arange(FL, dtype=jnp.int32) * B)[:, None]
+    else:
+        from .pallas_hist import prepare_feature_tiles
+        bins_pl = prepare_feature_tiles(bins_t, B, FL)
 
     def build(slot):
         # LOCAL histograms only — the defining property of feature-parallel
-        return _build_hist_nodes(bins_t, flat_bins, vals8, scales, grad,
+        return _build_hist_nodes(bins_pl, flat_bins, vals8, scales, grad,
                                  hess, row_valid, slot, S, FL, B, use_pallas)
 
     # constraints come from the static tuple in p, so the GLOBAL vector is

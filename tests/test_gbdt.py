@@ -849,13 +849,14 @@ def test_fused_route_hist_kernel_matches_xla():
 
     vals, scales = prep_hist_vals(jnp.asarray(grad), jnp.asarray(hess),
                                   jnp.asarray(mask))
-    # plain-mode universal routing: full range -> degrades to x <= thr
+    # plain-mode universal routing: full range -> degrades to x <= thr;
+    # the routing rows arrive pre-gathered (the production caller's take)
     new_id, hists = route_and_hist_pallas(
         jnp.asarray(bins_t), jnp.asarray(node_id), jnp.asarray(leaf),
-        jnp.asarray(feat), jnp.asarray(thr),
+        jnp.asarray(bins_t[feat]), jnp.asarray(thr),
         jnp.full(S, -1, jnp.int32), jnp.full(S, B, jnp.int32),
         jnp.ones(S, jnp.int32), jnp.asarray(l_id),
-        jnp.asarray(r_id), jnp.tile(vals, (1, S)), scales, S, B,
+        jnp.asarray(r_id), vals, scales, S, B,
         interpret=True)
 
     exp_id = node_id.copy()
