@@ -239,7 +239,7 @@ def bench_gbdt(X, y, max_bin=GBDT_MAX_BIN):
     return full, steady, warm, auc_h
 
 
-def bench_gbdt_anchor(X, y, max_bins=255):
+def bench_gbdt_anchor(X, y):
     """Same-host CPU anchor: sklearn's HistGradientBoosting (a LightGBM-
     style C++/OpenMP histogram GBDT) on the identical task/shape.
 
@@ -247,15 +247,20 @@ def bench_gbdt_anchor(X, y, max_bins=255):
     per-iteration cost, then both are amortized over the SAME GBDT_ITERS
     the TPU run uses — otherwise the anchor's fixed cost would be spread
     over fewer iterations and the vs_baseline ratio would be inflated.
-    Measured at ``max_bins`` so BOTH anchor configs (255 and 64) appear in
-    the emitted JSON — the TPU-vs-anchor comparison is self-contained
-    instead of resting on a comment's claimed bin-insensitivity."""
+    BOTH bin configs are measured with their trials INTERLEAVED
+    (median-of-3 each, the TPU windows' estimator): back-to-back config
+    blocks let one co-tenant burst on the shared 1-core host starve one
+    config and invert the comparison; interleaving spreads the noise
+    evenly, and both numbers land in the emitted JSON so the
+    TPU-vs-anchor ratio is self-contained."""
     import os
     import statistics
 
     from sklearn.ensemble import HistGradientBoostingClassifier
 
-    def run(iters):
+    bin_configs = (255, 64)
+
+    def run(iters, max_bins):
         clf = HistGradientBoostingClassifier(
             max_iter=iters, max_leaf_nodes=31, max_bins=max_bins,
             early_stopping=False, validation_fraction=None)
@@ -263,13 +268,19 @@ def bench_gbdt_anchor(X, y, max_bins=255):
         clf.fit(X, y)
         return time.perf_counter() - t0
 
-    # median-of-3 per run size: same estimator as every TPU window
-    t_small = statistics.median(run(2) for _ in range(3))
-    t_big = statistics.median(run(ANCHOR_ITERS) for _ in range(3))
-    per_iter = max((t_big - t_small) / (ANCHOR_ITERS - 2), 1e-9)
-    fixed = max(t_small - 2 * per_iter, 0.0)
-    ips_at_bench_iters = GBDT_ITERS / (fixed + GBDT_ITERS * per_iter)
-    return ips_at_bench_iters, os.cpu_count()
+    times = {b: {"small": [], "big": []} for b in bin_configs}
+    for _ in range(3):
+        for b in bin_configs:
+            times[b]["small"].append(run(2, b))
+            times[b]["big"].append(run(ANCHOR_ITERS, b))
+    out = {}
+    for b in bin_configs:
+        t_small = statistics.median(times[b]["small"])
+        t_big = statistics.median(times[b]["big"])
+        per_iter = max((t_big - t_small) / (ANCHOR_ITERS - 2), 1e-9)
+        fixed = max(t_small - 2 * per_iter, 0.0)
+        out[b] = GBDT_ITERS / (fixed + GBDT_ITERS * per_iter)
+    return out, os.cpu_count()
 
 
 def bench_resnet50():
@@ -476,8 +487,8 @@ def main():
               file=sys.stderr)
     try:
         if gbdt_ips is not None:
-            anchor_ips, anchor_cores = bench_gbdt_anchor(X, y, max_bins=255)
-            anchor_ips64, _ = bench_gbdt_anchor(X, y, max_bins=64)
+            anchors, anchor_cores = bench_gbdt_anchor(X, y)
+            anchor_ips, anchor_ips64 = anchors[255], anchors[64]
             print(f"[anchor] sklearn HistGradientBoosting same host "
                   f"({anchor_cores} cores): {anchor_ips:.2f} iters/sec "
                   f"@255 bins, {anchor_ips64:.2f} @64 bins",
