@@ -318,11 +318,14 @@ def bench_resnet50():
 def bench_llm():
     """Llama-3-1B-class autoregressive decode tokens/s/chip (the TP-ready
     LLM stretch path; KV-cached jitted scan decode)."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
     from synapseml_tpu.models.llm import (LlamaConfig, LlamaModel,
-                                          cast_params, generate)
+                                          cast_params, generate,
+                                          quantize_int8)
 
     cfg = LlamaConfig.llama3_1b(max_len=256)
     model = LlamaModel(cfg)
@@ -353,6 +356,42 @@ def bench_llm():
             print(f"[secondary] LLM decode batch {B} failed: {e}",
                   file=sys.stderr)
 
+    # int8 weight-only serving at batch 8 (QuantDense + QuantEmbed: the
+    # per-row-quantized tied table serves gather AND attend).  Two
+    # readings of the SAME config:
+    #  - single-call: one generate per wall window, the round-over-round
+    #    comparable number.  Its ~70-90 ms fixed cost is the TUNNEL round
+    #    trip + dispatch, not device work;
+    #  - pipelined: 4 back-to-back dispatches, ONE readback — the same
+    #    amortization idiom the ONNX bench uses, and what a serving loop
+    #    actually does (request i+1 dispatches while i runs).
+    int8_b8 = int8_b8_pipe = None
+    try:
+        B = 8
+        qcfg = dataclasses.replace(cfg, weight_quant="int8")
+        qmodel = LlamaModel(qcfg)
+        qvars = quantize_int8(variables)
+        # dedicated rng: consuming the shared stream here would shift the
+        # spec-decode prompt below and break round-over-round comparability
+        ids = np.random.default_rng(8).integers(0, cfg.vocab_size, (B, P))
+        generate(qmodel, qvars, ids, max_new_tokens=NEW)         # compile
+        best = pipe = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = generate(qmodel, qvars, ids, max_new_tokens=NEW)
+            best = max(best, B * NEW / (time.perf_counter() - t0))
+            calls = 4
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                out = generate(qmodel, qvars, ids, max_new_tokens=NEW,
+                               block=False)
+            np.asarray(out)                    # one readback drains all
+            pipe = max(pipe,
+                       calls * B * NEW / (time.perf_counter() - t0))
+        int8_b8, int8_b8_pipe = best, pipe
+    except Exception as e:
+        print(f"[secondary] int8 1B decode failed: {e}", file=sys.stderr)
+
     # speculative decoding (prompt-lookup drafts, exact greedy): measured
     # honestly against the SAME batch-8 config with greedy-equivalence
     # asserted.  On random-init weights the continuation stream is mostly
@@ -378,7 +417,7 @@ def bench_llm():
     except Exception as e:
         spec_stats = None      # never publish stats for a failed run
         print(f"[secondary] speculative decode failed: {e}", file=sys.stderr)
-    return rates[8], rates[32], spec_tps, spec_stats
+    return rates[8], rates[32], spec_tps, spec_stats, int8_b8, int8_b8_pipe
 
 
 def bench_llm_8b_int8():
@@ -418,12 +457,19 @@ def bench_llm_8b_int8():
 def main():
     bert_sps, mfu, n_params = bench_bert()
     llm_tps = llm_tps32 = llm_spec_tps = llm_spec_stats = None
+    llm_int8_tps = llm_int8_pipe_tps = None
     try:
-        llm_tps, llm_tps32, llm_spec_tps, llm_spec_stats = bench_llm()
+        (llm_tps, llm_tps32, llm_spec_tps, llm_spec_stats,
+         llm_int8_tps, llm_int8_pipe_tps) = bench_llm()
         b8 = f"{llm_tps:.0f}" if llm_tps else "failed"
         b32 = f"{llm_tps32:.0f}" if llm_tps32 else "failed"
         print(f"[secondary] Llama-1B decode: {b8} tokens/s/chip (batch 8), "
               f"{b32} tokens/s/chip (batch 32 serving)", file=sys.stderr)
+        if llm_int8_tps:
+            print(f"[secondary] Llama-1B int8 decode batch 8: "
+                  f"{llm_int8_tps:.0f} tokens/s single-call, "
+                  f"{llm_int8_pipe_tps:.0f} tokens/s pipelined (4 calls, "
+                  "one readback)", file=sys.stderr)
         if llm_spec_tps:
             print(f"[secondary] speculative decode (batch 8, greedy-exact): "
                   f"{llm_spec_tps:.0f} tokens/s, "
@@ -531,6 +577,10 @@ def main():
                                           if llm_tps else None),
         "llama1b_decode_b32_tokens_per_sec": (round(llm_tps32, 1)
                                               if llm_tps32 else None),
+        "llama1b_int8_decode_tokens_per_sec": (round(llm_int8_tps, 1)
+                                               if llm_int8_tps else None),
+        "llama1b_int8_decode_pipelined_tokens_per_sec": (
+            round(llm_int8_pipe_tps, 1) if llm_int8_pipe_tps else None),
         "llama1b_spec_decode_tokens_per_sec": (round(llm_spec_tps, 1)
                                                if llm_spec_tps else None),
         "llama1b_spec_tokens_per_step": (

@@ -114,25 +114,46 @@ def quantize_int8(variables: Any) -> Any:
     to ~8.6 GB (int8 projections + bf16 embeddings/norms), which is what
     fits the 8B config on ONE 16 GB v5e chip with KV cache and activation
     headroom.  ``nn.Partitioned`` metadata carries over (scales shard on
-    the kernel's output axis), so TP serving quantizes the same way."""
+    the kernel's output axis), so TP serving quantizes the same way.
+
+    TIED models (no ``lm_head`` in the tree) additionally quantize the
+    embedding table per vocab row for :class:`~.model.QuantEmbed` — the
+    attend head streams the whole table every token, so on Llama-1B that
+    is a third of the decode bandwidth."""
     import flax.linen as nn
+
+    params = variables.get("params", variables)
+    tied = isinstance(params, dict) and "lm_head" not in params
+
+    def quant(w, axis, scale_names):
+        """Symmetric int8 along ``axis`` → (q, scale), Partitioned-aware."""
+        meta = None
+        if isinstance(w, nn.Partitioned):
+            meta, w = w.names, w.value
+        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
+        scale = jnp.maximum(absmax / 127.0, 1e-12)
+        s = jnp.expand_dims(scale, axis) if w.ndim > scale.ndim else scale
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / s),
+                     -127, 127).astype(jnp.int8)
+        if meta is not None:
+            q = nn.Partitioned(q, names=meta)
+            scale = nn.Partitioned(scale, names=scale_names(meta))
+        return q, scale
 
     def walk(d):
         out = {}
         for k, v in d.items():
             if isinstance(v, dict):
-                if "kernel" in v:
-                    w = v["kernel"]
-                    meta = None
-                    if isinstance(w, nn.Partitioned):
-                        meta, w = w.names, w.value
-                    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
-                    scale = jnp.maximum(absmax / 127.0, 1e-12)
-                    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
-                                 -127, 127).astype(jnp.int8)
-                    if meta is not None:
-                        q = nn.Partitioned(q, names=meta)
-                        scale = nn.Partitioned(scale, names=(meta[-1],))
+                if tied and k == "tok_embed" and "embedding" in v:
+                    # tied-embedding table -> QuantEmbed params: int8 with
+                    # per-VOCAB-ROW scales (axis 1 is the contraction in
+                    # attend, so the row scale commutes out columnwise).
+                    # Non-tied models keep the bf16 table: its gather
+                    # reads a handful of rows, not the whole tensor
+                    q, scale = quant(v["embedding"], 1, lambda m: (m[0],))
+                    out[k] = {"embedding_q": q, "scale": scale}
+                elif "kernel" in v:
+                    q, scale = quant(v["kernel"], 0, lambda m: (m[-1],))
                     rest = {kk: vv for kk, vv in v.items() if kk != "kernel"}
                     out[k] = {"kernel_q": q, "scale": scale, **walk(rest)}
                 else:
@@ -291,9 +312,15 @@ def generate(model: LlamaModel, variables: Any, prompt_ids,
              max_new_tokens: int = 32, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 1.0,
              eos_id: Optional[int] = None, pad_id: int = 0,
-             seed: int = 0) -> np.ndarray:
+             seed: int = 0, block: bool = True
+             ) -> "np.ndarray | jax.Array":
     """Generate ``max_new_tokens`` continuations for a batch of
-    equal-length prompts (B, P) → (B, max_new_tokens) int32."""
+    equal-length prompts (B, P) → (B, max_new_tokens) int32.
+
+    ``block=False`` returns the on-device array without the host
+    readback: serving loops dispatch the next request's generate while
+    the previous one still runs, so the host↔device round trip is paid
+    once per pipeline drain instead of once per call."""
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -301,4 +328,4 @@ def generate(model: LlamaModel, variables: Any, prompt_ids,
                         jax.random.PRNGKey(seed), int(max_new_tokens),
                         float(temperature), int(top_k), float(top_p),
                         eos_id, int(pad_id))
-    return np.asarray(out)
+    return np.asarray(out) if block else out

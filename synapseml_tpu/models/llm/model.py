@@ -136,6 +136,40 @@ class QuantDense(nn.Module):
         return y * scale.astype(self.dtype)
 
 
+class QuantEmbed(nn.Module):
+    """int8 tied embedding: one (V, D) int8 table with per-VOCAB-ROW
+    scales serves both the input gather (exact per-row dequant) and the
+    output ``attend`` head (the per-row scale commutes out of the
+    contraction over D, multiplying the logits columnwise).  Decode
+    streams the table at half bf16 width — on Llama-1B the table is a
+    third of all weight bytes, so this is the largest single-tensor
+    bandwidth win the int8 path has."""
+    vocab_size: int
+    features: int
+    dtype: Any
+
+    def setup(self):
+        self.embedding_q = self.param(
+            "embedding_q", nn.with_partitioning(
+                nn.initializers.zeros_init(), ("vocab", "embed")),
+            (self.vocab_size, self.features), jnp.int8)
+        self.scale = self.param(
+            "scale", nn.with_partitioning(
+                nn.initializers.ones_init(), ("vocab",)),
+            (self.vocab_size,), jnp.float32)
+
+    def __call__(self, ids):
+        return (self.embedding_q[ids].astype(self.dtype)
+                * self.scale[ids].astype(self.dtype)[..., None])
+
+    def attend(self, x):
+        logits = jax.lax.dot_general(
+            x, self.embedding_q.astype(x.dtype),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return logits * self.scale
+
+
 def _dense(features, axes, name, dtype, quant: str = "none"):
     if quant == "int8":
         return QuantDense(features, axes, dtype, name=name)
@@ -254,11 +288,15 @@ class LlamaModel(nn.Module):
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
-                         embedding_init=nn.with_partitioning(
-                             nn.initializers.truncated_normal(0.02),
-                             ("vocab", "embed")),
-                         name="tok_embed")
+        if cfg.tie_embeddings and cfg.weight_quant == "int8":
+            embed = QuantEmbed(cfg.vocab_size, cfg.d_model, cfg.dtype,
+                               name="tok_embed")
+        else:
+            embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                             embedding_init=nn.with_partitioning(
+                                 nn.initializers.truncated_normal(0.02),
+                                 ("vocab", "embed")),
+                             name="tok_embed")
         x = embed(input_ids)
         new_caches = []
         for i in range(cfg.num_layers):
@@ -268,7 +306,10 @@ class LlamaModel(nn.Module):
             new_caches.append(nc)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_final")(x)
         if cfg.tie_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
+            if isinstance(embed, QuantEmbed):
+                logits = embed.attend(x)      # f32 accumulation inside
+            else:
+                logits = embed.attend(x.astype(jnp.float32))
         else:
             logits = _dense(cfg.vocab_size, ("embed", "vocab"), "lm_head",
                             jnp.float32, cfg.weight_quant)(x)

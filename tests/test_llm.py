@@ -288,3 +288,48 @@ def test_int8_weight_quantization_parity():
     # greedy paths agree on most steps at this tolerance
     agree = (out_f == out_q).mean()
     assert agree >= 0.75, (agree, out_f, out_q)
+
+
+def test_int8_tied_embedding_parity():
+    """Tied models quantize the embedding table too (QuantEmbed): the int8
+    per-row table serves gather AND attend, and the quantized model still
+    tracks the full-precision one.  This is the Llama-1B serving config —
+    the attend head streams the whole table every decode step, so its
+    quantization is a third of the int8 path's bandwidth win."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.llm import (LlamaConfig, LlamaModel, generate,
+                                          quantize_int8)
+
+    cfg = LlamaConfig.tiny(max_len=64)
+    cfg = dataclasses.replace(cfg, tie_embeddings=True)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)), jnp.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(1), ids)
+
+    qcfg = dataclasses.replace(cfg, weight_quant="int8")
+    qmodel = LlamaModel(qcfg)
+    qvars = quantize_int8(variables)
+    # the embedding table itself is int8 now (tied models only)
+    q_embed = qvars["params"]["tok_embed"]["embedding_q"]
+    q_embed = getattr(q_embed, "value", q_embed)
+    assert q_embed.dtype == jnp.int8
+    assert qvars["params"]["tok_embed"]["scale"] is not None
+    # param structure matches what the quantized model expects
+    expect = jax.jit(qmodel.init)(jax.random.PRNGKey(0), ids)
+    assert (jax.tree_util.tree_structure(expect)
+            == jax.tree_util.tree_structure(qvars))
+
+    full = np.asarray(model.apply(variables, ids), np.float32)
+    quant = np.asarray(qmodel.apply(qvars, ids), np.float32)
+    rel = np.abs(full - quant).max() / (np.abs(full).max() + 1e-9)
+    assert rel < 0.05, rel
+
+    out_f = generate(model, variables, np.asarray(ids), max_new_tokens=8)
+    out_q = generate(qmodel, qvars, np.asarray(ids), max_new_tokens=8)
+    agree = (out_f == out_q).mean()
+    assert agree >= 0.75, (agree, out_f, out_q)
