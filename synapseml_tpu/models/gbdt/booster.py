@@ -847,18 +847,12 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
     if config.monotone_constraints and any(config.monotone_constraints):
         if config.monotone_constraints_method not in ("basic",
-                                                      "intermediate"):
-            raise NotImplementedError(
+                                                      "intermediate",
+                                                      "advanced"):
+            raise ValueError(
                 f"monotone_constraints_method="
-                f"{config.monotone_constraints_method!r}: 'basic' and "
-                "'intermediate' are implemented; 'advanced' relaxes "
-                "different splits and would silently change semantics")
-        if (config.monotone_constraints_method == "intermediate"
-                and config.parallelism == "feature_parallel"):
-            raise NotImplementedError(
-                "monotone intermediate + feature_parallel: the whole-tree "
-                "bounds refresh needs every feature's picks re-evaluated "
-                "globally; use data_parallel/voting_parallel or basic")
+                f"{config.monotone_constraints_method!r}: must be 'basic', "
+                "'intermediate' or 'advanced'")
         if len(config.monotone_constraints) != F:
             raise ValueError(
                 f"monotone_constraints has "

@@ -107,8 +107,10 @@ class GBDTParams(Params):
             "168-183): 1 forces predictions non-decreasing in the "
             "feature, -1 non-increasing")
     monotoneConstraintsMethod = StringParam(
-        doc="constraint enforcement method (monotoneConstraintsMethod); "
-            "'basic' is implemented", default="basic",
+        doc="constraint enforcement method (monotoneConstraintsMethod): "
+            "'basic' (midpoint clamping), 'intermediate' (opposite-"
+            "subtree extremes), 'advanced' (exact pairwise leaf-box "
+            "constraints)", default="basic",
         allowed=("basic", "intermediate", "advanced"))
     monotonePenalty = FloatParam(
         doc="gain penalization for constrained-feature splits near the "

@@ -60,7 +60,9 @@ class GrowthParams(NamedTuple):
     #: "basic" (midpoint bound propagation) | "intermediate" (bounds from
     #: the opposite sibling SUBTREE's current extreme outputs, recomputed
     #: over the whole tree each wave — much less constraining, LightGBM's
-    #: recommended upgrade)
+    #: recommended upgrade) | "advanced" (the exact minimal pairwise
+    #: constraint set over ordered-and-overlapping leaf boxes — see
+    #: :func:`_advanced_bounds`; provably no tighter than intermediate)
     monotone_method: str = "basic"
 
 
@@ -257,78 +259,188 @@ def _mono_child_bounds(cf, lo, hi, wl, wr):
 
 
 def _intermediate_bounds(split_feature, left_child, right_child,
-                         raw_value, mono_c, n_iters: int = 4):
-    """Intermediate-method bounds: per-node [lo, hi] where a constrained
-    split bounds each child SUBTREE by the opposite subtree's extreme
-    CURRENT output (LightGBM's IntermediateLeafConstraints semantics)
-    instead of the midpoint.  Because clamping values moves the extremes,
-    (bounds ← tree extremes, values ← clip(raw, bounds)) iterates to a
-    fixed point — children always carry higher indices than parents in
-    every grower here, so one backward and one forward scan per round.
+                         raw_value, mono_c, n_iters: int = 0):
+    """Intermediate-method bounds: a constrained split bounds each child
+    SUBTREE by the opposite subtree's extreme leaf outputs (LightGBM's
+    IntermediateLeafConstraints semantics) instead of the midpoint.
+
+    Implementation: the constraint set is materialized as explicit pairs
+    — for a split at node a on feature f with c=+1, every node of L(a)
+    is <= every LEAF of R(a) and every node of R(a) is >= every LEAF of
+    L(a) (extremes range over leaves, matching the old scan formulation)
+    — then projected through :func:`_project_pairs`, which is exact and
+    convergent where the old clip-raw iteration oscillated on
+    conflicting raw values.  ``n_iters`` is kept for call-site
+    compatibility and ignored.
 
     Returns (lo, hi, clamped_value), each (M,)."""
+    del n_iters
     M = split_feature.shape[0]
-    JUNK = M                                 # scratch slot for leaf writes
+    leaf = left_child < 0
 
-    def one_round(carry, _):
-        val = carry
-        # backward: subtree min/max of current (clamped) outputs
-        smin = jnp.where(left_child < 0, val, jnp.inf)
-        smax = jnp.where(left_child < 0, val, -jnp.inf)
+    # desc[a, i]: node i lies in a's subtree (children carry higher
+    # indices than parents in every grower here, so one backward walk)
+    def back(k, desc):
+        j = M - 1 - k
+        l = jnp.maximum(left_child[j], 0)
+        r = jnp.maximum(right_child[j], 0)
+        internal = left_child[j] >= 0
+        row = jnp.zeros(M, jnp.bool_).at[j].set(True)
+        row = row | (jnp.where(internal, desc[l] | desc[r],
+                               jnp.zeros(M, jnp.bool_)))
+        return desc.at[j].set(row)
 
-        def back(i, mm):
-            mn, mx = mm
-            j = M - 1 - i
-            l = jnp.maximum(left_child[j], 0)
-            r = jnp.maximum(right_child[j], 0)
-            internal = left_child[j] >= 0
-            mn = mn.at[j].set(jnp.where(internal,
-                                        jnp.minimum(mn[l], mn[r]), mn[j]))
-            mx = mx.at[j].set(jnp.where(internal,
-                                        jnp.maximum(mx[l], mx[r]), mx[j]))
-            return mn, mx
+    desc = lax.fori_loop(0, M, back, jnp.zeros((M, M), jnp.bool_))
 
-        smin, smax = lax.fori_loop(0, M, back, (smin, smax))
+    internal = left_child >= 0
+    inL = jnp.where(internal[:, None],
+                    desc[jnp.maximum(left_child, 0)], False)    # (M, M)
+    inR = jnp.where(internal[:, None],
+                    desc[jnp.maximum(right_child, 0)], False)
+    c = jnp.where(internal, mono_c[jnp.maximum(split_feature, 0)], 0)
+    # side that must stay LOW / HIGH at each constrained split
+    low_side = jnp.where((c == 1)[:, None], inL,
+                         jnp.where((c == -1)[:, None], inR, False))
+    high_side = jnp.where((c == 1)[:, None], inR,
+                          jnp.where((c == -1)[:, None], inL, False))
+    # P[i, j]: val_i <= val_j with j leaf; Q[i, j]: val_i >= val_j, j leaf
+    f32 = jnp.float32
+    P = (low_side.T.astype(f32)
+         @ (high_side & leaf[None, :]).astype(f32)) > 0
+    Q = (high_side.T.astype(f32)
+         @ (low_side & leaf[None, :]).astype(f32)) > 0
+    return _project_pairs(P, Q, raw_value, leaf)
 
-        # forward: bounds flow root → children (scratch slot absorbs leaf
-        # writes)
-        lo = jnp.full(M + 1, -jnp.inf)
-        hi = jnp.full(M + 1, jnp.inf)
 
-        def fwd(j, bounds):
-            lo, hi = bounds
-            lraw, rraw = left_child[j], right_child[j]
-            internal = lraw >= 0
-            l = jnp.where(internal, lraw, JUNK)
-            r = jnp.where(internal, rraw, JUNK)
-            ls, rs = jnp.maximum(lraw, 0), jnp.maximum(rraw, 0)
-            c = jnp.where(internal,
-                          mono_c[jnp.maximum(split_feature[j], 0)], 0)
-            l_lo, l_hi = lo[j], hi[j]
-            r_lo, r_hi = lo[j], hi[j]
-            l_hi = jnp.where(c == 1, jnp.minimum(l_hi, smin[rs]), l_hi)
-            r_lo = jnp.where(c == 1, jnp.maximum(r_lo, smax[ls]), r_lo)
-            l_lo = jnp.where(c == -1, jnp.maximum(l_lo, smax[rs]), l_lo)
-            r_hi = jnp.where(c == -1, jnp.minimum(r_hi, smin[ls]), r_hi)
-            lo = lo.at[l].set(l_lo).at[r].set(r_lo)
-            hi = hi.at[l].set(l_hi).at[r].set(r_hi)
-            # scrub the scratch slot so junk writes never leak
-            return (lo.at[JUNK].set(-jnp.inf), hi.at[JUNK].set(jnp.inf))
+def _project_pairs(P, Q, raw_value, leaf):
+    """Feasible monotone assignment + bounds from explicit constraints.
 
-        lo, hi = lax.fori_loop(0, M, fwd, (lo, hi))
-        lo, hi = lo[:M], hi[:M]
-        return jnp.clip(raw_value, lo, hi), (lo, hi)
+    ``P[i, j]``: ``val_i <= val_j``; ``Q[i, j]``: ``val_i >= val_j`` —
+    in both, j is a LEAF (i may be any node).  Leaves take
+    ``(L + U) / 2`` with ``L_i = max(raw_i, max raw over transitive-
+    closure predecessors)`` and ``U_i = min(raw_i, min raw over closure
+    successors)``: L and U are each non-decreasing along every
+    constraint edge, so their average is feasible BY CONSTRUCTION and
+    equals raw wherever raw is already feasible — unlike the previous
+    clip-raw-to-current-bounds iteration, which oscillated with period 2
+    on conflicting raw values and, at an even iteration count, handed
+    the raw violating values straight back.  Internal nodes clamp to the
+    bounds the final leaf values imply (they never feed back).
 
-    val, (los, his) = lax.scan(one_round, raw_value, None, length=n_iters)
-    return los[-1], his[-1], val
+    Returns (lo, hi, val), each (M,)."""
+    M = raw_value.shape[0]
+    leaf_pairs = P & leaf[:, None]
+    f32 = jnp.float32
+
+    def sq(le, _):
+        return (le | ((le.astype(f32) @ le.astype(f32)) > 0)), None
+
+    rounds = max(int(np.ceil(np.log2(max(M, 2)))), 1)
+    close, _ = lax.scan(sq, leaf_pairs, None, length=rounds)
+    L = jnp.maximum(raw_value, jnp.max(
+        jnp.where(close.T, raw_value[None, :], -jnp.inf), axis=1))
+    U = jnp.minimum(raw_value, jnp.min(
+        jnp.where(close, raw_value[None, :], jnp.inf), axis=1))
+    vleaf = jnp.where(leaf, 0.5 * (L + U), raw_value)
+    # per-node bounds from the FINAL leaf values — what split search and
+    # internal-node clamping consume
+    hi = jnp.min(jnp.where(P, vleaf[None, :], jnp.inf), axis=1)
+    lo = jnp.max(jnp.where(Q, vleaf[None, :], -jnp.inf), axis=1)
+    val = jnp.where(leaf, vleaf, jnp.clip(raw_value, lo, hi))
+    return lo, hi, val
+
+
+def _advanced_bounds(split_feature, split_bin, left_child, right_child,
+                     raw_value, mono_c, total_bins: int, n_iters: int = 6):
+    """Advanced-method bounds: the EXACT minimal constraint set for
+    single-tree monotonicity.
+
+    ``val_i <= val_j`` is required iff leaves i and j are ORDERED on a
+    constrained feature f (i's bin box strictly left of j's) and their
+    boxes OVERLAP on every other feature — precisely the pairs some input
+    pair x <= x' (differing only in f) can land in, so the set is both
+    necessary and sufficient.  Intermediate's opposite-subtree extremes
+    are a SUPERSET of these pairs (it also constrains non-overlapping
+    boxes), which is why advanced is provably no tighter than
+    intermediate; LightGBM's own ``advanced`` pursues the same relaxation
+    via threshold-dependent per-leaf constraints
+    (reference surfaces the method string only:
+    params/LightGBMParams.scala:168-183).  O(M^2 F) memory — fine for
+    monotone-model sizes; reject upstream if it ever is not.
+
+    Returns (lo, hi, clamped_value), each (M,); internal nodes clamp to
+    the bounds the final leaf values imply."""
+    del n_iters                      # _project_pairs is exact, not iterative
+    M = split_feature.shape[0]
+    F = mono_c.shape[0]
+    JUNK = M
+
+    # per-node bin boxes (lo, hi] by a root->children walk (children carry
+    # higher indices than parents in every grower here); categorical
+    # features use target-ordered bins, so their splits are interval
+    # splits too and the box walk stays exact
+    lo0 = jnp.full((M + 1, F), -1, jnp.int32)
+    hi0 = jnp.full((M + 1, F), total_bins - 1, jnp.int32)
+
+    def fwd(j, boxes):
+        lo, hi = boxes
+        lraw, rraw = left_child[j], right_child[j]
+        internal = lraw >= 0
+        l = jnp.where(internal, lraw, JUNK)
+        r = jnp.where(internal, rraw, JUNK)
+        f = jnp.maximum(split_feature[j], 0)
+        b = split_bin[j]
+        lhi = hi[j].at[f].set(jnp.minimum(hi[j, f], b))
+        rlo = lo[j].at[f].set(jnp.maximum(lo[j, f], b))
+        lo = lo.at[l].set(lo[j]).at[r].set(rlo)
+        hi = hi.at[l].set(lhi).at[r].set(hi[j])
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, M, fwd, (lo0, hi0))
+    lo, hi = lo[:M], hi[:M]
+
+    leaf = left_child < 0
+    # boxes (lo, hi] intersect iff lo_i < hi_j and lo_j < hi_i
+    ov = ((lo[:, None, :] < hi[None, :, :])
+          & (lo[None, :, :] < hi[:, None, :]))          # (M, M, F)
+    n_ov = jnp.sum(ov.astype(jnp.int32), axis=-1)       # (M, M)
+    # overlap on every feature EXCEPT f
+    ov_exc = (n_ov[:, :, None] - ov.astype(jnp.int32)) == (F - 1)
+    ordered = hi[:, None, :] <= lo[None, :, :]          # i left of j on f
+    # any-node-to-LEAF constraint masks for _project_pairs (internal
+    # nodes get bounds from the leaf values but never feed back)
+    inc_f = ov_exc & (mono_c[None, None, :] == 1)
+    dec_f = ov_exc & (mono_c[None, None, :] == -1)
+    # val_i <= val_j: i left of j on a +1 feature, or right of j on a -1
+    P_any = (jnp.any(ordered & inc_f, axis=-1)
+             | jnp.any(ordered.transpose(1, 0, 2) & dec_f, axis=-1))
+    # val_i >= val_j: the mirrored directions
+    Q_any = (jnp.any(ordered.transpose(1, 0, 2) & inc_f, axis=-1)
+             | jnp.any(ordered & dec_f, axis=-1))
+    return _project_pairs(P_any & leaf[None, :], Q_any & leaf[None, :],
+                          raw_value, leaf)
+
+
+def _tree_bounds(split_feature, split_bin, left_child, right_child,
+                 raw_value, mono_c, p: "GrowthParams", n_iters: int = 4):
+    """Whole-tree bounds refresh for the method in ``p.monotone_method``
+    (``intermediate`` or ``advanced``) → (lo, hi, clamped_value)."""
+    if p.monotone_method == "advanced":
+        return _advanced_bounds(split_feature, split_bin, left_child,
+                                right_child, raw_value, mono_c,
+                                p.total_bins, n_iters=max(n_iters, 6))
+    return _intermediate_bounds(split_feature, left_child, right_child,
+                                raw_value, mono_c, n_iters=n_iters)
 
 
 def _refresh_intermediate(s, mono_c, p: "GrowthParams"):
-    """Replace a grower state's node bounds with intermediate-method
-    bounds recomputed over the whole current tree."""
+    """Replace a grower state's node bounds with whole-tree-refresh
+    bounds (intermediate or advanced method) recomputed over the whole
+    current tree."""
     raw = _leaf_output(s["sum_g"], s["sum_h"], p.lambda_l1, p.lambda_l2)
-    lo, hi, _ = _intermediate_bounds(s["split_feature"], s["left_child"],
-                                     s["right_child"], raw, mono_c)
+    lo, hi, _ = _tree_bounds(s["split_feature"], s["split_bin"],
+                             s["left_child"], s["right_child"], raw,
+                             mono_c, p)
     return dict(s, node_lo=lo, node_hi=hi)
 
 
@@ -583,7 +695,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
 
     def maybe_intermediate_split(s):
         out = do_split(s)
-        if mono_c is None or p.monotone_method != "intermediate":
+        if mono_c is None or p.monotone_method not in ("intermediate",
+                                                       "advanced"):
             return out
         # intermediate: bounds come from the OPPOSITE subtree's extremes
         # over the whole current tree; the fresh children re-pick under
@@ -614,10 +727,11 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     node_value = _leaf_output(state["sum_g"], state["sum_h"],
                               p.lambda_l1, p.lambda_l2)
     if mono_c is not None:
-        if p.monotone_method == "intermediate":
-            _, _, node_value = _intermediate_bounds(
-                state["split_feature"], state["left_child"],
-                state["right_child"], node_value, mono_c, n_iters=6)
+        if p.monotone_method in ("intermediate", "advanced"):
+            _, _, node_value = _tree_bounds(
+                state["split_feature"], state["split_bin"],
+                state["left_child"], state["right_child"], node_value,
+                mono_c, p, n_iters=6)
         else:
             node_value = jnp.clip(node_value, state["node_lo"],
                                   state["node_hi"])
@@ -991,10 +1105,11 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             node_lo=s["node_lo"].at[cids].set(c_lo),
             node_hi=s["node_hi"].at[cids].set(c_hi),
         )
-        if mono_c is not None and p.monotone_method == "intermediate":
-            # intermediate: bounds from opposite-subtree extremes over the
-            # whole tree; this wave's children re-pick under the refreshed
-            # (looser-than-midpoint) bounds
+        if mono_c is not None and p.monotone_method in ("intermediate",
+                                                        "advanced"):
+            # whole-tree refresh (opposite-subtree extremes, or the exact
+            # pairwise set for advanced); this wave's children re-pick
+            # under the refreshed (looser-than-midpoint) bounds
             out = _refresh_intermediate(out, mono_c, p)
             cbg2, cbf2, cbb2, cbgl2, cbhl2, cbcl2 = vpick(
                 unb(child_hists, cg, ch, cc), cg, ch, cc, cd,
@@ -1018,10 +1133,11 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     node_value = _leaf_output(state["sum_g"], state["sum_h"],
                               p.lambda_l1, p.lambda_l2)
     if mono_c is not None:
-        if p.monotone_method == "intermediate":
-            _, _, node_value = _intermediate_bounds(
-                state["split_feature"], state["left_child"],
-                state["right_child"], node_value, mono_c, n_iters=6)
+        if p.monotone_method in ("intermediate", "advanced"):
+            _, _, node_value = _tree_bounds(
+                state["split_feature"], state["split_bin"],
+                state["left_child"], state["right_child"], node_value,
+                mono_c, p, n_iters=6)
         else:
             node_value = jnp.clip(node_value, state["node_lo"],
                                   state["node_hi"])
@@ -1271,6 +1387,26 @@ def grow_tree_feature_parallel(
             node_lo=s["node_lo"].at[cids].set(c_lo),
             node_hi=s["node_hi"].at[cids].set(c_hi),
         )
+        if mono_global is not None and p.monotone_method in ("intermediate",
+                                                             "advanced"):
+            # the whole-tree refresh runs REPLICATED: tree arrays and
+            # sums are identical on every rank (splits are globally
+            # agreed), and the constraint vector is the static global
+            # tuple — so each rank recomputes the same bounds and the
+            # re-pick goes through global_pick's all_gather like any
+            # other pick
+            out = _refresh_intermediate(out, mono_global, p)
+            vg2 = jax.vmap(global_pick)(child_hists, cg, ch, cc, cd,
+                                        out["node_lo"][cids],
+                                        out["node_hi"][cids])
+            cbg2, cbf2, cbb2, cbgl2, cbhl2, cbcl2, cbthr2 = vg2
+            out["best_gain"] = out["best_gain"].at[cids].set(cbg2)
+            out["best_feat"] = out["best_feat"].at[cids].set(cbf2)
+            out["best_bin"] = out["best_bin"].at[cids].set(cbb2)
+            out["best_gl"] = out["best_gl"].at[cids].set(cbgl2)
+            out["best_hl"] = out["best_hl"].at[cids].set(cbhl2)
+            out["best_cl"] = out["best_cl"].at[cids].set(cbcl2)
+            out["best_thr"] = out["best_thr"].at[cids].set(cbthr2)
         out["active"] = out["active"].at[JUNK].set(False)
         out["best_gain"] = out["best_gain"].at[JUNK].set(-jnp.inf)
         out["split_feature"] = out["split_feature"].at[JUNK].set(-1)
@@ -1283,7 +1419,14 @@ def grow_tree_feature_parallel(
     node_value = _leaf_output(state["sum_g"], state["sum_h"],
                               p.lambda_l1, p.lambda_l2)
     if mono_global is not None:
-        node_value = jnp.clip(node_value, state["node_lo"], state["node_hi"])
+        if p.monotone_method in ("intermediate", "advanced"):
+            _, _, node_value = _tree_bounds(
+                state["split_feature"], state["split_bin"],
+                state["left_child"], state["right_child"], node_value,
+                mono_global, p, n_iters=6)
+        else:
+            node_value = jnp.clip(node_value, state["node_lo"],
+                                  state["node_hi"])
     node_value = learning_rate * node_value
     leaf_value = jnp.where(state["left_child"] < 0, node_value, 0.0)
     tree = Tree(split_feature=state["split_feature"],
