@@ -1,9 +1,10 @@
 """Model serving (reference: core Spark Serving layer)."""
 
+from .continuous import ContinuousClient
 from .distributed import DistributedServingServer, exchange_routing_table
 from .server import (ApiHandle, MultiPipelineServer, PipelineServer,
                      ServingReply, ServingRequest, ServingServer)
 
-__all__ = ["ApiHandle", "DistributedServingServer", "MultiPipelineServer",
-           "PipelineServer", "ServingReply", "ServingRequest",
-           "ServingServer", "exchange_routing_table"]
+__all__ = ["ApiHandle", "ContinuousClient", "DistributedServingServer",
+           "MultiPipelineServer", "PipelineServer", "ServingReply",
+           "ServingRequest", "ServingServer", "exchange_routing_table"]

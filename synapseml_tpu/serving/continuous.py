@@ -1,0 +1,105 @@
+"""Continuous-mode serving client: one persistent framed connection.
+
+The reference's continuous server mode keeps the HTTP exchange machinery
+out of the per-record path (reference: website/docs/features/
+spark_serving/about.md:18,151-154 — "continuousServer", sub-millisecond
+latency).  :class:`ContinuousClient` is the matching client for
+:meth:`ServingServer`'s ``Upgrade: sml-frames`` mode: after one HTTP/1.1
+upgrade handshake the connection carries length-prefixed binary frames
+both ways, replies always in request order.
+
+Pipelining is the point — ``request_many`` keeps a window of frames in
+flight so the server batches them into one ``transform`` and the
+per-record marginal cost is a 4-byte framed read, not an HTTP exchange.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Iterable, List, Optional, Tuple
+
+
+class ContinuousClient:
+    """Persistent framed connection to one ServingServer API.
+
+    >>> c = ContinuousClient(host, port, "/model")
+    >>> status, body = c.request(b'{"x": 1.0}')
+    >>> replies = c.request_many(payloads)      # pipelined, in order
+    """
+
+    def __init__(self, host: str, port: int, path: str = "/",
+                 timeout_s: float = 30.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rfile = self._sock.makefile("rb")
+        self._in_flight = 0
+        req = (f"GET {path or '/'} HTTP/1.1\r\n"
+               f"Host: {host}:{port}\r\n"
+               "Connection: Upgrade\r\n"
+               "Upgrade: sml-frames\r\n\r\n").encode("latin1")
+        self._sock.sendall(req)
+        status_line = self._rfile.readline().decode("latin1")
+        while True:                       # drain the handshake headers
+            line = self._rfile.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        if " 101 " not in status_line:
+            self.close()
+            raise ConnectionError(
+                f"continuous upgrade refused: {status_line.strip()!r}")
+
+    # -- framed protocol ---------------------------------------------------
+    def send(self, payload: bytes) -> None:
+        """Fire one request frame without waiting for its reply."""
+        self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+        self._in_flight += 1
+
+    def recv(self) -> Tuple[int, bytes]:
+        """Next in-order reply → (status, body)."""
+        hdr = self._rfile.read(4)
+        if len(hdr) < 4:
+            raise ConnectionError("continuous connection closed by server")
+        (total,) = struct.unpack("<I", hdr)
+        frame = self._rfile.read(total)
+        if len(frame) < total or total < 2:
+            raise ConnectionError("truncated continuous reply frame")
+        (status,) = struct.unpack("<H", frame[:2])
+        self._in_flight -= 1
+        return status, frame[2:]
+
+    def request(self, payload: bytes) -> Tuple[int, bytes]:
+        """One synchronous round trip (send + recv)."""
+        self.send(payload)
+        return self.recv()
+
+    def request_many(self, payloads: Iterable[bytes],
+                     window: int = 64) -> List[Tuple[int, bytes]]:
+        """Pipelined exchange: keep up to ``window`` frames in flight,
+        collect every reply in request order."""
+        out: List[Tuple[int, bytes]] = []
+        for p in payloads:
+            while self._in_flight >= max(1, window):
+                out.append(self.recv())
+            self.send(p)
+        while self._in_flight:
+            out.append(self.recv())
+        return out
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_WR)   # EOF ends the stream
+        except OSError:
+            pass
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "ContinuousClient":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.close()
+        return None

@@ -79,6 +79,7 @@ class ApiHandle:
     def __init__(self, path: str, max_queue: int = 1024,
                  reply_timeout_s: float = 30.0):
         self.path = path
+        self.max_queue = max_queue
         self.reply_timeout_s = reply_timeout_s
         self._queue: "Queue[_Exchange]" = Queue(maxsize=max_queue)
         self._pending: Dict[str, _Exchange] = {}
@@ -228,6 +229,13 @@ class ServingServer:
                         break
                     k, _, v = h.decode("latin1").partition(":")
                     headers[k.strip().lower()] = v.strip()
+                if headers.get("upgrade", "").lower() == "sml-frames":
+                    # continuous mode: the connection leaves HTTP for a
+                    # length-prefixed frame stream (the reference's
+                    # continuousServer analogue — one parse-free exchange
+                    # per record instead of one HTTP request)
+                    await self._handle_frames(reader, writer, path)
+                    break
                 te = headers.get("transfer-encoding", "").lower()
                 if "chunked" in te:
                     body = await self._read_chunked(reader, writer)
@@ -302,6 +310,126 @@ class ServingServer:
             except Exception:
                 pass
 
+    async def _await_reply(self, api: ApiHandle, ex: _Exchange):
+        """Attach this loop's waiter to ``ex`` and await its reply — the
+        ONE place the waiter-attach race and reply timeout live for both
+        the HTTP and frame paths.  The timeout is anchored at SUBMIT time
+        (``enqueued_at``), so pipelined frames awaited serially do not
+        compound each other's timeouts.  Always forgets the exchange;
+        raises ``asyncio.TimeoutError`` on expiry; returns the
+        ServingReply (None when the pipeline replied nothing)."""
+        fut = self._loop.create_future()
+        ex.waiter = (self._loop, fut)
+        if ex.event.is_set() and not fut.done():       # reply raced attach
+            fut.set_result(None)
+        remaining = max(
+            ex.request.enqueued_at + api.reply_timeout_s - time.monotonic(),
+            0.0)
+        try:
+            await asyncio.wait_for(fut, remaining)
+        finally:
+            api.forget(ex.request.id)
+        return ex.reply
+
+    async def _handle_frames(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter,
+                             path: str) -> None:
+        """Continuous (framed) mode: ``Upgrade: sml-frames``.
+
+        The reference's ``continuousServer`` keeps the exchange open and
+        streams record-at-a-time replies (spark_serving/about.md's
+        sub-millisecond continuous mode); the analogue here upgrades the
+        connection to a binary frame stream so the per-record cost drops
+        to one length-prefixed read — no request line, headers, routing,
+        or reply-head formatting per record.
+
+        Wire format: requests are ``u32le length + payload``; replies are
+        ``u32le (2+len) + u16le status + body``, always in request order
+        (a per-connection BOUNDED fifo of pending exchanges — a full
+        fifo backpressures the frame reader, so one fast client cannot
+        grow server memory without bound).  Client EOF ends the stream;
+        queued replies flush before close, and whatever neither side
+        consumed is forgotten so ``_pending`` never leaks."""
+        import struct
+
+        api = self._route(path)
+        if api is None:
+            writer.write(b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n"
+                         b"Connection: close\r\n\r\n")
+            await writer.drain()
+            return
+        writer.write(b"HTTP/1.1 101 Switching Protocols\r\n"
+                     b"Upgrade: sml-frames\r\nConnection: Upgrade\r\n\r\n")
+        await writer.drain()
+        conn = uuid.uuid4().hex
+        fifo: "asyncio.Queue" = asyncio.Queue(maxsize=max(api.max_queue, 1))
+
+        async def write_replies():
+            while True:
+                item = await fifo.get()
+                if item is None:
+                    return
+                if item[0] == "now":
+                    status, body = item[1]
+                else:
+                    try:
+                        rep = await self._await_reply(api, item[1])
+                        status = rep.status if rep else 500
+                        body = (rep.body if rep
+                                else b'{"error": "empty reply"}')
+                        if not isinstance(body, (bytes, bytearray)):
+                            # frames are single messages; stream bodies
+                            # (iterables) concatenate
+                            body = b"".join(bytes(c) for c in body)
+                    except asyncio.TimeoutError:
+                        status = 504
+                        body = b'{"error": "serving pipeline timeout"}'
+                writer.write(struct.pack("<IH", 2 + len(body), status)
+                             + bytes(body))
+                await writer.drain()
+
+        wtask = asyncio.ensure_future(write_replies())
+        seq = 0
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = struct.unpack("<I", hdr)
+                if ln > self.max_body_bytes:
+                    if not wtask.done():
+                        await fifo.put(("now", (413, b"")))
+                    break
+                payload = await reader.readexactly(ln) if ln else b""
+                req = ServingRequest(id=f"{conn}:{seq}", method="FRAME",
+                                     path=path, headers={}, body=payload)
+                seq += 1
+                ex = api.submit(req)
+                if wtask.done():          # writer died: stop accepting
+                    if ex is not None:
+                        api.forget(req.id)
+                    break
+                if ex is None:                          # backpressure
+                    await fifo.put(("now",
+                                    (503, b'{"error": "serving queue '
+                                          b'saturated"}')))
+                    continue
+                await fifo.put(("ex", ex))
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError):
+            pass                                        # client went away
+        finally:
+            if not wtask.done():
+                await fifo.put(None)                    # flush in order
+            try:
+                await wtask
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            # forget exchanges neither flushed nor timed out (writer died
+            # mid-burst) so ApiHandle._pending cannot leak
+            while not fifo.empty():
+                item = fifo.get_nowait()
+                if item is not None and item[0] == "ex":
+                    api.forget(item[1].request.id)
+
     async def _write_413(self, writer: asyncio.StreamWriter) -> None:
         writer.write(b"HTTP/1.1 413 Payload Too Large\r\n"
                      b"Content-Length: 0\r\nConnection: close\r\n\r\n")
@@ -345,17 +473,10 @@ class ServingServer:
         ex = api.submit(req)
         if ex is None:                                 # backpressure
             return 503, b'{"error": "serving queue saturated"}', {}
-        fut = self._loop.create_future()
-        ex.waiter = (self._loop, fut)
-        if ex.event.is_set() and not fut.done():       # reply raced attach
-            fut.set_result(None)
         try:
-            await asyncio.wait_for(fut, api.reply_timeout_s)
+            rep = await self._await_reply(api, ex)
         except asyncio.TimeoutError:
-            api.forget(req.id)
             return 504, b'{"error": "serving pipeline timeout"}', {}
-        api.forget(req.id)
-        rep = ex.reply
         if rep is None:
             return 500, b'{"error": "empty reply"}', {}
         return rep.status, rep.body, dict(rep.headers)
