@@ -94,8 +94,9 @@ class BoostingConfig:
     #: densification strategy (LightGBM enable_bundle).  Bundling only
     #: compresses histogram construction; split search, routing, and the
     #: trees stay in ORIGINAL feature space, so predict/SHAP/LightGBM
-    #: export/monotone constraints all work unchanged (dart and
-    #: voting_parallel are the exceptions and reject loudly).
+    #: export/monotone constraints/dart/voting_parallel all work
+    #: unchanged (feature_parallel is the one exception and rejects
+    #: loudly: bundling changes the per-rank feature axis).
     enable_bundle: bool = False
     max_conflict_rate: float = 0.0
     #: feature indexes holding category codes (categoricalSlotIndexes,
@@ -825,12 +826,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             config = dataclasses.replace(
                 config, num_iterations=config.num_iterations - done)
             init_model = resumed
-    if config.enable_bundle:
-        if config.parallelism == "voting_parallel":
-            raise NotImplementedError(
-                "enable_bundle + voting_parallel: feature votes are "
-                "per original feature but voting aggregates bundled "
-                "histogram columns; use data_parallel")
     source = X if hasattr(X, "iter_chunks") else None
     if source is not None:
         n, F = source.num_rows, source.num_features

@@ -543,8 +543,6 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     # features inside _best_split_voting; full data-parallel psums every
     # histogram as it is built
     voting = p.voting_k > 0 and axis_name is not None
-    assert not (voting and bundle_map is not None), \
-        "voting_parallel + EFB is rejected at the train() surface"
     F_search = num_bins.shape[0]           # ORIGINAL feature count
     mono_c = _mono_vec(p, F_search)
 
@@ -559,6 +557,16 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
 
     if voting:
         def pick(hist3, g, h, c, depth, lo, hi):
+            if bundle_map is not None:
+                # unbundle the LOCAL histograms before voting: gather and
+                # residual are linear, so the selective psum of unbundled
+                # columns equals unbundling the psum — votes and the
+                # aggregated gains both live in ORIGINAL feature space.
+                # The local node totals come from bundled column 0, whose
+                # bins cover every row of the node exactly once
+                ltot = jnp.sum(hist3[0], axis=0)
+                hist3 = _unbundle_hists(hist3, bundle_map["gather_src"],
+                                        ltot)
             return _best_split_voting(hist3, g, h, c, num_bins, feature_mask,
                                       depth, p, axis_name, lo, hi, mono_c)
     else:

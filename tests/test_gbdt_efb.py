@@ -195,6 +195,28 @@ def test_efb_composes_with_monotone():
     assert viol <= 1e-6, viol
 
 
+def test_efb_voting_parallel_matches_unbundled():
+    """EFB x voting_parallel (previously rejected): the LOCAL histograms
+    unbundle before the vote — gather and residual are linear, so the
+    selective psum of unbundled columns equals unbundling the psum, and
+    votes/gains/splits all live in original feature space.  Bundled
+    voting grows the same split features as unbundled voting."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = onehot_data(n=2048)
+    kw = dict(objective="binary", num_iterations=6, num_leaves=15,
+              min_data_in_leaf=5, parallelism="voting_parallel", top_k=8)
+    mesh = data_parallel_mesh(8)
+    b_plain, _ = train(X, y, BoostingConfig(**kw), mesh=mesh)
+    b_efb, _ = train(X, y, BoostingConfig(enable_bundle=True, **kw),
+                     mesh=mesh)
+    assert b_efb.bundler is not None
+    for t_p, t_e in zip(b_plain.trees, b_efb.trees):
+        np.testing.assert_array_equal(np.asarray(t_p.split_feature),
+                                      np.asarray(t_e.split_feature))
+    np.testing.assert_allclose(b_plain.predict_margin(X[:512]),
+                               b_efb.predict_margin(X[:512]), atol=2e-3)
+
+
 def test_efb_dart_matches_unbundled_dart():
     """EFB x dart (previously rejected): dart's drop/rescore traverses
     the BUNDLED device matrix through the universal routing form, so

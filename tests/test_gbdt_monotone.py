@@ -167,6 +167,23 @@ def test_monotone_validation_errors():
                                    categorical_feature=[0]))
 
 
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_monotone_refresh_methods_voting_parallel(method):
+    """voting_parallel x intermediate/advanced: the whole-tree refresh
+    re-picks through the voting pick (selective psum) — the sharded
+    model stays provably monotone."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = mono_data(n=4096, seed=10)
+    cfg = BoostingConfig(objective="regression", num_iterations=4,
+                         num_leaves=15, min_data_in_leaf=5,
+                         monotone_constraints=CONS,
+                         monotone_constraints_method=method,
+                         parallelism="voting_parallel", top_k=2)
+    b, _ = train(X, y, cfg, mesh=data_parallel_mesh(8))
+    assert max_violation(sweep_margins(b, 0), +1) <= 1e-6
+    assert max_violation(sweep_margins(b, 1), -1) <= 1e-6
+
+
 def test_monotone_estimator_params():
     from synapseml_tpu import Dataset
     from synapseml_tpu.models.gbdt import GBDTRegressor
