@@ -220,17 +220,18 @@ def bench_gbdt(X, y, max_bin=GBDT_MAX_BIN):
     cfg = BoostingConfig(objective="binary", num_iterations=GBDT_ITERS,
                          num_leaves=31, max_bin=max_bin)
     train(X, y, cfg)     # compile the scanned whole-run program off-window
-    # MEDIAN of three measured runs (same estimator as the BERT windows and
-    # the CPU anchor): robust to one contended window on the shared chip
-    # without the upward bias of a max
+    # MEDIAN of five measured runs (same estimator as the BERT windows and
+    # the CPU anchor): co-tenant windows on the shared chip swing up to
+    # 2x, and five samples make the median robust to two bad windows
+    # where three tolerated only one
     runs = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         booster, _ = train(X, y, cfg)
         dt = time.perf_counter() - t0
         runs.append((GBDT_ITERS / dt,
                      booster.measures.iterations_per_sec(), booster))
-    full, steady, booster = sorted(runs, key=lambda t: t[0])[1]
+    full, steady, booster = sorted(runs, key=lambda t: t[0])[len(runs) // 2]
     # model quality on a fresh holdout from the same generator — guards the
     # speed number against a silently degenerate model
     rng = np.random.default_rng(7)
