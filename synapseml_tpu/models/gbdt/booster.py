@@ -94,9 +94,9 @@ class BoostingConfig:
     #: densification strategy (LightGBM enable_bundle).  Bundling only
     #: compresses histogram construction; split search, routing, and the
     #: trees stay in ORIGINAL feature space, so predict/SHAP/LightGBM
-    #: export/monotone constraints/dart/voting_parallel all work
-    #: unchanged (feature_parallel is the one exception and rejects
-    #: loudly: bundling changes the per-rank feature axis).
+    #: export/monotone constraints/dart and ALL THREE parallelism modes
+    #: work unchanged (feature_parallel bundles each rank's slice
+    #: independently — bundles never cross rank boundaries).
     enable_bundle: bool = False
     max_conflict_rate: float = 0.0
     #: feature indexes holding category codes (categoricalSlotIndexes,
@@ -481,6 +481,7 @@ def _step_factory_args(config: "BoostingConfig", K: int, mesh, featpar: bool,
                   use_pallas=use_pallas,
                   growth_policy=config.growth_policy,
                   feature_parallel=featpar,
+                  bundled_featpar=bool(featpar and config.enable_bundle),
                   bagging_fraction=(config.bagging_fraction
                                     if use_bagging else 1.0))
     return args, kwargs
@@ -555,7 +556,8 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                top_rate: float, other_rate: float, ova: bool = False,
                use_pallas: bool = False, bagging_fraction: float = 1.0,
                growth_policy: str = "depthwise",
-               feature_parallel: bool = False):
+               feature_parallel: bool = False,
+               bundled_featpar: bool = False):
     """Build the jitted one-iteration step.
 
     step(binned, scores, labels, weights, (base_bag, bag_key),
@@ -651,13 +653,19 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
 
     ndim_scores = 1 if num_class == 1 else 2
     if feature_parallel:
-        # vertical sharding: FEATURES split over the axis, rows replicated
-        in_specs = (P(DATA_AXIS, None),                    # bins_t (F, N)
+        # vertical sharding: FEATURES split over the axis, rows replicated.
+        # Under EFB the per-rank route tables shard on their (stacked)
+        # original-feature axis exactly like bounds/nbins
+        bm_spec = ({"col": P(DATA_AXIS), "lo": P(DATA_AXIS),
+                    "hi": P(DATA_AXIS), "default_bin": P(DATA_AXIS),
+                    "gather_src": P(DATA_AXIS, None)}
+                   if bundled_featpar else P())
+        in_specs = (P(DATA_AXIS, None),                    # bins_t (Fb, N)
                     P(), P(), P(),                         # scores/labels/w
                     (P(), P()),                            # (base_bag, key)
                     P(DATA_AXIS), P(),                     # fmask/key
                     P(DATA_AXIS, None), P(DATA_AXIS),      # bounds/nbins
-                    P())                                   # bundle_map (n/a)
+                    bm_spec)                               # route tables
         out_specs = (P(), P())                             # all replicated
     else:
         in_specs = (P(None, DATA_AXIS),                    # bins_t (F, N)
@@ -1104,14 +1112,29 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         return mapper.transform(mat).astype(np.uint16)
 
     # exclusive feature bundling: fit on a binned sample, then every
-    # chunk/matrix flows through the bundle remap before device upload
+    # chunk/matrix flows through the bundle remap before device upload.
+    # feature_parallel fits ONE BUNDLER PER RANK SLICE (bundles never
+    # cross rank boundaries, so vertical sharding and bundling compose);
+    # every rank's bundled block pads to the widest rank's bundle count
+    # so the sharded matrix stays rectangular
     bundler = None
+    rank_bundlers = None
+    Fsl = Fp // shards if featpar else 0
+    # ONE padded num_bins vector (pad features: 1 bin, never split) and ONE
+    # column padder — the route tables, bundler fits, chunk binning and the
+    # device num_bins below must all agree on the padding convention
+    _nb_pad = mapper.num_bins if Fp == F else np.concatenate(
+        [mapper.num_bins, np.ones(Fp - F, mapper.num_bins.dtype)])
+
+    def _pad_cols_to_fp(mat):
+        if Fp == F:
+            return mat
+        return np.concatenate(
+            [mat, np.zeros((len(mat), Fp - F), mat.dtype)], axis=1)
+
     if config.enable_bundle:
-        if featpar:
-            raise NotImplementedError(
-                "enable_bundle + feature_parallel: bundling changes the "
-                "feature axis per rank; use data_parallel/voting_parallel")
-        if init_model is not None and init_model.bundler is not None:
+        if init_model is not None and init_model.bundler is not None \
+                and not featpar:
             bundler = init_model.bundler
         else:
             if source is not None:
@@ -1120,10 +1143,23 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             else:
                 take = min(n, 50_000)
                 sample_mat = X[:take]
-            bundler = FeatureBundler.fit(
-                bin_host(np.ascontiguousarray(sample_mat, np.float32)),
-                mapper.num_bins, max_total_bins=config.max_bin + 1,
-                max_conflict_rate=config.max_conflict_rate)
+            sample_b = bin_host(np.ascontiguousarray(sample_mat, np.float32))
+            if featpar:
+                sample_b = _pad_cols_to_fp(sample_b)
+                rank_bundlers = [
+                    FeatureBundler.fit(
+                        sample_b[:, r * Fsl:(r + 1) * Fsl],
+                        _nb_pad[r * Fsl:(r + 1) * Fsl],
+                        max_total_bins=config.max_bin + 1,
+                        max_conflict_rate=config.max_conflict_rate)
+                    for r in range(shards)]
+            else:
+                bundler = FeatureBundler.fit(
+                    sample_b, mapper.num_bins,
+                    max_total_bins=config.max_bin + 1,
+                    max_conflict_rate=config.max_conflict_rate)
+    Fb_rank = (max(b.num_bundles for b in rank_bundlers)
+               if rank_bundlers else 0)
 
     if (bundler is not None and pallas_candidate and uses_fused
             and not use_pallas):
@@ -1135,6 +1171,17 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
     def bin_eff(mat):
         b = bin_host(mat)
+        if rank_bundlers is not None:
+            b = _pad_cols_to_fp(b)
+            parts = []
+            for r, br in enumerate(rank_bundlers):
+                t = br.transform(b[:, r * Fsl:(r + 1) * Fsl])
+                if t.shape[1] < Fb_rank:
+                    t = np.concatenate(
+                        [t, np.zeros((len(t), Fb_rank - t.shape[1]),
+                                     t.dtype)], axis=1)
+                parts.append(t)
+            return np.concatenate(parts, axis=1)
         return bundler.transform(b) if bundler is not None else b
 
     if mesh is None:
@@ -1150,9 +1197,9 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         slice (P(None, data)) — replicating the full matrix would multiply
         both link traffic and HBM by the rank count."""
         if featpar:
-            if Fp != F:
-                mat = np.concatenate(
-                    [mat, np.zeros((len(mat), Fp - F), mat.dtype)], axis=1)
+            if rank_bundlers is None:
+                # (the EFB path pads + bundles inside bin_eff already)
+                mat = _pad_cols_to_fp(mat)
             return jax.device_put(mat, NamedSharding(mesh, P(None, DATA_AXIS)))
         return put(mat, 2)
 
@@ -1198,7 +1245,12 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             dev_chunks.append(put_bins(b[:keep]))
     tail_rows = (len(carry) if carry is not None else 0) + stream_pad
     if tail_rows:
-        pad_f = bundler.num_bundles if bundler is not None else F
+        if rank_bundlers is not None:
+            pad_f = shards * Fb_rank
+        elif bundler is not None:
+            pad_f = bundler.num_bundles
+        else:
+            pad_f = F
         tail = np.zeros((tail_rows, pad_f), bin_dt)
         if carry is not None and len(carry):
             tail[:len(carry)] = carry
@@ -1248,7 +1300,20 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     ub_np = mapper.upper_bounds
     nb_np = mapper.num_bins
     bundle_map_dev = None
-    if bundler is not None:
+    if rank_bundlers is not None:
+        # per-rank route tables stacked on the ORIGINAL feature axis and
+        # sharded like bounds/nbins — each rank sees its own tables, whose
+        # col/gather_src indices point into its own padded bundled slice
+        maps = [br.route_tables(_nb_pad[r * Fsl:(r + 1) * Fsl], B_total)
+                for r, br in enumerate(rank_bundlers)]
+        bundle_map_dev = {}
+        for k in maps[0]:
+            stacked = np.concatenate([m[k] for m in maps], axis=0)
+            spec = P(DATA_AXIS, None) if stacked.ndim == 2 else P(DATA_AXIS)
+            bundle_map_dev[k] = jax.device_put(
+                jnp.asarray(stacked.astype(np.int32)),
+                NamedSharding(mesh, spec))
+    elif bundler is not None:
         bm = bundler.route_tables(mapper.num_bins, B_total)
         bundle_map_dev = {k: jnp.asarray(v.astype(np.int32))
                           for k, v in bm.items()}
@@ -1258,7 +1323,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if Fp != F:                         # padded features: 1 bin, never split
         ub_np = np.concatenate(
             [ub_np, np.full((Fp - F, ub_np.shape[1]), np.inf, np.float32)])
-        nb_np = np.concatenate([nb_np, np.ones(Fp - F, np.int32)])
+        nb_np = _nb_pad.astype(np.int32)
     upper_bounds = jnp.asarray(ub_np)
     num_bins = jnp.asarray(nb_np)
     if mesh is not None:

@@ -1194,19 +1194,25 @@ def grow_tree_feature_parallel(
         axis_name: str,
         use_pallas: bool = False,
         n_slots: int = 16,
-        bundle_map: Optional[dict] = None,    # EFB+featpar rejected upstream
+        bundle_map: Optional[dict] = None,
 ) -> Tuple[Tree, jnp.ndarray]:
     """Depth-level growth with the FEATURE axis sharded over ``axis_name``.
 
     Returns the identical tree on every rank; ``split_feature`` carries
     GLOBAL feature ids (rank · F_local + local id).  Semantics match
     :func:`grow_tree_depthwise` on the unsharded data.
+
+    Under EFB, ``bins_t`` holds THIS RANK's bundled columns (each rank
+    bundles its own slice, padded to a common width) and ``bundle_map``
+    its route tables: local histograms unbundle before every pick, and
+    the owner routes splits through the universal routing form — trees
+    stay in ORIGINAL (global) feature space exactly like the other
+    growers' EFB paths.
     """
     from .pallas_hist import prep_hist_vals
 
-    assert bundle_map is None, \
-        "feature_parallel + EFB is rejected at the train() surface"
-    FL, N = bins_t.shape
+    FL, N = bins_t.shape              # bundled column count under EFB
+    F_loc = num_bins.shape[0]         # ORIGINAL features on this rank
     B = p.total_bins
     L = p.num_leaves
     M = max_nodes(L)
@@ -1232,11 +1238,17 @@ def grow_tree_feature_parallel(
     # constraints come from the static tuple in p, so the GLOBAL vector is
     # available on every rank; each rank's gain pass slices its own span
     n_ranks = lax.axis_size(axis_name)
-    mono_global = _mono_vec(p, FL * n_ranks)
+    mono_global = _mono_vec(p, F_loc * n_ranks)
     mono_local = (None if mono_global is None else
-                  lax.dynamic_slice(mono_global, (rank * FL,), (FL,)))
+                  lax.dynamic_slice(mono_global, (rank * F_loc,), (F_loc,)))
 
     def pick_local(hist, g, h, c, depth, lo, hi):
+        if bundle_map is not None:
+            # unbundle this rank's LOCAL bundled histograms to its
+            # original features before the gain pass (the same linearity
+            # the voting pick leans on)
+            hist = _unbundle_hists(hist, bundle_map["gather_src"],
+                                   jnp.stack([g, h, c], -1))
         return _best_split(hist, g, h, c, num_bins, feature_mask, depth, p,
                            lo, hi, mono_local)
 
@@ -1248,7 +1260,7 @@ def grow_tree_feature_parallel(
                                                 lo, hi)
         thr = jnp.where(bb >= 1, upper_bounds[bf_, jnp.maximum(bb - 1, 0)],
                         -jnp.inf)
-        packed = jnp.stack([bg, (rank * FL + bf_).astype(jnp.float32),
+        packed = jnp.stack([bg, (rank * F_loc + bf_).astype(jnp.float32),
                             bb.astype(jnp.float32), bgl, bhl, bcl, thr])
         allp = lax.all_gather(packed, axis_name)           # (ranks, 7)
         win = jnp.argmax(allp[:, 0])
@@ -1312,13 +1324,18 @@ def grow_tree_feature_parallel(
 
         # owner-exclusive routing: this rank contributes the go-left mask
         # only for slots whose winning feature lives in its slice; one psum
-        # assembles every slot's mask on every rank
+        # assembles every slot's mask on every rank.  Routing goes through
+        # the universal form so plain and EFB splits share one path
         wf = s["best_feat"][parents]                        # (S,) global ids
         wb = s["best_bin"][parents]
-        owner = wf // FL
-        floc = jnp.clip(wf - rank * FL, 0, FL - 1)
+        owner = wf // F_loc
+        floc = jnp.clip(wf - rank * F_loc, 0, F_loc - 1)
         mine = (owner == rank) & valid
-        local_gl = (bins_t[floc, :] <= wb[:, None])         # (S, N)
+        col_s, t1_s, lo_s, hi_s, df_s = _slot_route_params(
+            floc, wb, B, bundle_map)
+        local_gl = _route_left(bins_t[col_s, :], t1_s[:, None],
+                               lo_s[:, None], hi_s[:, None],
+                               df_s[:, None])               # (S, N)
         gl_slots = lax.psum(
             jnp.where(mine[:, None], local_gl, False).astype(jnp.int8),
             axis_name) > 0                                  # (S, N) bool

@@ -217,6 +217,53 @@ def test_efb_voting_parallel_matches_unbundled():
                                b_efb.predict_margin(X[:512]), atol=2e-3)
 
 
+def test_efb_feature_parallel_matches_unbundled():
+    """EFB x feature_parallel (previously rejected): each rank bundles
+    its own feature slice (bundles never cross rank boundaries), local
+    histograms unbundle before every pick, and the owner routes splits
+    through the universal routing form.  Bundled feature-parallel grows
+    the same split features as unbundled feature-parallel."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = onehot_data(n=2048)
+    kw = dict(objective="binary", num_iterations=6, num_leaves=15,
+              min_data_in_leaf=5, parallelism="feature_parallel")
+    mesh = data_parallel_mesh(8)
+    b_plain, _ = train(X, y, BoostingConfig(**kw), mesh=mesh)
+    b_efb, _ = train(X, y, BoostingConfig(enable_bundle=True, **kw),
+                     mesh=mesh)
+    for t_p, t_e in zip(b_plain.trees, b_efb.trees):
+        np.testing.assert_array_equal(np.asarray(t_p.split_feature),
+                                      np.asarray(t_e.split_feature))
+    np.testing.assert_allclose(b_plain.predict_margin(X[:512]),
+                               b_efb.predict_margin(X[:512]), atol=2e-3)
+    a = auc(y, b_efb.predict_margin(X))
+    assert a > 0.85, a
+
+
+def test_efb_feature_parallel_padded_features():
+    """F=61 on 8 shards exercises every Fp != F padding branch of the
+    featpar EFB path (rank-bundler fit, chunk binning, tail block, route
+    tables).  Split-feature equality is tie-fragile under padding
+    (degenerate near-zero gains), so the pin is margins + quality."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = onehot_data(n=2048)
+    X = X[:, :61]                     # 61 features: 8 shards pad to 64
+    kw = dict(objective="binary", num_iterations=6, num_leaves=15,
+              min_data_in_leaf=5, parallelism="feature_parallel")
+    mesh = data_parallel_mesh(8)
+    b_plain, _ = train(X, y, BoostingConfig(**kw), mesh=mesh)
+    b_efb, _ = train(X, y, BoostingConfig(enable_bundle=True, **kw),
+                     mesh=mesh)
+    # padded features (global ids 61-63) must never be split on
+    feats = np.concatenate([np.asarray(t.split_feature)
+                            for t in b_efb.trees])
+    assert feats.max() < 61, feats.max()
+    np.testing.assert_allclose(b_plain.predict_margin(X[:512]),
+                               b_efb.predict_margin(X[:512]), atol=5e-3)
+    a = auc(y, b_efb.predict_margin(X))
+    assert a > 0.8, a
+
+
 def test_efb_dart_matches_unbundled_dart():
     """EFB x dart (previously rejected): dart's drop/rescore traverses
     the BUNDLED device matrix through the universal routing form, so
