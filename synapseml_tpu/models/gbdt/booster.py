@@ -992,10 +992,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         from .pallas_hist import fused_geometry
         use_pallas = fused_geometry(
             F, B_total, default_n_slots(config.num_leaves)) is not None
-    if featpar and config.boosting_type == "dart":
-        raise NotImplementedError(
-            "feature_parallel + dart: dart rescoring traverses binned "
-            "columns that are sharded across ranks; use data_parallel")
     if featpar and config.growth_policy == "lossguide":
         raise NotImplementedError(
             "feature_parallel grows depth-level waves; strict lossguide "
@@ -1433,6 +1429,39 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # leaf-wise depth is bounded by num_leaves-1 splits; never truncate
     depth_hint = max(2, config.num_leaves)
 
+    # dart under feature_parallel: rescoring traverses the SHARDED binned
+    # matrix with owner-broadcast go-left masks (one psum per level, the
+    # training routing pattern) instead of gathering columns
+    _fp_tree_predict = None
+    if featpar and is_dart:
+        _bm_spec = ({"col": P(DATA_AXIS), "lo": P(DATA_AXIS),
+                     "hi": P(DATA_AXIS), "default_bin": P(DATA_AXIS),
+                     "gather_src": P(DATA_AXIS, None)}
+                    if bundle_map_dev is not None else None)
+        from .trainer import predict_binned_tree_featpar as _fp_body
+
+        def _mk_fp_predict():
+            in_specs = [P(DATA_AXIS, None), P()]
+            if _bm_spec is not None:
+                in_specs.append(_bm_spec)
+
+            def inner(bl, tree, *bm):
+                return _fp_body(bl, tree, depth_hint, B_total, DATA_AXIS,
+                                bundle_map=bm[0] if bm else None)
+
+            sm = jax.shard_map(inner, mesh=mesh, in_specs=tuple(in_specs),
+                               out_specs=P())
+            if _bm_spec is not None:
+                return jax.jit(lambda b, t: sm(b, t, bundle_map_dev))
+            return jax.jit(sm)
+        _fp_tree_predict = _mk_fp_predict()
+
+    def _dart_tree_predict(tree_dev):
+        if _fp_tree_predict is not None:
+            return _fp_tree_predict(bins_t, tree_dev)
+        return _predict_binned_tree(bins_t, tree_dev, depth_hint,
+                                    bundle_map_dev, B_total)
+
     if _warm_thread is not None:
         _warm_thread.join()
 
@@ -1516,9 +1545,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             drop_mask = rng.random(len(trees)) < config.drop_rate
             dropped = list(np.nonzero(drop_mask)[0][:config.max_drop])
             for d in dropped:
-                contrib = _predict_binned_tree(bins_t, _to_device_tree(trees[d]),
-                                               depth_hint,
-                                               bundle_map_dev, B_total) * tree_weights[d]
+                contrib = (_dart_tree_predict(_to_device_tree(trees[d]))
+                           * tree_weights[d])
                 scores = _sub_scores(scores, contrib, tree_class[d], K)
 
         # mask to 32 bits so looped and scanned runs derive identical keys
@@ -1544,17 +1572,15 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             new_w = 1.0 / (ndrop + 1)
             factor = ndrop / (ndrop + 1)
             for k in range(K):
-                contrib = _predict_binned_tree(bins_t, _to_device_tree(new_trees[k]),
-                                               depth_hint,
-                                               bundle_map_dev, B_total) * new_w
+                contrib = (_dart_tree_predict(_to_device_tree(new_trees[k]))
+                           * new_w)
                 scores = _add_scores(scores, contrib, k, K)
             for d in dropped:
                 old_w = tree_weights[d]
                 tree_weights[d] = old_w * factor
                 dropped_weight_changes.append((d, old_w))
-                contrib = _predict_binned_tree(bins_t, _to_device_tree(trees[d]),
-                                               depth_hint,
-                                               bundle_map_dev, B_total) * tree_weights[d]
+                contrib = (_dart_tree_predict(_to_device_tree(trees[d]))
+                           * tree_weights[d])
                 scores = _add_scores(scores, contrib, tree_class[d], K)
             weights_new = [new_w] * K
         else:

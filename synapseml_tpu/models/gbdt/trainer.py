@@ -1471,6 +1471,46 @@ def grow_tree_feature_parallel(
 
 # -- prediction -------------------------------------------------------------
 
+def predict_binned_tree_featpar(bins_local: jnp.ndarray,   # (FL, N) local
+                                tree: Tree,                # replicated
+                                depth_bound: int,
+                                total_bins: int,
+                                axis_name: str,
+                                bundle_map: Optional[dict] = None):
+    """One tree's leaf values over a FEATURE-SHARDED binned matrix — runs
+    INSIDE shard_map.  Each traversal step's go-left mask is computed by
+    the rank owning the split feature and broadcast with one psum (the
+    same owner-exclusive pattern the feature-parallel grower's routing
+    uses), so dart rescoring works without gathering the matrix.  Under
+    EFB the owner routes through its local route tables (universal
+    routing form)."""
+    FL, N = bins_local.shape
+    F_loc = (bundle_map["col"].shape[0] if bundle_map is not None else FL)
+    rank = lax.axis_index(axis_name)
+    rows = jnp.arange(N)
+
+    def step(_, node):
+        feat = tree.split_feature[node]                  # GLOBAL id
+        is_leaf = feat < 0
+        f = jnp.maximum(feat, 0)
+        owner = f // F_loc
+        floc = jnp.clip(f - rank * F_loc, 0, F_loc - 1)
+        col, t1, rlo, rhi, dflt = _slot_route_params(
+            floc, tree.split_bin[node], total_bins, bundle_map)
+        gl_local = _route_left(bins_local[col, rows], t1, rlo, rhi, dflt)
+        # int8 like the grower's routing psum: the owner-exclusive 0/1
+        # mask sums to at most 1, and int32 would 4x the ICI traffic
+        gl = lax.psum(jnp.where(owner == rank,
+                                gl_local.astype(jnp.int8),
+                                jnp.int8(0)),
+                      axis_name) > 0
+        child = jnp.where(gl, tree.left_child[node], tree.right_child[node])
+        return jnp.where(is_leaf, node, child)
+
+    leaf = lax.fori_loop(0, depth_bound, step, jnp.zeros(N, jnp.int32))
+    return tree.leaf_value[leaf]
+
+
 def _traverse(binned, tree: Tree, depth_bound: int):
     """Vectorized binned-feature traversal: (N, F) → leaf node id (N,)."""
     N = binned.shape[0]

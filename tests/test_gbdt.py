@@ -190,11 +190,37 @@ def test_feature_parallel_estimator_and_guards():
     model = clf.fit(ds)
     out = model.transform(ds)
     assert auc(y, np.stack(out["probability"])[:, 1]) > 0.9
-    # dart traversal needs unsharded binned columns — rejected loudly
-    bad = BoostingConfig(objective="binary", boosting_type="dart",
+    # strict lossguide order is inherent to wave-free growth — featpar
+    # grows depth-level waves and rejects loudly
+    bad = BoostingConfig(objective="binary", growth_policy="lossguide",
                          parallelism="feature_parallel", num_iterations=2)
-    with pytest.raises(NotImplementedError, match="feature_parallel"):
+    with pytest.raises(NotImplementedError, match="lossguide"):
         train(X, y, bad, mesh=data_parallel_mesh(8))
+
+
+def test_feature_parallel_dart_matches_single_device():
+    """dart + feature_parallel (previously rejected): rescoring traverses
+    the SHARDED binned matrix with owner-broadcast go-left masks (one
+    psum per level, the training routing pattern).  Same host rng seed
+    => same drop decisions, and the sharded run grows the same trees as
+    single-device depthwise dart."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(2000, 11)).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+         + rng.normal(scale=0.5, size=2000) > 0).astype(np.float64)
+    kw = dict(objective="binary", boosting_type="dart", num_iterations=8,
+              num_leaves=15, min_data_in_leaf=5, drop_rate=0.3,
+              skip_drop=0.2, seed=13)
+    b1, _ = train(X, y, BoostingConfig(growth_policy="depthwise", **kw))
+    bf, _ = train(X, y, BoostingConfig(parallelism="feature_parallel",
+                                       **kw),
+                  mesh=data_parallel_mesh(8))
+    for t_p, t_e in zip(b1.trees, bf.trees):
+        np.testing.assert_array_equal(np.asarray(t_p.split_feature),
+                                      np.asarray(t_e.split_feature))
+    np.testing.assert_allclose(b1.predict_margin(X[:512]),
+                               bf.predict_margin(X[:512]), atol=1e-4)
 
 
 def test_voting_parallel_estimator():

@@ -240,6 +240,26 @@ def test_efb_feature_parallel_matches_unbundled():
     assert a > 0.85, a
 
 
+def test_efb_feature_parallel_dart():
+    """The triple: EFB x feature_parallel x dart — dart's owner-broadcast
+    rescore routes through each rank's local route tables (the bundled
+    universal form), and the run matches single-device EFB dart."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    X, y = onehot_data(n=2048)
+    kw = dict(objective="binary", num_iterations=6, num_leaves=15,
+              min_data_in_leaf=5, boosting_type="dart", drop_rate=0.3,
+              skip_drop=0.2, seed=7, enable_bundle=True)
+    b1, _ = train(X, y, BoostingConfig(growth_policy="depthwise", **kw))
+    bf, _ = train(X, y, BoostingConfig(parallelism="feature_parallel",
+                                       **kw),
+                  mesh=data_parallel_mesh(8))
+    for t_p, t_e in zip(b1.trees, bf.trees):
+        np.testing.assert_array_equal(np.asarray(t_p.split_feature),
+                                      np.asarray(t_e.split_feature))
+    np.testing.assert_allclose(b1.predict_margin(X[:512]),
+                               bf.predict_margin(X[:512]), atol=2e-3)
+
+
 def test_efb_feature_parallel_padded_features():
     """F=61 on 8 shards exercises every Fp != F padding branch of the
     featpar EFB path (rank-bundler fit, chunk binning, tail block, route
