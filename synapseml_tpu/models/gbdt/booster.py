@@ -821,11 +821,13 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     measures = InstrumentationMeasures()
     _t0 = _time.perf_counter()
     if checkpoint_dir and checkpoint_interval > 0:
-        if config.boosting_type in ("dart", "rf"):
+        if config.boosting_type == "dart":
             raise NotImplementedError(
-                "checkpoint/resume supports gbdt/goss: dart reweights and "
-                "rf averages earlier trees, so a truncated prefix is not a "
-                "valid model to resume from")
+                "checkpoint/resume supports gbdt/goss/rf: dart reweights "
+                "EARLIER trees during later drop iterations, so a resumed "
+                "run cannot continue the drop/normalize sequence.  rf "
+                "resumes fine: prediction averages over the tree count, "
+                "so any prefix is itself a valid rf model")
         resumed = _latest_checkpoint(checkpoint_dir)
         if resumed is not None:
             done = resumed.num_trees // max(resumed.num_class, 1)
@@ -958,7 +960,13 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
     # -- init score (boost_from_average) -----------------------------------
     if init_model is not None:
-        if source is not None:
+        if config.boosting_type == "rf":
+            # rf trees are INDEPENDENT fits at the constant init margin —
+            # continued training must not boost from the ensemble margin
+            # (and must not pay a full carried-model prediction pass only
+            # to discard it)
+            base_margin = None
+        elif source is not None:
             base_margin = np.concatenate(
                 [init_model.predict_margin(cx)
                  for cx, _, _ in source.iter_chunks()])
@@ -1281,7 +1289,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         weights = dev_fill(1.0, (N,))
     else:
         weights = put(w, 1)
-    if init_model is not None:
+    if init_model is not None and base_margin is not None:
         if pad:
             shp = (pad,) if base_margin.ndim == 1 else (pad, K)
             base_margin = np.concatenate(
@@ -1402,6 +1410,17 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     best_iter = -1
     rounds_no_improve = 0
 
+    # continued training picks the bag/key streams up where the carried
+    # model left off: replaying iteration indices from 0 would hand a
+    # resumed rf the SAME subsamples (and, at the constant init margin,
+    # the IDENTICAL trees) it already has
+    prior_iters = (len(init_model.trees) // max(K, 1)
+                   if init_model is not None else 0)
+    if prior_iters and config.feature_fraction < 1.0:
+        k = max(1, int(round(F * config.feature_fraction)))
+        for _ in range(prior_iters):      # fast-forward the host stream
+            rng.choice(F, k, replace=False)
+
     rf_denominator = 0
     bag = np.ones(N, np.float32)
     if lr_pack is not None:
@@ -1488,7 +1507,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 bins_t, sc, labels, weights, base_bag_dev, bag_root_key,
                 fmask_dev, upper_bounds, num_bins, bundle_map_dev,
                 init_scores_dev if is_rf else scores,
-                jnp.asarray(ci * SCAN_CHUNK, jnp.int32))
+                jnp.asarray(prior_iters + ci * SCAN_CHUNK, jnp.int32))
             chunk_stacks.append(tstacks)
             if ci == 0:
                 # first dispatch returns once compiled; execution is async
@@ -1523,8 +1542,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         # bagging (bagging_fraction/freq semantics): the mask is drawn on
         # device from this key; reusing a key across freq iterations
         # reproduces the persist-until-refresh behavior
-        bag_key = jax.random.fold_in(bag_root_key,
-                                     it // max(config.bagging_freq, 1))
+        bag_key = jax.random.fold_in(
+            bag_root_key, (prior_iters + it) // max(config.bagging_freq, 1))
         if config.feature_fraction < 1.0:
             k = max(1, int(round(F * config.feature_fraction)))
             feature_mask = np.zeros(Fp, bool)  # padded features stay off
@@ -1551,7 +1570,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
 
         # mask to 32 bits so looped and scanned runs derive identical keys
         # even under jax_enable_x64 (the scan's seed_base is masked too)
-        key = jax.random.PRNGKey((config.seed * 100003 + it) & 0xffffffff)
+        key = jax.random.PRNGKey(
+            (config.seed * 100003 + prior_iters + it) & 0xffffffff)
         tstack, new_scores = step(bins_t, scores, labels, weights,
                                   (base_bag_dev, bag_key), fmask_dev,
                                   key, upper_bounds, num_bins,
@@ -1621,8 +1641,16 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                     valid_contrib += contrib * delta_w
                 else:
                     valid_contrib[:, tree_class[d]] += contrib * delta_w
-            vm = valid_init + (valid_contrib / rf_denominator if is_rf
-                               else valid_contrib)
+            if is_rf:
+                # the final rf model averages over ALL trees (carried +
+                # new): un-average the carried model's margin and re-pool
+                base_ = (init_sc[0] if K == 1
+                         else np.asarray(init_sc)[None, :])
+                old_sum = (valid_init - base_) * prior_iters
+                vm = base_ + ((old_sum + valid_contrib)
+                              / max(prior_iters + rf_denominator, 1))
+            else:
+                vm = valid_init + valid_contrib
             val = metric_fn(yv, vm, wv)
             eval_history.append(EvalRecord(it, metric_name, val))
             improved = (best_val is None

@@ -741,6 +741,38 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path):
     assert again.num_trees >= 10 and hist == []
 
 
+def test_rf_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """rf resume (previously rejected): prediction averages over the tree
+    count, so any prefix is a valid rf model — and the bag-key stream
+    continues from the carried iteration count (global index it+prior),
+    so resumed trees use the SAME subsamples the uninterrupted run's
+    later iterations draw.  With constant init-margin gradients that
+    makes resume EXACTLY equal to the uninterrupted run."""
+    X, y = binary_data(n=1500)
+    ck = str(tmp_path / "rf_ck")
+
+    def cfg(iters):
+        return BoostingConfig(objective="binary", boosting_type="rf",
+                              num_iterations=iters, num_leaves=7,
+                              min_data_in_leaf=5, bagging_fraction=0.6,
+                              bagging_freq=1, seed=3)
+
+    full, _ = train(X, y, cfg(12))
+    train(X, y, cfg(6), checkpoint_dir=ck, checkpoint_interval=3)
+    resumed, _ = train(X, y, cfg(12), checkpoint_dir=ck,
+                       checkpoint_interval=3)
+    assert resumed.num_trees == 12
+    np.testing.assert_allclose(full.predict_margin(X),
+                               resumed.predict_margin(X), atol=1e-4)
+    a = auc(y, resumed.predict_margin(X))
+    assert a > 0.85, a
+    # dart stays rejected, with the reason in the message
+    with pytest.raises(NotImplementedError, match="dart"):
+        train(X, y, BoostingConfig(objective="binary", boosting_type="dart",
+                                   num_iterations=2),
+              checkpoint_dir=ck, checkpoint_interval=1)
+
+
 def test_checkpoint_estimator_param(tmp_path):
     X, y = binary_data(n=900)
     ds = vec_dataset(X, y)
