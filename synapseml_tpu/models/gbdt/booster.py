@@ -878,13 +878,14 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # per-shard slabs padded to a common length, lambdas stay shard-local
     lr_pack = None
     lr_stream_perm = None
-    if config.objective == "lambdarank" and mesh is not None:
+    if (config.objective == "lambdarank" and mesh is not None
+            and config.parallelism != "feature_parallel"):
+        # data_parallel AND voting_parallel shard ROWS, so whole groups
+        # pack onto shards and lambdas compute shard-locally.
+        # feature_parallel REPLICATES rows, so it skips the packing and
+        # uses the plain in-memory objective on every rank
         if group is None:
             raise ValueError("lambdarank requires group sizes (groupCol)")
-        if config.parallelism != "data_parallel":
-            raise NotImplementedError(
-                "distributed lambdarank runs data_parallel (whole groups "
-                "per shard)")
         from .pallas_hist import hist_pad_multiple
         from .ranking import pack_groups_for_shards
         _shards = mesh.shape[DATA_AXIS]

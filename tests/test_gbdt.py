@@ -815,6 +815,41 @@ def test_distributed_lambdarank_matches_single_device():
     assert n_model > n_random + 0.1
 
 
+@pytest.mark.parametrize("mode", ["voting_parallel", "feature_parallel"])
+def test_lambdarank_other_parallelism_modes(mode):
+    """lambdarank × voting_parallel / feature_parallel (previously
+    rejected): voting shards rows like data_parallel so the whole-group
+    packing and shard-local lambdas apply unchanged; feature_parallel
+    replicates rows so every rank runs the plain in-memory objective.
+    Both must beat random ranking and stay close to the single-device
+    ranker."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+    rng = np.random.default_rng(6)
+    Q, F = 48, 5
+    sizes = rng.integers(4, 14, Q)
+    n = int(sizes.sum())
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    rel = np.clip(X[:, 0] * 2 + rng.normal(scale=0.3, size=n), -2, 2)
+    y = np.digitize(rel, [-0.5, 0.5, 1.2]).astype(np.float64)
+    kw = dict(objective="lambdarank", num_iterations=15, num_leaves=7,
+              learning_rate=0.2, min_data_in_leaf=3)
+    b1, _ = train(X, y, BoostingConfig(**kw), group=sizes)
+    bp, _ = train(X, y, BoostingConfig(parallelism=mode, top_k=3, **kw),
+                  group=sizes, mesh=data_parallel_mesh(8))
+    s1 = ndcg_at(5)(y, b1.predict_margin(X), sizes)
+    sp = ndcg_at(5)(y, bp.predict_margin(X), sizes)
+    s_rand = ndcg_at(5)(y, rng.normal(size=n), sizes)
+    assert sp > s_rand + 0.1
+    assert sp > s1 - 0.05, (s1, sp)
+    if mode == "feature_parallel":
+        # replicated rows + the depthwise-matching grower: exact parity
+        # with the single-device depthwise ranker
+        bd, _ = train(X, y, BoostingConfig(growth_policy="depthwise",
+                                           **kw), group=sizes)
+        np.testing.assert_allclose(bd.predict_margin(X),
+                                   bp.predict_margin(X), atol=1e-4)
+
+
 def test_streamed_distributed_lambdarank_matches_in_memory(tmp_path):
     """Ranking trains OUT-OF-CORE on the mesh: the binned matrix streams
     from a ChunkedColumnSource in source order and packs whole groups
