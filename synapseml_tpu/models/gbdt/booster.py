@@ -872,6 +872,24 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             raise ValueError("monotone constraints on categorical features "
                              "are not meaningful (category-subset splits "
                              "have no direction)")
+        if config.monotone_constraints_method == "advanced":
+            # the advanced refresh materializes (M, M, F) overlap masks
+            # (bool + int32 reductions, ~5 bytes/entry) inside the jitted
+            # per-wave refresh — guard the O(M^2 F) memory here so a big
+            # num_leaves × wide-F config fails fast instead of OOMing or
+            # stalling compilation mid-train
+            from .trainer import max_nodes
+            m_nodes = max_nodes(config.num_leaves)
+            adv_bytes = 5 * m_nodes * m_nodes * F
+            if adv_bytes > 1 << 30:
+                raise ValueError(
+                    f"monotone_constraints_method='advanced' with "
+                    f"num_leaves={config.num_leaves} and {F} features "
+                    f"needs ~{adv_bytes / 2**30:.1f} GiB of (M, M, F) "
+                    f"constraint masks per refresh (M={m_nodes} nodes); "
+                    "use monotone_constraints_method='intermediate' "
+                    "(a provable superset of the advanced constraint "
+                    "set) for models this size")
 
     # distributed lambdarank: pack WHOLE groups onto shards up front (the
     # reference's query-rows-share-a-partition rule); rows permute into

@@ -389,6 +389,34 @@ class ServingServer:
                 await writer.drain()
 
         wtask = asyncio.ensure_future(write_replies())
+
+        async def fifo_put(item) -> bool:
+            """Bounded put that cannot deadlock on a dead writer: a plain
+            ``await fifo.put`` on a full fifo blocks forever once the
+            writer task has died (nothing consumes), leaking the handler
+            and every queued exchange — poll instead, and report failure
+            when the writer is gone."""
+            while True:
+                try:
+                    fifo.put_nowait(item)
+                    return True
+                except asyncio.QueueFull:
+                    if wtask.done():
+                        return False
+                    # race the blocking put against the writer's death so
+                    # a freed slot wakes us immediately (no poll latency
+                    # on the live-writer backpressure path)
+                    put = asyncio.ensure_future(fifo.put(item))
+                    await asyncio.wait({put, wtask},
+                                       return_when=asyncio.FIRST_COMPLETED)
+                    if put.done() and put.exception() is None:
+                        return True
+                    put.cancel()
+                    try:
+                        await put
+                    except (asyncio.CancelledError, Exception):
+                        pass
+
         seq = 0
         try:
             while True:
@@ -396,7 +424,7 @@ class ServingServer:
                 (ln,) = struct.unpack("<I", hdr)
                 if ln > self.max_body_bytes:
                     if not wtask.done():
-                        await fifo.put(("now", (413, b"")))
+                        await fifo_put(("now", (413, b"")))
                     break
                 payload = await reader.readexactly(ln) if ln else b""
                 req = ServingRequest(id=f"{conn}:{seq}", method="FRAME",
@@ -408,27 +436,32 @@ class ServingServer:
                         api.forget(req.id)
                     break
                 if ex is None:                          # backpressure
-                    await fifo.put(("now",
-                                    (503, b'{"error": "serving queue '
-                                          b'saturated"}')))
+                    if not await fifo_put(
+                            ("now", (503, b'{"error": "serving queue '
+                                          b'saturated"}'))):
+                        break
                     continue
-                await fifo.put(("ex", ex))
+                if not await fifo_put(("ex", ex)):      # writer died
+                    api.forget(req.id)
+                    break
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 BrokenPipeError):
             pass                                        # client went away
         finally:
             if not wtask.done():
-                await fifo.put(None)                    # flush in order
+                await fifo_put(None)                    # flush in order
             try:
                 await wtask
             except (ConnectionResetError, BrokenPipeError):
                 pass
-            # forget exchanges neither flushed nor timed out (writer died
-            # mid-burst) so ApiHandle._pending cannot leak
-            while not fifo.empty():
-                item = fifo.get_nowait()
-                if item is not None and item[0] == "ex":
-                    api.forget(item[1].request.id)
+            finally:
+                # forget exchanges neither flushed nor timed out (writer
+                # died mid-burst) so ApiHandle._pending cannot leak —
+                # runs even when wtask re-raises something unexpected
+                while not fifo.empty():
+                    item = fifo.get_nowait()
+                    if item is not None and item[0] == "ex":
+                        api.forget(item[1].request.id)
 
     async def _write_413(self, writer: asyncio.StreamWriter) -> None:
         writer.write(b"HTTP/1.1 413 Payload Too Large\r\n"

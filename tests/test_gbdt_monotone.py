@@ -326,3 +326,18 @@ def test_monotone_refresh_methods_feature_parallel(method):
     b_1, _ = train(X, y, BoostingConfig(growth_policy="depthwise", **kw))
     np.testing.assert_allclose(b_fp.predict_margin(X[:1024]),
                                b_1.predict_margin(X[:1024]), atol=1e-4)
+
+
+def test_advanced_memory_guard_rejects_huge_configs():
+    """The advanced refresh materializes (M, M, F) masks; a config whose
+    masks would exceed ~1 GiB must fail fast with a message pointing at
+    'intermediate' instead of OOMing mid-compile."""
+    F = 4096
+    X = np.zeros((32, F), np.float32)
+    y = np.zeros(32)
+    cfg = BoostingConfig(objective="regression", num_iterations=1,
+                         num_leaves=512, min_data_in_leaf=1,
+                         monotone_constraints=[1] * F,
+                         monotone_constraints_method="advanced")
+    with pytest.raises(ValueError, match="intermediate"):
+        train(X, y, cfg)
