@@ -89,6 +89,18 @@ class BoostingConfig:
     #: device pass (fast path); "lossguide": strict best-first leaf-wise
     #: (LightGBM's exact growth order).  voting_parallel implies lossguide.
     growth_policy: str = "depthwise"
+    #: two-level (coarse-then-refine) histograms for wide-bin depthwise
+    #: growth: "auto" (on at >= 500k global rows), "on", "off".
+    #: Histograms build at coarse (bin >> 2) resolution; the top
+    #: ``refine_features`` features — chosen once per TREE from the
+    #: root's coarse gains — are refined at full resolution every wave.
+    #: Faster wide-bin training; split quality is preserved unless a
+    #: feature outside the root-chosen top-K wins only on a
+    #: sub-coarse-boundary cut.  Structurally off for EFB, monotone
+    #: constraints, lossguide, voting/feature parallelism, max_bin < 127
+    two_level_hist: str = "auto"
+    #: features refined at full resolution under two_level_hist
+    refine_features: int = 8
     #: exclusive feature bundling: merge rarely-co-nonzero (binned)
     #: features into shared HISTOGRAM columns — the sparse/one-hot
     #: densification strategy (LightGBM enable_bundle).  Bundling only
@@ -134,6 +146,9 @@ class BoostingConfig:
             monotone_constraints=mono,
             monotone_penalty=float(self.monotone_penalty),
             monotone_method=self.monotone_constraints_method,
+            two_level=({True: "on", False: "off"}.get(
+                self.two_level_hist, str(self.two_level_hist))),
+            refine_k=int(self.refine_features),
         )
 
 
@@ -850,6 +865,11 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         X = np.ascontiguousarray(X, np.float32)
         n, F = X.shape
 
+    if config.two_level_hist not in ("auto", "on", "off", True, False):
+        raise ValueError(
+            f"two_level_hist={config.two_level_hist!r}: must be 'auto', "
+            "'on', or 'off'")
+
     if config.monotone_constraints and any(config.monotone_constraints):
         if config.monotone_constraints_method not in ("basic",
                                                       "intermediate",
@@ -1056,6 +1076,25 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         return jax.jit(lambda: jnp.full(shape, fill, jnp.float32),
                        out_shardings=sh)()
 
+    if config.two_level_hist == "auto":
+        # resolve here, where BOTH the global row count (the grower only
+        # sees shard-local rows, which would scale the documented 500k
+        # threshold with device count) and the pallas decision are known:
+        # on the XLA scatter fallback two-level only ADDS work (fine
+        # hists get built then pooled) while coarsening non-top-K splits,
+        # so auto requires the fused pallas path.  Must resolve BEFORE
+        # the warm-compile thread below — GrowthParams is the jit/lru
+        # cache key, so a thread warming the 'auto' config would compile
+        # a program the run never uses.  (The EFB re-gate further down
+        # can only flip use_pallas when enable_bundle is set, and EFB
+        # structurally disables two-level in the grower anyway.)
+        from .trainer import TWO_LEVEL_MIN_ROWS
+        config = dataclasses.replace(
+            config,
+            two_level_hist=("on" if (n >= TWO_LEVEL_MIN_ROWS and use_pallas
+                                     and uses_fused)
+                            else "off"))
+
     # -- compile/transfer overlap ------------------------------------------
     # the jitted step's first compile (cold: tens of seconds, warm cache:
     # seconds) and the host-side binning + u8 upload are independent; warm
@@ -1191,6 +1230,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         use_pallas = fused_geometry(
             bundler.num_bundles, B_total,
             default_n_slots(config.num_leaves)) is not None
+
 
     def bin_eff(mat):
         b = bin_host(mat)

@@ -338,7 +338,14 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
 # 27 ms → 10.5 ms per level pass at max_bin=63.
 
 
-def _make_fused_kernel(ft: int):
+def coarse_bins(total_bins: int, shift: int) -> int:
+    """Histogram width of the coarse (``bin >> shift``) level, padded to a
+    sublane multiple so the (ft·Bc, chunk) one-hot scratch tiles cleanly."""
+    bc = -(-total_bins // (1 << shift))
+    return -(-bc // 8) * 8
+
+
+def _make_fused_kernel(ft: int, shift: int = 0):
     def kernel(leaf_ref, t1_ref, rlo_ref, rhi_ref, dflt_ref,
                lid_ref, rid_ref,
                sel_ref, bins_ref, nid_ref, vals_ref,
@@ -396,6 +403,12 @@ def _make_fused_kernel(ft: int):
         iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
         for k in range(ft):
             b = bins_ref[0, k, :]
+            if shift:
+                # two-level mode: histogram at COARSE (bin >> shift)
+                # resolution while routing stays at fine resolution — the
+                # one-hot build (the measured VPU bottleneck of the 255-bin
+                # level pass) and the matmul both shrink by 2^shift
+                b = b >> shift
             oh_ref[k * B:(k + 1) * B, :] = (iota_b == b[None, :]).astype(
                 jnp.int8)
         contrib = lax.dot_general(oh_ref[...], vn_ref[...],
@@ -406,7 +419,7 @@ def _make_fused_kernel(ft: int):
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots", "total_bins",
-                                             "interpret"))
+                                             "hist_shift", "interpret"))
 def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                           node_id: jnp.ndarray,  # (N,) int32
                           leaf: jnp.ndarray,     # (S,) int32 leaf being split
@@ -421,15 +434,22 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                           scales: jnp.ndarray,   # (2,) f32 from prep_hist_vals
                           n_slots: int,
                           total_bins: int,
+                          hist_shift: int = 0,
                           interpret: bool = False):
-    """One pass: → (new_node_id (N,), hists (n_slots, F, B, 3)).
+    """One pass: → (new_node_id (N,), hists (n_slots, F, Bh, 3)).
 
     Routing per slot: rows of ``sel`` (the split columns' bin rows,
     pre-gathered by the caller: ``jnp.take(bins_flat, cols, axis=0)``)
     go left iff ``x in (rlo, rhi] ? x <= t1 : dflt`` — plain splits pass
     rlo=-1, rhi=B, t1=split_bin; EFB passes the bundled range of the
-    ORIGINAL feature being split."""
+    ORIGINAL feature being split.
+
+    ``hist_shift`` > 0 (two-level mode) histograms at the COARSE
+    ``bin >> hist_shift`` resolution (Bh = :func:`coarse_bins`) while
+    routing stays at fine resolution — the grower refines a top-K feature
+    subset at full resolution in a separate narrow pass."""
     B = total_bins
+    Bh = coarse_bins(B, hist_shift) if hist_shift else B
     bins_r, F, G, ft, N = _bins_tiles(bins_t, B)
     geo = fused_geometry(F, B, n_slots)
     assert geo is not None, (
@@ -450,21 +470,21 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
         ],
         out_specs=[
             pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((G, ft * B, VN),
+            pl.BlockSpec((G, ft * Bh, VN),
                          lambda c, f, *_: (0, 0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.int8),
+        scratch_shapes=[pltpu.VMEM((ft * Bh, chunk), jnp.int8),
                         pltpu.VMEM((chunk, VN), jnp.int8)],
     )
     new_id, out = pl.pallas_call(
-        _make_fused_kernel(ft),
+        _make_fused_kernel(ft, hist_shift),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((1, N), jnp.int32),
-                   jax.ShapeDtypeStruct((G, ft * B, VN), jnp.int32)],
+                   jax.ShapeDtypeStruct((G, ft * Bh, VN), jnp.int32)],
         interpret=interpret,
     )(leaf, t1, rlo, rhi, dflt, l_id, r_id,
       sel, bins_r, node_id[None, :], vals)
 
-    out = out.reshape(G * ft, B, n_slots, SLOT_LANES)[:F]
-    out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)
+    out = out.reshape(G * ft, Bh, n_slots, SLOT_LANES)[:F]
+    out = jnp.moveaxis(out, 2, 0)                      # (S, F, Bh, 8)
     return new_id[0], _reconstruct(out, scales)

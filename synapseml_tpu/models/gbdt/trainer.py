@@ -64,6 +64,24 @@ class GrowthParams(NamedTuple):
     #: constraint set over ordered-and-overlapping leaf boxes — see
     #: :func:`_advanced_bounds`; provably no tighter than intermediate)
     monotone_method: str = "basic"
+    #: two-level histograms for wide-bin depthwise growth: "off" | "auto"
+    #: (on for N >= TWO_LEVEL_MIN_ROWS; N is shard-local here — train()
+    #: resolves "auto" from the GLOBAL row count before building steps)
+    #: | "on".  Histograms build and store at COARSE
+    #: (bin >> TWO_LEVEL_SHIFT) resolution; the top ``refine_k`` features
+    #: — chosen ONCE per tree from the root's coarse per-feature gains —
+    #: are refined at full resolution every wave (left children built,
+    #: right children by fine subtraction) and each split picks the
+    #: better of the refined fine candidates and the unrefined
+    #: coarse-boundary candidates.  The 255-bin one-hot build — the
+    #: measured VPU bottleneck of the level pass — shrinks ~4x; split
+    #: quality is preserved unless a feature outside the root-chosen
+    #: top-K beats every refined feature only on a sub-coarse-boundary
+    #: cut (each coarse boundary IS a fine split, so coarse candidates
+    #: remain exact lower bounds)
+    two_level: str = "off"
+    #: features refined at full resolution when two-level is on
+    refine_k: int = 0
 
 
 class Tree(NamedTuple):
@@ -233,6 +251,104 @@ def _best_split(hist, sum_g, sum_h, sum_c, num_bins, feature_mask,
     gl, hl, cl = cum
     return bgain, bf.astype(jnp.int32), bb.astype(jnp.int32), \
         gl[bf, bb], hl[bf, bb], cl[bf, bb]
+
+
+# -- two-level (coarse-then-refine) histograms ------------------------------
+#
+# At max_bin=255 the level pass is bounded by the VPU one-hot build
+# (measured: the int8 matmul runs at ~122 Tmac/s while the (ft·B, C)
+# one-hot construction costs ~1.5x the matmul and the step time equals
+# the max of the two).  Two-level growth builds the per-wave histograms
+# at COARSE (bin >> 2) resolution — 4x less one-hot work, 4x smaller
+# matmul, 4x smaller split scans and histogram state — then refines only
+# a top-K feature subset, chosen ONCE per tree from the ROOT's coarse
+# per-feature gains, with ONE narrow full-resolution pass per wave (left
+# children only; right children by subtraction from the parent's stored
+# fine-K histograms — a per-wave adaptive set would need both children
+# built fresh at 2S lanes, which was measured to eat the coarse win).
+# Every coarse boundary is itself a fine split, so unrefined features
+# keep exact (if coarser) candidates; the tradeoff is only that a
+# feature outside the root-chosen top-K cannot win on a
+# sub-coarse-boundary cut.
+
+#: rows below which "auto" two-level stays off (small data gains nothing
+#: and exactness-vs-255-bins matters more in tests)
+TWO_LEVEL_MIN_ROWS = 500_000
+#: coarse level is bin >> this shift (255-bin fine -> 64-bin coarse)
+TWO_LEVEL_SHIFT = 2
+
+
+def _pool_coarse(hist, Bc: int, shift: int):
+    """Fine (..., B, 3) f32 histograms → coarse (..., Bc, 3) by summing
+    the ``1 << shift`` fine bins sharing each coarse index — the XLA-path
+    counterpart of the pallas kernel's in-kernel coarse build."""
+    B = hist.shape[-2]
+    g = 1 << shift
+    pad = Bc * g - B
+    h = jnp.pad(hist, [(0, 0)] * (hist.ndim - 2) + [(0, pad), (0, 0)])
+    return h.reshape(h.shape[:-2] + (Bc, g, 3)).sum(-2)
+
+
+def _tl_coarse_gains(c_hists, sum_g, sum_h, sum_c, depth, lo, hi,
+                     num_bins_c, feature_mask, p: GrowthParams):
+    """Batched coarse gain matrices for two-level selection.
+
+    → (gains (S', F, Bc), cum 3-tuple of (S', F, Bc), per-feature max
+    gains (S', F))."""
+    def one(h, g, hh, c, d, l, u):
+        return _gain_matrix(h, g, hh, c, num_bins_c, feature_mask, d, p,
+                            l, u, None)
+    cg, ccum = jax.vmap(one)(c_hists, sum_g, sum_h, sum_c, depth, lo, hi)
+    return cg, ccum, jnp.max(cg, axis=-1)
+
+
+def _tl_final_pick(cg, ccum, f_hists, topk, sum_g, sum_h, sum_c, depth,
+                   lo, hi, num_bins, feature_mask, p: GrowthParams,
+                   shift: int):
+    """Merge the refined fine candidates with the unrefined coarse
+    candidates → per-node best split in FINE bin space.
+
+    ``cg``/``ccum``: coarse gains and cumulative left sums from
+    :func:`_tl_coarse_gains`; ``f_hists`` (S', K, B, 3): full-resolution
+    histograms of the ``topk`` features.  A coarse candidate at coarse bin
+    c maps to the fine boundary ``(c+1)·2^shift - 1`` (the rows ≤ that
+    fine bin are exactly the rows ≤ c at coarse resolution, so the coarse
+    cum sums are exact for the mapped split)."""
+    Sp, F, Bc = cg.shape
+    B = f_hists.shape[-2]
+    rows = jnp.arange(Sp)
+    # coarse candidates exclude the refined features (they compete at
+    # fine resolution instead)
+    cg = cg.at[:, topk, :].set(-jnp.inf)
+    flat = jnp.argmax(cg.reshape(Sp, -1), axis=-1)
+    cf, cc = flat // Bc, flat % Bc
+    cgain = cg[rows, cf, cc]
+    cgl = ccum[0][rows, cf, cc]
+    chl = ccum[1][rows, cf, cc]
+    ccl = ccum[2][rows, cf, cc]
+    step = 1 << shift
+    cbin = jnp.minimum(cc * step + step - 1, num_bins[cf] - 1)
+
+    nbk = num_bins[topk]
+    fmk = feature_mask[topk]
+
+    def one(h, g, hh, c, d, l, u):
+        return _gain_matrix(h, g, hh, c, nbk, fmk, d, p, l, u, None)
+    fg, fcum = jax.vmap(one)(f_hists, sum_g, sum_h, sum_c, depth, lo, hi)
+    fflat = jnp.argmax(fg.reshape(Sp, -1), axis=-1)
+    fk, fb = fflat // B, fflat % B
+    fgain = fg[rows, fk, fb]
+    fgl = fcum[0][rows, fk, fb]
+    fhl = fcum[1][rows, fk, fb]
+    fcl = fcum[2][rows, fk, fb]
+
+    use_f = fgain >= cgain
+    return (jnp.where(use_f, fgain, cgain),
+            jnp.where(use_f, topk[fk], cf).astype(jnp.int32),
+            jnp.where(use_f, fb, cbin).astype(jnp.int32),
+            jnp.where(use_f, fgl, cgl),
+            jnp.where(use_f, fhl, chl),
+            jnp.where(use_f, fcl, ccl))
 
 
 def _mono_vec(p: GrowthParams, F: int):
@@ -915,6 +1031,22 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     F_search = num_bins.shape[0]           # ORIGINAL feature count
     mono_c = _mono_vec(p, F_search)
 
+    # two-level (coarse-then-refine) histograms: see the module comment
+    # above _pool_coarse.  Structural exclusions keep every exactness-
+    # pinned path (EFB bit-identity, monotone refresh re-picks) at full
+    # resolution; "auto" additionally requires big data so small-data
+    # tests keep exact-255 semantics
+    from .pallas_hist import coarse_bins
+    tl = (p.refine_k > 0 and p.two_level != "off"
+          and bundle_map is None and mono_c is None
+          and B >= 128 and F > p.refine_k
+          and (p.two_level == "on" or N >= TWO_LEVEL_MIN_ROWS))
+    SH = TWO_LEVEL_SHIFT
+    Bc = coarse_bins(B, SH)
+    Bh = Bc if tl else B                   # stored-histogram width
+    K = p.refine_k
+    num_bins_c = -(-num_bins // (1 << SH))
+
     def unb(hists, g, h, c):
         if bundle_map is None:
             return hists
@@ -925,6 +1057,21 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                              feature_mask=feature_mask, p=p, mono_c=mono_c)
     vpick = jax.vmap(lambda h, g, hh, c, d, lo, hi: pick(
         h, g, hh, c, node_depth=d, node_lo=lo, node_hi=hi))
+
+    def build_fine_k(bins_kp, slot_vec, n_slots_):
+        """Full-resolution histograms of the refined features for the
+        two-level refine pass.  ``bins_kp`` is the PRE-GATHERED and
+        pre-tiled (pallas) / pre-flattened (XLA) K-feature bin matrix —
+        prepared once per tree right after the root picks ``topk`` so the
+        wave loop never re-materializes the copy (XLA cannot hoist it out
+        of while_loop)."""
+        if use_pallas:
+            from .pallas_hist import build_hist_nodes_pallas
+            return build_hist_nodes_pallas(
+                bins_kp, slot_vec, vals8, scales, n_slots_, B,
+                interpret=(use_pallas == "interpret"))
+        return _build_hist_nodes_xla(bins_kp, grad, hess, row_valid,
+                                     slot_vec, n_slots_, K, B)
 
     # root: one batched pass with every row in slot 0.  On the pallas path
     # this rides the FUSED kernel with a degenerate all-left split of leaf 0
@@ -943,23 +1090,62 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             jnp.full((S,), -1, jnp.int32), jnp.full((S,), B, jnp.int32),
             jnp.ones(S, jnp.int32), jnp.zeros(S, jnp.int32),
             jnp.zeros(S, jnp.int32), vals8, scales, S, B,
+            hist_shift=(SH if tl else 0),
             interpret=(use_pallas == "interpret"))
-        root_hist = ar(root_hists)[0]                      # (F, B, 3)
+        root_hist = ar(root_hists)[0]                      # (F, Bh, 3)
     else:
         root_hist = build(jnp.zeros(N, jnp.int32))[0]      # (F, B, 3)
+        if tl:
+            root_hist = _pool_coarse(root_hist, Bc, SH)
     root_stats = jnp.sum(root_hist[0], axis=0)
     root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
 
     zi = jnp.zeros(M, jnp.int32)
     zf = jnp.zeros(M, jnp.float32)
-    bg, bf_, bb, bgl, bhl, bcl = pick(unb(root_hist, root_g, root_h, root_c),
-                                      root_g, root_h, root_c,
-                                      node_depth=jnp.zeros((), jnp.int32),
-                                      node_lo=-jnp.inf, node_hi=jnp.inf)
+    topk = None
+    root_fine = None
+    if tl:
+        # the refined feature set is chosen ONCE per tree from the ROOT's
+        # coarse per-feature gains: a fixed set lets every wave refine
+        # LEFT children only (S slot lanes, the full 128-lane tile) and
+        # derive right-child fine histograms by subtraction from the
+        # parent's stored fine-K histograms — a per-wave adaptive set
+        # needs both children built fresh (2S lanes), which doubles the
+        # refine matmul and was measured to eat the coarse pass's win
+        z1 = jnp.zeros((1,), jnp.int32)
+        ninf1 = jnp.full((1,), -jnp.inf)
+        inf1 = jnp.full((1,), jnp.inf)
+        cg0, ccum0, fgain0 = _tl_coarse_gains(
+            root_hist[None], root_g[None], root_h[None], root_c[None],
+            z1, ninf1, inf1, num_bins_c, feature_mask, p)
+        topk = lax.top_k(fgain0[0], K)[1].astype(jnp.int32)
+        # gather + layout the K refined feature rows ONCE per tree (a
+        # contiguous feature-axis row copy, NOT the pathological per-row
+        # gather); the wave loop closes over the result
+        bins_kp = jnp.take(bins_t, topk, axis=0)
+        if use_pallas:
+            from .pallas_hist import prepare_feature_tiles
+            bins_kp = prepare_feature_tiles(bins_kp, B, K)
+        else:
+            bins_kp = bins_kp + (jnp.arange(K, dtype=jnp.int32)
+                                 * B)[:, None]
+        rslot0 = jnp.where(row_valid > 0, 0, -1).astype(jnp.int32)
+        root_fine = ar(build_fine_k(bins_kp, rslot0, 1))   # (1, K, B, 3)
+        rbest = _tl_final_pick(cg0, ccum0, root_fine, topk,
+                               root_g[None], root_h[None], root_c[None],
+                               z1, ninf1, inf1, num_bins, feature_mask,
+                               p, SH)
+        bg, bf_, bb, bgl, bhl, bcl = (x[0] for x in rbest)
+    else:
+        bg, bf_, bb, bgl, bhl, bcl = pick(
+            unb(root_hist, root_g, root_h, root_c),
+            root_g, root_h, root_c,
+            node_depth=jnp.zeros((), jnp.int32),
+            node_lo=-jnp.inf, node_hi=jnp.inf)
     state = dict(
         node_id=jnp.zeros(N, jnp.int32),
-        hist=jnp.zeros((L + 2, F * B, 3), jnp.float32).at[0].set(
-            root_hist.reshape(F * B, 3)),
+        hist=jnp.zeros((L + 2, F * Bh, 3), jnp.float32).at[0].set(
+            root_hist.reshape(F * Bh, 3)),
         slot=zi,
         sum_g=zf.at[0].set(root_g),
         sum_h=zf.at[0].set(root_h),
@@ -981,6 +1167,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         node_lo=jnp.full(M, -jnp.inf, jnp.float32),
         node_hi=jnp.full(M, jnp.inf, jnp.float32),
     )
+    if tl:
+        state["hist_f"] = jnp.zeros((L + 2, K * B, 3), jnp.float32).at[
+            0].set(root_fine[0].reshape(K * B, 3))
 
     def cond(s):
         leaves = (s["num_nodes"] + 1) // 2
@@ -1007,6 +1196,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         # chunk's routing once and keeps it in VMEM for the histogram tiles
         rt_col, rt_t1, rt_lo, rt_hi, rt_df = _slot_route_params(
             s["best_feat"][parents], s["best_bin"][parents], B, bundle_map)
+        leaves_after = (s["num_nodes"] + 1) // 2 + n_valid
         if use_pallas:
             from .pallas_hist import route_and_hist_pallas
 
@@ -1015,6 +1205,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                     bins_pl, s["node_id"], parents,
                     jnp.take(bins_t, rt_col, axis=0), rt_t1, rt_lo,
                     rt_hi, rt_df, l_ids, r_ids, vals8, scales, S, B,
+                    hist_shift=(SH if tl else 0),
                     interpret=(use_pallas == "interpret"))
 
             def route_only(_):
@@ -1031,9 +1222,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 new = (jnp.sum(jnp.where(inleaf & gl, l_ids[:, None], 0), 0)
                        + jnp.sum(jnp.where(inleaf & ~gl, r_ids[:, None], 0), 0)
                        + jnp.where(jnp.any(inleaf, 0), 0, s["node_id"]))
-                return new, jnp.zeros((S, F, B, 3), jnp.float32)
+                return new, jnp.zeros((S, F, Bh, 3), jnp.float32)
 
-            leaves_after = (s["num_nodes"] + 1) // 2 + n_valid
             new_node_id, l_hists = lax.cond(leaves_after >= L,
                                             route_only, fused_wave, None)
             l_hists = ar(l_hists)
@@ -1050,7 +1240,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 s["node_id"])
             bslot = jnp.where(go_left, rslot, -1)
             l_hists = build(bslot)                       # (S, F, B, 3)
-        l_flat = l_hists.reshape(S, F * B, 3)
+            if tl:
+                l_hists = _pool_coarse(l_hists, Bc, SH)
+        l_flat = l_hists.reshape(S, F * Bh, 3)
         pslot = jnp.where(valid, s["slot"][parents], HJUNK)
         r_flat = s["hist"][pslot] - l_flat
         r_slots = jnp.where(valid, s["next_slot"] + jidx, HJUNK)
@@ -1071,13 +1263,38 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         c_hi = jnp.concatenate([l_hi, r_hi])
 
         child_hists = jnp.concatenate(
-            [l_flat.reshape(S, F, B, 3), r_flat.reshape(S, F, B, 3)])
+            [l_flat.reshape(S, F, Bh, 3), r_flat.reshape(S, F, Bh, 3)])
         cg = jnp.concatenate([lg, rg])
         ch = jnp.concatenate([lh, rh])
         cc = jnp.concatenate([lc, rc])
         cd = jnp.concatenate([cdepth, cdepth])
-        cbg, cbf, cbb, cbgl, cbhl, cbcl = vpick(
-            unb(child_hists, cg, ch, cc), cg, ch, cc, cd, c_lo, c_hi)
+        if tl:
+            cgm, ccum, _ = _tl_coarse_gains(
+                child_hists, cg, ch, cc, cd, c_lo, c_hi,
+                num_bins_c, feature_mask, p)
+            lslot = (jnp.full(M, -1, jnp.int32)
+                     .at[l_ids].set(jidx).at[JUNK].set(-1))
+
+            def fine(_):
+                return build_fine_k(bins_kp, lslot[new_node_id], S)
+
+            def fine_zeros(_):
+                # budget-filling wave: the children never split again, so
+                # the refine pass is skipped like the coarse route_only
+                # shortcut (zero hists fail min_data and pick -inf)
+                return jnp.zeros((S, K, B, 3), jnp.float32)
+
+            lf = ar(lax.cond(leaves_after >= L, fine_zeros, fine, None))
+            lf_flat = lf.reshape(S, K * B, 3)
+            rf_flat = s["hist_f"][pslot] - lf_flat
+            f_hists = jnp.concatenate([lf_flat.reshape(S, K, B, 3),
+                                       rf_flat.reshape(S, K, B, 3)])
+            cbg, cbf, cbb, cbgl, cbhl, cbcl = _tl_final_pick(
+                cgm, ccum, f_hists, topk, cg, ch, cc, cd, c_lo, c_hi,
+                num_bins, feature_mask, p, SH)
+        else:
+            cbg, cbf, cbb, cbgl, cbhl, cbcl = vpick(
+                unb(child_hists, cg, ch, cc), cg, ch, cc, cd, c_lo, c_hi)
 
         cids = jnp.concatenate([l_ids, r_ids])           # (2S,)
         thr = jnp.where(s["best_bin"][parents] >= 1,
@@ -1113,6 +1330,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             node_lo=s["node_lo"].at[cids].set(c_lo),
             node_hi=s["node_hi"].at[cids].set(c_hi),
         )
+        if tl:
+            out["hist_f"] = (s["hist_f"].at[pslot].set(lf_flat)
+                             .at[r_slots].set(rf_flat))
         if mono_c is not None and p.monotone_method in ("intermediate",
                                                         "advanced"):
             # whole-tree refresh (opposite-subtree extremes, or the exact

@@ -407,15 +407,20 @@ class ServingServer:
                     # a freed slot wakes us immediately (no poll latency
                     # on the live-writer backpressure path)
                     put = asyncio.ensure_future(fifo.put(item))
-                    await asyncio.wait({put, wtask},
-                                       return_when=asyncio.FIRST_COMPLETED)
-                    if put.done() and put.exception() is None:
-                        return True
-                    put.cancel()
                     try:
-                        await put
-                    except (asyncio.CancelledError, Exception):
-                        pass
+                        await asyncio.wait({put, wtask},
+                                           return_when=asyncio.FIRST_COMPLETED)
+                        if put.done() and put.exception() is None:
+                            return True
+                    finally:
+                        # also on handler cancellation: never orphan the
+                        # put task (it could enqueue after the drain ran)
+                        if not put.done():
+                            put.cancel()
+                            try:
+                                await put
+                            except (asyncio.CancelledError, Exception):
+                                pass
 
         seq = 0
         try:
