@@ -91,7 +91,8 @@ class BoostingConfig:
     growth_policy: str = "depthwise"
     #: two-level (coarse-then-refine) histograms for wide-bin depthwise
     #: growth: "auto" (on at >= 500k global rows), "on", "off".
-    #: Histograms build at coarse (bin >> 2) resolution; the top
+    #: Histograms build at coarse (bin >> TWO_LEVEL_SHIFT, currently
+    #: >> 3) resolution; the top
     #: ``refine_features`` features — chosen once per TREE from the
     #: root's coarse gains — are refined at full resolution every wave.
     #: Faster wide-bin training; split quality is preserved unless a

@@ -2,7 +2,8 @@
 growth.
 
 At max_bin=255 the level pass is bounded by the VPU one-hot build; the
-two-level mode histograms every wave at coarse (bin >> 2) resolution and
+two-level mode histograms every wave at coarse (bin >> TWO_LEVEL_SHIFT)
+resolution and
 refines a root-chosen top-K feature subset at full resolution (left
 children built, right children by fine subtraction).  These tests pin:
 the XLA and pallas-interpret implementations grow the SAME tree, the
