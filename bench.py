@@ -52,14 +52,32 @@ CHIP_PEAK_FLOPS = {
     "TPU v6 lite": 918e12,   # v6e / Trillium
 }
 
+#: HBM bandwidth bytes/s by device kind (public spec sheets) — used to
+#: emit the vision bench's bandwidth roofline into the JSON
+CHIP_HBM_BW = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5": 2765e9,        # v5p
+    "TPU v6 lite": 1640e9,   # v6e / Trillium
+}
 
-def _chip_peak(device) -> float:
+
+def _chip_lookup(device, table, default):
+    """Longest-prefix device-kind match into a spec table."""
     kind = getattr(device, "device_kind", "")
     best = None
-    for name, peak in CHIP_PEAK_FLOPS.items():
+    for name, val in table.items():
         if kind.startswith(name) and (best is None or len(name) > best[0]):
-            best = (len(name), peak)
-    return best[1] if best else 197e12
+            best = (len(name), val)
+    return best[1] if best else default
+
+
+def _chip_bw(device) -> float:
+    return _chip_lookup(device, CHIP_HBM_BW, 819e9)
+
+
+def _chip_peak(device) -> float:
+    return _chip_lookup(device, CHIP_PEAK_FLOPS, 197e12)
 
 
 def _median_window(run_steps, n_windows=3):
@@ -77,6 +95,21 @@ def _median_window(run_steps, n_windows=3):
         barrier()
         rates.append(n_items / (time.perf_counter() - t0))
     return sorted(rates)[len(rates) // 2]
+
+
+def _median_rate(run_once, n=3):
+    """Median items/sec over ``n`` timed calls of ``run_once()`` (which
+    must BLOCK — e.g. end in a readback — and return its item count).
+
+    The single estimator for every decode/inference window: best-of-N
+    biased exactly the numbers closest to a bar on the shared chip, so
+    no bench section uses max anymore."""
+    rates = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        n_items = run_once()
+        rates.append(n_items / (time.perf_counter() - t0))
+    return sorted(rates)[n // 2]
 
 
 def bench_bert():
@@ -162,6 +195,7 @@ def bench_vision():
     # whole graph a second time over the tunnel)
     compiled = step.lower(state, (bi,), bl, key).compile()
     flops_per_sample = None
+    bytes_per_sample = None
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, list):
@@ -171,6 +205,9 @@ def bench_vision():
         per_dev_flops = float(cost.get("flops", 0.0))
         if per_dev_flops:
             flops_per_sample = per_dev_flops / (bs / len(devs))
+        per_dev_bytes = float(cost.get("bytes accessed", 0.0))
+        if per_dev_bytes:
+            bytes_per_sample = per_dev_bytes / (bs / len(devs))
     except Exception:
         pass
     if not flops_per_sample:
@@ -191,7 +228,27 @@ def bench_vision():
 
     sps_chip = _median_window(window) / len(devs)
     mfu = (sps_chip * flops_per_sample) / _chip_peak(devs[0])
-    return sps_chip, mfu
+    # the roofline decomposition behind the MFU number, emitted so the
+    # "this graph is bandwidth-bound" claim audits from the JSON alone:
+    # XLA's own bytes-accessed sets the memory roofline, the chip peak
+    # sets the compute roofline, and the measured step lands against them
+    roof = None
+    if bytes_per_sample:
+        per_dev_bs = bs / len(devs)
+        peak, bw = _chip_peak(devs[0]), _chip_bw(devs[0])
+        measured_ms = per_dev_bs / sps_chip * 1e3
+        comp_ms = per_dev_bs * flops_per_sample / peak * 1e3
+        bw_ms = per_dev_bs * bytes_per_sample / bw * 1e3
+        roof = {
+            "xla_bytes_per_sample_mb": bytes_per_sample / 1e6,
+            "xla_flops_per_sample_g": flops_per_sample / 1e9,
+            "roofline_compute_ms": comp_ms,
+            "roofline_bandwidth_ms": bw_ms,
+            "measured_step_ms": measured_ms,
+            "frac_of_bandwidth_roofline": bw_ms / measured_ms,
+            "mfu_ceiling_bandwidth_bound": comp_ms / bw_ms,
+        }
+    return sps_chip, mfu, roof
 
 
 def _gbdt_labels(rng, X):
@@ -284,6 +341,138 @@ def bench_gbdt_anchor(X, y):
     return out, os.cpu_count()
 
 
+#: iterations for the streamed-ingestion characterization (secondary —
+#: the headline GBDT numbers stay on the in-memory path above)
+STREAM_ITERS = 40
+
+_STREAM_CHILD = r'''
+import json, sys, time
+sys.path.insert(0, sys.argv[4])
+import numpy as np
+
+def rss_mb(field="VmRSS"):
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith(field):
+                return int(line.split()[1]) / 1024.0
+
+mode, path, iters = sys.argv[1], sys.argv[2], int(sys.argv[3])
+label_col = int(sys.argv[5])
+from synapseml_tpu.io.colstore import ChunkedColumnSource
+if mode == "scan":
+    src = ChunkedColumnSource(path, label_col=label_col)
+    t0 = time.perf_counter(); n = 0
+    for cx, cy, cw in src.iter_chunks():
+        n += len(cx)
+    print(json.dumps({"rows_per_sec": n / (time.perf_counter() - t0)}))
+    raise SystemExit
+from synapseml_tpu.models.gbdt import BoostingConfig, train
+cfg = BoostingConfig(objective="binary", num_iterations=iters,
+                     num_leaves=31, max_bin=63)
+if mode == "stream":
+    Xa, ya = ChunkedColumnSource(path, label_col=label_col), None
+else:
+    src = ChunkedColumnSource(path, label_col=label_col)
+    Xa = np.concatenate([cx for cx, _, _ in src.iter_chunks()])
+    ya = src.read_labels()
+t0 = time.perf_counter()
+b, _ = train(Xa, ya, cfg)
+print(json.dumps({"full_wall_its": iters / (time.perf_counter() - t0),
+                  "steady_its": b.measures.iterations_per_sec(),
+                  "peak_rss_mb": rss_mb("VmHWM")}))
+'''
+
+
+def bench_gbdt_streamed(X, y):
+    """Streamed (out-of-core) GBDT ingestion on the bench record — the
+    reference's default execution mode is streaming dataset assembly
+    (StreamingPartitionTask.scala:101-422).  The 1M x 28 matrix persists
+    to an SMLC column store and trains from a ChunkedColumnSource; each
+    leg runs in a SUBPROCESS so peak host RSS (VmHWM) isolates per mode.
+    The streamed peak should undercut the in-memory peak by roughly the
+    materialized matrix size (the stream's host residency is O(chunk)).
+
+    → dict: ingest rows/s, full-wall + steady it/s, streamed and
+    in-memory subprocess RSS peaks (MB)."""
+    import os
+    import subprocess
+    import tempfile
+
+    import synapseml_tpu
+    from synapseml_tpu.io.colstore import write_matrix
+
+    repo = os.path.dirname(os.path.dirname(synapseml_tpu.__file__))
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench_stream.smlc")
+        write_matrix(path, np.concatenate(
+            [X, np.asarray(y, np.float32)[:, None]], axis=1))
+
+        def run(mode):
+            r = subprocess.run(
+                [sys.executable, "-c", _STREAM_CHILD, mode, path,
+                 str(STREAM_ITERS), repo, str(X.shape[1])],
+                capture_output=True, text=True, timeout=900)
+            if r.returncode != 0:
+                raise RuntimeError(r.stderr[-500:])
+            return json.loads(r.stdout.strip().splitlines()[-1])
+
+        scan = run("scan")
+        streamed = run("stream")
+        mem = run("mem")
+    return {"ingest_rows_per_sec": scan["rows_per_sec"],
+            "iters_per_sec": streamed["full_wall_its"],
+            "steady_iters_per_sec": streamed["steady_its"],
+            "peak_rss_mb": streamed["peak_rss_mb"],
+            "inmem_peak_rss_mb": mem["peak_rss_mb"],
+            "inmem_steady_iters_per_sec": mem["steady_its"]}
+
+
+def bench_serving():
+    """Continuous (framed) serving marginal cost — the reference's
+    sub-millisecond continuous-mode claim (spark_serving/about.md:18,
+    151-154), tracked round over round instead of only asserted in a
+    test printout.
+
+    → (marginal ms/record at window 128 over 512 records, solo round-trip
+    ms), both medians of 3 through a real PipelineServer on localhost."""
+    import json as _json
+
+    from synapseml_tpu import Dataset
+    from synapseml_tpu.serving import ContinuousClient, PipelineServer
+
+    class _Doubler:
+        def transform(self, ds):
+            x = np.asarray([float(v) for v in ds["x"]])
+            return Dataset({"x": ds["x"], "prediction": 2.0 * x})
+
+    ps = PipelineServer(_Doubler(), lambda r: {"x": r.json()["x"]},
+                        batch_timeout_s=0.01)
+    try:
+        host, port = ps.server.address
+        with ContinuousClient(host, port, "/") as c:
+            status, _ = c.request(b'{"x": 0.0}')            # warm path
+            assert status == 200, status
+            n = 512
+            payloads = [_json.dumps({"x": float(i)}).encode()
+                        for i in range(n)]
+            marg, solo = [], []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                replies = c.request_many(payloads, window=128)
+                marg.append((time.perf_counter() - t0) / n * 1e3)
+                assert len(replies) == n
+                # a latency number built from error frames is not a
+                # serving number — every reply must be a 200
+                assert all(s == 200 for s, _ in replies)
+                t0 = time.perf_counter()
+                status, _ = c.request(b'{"x": 1.0}')
+                solo.append((time.perf_counter() - t0) * 1e3)
+                assert status == 200, status
+        return sorted(marg)[1], sorted(solo)[1]
+    finally:
+        ps.close()
+
+
 def bench_resnet50():
     """ResNet-50 ONNX batch inference img/s/chip at f32 and bf16
     (BASELINE config #2; reference path: ONNXModel.scala:242-251 over ONNX
@@ -305,14 +494,13 @@ def bench_resnet50():
         fn = compile_onnx(model_bytes, dtype=dt)
         out = fn(data=x_dev)
         np.asarray(out["logits"][0, :1])         # true barrier (readback)
-        best = 0.0
-        for _ in range(2):
-            t0 = time.perf_counter()
+
+        def window():
             for _ in range(steps):
-                out = fn(data=x_dev)
-            np.asarray(out["logits"][0, :1])
-            best = max(best, bs * steps / (time.perf_counter() - t0))
-        rates[label] = best
+                o = fn(data=x_dev)
+            np.asarray(o["logits"][0, :1])
+            return bs * steps
+        rates[label] = _median_rate(window)
     return rates["f32"], rates["bf16"]
 
 
@@ -345,14 +533,13 @@ def bench_llm():
     for B in (8, 32):
         try:
             ids = rng.integers(0, cfg.vocab_size, (B, P))
-            generate(model, variables, ids, max_new_tokens=NEW)  # compile
-            best = 0.0
-            for _ in range(2):
-                t0 = time.perf_counter()
-                out = generate(model, variables, ids, max_new_tokens=NEW)
-                best = max(best, B * NEW / (time.perf_counter() - t0))
+            out = generate(model, variables, ids, max_new_tokens=NEW)
             assert out.shape == (B, NEW)
-            rates[B] = best
+
+            def once(B=B, ids=ids):
+                generate(model, variables, ids, max_new_tokens=NEW)
+                return B * NEW
+            rates[B] = _median_rate(once)
         except Exception as e:    # keep the batch-8 number if B=32 OOMs
             print(f"[secondary] LLM decode batch {B} failed: {e}",
                   file=sys.stderr)
@@ -376,20 +563,19 @@ def bench_llm():
         # spec-decode prompt below and break round-over-round comparability
         ids = np.random.default_rng(8).integers(0, cfg.vocab_size, (B, P))
         generate(qmodel, qvars, ids, max_new_tokens=NEW)         # compile
-        best = pipe = 0.0
-        for _ in range(2):
-            t0 = time.perf_counter()
-            out = generate(qmodel, qvars, ids, max_new_tokens=NEW)
-            best = max(best, B * NEW / (time.perf_counter() - t0))
-            calls = 4
-            t0 = time.perf_counter()
+
+        def once():
+            generate(qmodel, qvars, ids, max_new_tokens=NEW)
+            return B * NEW
+
+        def pipelined(calls=4):
             for _ in range(calls):
                 out = generate(qmodel, qvars, ids, max_new_tokens=NEW,
                                block=False)
             np.asarray(out)                    # one readback drains all
-            pipe = max(pipe,
-                       calls * B * NEW / (time.perf_counter() - t0))
-        int8_b8, int8_b8_pipe = best, pipe
+            return calls * B * NEW
+        int8_b8 = _median_rate(once)
+        int8_b8_pipe = _median_rate(pipelined)
     except Exception as e:
         print(f"[secondary] int8 1B decode failed: {e}", file=sys.stderr)
 
@@ -408,13 +594,12 @@ def bench_llm():
         out, spec_stats = generate_speculative(model, variables, pids,
                                                max_new_tokens=NEW)
         assert np.array_equal(ref, out), "speculative != greedy"
-        best = 0.0
-        for _ in range(2):
-            t0 = time.perf_counter()
+
+        def once():
             generate_speculative(model, variables, pids,
                                  max_new_tokens=NEW)
-            best = max(best, B * NEW / (time.perf_counter() - t0))
-        spec_tps = best
+            return B * NEW
+        spec_tps = _median_rate(once)
     except Exception as e:
         spec_stats = None      # never publish stats for a failed run
         print(f"[secondary] speculative decode failed: {e}", file=sys.stderr)
@@ -447,12 +632,11 @@ def bench_llm_8b_int8():
              for l in jax.tree.leaves(variables)) / 1e9
     ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (B, P))
     generate(model, variables, ids, max_new_tokens=NEW)      # compile
-    best = 0.0
-    for _ in range(2):
-        t0 = time.perf_counter()
+
+    def once():
         generate(model, variables, ids, max_new_tokens=NEW)
-        best = max(best, B * NEW / (time.perf_counter() - t0))
-    return best, gb
+        return B * NEW
+    return _median_rate(once), gb
 
 
 def main():
@@ -498,12 +682,18 @@ def main():
     except Exception as e:
         print(f"[secondary] ResNet-50 bench failed: {e}", file=sys.stderr)
 
-    vision_sps = vision_mfu = None
+    vision_sps = vision_mfu = vision_roof = None
     try:
-        vision_sps, vision_mfu = bench_vision()
+        vision_sps, vision_mfu, vision_roof = bench_vision()
         print(f"[secondary] DeepVisionClassifier ResNet-50 fine-tune: "
               f"{vision_sps:.1f} samples/s/chip, MFU {vision_mfu:.3f}",
               file=sys.stderr)
+        if vision_roof:
+            print(f"[secondary]   roofline: {vision_roof['measured_step_ms']:.1f} ms/step measured, "
+                  f"bandwidth bound {vision_roof['roofline_bandwidth_ms']:.1f} ms "
+                  f"({vision_roof['xla_bytes_per_sample_mb']:.0f} MB/sample), "
+                  f"compute bound {vision_roof['roofline_compute_ms']:.1f} ms",
+                  file=sys.stderr)
     except Exception as e:
         print(f"[secondary] vision bench failed: {e}", file=sys.stderr)
 
@@ -543,6 +733,33 @@ def main():
     except Exception as e:
         print(f"[anchor] failed: {e}", file=sys.stderr)
 
+    gbdt_streamed = None
+    try:
+        if gbdt_ips is not None:
+            gbdt_streamed = bench_gbdt_streamed(X, y)
+            print(f"[secondary] GBDT streamed @1Mx{GBDT_FEATURES} "
+                  f"max_bin=63: ingest "
+                  f"{gbdt_streamed['ingest_rows_per_sec']:.0f} rows/s, "
+                  f"{gbdt_streamed['steady_iters_per_sec']:.2f} steady "
+                  f"it/s vs {gbdt_streamed['inmem_steady_iters_per_sec']:.2f} "
+                  f"in-memory SAME-protocol (fresh-compile subprocess "
+                  f"legs — compare to each other, not the warm headline), "
+                  f"peak RSS {gbdt_streamed['peak_rss_mb']:.0f} MB vs "
+                  f"{gbdt_streamed['inmem_peak_rss_mb']:.0f} MB in-memory",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] streamed GBDT bench failed: {e}",
+              file=sys.stderr)
+
+    serving_marg_ms = serving_solo_ms = None
+    try:
+        serving_marg_ms, serving_solo_ms = bench_serving()
+        print(f"[secondary] continuous serving: {serving_marg_ms:.3f} "
+              f"ms/record marginal (window 128), solo RTT "
+              f"{serving_solo_ms:.2f} ms", file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] serving bench failed: {e}", file=sys.stderr)
+
     out = {
         "metric": "DeepTextClassifier BERT-base fine-tune throughput per chip",
         "value": round(bert_sps, 2),
@@ -570,6 +787,8 @@ def main():
                                               if vision_sps else None),
         "resnet50_finetune_mfu": (round(vision_mfu, 4)
                                   if vision_mfu else None),
+        **({f"resnet50_finetune_{k}": round(v, 4)
+            for k, v in vision_roof.items()} if vision_roof else {}),
         "resnet50_onnx_imgs_per_sec": (round(resnet_ips, 1)
                                        if resnet_ips else None),
         "resnet50_onnx_bf16_imgs_per_sec": (round(resnet_bf16_ips, 1)
@@ -592,6 +811,28 @@ def main():
             if llm_spec_stats else None),
         "llama8b_int8_decode_tokens_per_sec": (round(llm8b_tps, 1)
                                                if llm8b_tps else None),
+        "gbdt_streamed_ingest_rows_per_sec": (
+            round(gbdt_streamed["ingest_rows_per_sec"], 0)
+            if gbdt_streamed else None),
+        "gbdt_streamed_iters_per_sec": (
+            round(gbdt_streamed["iters_per_sec"], 3)
+            if gbdt_streamed else None),
+        "gbdt_streamed_steady_iters_per_sec": (
+            round(gbdt_streamed["steady_iters_per_sec"], 3)
+            if gbdt_streamed else None),
+        "gbdt_streamed_peak_rss_mb": (
+            round(gbdt_streamed["peak_rss_mb"], 0)
+            if gbdt_streamed else None),
+        "gbdt_streamed_inmem_peak_rss_mb": (
+            round(gbdt_streamed["inmem_peak_rss_mb"], 0)
+            if gbdt_streamed else None),
+        "gbdt_streamed_inmem_steady_iters_per_sec": (
+            round(gbdt_streamed["inmem_steady_iters_per_sec"], 3)
+            if gbdt_streamed else None),
+        "serving_continuous_ms_per_record": (
+            round(serving_marg_ms, 4) if serving_marg_ms else None),
+        "serving_solo_rtt_ms": (round(serving_solo_ms, 3)
+                                if serving_solo_ms else None),
         "anchor": (f"sklearn HistGradientBoostingClassifier, same host, "
                    f"{anchor_cores} CPU cores" if anchor_ips else None),
     }
