@@ -1,0 +1,200 @@
+"""Pipeline-parallel training for the BERT-style :class:`TextEncoder`.
+
+The reference has no pipeline parallelism at all (SURVEY §2.3) — this is
+TPU-native capability: the encoder block stack splits into S stages of
+``num_layers / S`` blocks each, activations (and the attention mask
+riding alongside them) rotate one ICI hop per tick under the GPipe
+schedule in :mod:`synapseml_tpu.parallel.pipeline`, and the embedding +
+pooler/classifier head stay REPLICATED on every stage — they are a few
+percent of the FLOPs, and keeping them replicated preserves the uniform
+SPMD program shard_map requires.
+
+Semantics: with dropout off (``deterministic=True`` — the supported PP
+training mode) the pipelined forward/backward is EXACTLY the sequential
+model's: microbatching is exact for per-sample ops (layernorm,
+attention), the GPipe schedule is a schedule, not an approximation, and
+``jax.grad`` through the transposed ``ppermute`` delivers the sequential
+gradients.  Pinned by tests/test_pipeline_parallel.py (PP loss == DP
+loss on the same params, grads finite and equal).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.mesh import DATA_AXIS, PIPE_AXIS
+from ...parallel.pipeline import pipeline_apply, stack_stage_params
+from .transformer import EncoderBlock, TextEncoder, TransformerConfig
+
+__all__ = ["split_encoder_stages", "encoder_stage_fn",
+           "pp_logits_fn", "pp_train_loss"]
+
+
+def split_encoder_stages(variables: Any, n_stages: int
+                         ) -> Tuple[Dict, Any]:
+    """Partition TextEncoder ``variables`` into (outer, stacked_stages).
+
+    ``outer`` keeps the replicated pieces (embeddings, final head) —
+    everything except the ``layer_{i}`` blocks; ``stacked_stages`` stacks
+    the per-stage block groups (leading dim = stage) for sharding over
+    the ``pipe`` axis.  Requires ``num_layers % n_stages == 0``."""
+    params = dict(variables["params"])
+    layer_keys = sorted((k for k in params if k.startswith("layer_")),
+                        key=lambda k: int(k.split("_")[1]))
+    L = len(layer_keys)
+    if L % n_stages:
+        raise ValueError(f"num_layers={L} not divisible by "
+                         f"n_stages={n_stages}")
+    per = L // n_stages
+    stages = []
+    for s in range(n_stages):
+        stages.append({f"b{j}": params.pop(layer_keys[s * per + j])
+                       for j in range(per)})
+    outer = dict(variables, params=params)
+    return outer, stack_stage_params(stages)
+
+
+def merge_encoder_stages(outer: Dict, stacked_stages: Any) -> Dict:
+    """Inverse of :func:`split_encoder_stages` (host-side convenience for
+    checkpointing a PP-trained model back into TextEncoder layout)."""
+    params = dict(outer["params"])
+    n_stages = jax.tree_util.tree_leaves(stacked_stages)[0].shape[0]
+    per = len(stacked_stages)
+    for s in range(n_stages):
+        for j in range(per):
+            params[f"layer_{s * per + j}"] = jax.tree_util.tree_map(
+                lambda a: a[s], stacked_stages[f"b{j}"])
+    return dict(outer, params=params)
+
+
+def encoder_stage_fn(cfg: TransformerConfig):
+    """Stage function for :func:`pipeline_apply`: applies this stage's
+    group of EncoderBlocks to the activation, with the attention mask
+    riding the pipeline as a float leaf (psum/ppermute cannot carry
+    bools).  ``cfg.remat`` rematerializes each block on the backward
+    pass, exactly like TextEncoder's own stack."""
+    if cfg.num_experts > 0:
+        # TextEncoder builds MoE blocks at cfg-dependent positions; a
+        # plain EncoderBlock here would silently train a DIFFERENT
+        # (non-MoE) model — combine MoE with expert parallelism instead
+        raise NotImplementedError(
+            "pipeline parallelism over MoE TextEncoders is not supported "
+            "(num_experts > 0): shard experts over the 'expert' mesh "
+            "axis instead")
+    block = EncoderBlock(cfg)
+
+    def one_block(p, x, bmask):
+        return block.apply({"params": p}, x, bmask, True)
+    if cfg.remat:
+        one_block = jax.checkpoint(one_block)
+
+    def fn(stage_params, state):
+        x, mask = state["x"], state["mask"]
+        bmask = mask > 0.5
+        for j in range(len(stage_params)):
+            x = one_block(stage_params[f"b{j}"], x, bmask)
+        return {"x": x, "mask": mask}
+    return fn
+
+
+class _EmbedFront(nn.Module):
+    """TextEncoder's pre-block section (token + position embed + ln) as a
+    standalone module — SAME submodule names, so it applies directly on
+    the ``outer`` slice of a split TextEncoder parameter tree."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.cfg
+        S = input_ids.shape[1]
+        tok = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       embedding_init=nn.with_partitioning(
+                           nn.initializers.truncated_normal(0.02),
+                           ("vocab", "embed")),
+                       name="tok_embed")(input_ids)
+        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=cfg.dtype,
+                       embedding_init=nn.with_partitioning(
+                           nn.initializers.truncated_normal(0.02),
+                           ("pos", "embed")),
+                       name="pos_embed")(jnp.arange(S)[None, :])
+        return nn.LayerNorm(dtype=cfg.dtype, name="ln_embed")(tok + pos)
+
+
+class _Head(nn.Module):
+    """TextEncoder's post-block section ([CLS] pooler + classifier)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from .transformer import _dense
+        cfg = self.cfg
+        cls = x[:, 0, :]
+        pooled = jnp.tanh(_dense(cfg.d_model, ("embed", "pooled"),
+                                 "pooler", cfg.dtype)(cls))
+        return _dense(cfg.num_classes, ("embed", "classes"), "classifier",
+                      jnp.float32)(pooled)
+
+
+_FRONT_KEYS = ("tok_embed", "pos_embed", "ln_embed")
+_HEAD_KEYS = ("pooler", "classifier")
+
+
+def pp_logits_fn(cfg: TransformerConfig, num_microbatches: int):
+    """Body for shard_map over a ``(pipe, data)`` mesh: replicated embed →
+    pipelined block stack → replicated head.  Returns per-rank logits for
+    this data shard."""
+    stage_fn = encoder_stage_fn(cfg)
+    front, head = _EmbedFront(cfg), _Head(cfg)
+
+    def fn(outer, stacked, input_ids, attention_mask):
+        B = input_ids.shape[0]
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"per-rank batch {B} not divisible by "
+                             f"num_microbatches={M}")
+        p = outer["params"]
+        x = front.apply({"params": {k: p[k] for k in _FRONT_KEYS}},
+                        input_ids)
+        mb = B // M
+        mbs = {"x": x.reshape(M, mb, *x.shape[1:]),
+               "mask": attention_mask.astype(jnp.float32)
+                                     .reshape(M, mb, -1)}
+        # the mask rides the pipeline but is never an output — collect
+        # only the activations so it skips the outputs carry and psum
+        out = pipeline_apply(stage_fn, stacked, mbs, PIPE_AXIS,
+                             collect=lambda s: s["x"])
+        y = out.reshape(B, *x.shape[1:])
+        return head.apply({"params": {k: p[k] for k in _HEAD_KEYS}}, y)
+    return fn
+
+
+def pp_train_loss(cfg: TransformerConfig, mesh: Mesh,
+                  num_microbatches: int = 4):
+    """Jittable (outer, stacked, ids, mask, labels) → mean softmax-CE
+    loss under a ``(pipe, data)`` mesh; differentiate with ``jax.grad``
+    over the first two arguments for a PP train step.
+
+    The loss psum-averages over the data axis, so its value (and the
+    gradients) match the single-device full-batch model exactly when
+    dropout is off."""
+    logits_fn = pp_logits_fn(cfg, num_microbatches)
+
+    def body(outer, stacked, ids, mask, labels):
+        logits = logits_fn(outer, stacked, ids, mask)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        total = jax.lax.psum(jnp.sum(nll), DATA_AXIS)
+        count = jax.lax.psum(nll.shape[0], DATA_AXIS)
+        return total / count
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(PIPE_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False))

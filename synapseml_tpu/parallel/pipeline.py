@@ -39,10 +39,21 @@ def stack_stage_params(per_stage_params: Sequence[Any]):
         lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
 
-def _run_schedule(stage_fn, stacked_params, microbatches, axis_name):
-    """The tick loop; returns (outputs valid on last stage, stage, S)."""
-    params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
-    M = microbatches.shape[0]
+def _run_schedule(stage_fn, stacked_params, microbatches, axis_name,
+                  collect=None):
+    """The tick loop; returns (outputs valid on last stage, stage, S).
+
+    ``microbatches`` may be a single (M, mb, ...) array OR a pytree of
+    them (e.g. ``{"x": ..., "mask": ...}``) — transformer stages carry
+    the attention mask alongside the activations; pass-through leaves
+    simply rotate unchanged.  ``collect`` (state pytree → output pytree,
+    default identity) selects which leaves land in the outputs buffer —
+    pass-through leaves the caller discards should not pay the output
+    carry or the closing psum."""
+    collect = collect if collect is not None else (lambda s: s)
+    tmap = jax.tree_util.tree_map
+    params = tmap(lambda a: a[0], stacked_params)
+    M = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
     stage = lax.axis_index(axis_name)
     S = lax.psum(1, axis_name)
     T = M + S - 1
@@ -52,22 +63,26 @@ def _run_schedule(stage_fn, stacked_params, microbatches, axis_name):
         state, outputs = carry
         # stage 0 ingests microbatch t (clamped gather keeps shapes static;
         # ingested garbage for t >= M never reaches an output slot)
-        inp = lax.dynamic_index_in_dim(microbatches, jnp.minimum(t, M - 1),
-                                       axis=0, keepdims=False)
-        state = jnp.where(stage == 0, inp, state)
+        inp = tmap(lambda mbs: lax.dynamic_index_in_dim(
+            mbs, jnp.minimum(t, M - 1), axis=0, keepdims=False),
+            microbatches)
+        state = tmap(lambda i, s: jnp.where(stage == 0, i, s), inp, state)
         out = stage_fn(params, state)
         # last stage retires microbatch t-(S-1) at tick t
         retire = t - (S - 1)
-        outputs = jnp.where(
-            (stage == S - 1) & (retire >= 0),
-            lax.dynamic_update_index_in_dim(
-                outputs, out, jnp.maximum(retire, 0), axis=0),
-            outputs)
-        state = lax.ppermute(out, axis_name, perm)
+        outputs = tmap(
+            lambda os, o: jnp.where(
+                (stage == S - 1) & (retire >= 0),
+                lax.dynamic_update_index_in_dim(
+                    os, o, jnp.maximum(retire, 0), axis=0),
+                os),
+            outputs, collect(out))
+        state = tmap(lambda o: lax.ppermute(o, axis_name, perm), out)
         return (state, outputs), None
 
-    state0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
-    outputs0 = jnp.zeros_like(microbatches)
+    state0 = tmap(lambda mbs: jnp.zeros(mbs.shape[1:], mbs.dtype),
+                  microbatches)
+    outputs0 = tmap(jnp.zeros_like, collect(microbatches))
     (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(T))
     return outputs, stage, S
 
@@ -75,7 +90,8 @@ def _run_schedule(stage_fn, stacked_params, microbatches, axis_name):
 def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stacked_params: Any,
                    microbatches: jnp.ndarray,
-                   axis_name: str = PIPE_AXIS) -> jnp.ndarray:
+                   axis_name: str = PIPE_AXIS,
+                   collect: Callable[[Any], Any] = None) -> jnp.ndarray:
     """Run microbatches through the S-stage pipeline.  MUST be called
     inside ``shard_map`` with ``axis_name`` bound and ``stacked_params``
     sharded so each rank's slice has leading dim 1.
@@ -89,10 +105,17 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     For TRAINING use :func:`pipeline_loss`: differentiating through this
     broadcast with an identical per-rank loss inflates gradients by S
     (every rank seeds the same cotangent into the psum transpose).
+
+    ``collect`` (state pytree → output pytree) selects the leaves worth
+    retiring and broadcasting — pass-through leaves (e.g. an attention
+    mask riding the pipeline) should not pay the outputs carry/psum.
     """
     outputs, stage, S = _run_schedule(stage_fn, stacked_params,
-                                      microbatches, axis_name)
-    return lax.psum(jnp.where(stage == S - 1, outputs, 0.0), axis_name)
+                                      microbatches, axis_name, collect)
+    return jax.tree_util.tree_map(
+        lambda o: lax.psum(jnp.where(stage == S - 1, o,
+                                     jnp.zeros_like(o)), axis_name),
+        outputs)
 
 
 def pipeline_loss(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
