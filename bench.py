@@ -554,6 +554,7 @@ def bench_llm():
     #    amortization idiom the ONNX bench uses, and what a serving loop
     #    actually does (request i+1 dispatches while i runs).
     int8_b8 = int8_b8_pipe = None
+    int8_slope_ms = int8_fixed_ms = None
     try:
         B = 8
         qcfg = dataclasses.replace(cfg, weight_quant="int8")
@@ -576,6 +577,16 @@ def bench_llm():
             return calls * B * NEW
         int8_b8 = _median_rate(once)
         int8_b8_pipe = _median_rate(pipelined)
+        # two-point decomposition (the claim the README's key promotion
+        # rests on): t(1 call) and t(4 calls, one readback) split the
+        # per-call cost into the device+dispatch slope and the fixed
+        # tunnel intercept — the intercept is the platform's round trip,
+        # not program work, so the SINGLE-call rate rides the tunnel and
+        # the pipelined rate is the tracked serving number
+        t1 = B * NEW / int8_b8
+        t4 = 4 * B * NEW / int8_b8_pipe
+        int8_slope_ms = (t4 - t1) / 3 * 1e3
+        int8_fixed_ms = t1 * 1e3 - int8_slope_ms
     except Exception as e:
         print(f"[secondary] int8 1B decode failed: {e}", file=sys.stderr)
 
@@ -603,7 +614,79 @@ def bench_llm():
     except Exception as e:
         spec_stats = None      # never publish stats for a failed run
         print(f"[secondary] speculative decode failed: {e}", file=sys.stderr)
-    return rates[8], rates[32], spec_tps, spec_stats, int8_b8, int8_b8_pipe
+    return (rates[8], rates[32], spec_tps, spec_stats, int8_b8,
+            int8_b8_pipe, int8_slope_ms, int8_fixed_ms)
+
+
+def bench_llm_spec_target():
+    """Speculative decoding in its TARGET regime: predictable text.
+
+    Zero egress blocks real checkpoints, but predictability doesn't need
+    one — a small Llama-class model fine-tunes IN-BENCH on a templated
+    log corpus until greedy continuations are locally predictable, then
+    prompt-lookup drafting is measured against plain greedy decode at
+    batch 8 with greedy-equality asserted.  Both single-call and
+    pipelined (8 dispatches, one readback — the serving-loop idiom every
+    decode section uses) readings are published; the random-init numbers
+    in bench_llm stay alongside as the honesty anchor for chaotic text.
+
+    → dict of rates/stats, or raises on any mismatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.llm import (LlamaConfig, LlamaModel,
+                                          finetune_lm, generate,
+                                          generate_speculative,
+                                          templated_log_corpus)
+
+    cfg = LlamaConfig.tiny(vocab_size=512, d_model=1024, num_layers=12,
+                           num_heads=16, num_kv_heads=4, max_len=256)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, 8), jnp.int32))
+    t0 = time.perf_counter()
+    variables, final_loss = finetune_lm(
+        model, variables, (templated_log_corpus(rng, 32, 8) for _ in range(250)),
+        learning_rate=5e-4)
+    train_s = time.perf_counter() - t0
+
+    B, NEW, CALLS = 8, 64, 8
+    prompts = templated_log_corpus(rng, B, 3)
+    ref = generate(model, variables, prompts, max_new_tokens=NEW)
+    out, stats = generate_speculative(model, variables, prompts,
+                                      max_new_tokens=NEW)
+    assert np.array_equal(ref, out), "speculative != greedy"
+
+    def plain_once():
+        generate(model, variables, prompts, max_new_tokens=NEW)
+        return B * NEW
+
+    def spec_once():
+        generate_speculative(model, variables, prompts, max_new_tokens=NEW)
+        return B * NEW
+
+    def plain_pipe():
+        for _ in range(CALLS):
+            o = generate(model, variables, prompts, max_new_tokens=NEW,
+                         block=False)
+        np.asarray(o)
+        return CALLS * B * NEW
+
+    def spec_pipe():
+        for _ in range(CALLS):
+            p = generate_speculative(model, variables, prompts,
+                                     max_new_tokens=NEW, block=False)
+        np.asarray(p)
+        return CALLS * B * NEW
+
+    return {"plain_tokens_per_sec": _median_rate(plain_once),
+            "tokens_per_sec": _median_rate(spec_once),
+            "plain_pipelined_tokens_per_sec": _median_rate(plain_pipe),
+            "pipelined_tokens_per_sec": _median_rate(spec_pipe),
+            "tokens_per_step": stats["tokens_per_step"],
+            "acceptance_rate": stats["acceptance_rate"],
+            "train_s": train_s, "final_loss": final_loss}
 
 
 def bench_llm_8b_int8():
@@ -643,9 +726,11 @@ def main():
     bert_sps, mfu, n_params = bench_bert()
     llm_tps = llm_tps32 = llm_spec_tps = llm_spec_stats = None
     llm_int8_tps = llm_int8_pipe_tps = None
+    llm_int8_slope_ms = llm_int8_fixed_ms = None
     try:
         (llm_tps, llm_tps32, llm_spec_tps, llm_spec_stats,
-         llm_int8_tps, llm_int8_pipe_tps) = bench_llm()
+         llm_int8_tps, llm_int8_pipe_tps, llm_int8_slope_ms,
+         llm_int8_fixed_ms) = bench_llm()
         b8 = f"{llm_tps:.0f}" if llm_tps else "failed"
         b32 = f"{llm_tps32:.0f}" if llm_tps32 else "failed"
         print(f"[secondary] Llama-1B decode: {b8} tokens/s/chip (batch 8), "
@@ -663,6 +748,24 @@ def main():
                   file=sys.stderr)
     except Exception as e:
         print(f"[secondary] LLM bench failed: {e}", file=sys.stderr)
+
+    spec_target = None
+    try:
+        spec_target = bench_llm_spec_target()
+        sp = spec_target
+        print(f"[secondary] speculative decode TARGET regime (in-bench "
+              f"fine-tune on templated logs, {sp['train_s']:.0f}s, "
+              f"greedy-exact): {sp['tokens_per_step']:.2f} tokens/step, "
+              f"single-call {sp['tokens_per_sec']:.0f} vs plain "
+              f"{sp['plain_tokens_per_sec']:.0f} tok/s "
+              f"({sp['tokens_per_sec']/sp['plain_tokens_per_sec']:.2f}x), "
+              f"pipelined {sp['pipelined_tokens_per_sec']:.0f} vs "
+              f"{sp['plain_pipelined_tokens_per_sec']:.0f} tok/s "
+              f"({sp['pipelined_tokens_per_sec']/sp['plain_pipelined_tokens_per_sec']:.2f}x)",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] spec target-regime bench failed: {e}",
+              file=sys.stderr)
 
     llm8b_tps = llm8b_gb = None
     try:
@@ -801,6 +904,10 @@ def main():
                                                if llm_int8_tps else None),
         "llama1b_int8_decode_pipelined_tokens_per_sec": (
             round(llm_int8_pipe_tps, 1) if llm_int8_pipe_tps else None),
+        "llama1b_int8_call_device_ms": (
+            round(llm_int8_slope_ms, 2) if llm_int8_slope_ms else None),
+        "llama1b_int8_call_fixed_ms": (
+            round(llm_int8_fixed_ms, 2) if llm_int8_fixed_ms else None),
         "llama1b_spec_decode_tokens_per_sec": (round(llm_spec_tps, 1)
                                                if llm_spec_tps else None),
         "llama1b_spec_tokens_per_step": (
@@ -811,6 +918,12 @@ def main():
             if llm_spec_stats else None),
         "llama8b_int8_decode_tokens_per_sec": (round(llm8b_tps, 1)
                                                if llm8b_tps else None),
+        **({f"llm_spec_target_{k}": round(v, 4)
+            for k, v in spec_target.items()} if spec_target else {}),
+        "llm_spec_target_speedup_pipelined": (
+            round(spec_target["pipelined_tokens_per_sec"]
+                  / spec_target["plain_pipelined_tokens_per_sec"], 3)
+            if spec_target else None),
         "gbdt_streamed_ingest_rows_per_sec": (
             round(gbdt_streamed["ingest_rows_per_sec"], 0)
             if gbdt_streamed else None),

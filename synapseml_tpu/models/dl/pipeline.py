@@ -31,8 +31,8 @@ from ...parallel.mesh import DATA_AXIS, PIPE_AXIS
 from ...parallel.pipeline import pipeline_apply, stack_stage_params
 from .transformer import EncoderBlock, TextEncoder, TransformerConfig
 
-__all__ = ["split_encoder_stages", "encoder_stage_fn",
-           "pp_logits_fn", "pp_train_loss"]
+__all__ = ["split_encoder_stages", "merge_encoder_stages",
+           "encoder_stage_fn", "pp_logits_fn", "pp_train_loss"]
 
 
 def split_encoder_stages(variables: Any, n_stages: int
@@ -105,7 +105,13 @@ def encoder_stage_fn(cfg: TransformerConfig):
 class _EmbedFront(nn.Module):
     """TextEncoder's pre-block section (token + position embed + ln) as a
     standalone module — SAME submodule names, so it applies directly on
-    the ``outer`` slice of a split TextEncoder parameter tree."""
+    the ``outer`` slice of a split TextEncoder parameter tree.
+
+    Deliberately a COPY of TextEncoder.__call__'s pre-block lines rather
+    than a shared submodule: restructuring TextEncoder into
+    front/blocks/head submodules would rename every param path and break
+    existing checkpoints + the HF import mapping.  Drift between the two
+    copies is pinned by the PP==sequential grad-parity test."""
     cfg: TransformerConfig
 
     @nn.compact

@@ -2,9 +2,11 @@
 reference's only LLM surface is remote OpenAI calls,
 cognitive/.../openai/OpenAI.scala:246)."""
 
+from .finetune import (finetune_lm, make_lm_train_step,
+                       templated_log_corpus)
 from .generate import (cast_params, generate, generate_speculative,
                        quantize_int8,
-                       sample_logits)
+                       sample_logits, spec_unpack)
 from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
                     causal_lm_loss, init_cache, llama_from_pretrained,
@@ -14,8 +16,9 @@ from .stage import LLMTransformer
 __all__ = [
     "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LLMTransformer",
     "LlamaConfig", "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss",
-    "cast_params", "generate", "generate_speculative", "init_cache",
-    "llama_from_pretrained",
+    "cast_params", "finetune_lm", "generate", "generate_speculative",
+    "init_cache", "llama_from_pretrained", "make_lm_train_step",
     "quantize_int8",
-    "rope_frequencies", "sample_logits",
+    "rope_frequencies", "sample_logits", "spec_unpack",
+    "templated_log_corpus",
 ]

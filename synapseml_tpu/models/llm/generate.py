@@ -174,9 +174,14 @@ def _ngram_draft(ctx: jnp.ndarray, cur_len: jnp.ndarray, draft_len: int,
     (strong on repetitive/structured text, harmless elsewhere because
     verification keeps greedy output exact).  → (B, draft_len) int32."""
     B, L = ctx.shape
-    # the trailing n-gram of each sequence
-    tail = jnp.take_along_axis(
-        ctx, jnp.maximum(cur_len[:, None] - ngram + jnp.arange(ngram), 0), 1)
+    iota_l = jnp.arange(L)[None, :]
+    # gathers (take_along_axis) are the TPU pathology — every dynamic
+    # read here is a one-hot contraction instead (measured: the gather
+    # formulation cost several ms/step of the speculative loop's glue)
+    gpos = jnp.maximum(cur_len[:, None] - ngram + jnp.arange(ngram), 0)
+    tail = jnp.einsum("bjl,bl->bj",
+                      (gpos[:, :, None] == iota_l[:, None, :])
+                      .astype(jnp.int32), ctx)          # (B, n)
     # windows[b, p, j] = ctx[b, p + j] for p in [0, L - ngram]
     windows = jnp.stack([ctx[:, j:L - ngram + 1 + j] for j in range(ngram)],
                         axis=-1)                       # (B, L-n+1, n)
@@ -190,8 +195,10 @@ def _ngram_draft(ctx: jnp.ndarray, cur_len: jnp.ndarray, draft_len: int,
     src = p_best[:, None] + ngram + jnp.arange(draft_len)      # (B, K)
     # clip unknown continuation positions to the last known token
     src = jnp.minimum(src, cur_len[:, None] - 1)
-    draft = jnp.take_along_axis(ctx, src, 1)
-    last = jnp.take_along_axis(ctx, cur_len[:, None] - 1, 1)
+    oh = (src[:, :, None] == iota_l[:, None, :]).astype(jnp.int32)
+    draft = jnp.einsum("bkl,bl->bk", oh, ctx)
+    last = jnp.sum(jnp.where(iota_l == cur_len[:, None] - 1, ctx, 0),
+                   axis=1, keepdims=True)
     return jnp.where(has[:, None], draft,
                      jnp.broadcast_to(last, draft.shape)).astype(jnp.int32)
 
@@ -223,7 +230,9 @@ def _generate_spec_jit(model: LlamaModel, variables: Any,
     def body(s):
         ctx, cur_len, done, cache, steps, acc, row_steps = s
         draft = _ngram_draft(ctx, cur_len, K, ngram)            # (B, K)
-        last = jnp.take_along_axis(ctx, cur_len[:, None] - 1, 1)
+        last = jnp.sum(jnp.where(jnp.arange(L)[None, :]
+                                 == cur_len[:, None] - 1, ctx, 0),
+                       axis=1, keepdims=True)
         inputs = jnp.concatenate([last, draft], axis=1)         # (B, K+1)
         pos = (cur_len - 1)[:, None] + jnp.arange(K + 1)[None, :]
         logits, new_cache = model.apply(variables, inputs, positions=pos,
@@ -268,13 +277,36 @@ def _generate_spec_jit(model: LlamaModel, variables: Any,
     # pad everything past each sequence's end (eos freeze)
     keep = jnp.arange(max_new_tokens)[None, :] < (cur_len - P)[:, None]
     out = jnp.where(keep, out, pad_id)
-    return out, steps, acc, row_steps
+    # pack tokens + stats into ONE array: each separate host readback
+    # costs a full tunnel round trip (~90 ms measured), and four of them
+    # were the dominant per-call cost of the whole speculative path
+    packed = jnp.concatenate(
+        [out, acc[:, None], row_steps[:, None],
+         jnp.broadcast_to(steps, (B,))[:, None]], axis=1)
+    return packed
+
+
+def spec_unpack(packed, max_new_tokens: int, draft_len: int):
+    """Host-side unpack of a ``block=False`` speculative result →
+    (tokens (B, max_new_tokens), stats dict) — same stats as the
+    blocking path."""
+    packed = np.asarray(packed)
+    out = packed[:, :max_new_tokens]
+    acc = packed[:, max_new_tokens].astype(np.float64)
+    row_steps = np.maximum(packed[:, max_new_tokens + 1].astype(np.float64),
+                           1.0)
+    tps = float(np.mean(acc / row_steps))
+    stats = {"steps": int(packed[0, max_new_tokens + 2]),
+             "accepted": int(acc.sum()),
+             "tokens_per_step": tps,
+             "acceptance_rate": max(tps - 1.0, 0.0) / max(int(draft_len), 1)}
+    return out, stats
 
 
 def generate_speculative(model: LlamaModel, variables: Any, prompt_ids,
                          max_new_tokens: int = 32, draft_len: int = 7,
                          ngram: int = 2, eos_id: Optional[int] = None,
-                         pad_id: int = 0):
+                         pad_id: int = 0, block: bool = True):
     """Greedy decode with self-speculative (prompt-lookup) drafting.
 
     Each loop step verifies ``draft_len`` n-gram-drafted tokens in ONE
@@ -287,25 +319,31 @@ def generate_speculative(model: LlamaModel, variables: Any, prompt_ids,
 
     Returns (tokens (B, max_new_tokens) int32, stats dict with
     ``steps``/``accepted``/``tokens_per_step``).
+
+    ``block=False`` instead returns the PACKED on-device
+    (B, max_new_tokens + 3) array without the host readback — serving
+    loops dispatch the next request while this one runs and recover
+    (tokens, stats) later with :func:`spec_unpack`; the tunnel round trip
+    is paid once per pipeline drain instead of once per call.
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     if prompt_ids.shape[1] < max(ngram, 2):
         raise ValueError("prompt must be at least ngram tokens long")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
-    out, steps, acc, row_steps = _generate_spec_jit(
+    packed = _generate_spec_jit(
         model, variables, prompt_ids, int(max_new_tokens), int(draft_len),
         int(ngram), eos_id, int(pad_id))
-    out = np.asarray(out)
-    acc = np.asarray(acc, np.float64)
-    row_steps = np.maximum(np.asarray(row_steps, np.float64), 1.0)
-    # per-ROW averages: rows finish at different times, and a finished
-    # row must not dilute the rate of rows still decoding
-    tps = float(np.mean(acc / row_steps))
-    stats = {"steps": int(steps), "accepted": int(acc.sum()),
-             "tokens_per_step": tps,
-             "acceptance_rate": max(tps - 1.0, 0.0) / max(int(draft_len), 1)}
-    return out, stats
+    if not block:
+        # serving loops dispatch the next request while this one runs and
+        # unpack later via :func:`spec_unpack` — the tunnel round trip is
+        # paid once per pipeline drain, not once per call
+        return packed
+    # per-ROW stat averages (inside spec_unpack): rows finish at
+    # different times, and a finished row must not dilute the rate of
+    # rows still decoding.  ONE readback: per-field downloads each cost
+    # a full tunnel round trip
+    return spec_unpack(packed, int(max_new_tokens), int(draft_len))
 
 
 def generate(model: LlamaModel, variables: Any, prompt_ids,

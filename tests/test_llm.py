@@ -333,3 +333,46 @@ def test_int8_tied_embedding_parity():
     out_q = generate(qmodel, qvars, np.asarray(ids), max_new_tokens=8)
     agree = (out_f == out_q).mean()
     assert agree >= 0.75, (agree, out_f, out_q)
+
+
+def test_speculative_target_regime_finetuned():
+    """Speculative decoding in its TARGET regime: after fine-tuning on a
+    templated corpus (finetune_lm — the in-image substitute for a real
+    checkpoint under zero egress), greedy continuations become locally
+    predictable and prompt-lookup acceptance jumps from ~0 (random init)
+    to several tokens per step, with output still EXACTLY greedy."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.llm import (LlamaConfig, LlamaModel,
+                                          finetune_lm, generate,
+                                          generate_speculative,
+                                          templated_log_corpus)
+
+    def corpus(rng, n, n_rec):
+        return templated_log_corpus(rng, n, n_rec, field_range=(64, 256))
+
+    cfg = LlamaConfig.tiny(vocab_size=256, d_model=128, num_layers=2,
+                           num_heads=4, num_kv_heads=2, max_len=160)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, 8), jnp.int32))
+    # random init: chaotic continuations, acceptance near zero
+    prompts = corpus(rng, 4, 3)
+    _, stats0 = generate_speculative(model, variables, prompts,
+                                     max_new_tokens=32)
+    # random-init continuations are chaotic: acceptance near zero is the
+    # claimed contrast, so pin it
+    assert stats0["tokens_per_step"] < 2.0, stats0
+
+    variables, _ = finetune_lm(model, variables,
+                               (corpus(rng, 16, 6) for _ in range(150)),
+                               learning_rate=1e-3)
+    ref = generate(model, variables, prompts, max_new_tokens=32)
+    out, stats = generate_speculative(model, variables, prompts,
+                                      max_new_tokens=32)
+    np.testing.assert_array_equal(ref, out)       # still exactly greedy
+    assert stats["tokens_per_step"] > 2.5, stats
+    assert stats["tokens_per_step"] > 1.5 * stats0["tokens_per_step"], \
+        (stats0, stats)
