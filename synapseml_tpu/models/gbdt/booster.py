@@ -593,8 +593,15 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
     """
     axis = DATA_AXIS if mesh is not None else None
     if feature_parallel:
+        # strict lossguide order under vertical sharding = the wave
+        # grower with ONE slot per wave: the top-1 "wave" is exactly the
+        # best-first split, at the cost of one owner-broadcast per SPLIT
+        # instead of per level (the native engine's tree_learner=feature
+        # runs its default leaf-wise growth the same way)
+        fp_slots = (1 if growth_policy == "lossguide"
+                    else default_n_slots(p.num_leaves))
         grower = functools.partial(grow_tree_feature_parallel,
-                                   n_slots=default_n_slots(p.num_leaves))
+                                   n_slots=fp_slots)
     elif growth_policy == "depthwise" and p.voting_k == 0:
         grower = functools.partial(grow_tree_depthwise,
                                    n_slots=default_n_slots(p.num_leaves))
@@ -819,8 +826,10 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     with the same dir resumes from the newest file and trains only the
     remaining iterations.  Resume re-bases scores from the saved model, so
     unbagged gbdt/goss runs continue on the identical tree sequence;
-    bagged/dart runs continue with a fresh subsample stream (documented
-    semantics of the reference's warm start too).
+    bagged runs continue with a fresh subsample stream and dart runs
+    freeze the carried trees at their checkpointed weights with a fresh
+    drop stream over the new trees (both the documented-approximate
+    semantics of the reference's warm start, LightGBMBase.scala:38-59).
 
     When ``mesh`` is given, rows are sharded over its ``data`` axis and each
     iteration's histograms ride one psum — the entire distributed story.
@@ -837,13 +846,15 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     measures = InstrumentationMeasures()
     _t0 = _time.perf_counter()
     if checkpoint_dir and checkpoint_interval > 0:
-        if config.boosting_type == "dart":
-            raise NotImplementedError(
-                "checkpoint/resume supports gbdt/goss/rf: dart reweights "
-                "EARLIER trees during later drop iterations, so a resumed "
-                "run cannot continue the drop/normalize sequence.  rf "
-                "resumes fine: prediction averages over the tree count, "
-                "so any prefix is itself a valid rf model")
+        # dart resume uses the warm-start (init_model) semantics LightGBM
+        # itself documents as APPROXIMATE: the carried trees are frozen
+        # at their checkpointed weights (they re-based the score margin)
+        # and the fresh run's drop/normalize stream applies only to the
+        # trees grown after resume.  Exact continuation is impossible —
+        # later drops reweight EARLIER trees, so the uninterrupted
+        # drop/normalize sequence cannot be replayed from a prefix — and
+        # the reference's own numBatches warm start has the same
+        # stated-approximate behavior (LightGBMBase.scala:38-59).
         resumed = _latest_checkpoint(checkpoint_dir)
         if resumed is not None:
             done = resumed.num_trees // max(resumed.num_class, 1)
@@ -1040,10 +1051,6 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         from .pallas_hist import fused_geometry
         use_pallas = fused_geometry(
             F, B_total, default_n_slots(config.num_leaves)) is not None
-    if featpar and config.growth_policy == "lossguide":
-        raise NotImplementedError(
-            "feature_parallel grows depth-level waves; strict lossguide "
-            "order is only available with data_parallel/voting_parallel")
     # feature_parallel replicates ROWS and shards FEATURES: rows pad only
     # for the pallas chunk, features pad to the rank count
     row_shards = 1 if featpar else shards

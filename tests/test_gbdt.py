@@ -190,12 +190,9 @@ def test_feature_parallel_estimator_and_guards():
     model = clf.fit(ds)
     out = model.transform(ds)
     assert auc(y, np.stack(out["probability"])[:, 1]) > 0.9
-    # strict lossguide order is inherent to wave-free growth — featpar
-    # grows depth-level waves and rejects loudly
-    bad = BoostingConfig(objective="binary", growth_policy="lossguide",
-                         parallelism="feature_parallel", num_iterations=2)
-    with pytest.raises(NotImplementedError, match="lossguide"):
-        train(X, y, bad, mesh=data_parallel_mesh(8))
+    # strict lossguide under featpar trains too (one-slot waves are
+    # best-first order — pinned against the single-device lossguide tree
+    # in test_featpar_lossguide_matches_single_device)
 
 
 def test_feature_parallel_dart_matches_single_device():
@@ -766,11 +763,8 @@ def test_rf_checkpoint_resume_matches_uninterrupted(tmp_path):
                                resumed.predict_margin(X), atol=1e-4)
     a = auc(y, resumed.predict_margin(X))
     assert a > 0.85, a
-    # dart stays rejected, with the reason in the message
-    with pytest.raises(NotImplementedError, match="dart"):
-        train(X, y, BoostingConfig(objective="binary", boosting_type="dart",
-                                   num_iterations=2),
-              checkpoint_dir=ck, checkpoint_interval=1)
+    # dart resumes too, with documented-approximate warm-start semantics
+    # (pinned in test_checkpoint.py's dart resume test)
 
 
 def test_checkpoint_estimator_param(tmp_path):
@@ -782,11 +776,8 @@ def test_checkpoint_estimator_param(tmp_path):
     clf.fit(ds)
     import os
     assert any(f.startswith("iter_") for f in os.listdir(ck))
-    # dart cannot resume from a truncated prefix — rejected loudly
-    with pytest.raises(NotImplementedError, match="checkpoint"):
-        train(X, y, BoostingConfig(objective="binary", boosting_type="dart",
-                                   num_iterations=4),
-              checkpoint_dir=ck, checkpoint_interval=2)
+    # dart resumes with documented-approximate warm-start semantics
+    # (pinned in test_checkpoint.py's dart resume test)
 
 
 def test_distributed_lambdarank_matches_single_device():
@@ -1059,3 +1050,51 @@ end of trees
     np.testing.assert_allclose(b2.predict_margin(X), b.predict_margin(X),
                                atol=1e-6)
     assert "decision_type=6 4" in b.to_string()
+
+
+def test_featpar_lossguide_matches_single_device():
+    """Strict lossguide growth under feature_parallel (previously
+    rejected): the wave grower with one slot per wave IS best-first
+    order — one owner-broadcast per split — and grows the EXACT tree the
+    single-device lossguide grower does.  Reference bar: the native
+    engine accepts tree_learner=feature with its default leaf-wise
+    growth (params/BaseTrainParams.scala:99 pass-through)."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+
+    X, y = binary_data(n=4096, F=16)
+    kw = dict(objective="binary", num_iterations=6, num_leaves=15,
+              min_data_in_leaf=5, growth_policy="lossguide")
+    b_fp, _ = train(X, y, BoostingConfig(parallelism="feature_parallel",
+                                         **kw),
+                    mesh=data_parallel_mesh(8))
+    b_1, _ = train(X, y, BoostingConfig(**kw))
+    np.testing.assert_allclose(b_fp.predict_margin(X),
+                               b_1.predict_margin(X), atol=1e-4)
+    for t_fp, t_1 in zip(b_fp.trees, b_1.trees):
+        np.testing.assert_array_equal(np.asarray(t_fp.split_feature),
+                                      np.asarray(t_1.split_feature))
+
+
+def test_featpar_lossguide_with_efb():
+    """lossguide x feature_parallel x EFB: per-rank bundling composes
+    with one-slot waves — margins match unbundled single-device
+    lossguide."""
+    from synapseml_tpu.parallel import data_parallel_mesh
+
+    rng = np.random.default_rng(11)
+    n, F = 4096, 24
+    X = np.zeros((n, F), np.float32)
+    # mostly-exclusive sparse features so bundling actually happens
+    owner = rng.integers(0, F // 4, n)
+    for j in range(F):
+        rows = owner == (j % (F // 4))
+        X[rows, j] = rng.normal(size=rows.sum())
+    y = (X.sum(axis=1) + rng.normal(scale=0.3, size=n) > 0).astype(np.float64)
+    kw = dict(objective="binary", num_iterations=5, num_leaves=15,
+              min_data_in_leaf=5, growth_policy="lossguide")
+    b_fp, _ = train(X, y, BoostingConfig(parallelism="feature_parallel",
+                                         enable_bundle=True, **kw),
+                    mesh=data_parallel_mesh(8))
+    b_1, _ = train(X, y, BoostingConfig(**kw))
+    np.testing.assert_allclose(b_fp.predict_margin(X),
+                               b_1.predict_margin(X), atol=1e-4)
