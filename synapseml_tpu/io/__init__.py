@@ -11,6 +11,8 @@ from .colstore import (ChunkedColumnSource, SparseChunkedSource,
                        csv_to_colstore, dense_to_csr, write_csr,
                        write_matrix)
 from .image import decode_image, read_images
+from .port_forward import (ForwardSession, TcpRelay,
+                           forward_port_to_remote)
 from .powerbi import PowerBIResponseError, PowerBIWriter
 
 __all__ = [
@@ -21,4 +23,5 @@ __all__ = [
     "ChunkedColumnSource", "SparseChunkedSource", "csv_to_colstore",
     "dense_to_csr", "write_csr", "write_matrix",
     "PowerBIWriter", "PowerBIResponseError",
+    "ForwardSession", "TcpRelay", "forward_port_to_remote",
 ]

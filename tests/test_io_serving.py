@@ -570,3 +570,89 @@ class TestMultiPipelineServer:
             assert ei.value.code == 404
         finally:
             srv.close()
+
+
+class TestPortForwarding:
+    """io/http PortForwarding analogue (PortForwarding.scala): reverse
+    ssh tunnel via the system ssh binary + a pure-Python TCP relay (the
+    testable half — an ssh hop is this relay over a secure channel)."""
+
+    def test_ssh_command_matches_reference_semantics(self):
+        from synapseml_tpu.io.port_forward import build_ssh_command
+        cmd = build_ssh_command("hadoop", "db-cluster", 2200, "*", 9999,
+                                "0.0.0.0", 8899, key_file="/keys/id_rsa")
+        assert cmd[0] == "ssh" and "-N" in cmd
+        assert "StrictHostKeyChecking=no" in cmd   # reference sets this
+        assert "ExitOnForwardFailure=yes" in cmd   # port-walk detection
+        assert "*:9999:0.0.0.0:8899" in cmd
+        assert cmd[cmd.index("-i") + 1] == "/keys/id_rsa"
+        assert cmd[-1] == "hadoop@db-cluster"
+        assert cmd[cmd.index("-p") + 1] == "2200"
+
+    def test_relay_pipes_a_serving_endpoint(self):
+        """End-to-end through the relay: a PipelineServer behind a
+        TcpRelay answers HTTP exactly as if reached directly."""
+        from synapseml_tpu.serving import PipelineServer
+        from synapseml_tpu.io.port_forward import TcpRelay
+        ps = PipelineServer(_Doubler(), lambda r: {"x": r.json()["x"]},
+                            batch_timeout_s=0.01)
+        try:
+            host, port = ps.server.address
+            relay = TcpRelay((host, port))
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{relay.port}/",
+                    data=json.dumps({"x": 21.0}).encode(), method="POST")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert json.loads(r.read())["prediction"] == 42.0
+                # teardown revokes live connections, like an ssh forward
+                import socket as _socket
+                s2 = _socket.create_connection(("127.0.0.1", relay.port))
+                s2.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+                time.sleep(0.3)
+            finally:
+                relay.close()
+            s2.settimeout(5)
+            tail = b"x"
+            while tail:                      # drain until remote close
+                tail = s2.recv(65536)
+            s2.close()
+        finally:
+            ps.close()
+
+    def test_forward_walks_ports_and_reports_failure(self, monkeypatch):
+        """The retry walk covers the whole remote port range (the
+        reference's remotePortStart + attempt loop) and fails cleanly
+        with the range in the message; a missing ssh binary gets its own
+        clear error."""
+        import subprocess as _sp
+
+        import pytest as _pytest
+
+        from synapseml_tpu.io import port_forward as pf
+
+        seen = []
+
+        class FakeProc:
+            def __init__(self, cmd, **kw):
+                seen.append(cmd)
+                import io as _io
+                self.stderr = _io.BytesIO(b"bind: port taken")
+            def poll(self):
+                return 255        # immediate exit = forward bind failed
+
+        monkeypatch.setattr(pf.subprocess, "Popen", FakeProc)
+        with _pytest.raises(RuntimeError, match=r"\[9990, 9991\]"):
+            pf.forward_port_to_remote("nobody", "host",
+                                      remote_port_start=9990,
+                                      local_port=80, max_retries=1,
+                                      settle_s=0.0)
+        forwards = [c[c.index("-R") + 1] for c in seen]
+        assert forwards == ["*:9990:0.0.0.0:80", "*:9991:0.0.0.0:80"]
+        monkeypatch.undo()
+        if _sp.run(["which", "ssh"], capture_output=True).returncode != 0:
+            with _pytest.raises(RuntimeError, match="ssh"):
+                pf.forward_port_to_remote("nobody", "host",
+                                          remote_port_start=1,
+                                          local_port=80, max_retries=0,
+                                          settle_s=0.0)
