@@ -12,7 +12,7 @@ EXAMPLES = ["gbdt_classification", "online_learning", "deep_learning",
             "explainability", "serving", "onnx_inference",
             "lightgbm_interop", "streaming_out_of_core",
             "multi_endpoint_serving", "multiprocess_cluster",
-            "speculative_decoding"]
+            "speculative_decoding", "pipeline_parallelism"]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
