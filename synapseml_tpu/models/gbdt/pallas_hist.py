@@ -345,11 +345,38 @@ def coarse_bins(total_bins: int, shift: int) -> int:
     return -(-bc // 8) * 8
 
 
-def _make_fused_kernel(ft: int, shift: int = 0):
+def fused_refine_fits(num_features: int, total_bins: int, n_slots: int,
+                      shift: int, refine_k: int) -> bool:
+    """Whether the two-level fused pass (coarse tiles + the K refined
+    features' FULL-resolution scratch/accumulator) fits VMEM at the base
+    geometry.  ``fused_geometry`` models only the plain kernel; the
+    refine buffers scale with ``refine_k * total_bins`` and an uncapped
+    ``refine_features`` config must fall back to full-resolution growth
+    instead of failing at Mosaic compile time."""
+    geo = fused_geometry(num_features, total_bins, n_slots)
+    if geo is None:
+        return False
+    ft, chunk = geo
+    Bh = coarse_bins(total_bins, shift)
+    VN = n_slots * SLOT_LANES
+    Fp = -(-num_features // ft) * ft
+    need = (ft * Bh * chunk                     # coarse one-hot (int8)
+            + Fp * Bh * VN * 4                  # coarse accumulator (i32)
+            + 2 * chunk * VN                    # vn scratch + vals (int8)
+            + refine_k * total_bins * chunk     # fine one-hot (int8)
+            + refine_k * total_bins * VN * 4)   # fine accumulator (i32)
+    return need <= _VMEM_BUDGET
+
+
+def _make_fused_kernel(ft: int, shift: int = 0, refine: bool = False):
+    """``refine=True`` (two-level mode) adds a second histogram output:
+    full-resolution histograms of K pre-gathered refined-feature rows
+    (``selk``), built at f==0 from the SAME slot-masked value matrix the
+    coarse tiles use — one bins read, one routing, one vn build for both
+    levels."""
     def kernel(leaf_ref, t1_ref, rlo_ref, rhi_ref, dflt_ref,
                lid_ref, rid_ref,
-               sel_ref, bins_ref, nid_ref, vals_ref,
-               newid_ref, out_ref, oh_ref, vn_ref):
+               *refs):
         """Grid (N//chunk, G) — f fastest.  sel block (S, C) int32 (the
         split columns' bin rows), bins block (1, ft, C) (histogram tile),
         nid (1, C), vals (C, 8) int8 limbs (lane-tiled in-kernel);
@@ -362,12 +389,21 @@ def _make_fused_kernel(ft: int, shift: int = 0):
         original feature's bundled range so an ORIGINAL-feature split
         routes straight off the bundled column (binning.py
         FeatureBundler.route_tables)."""
+        if refine:
+            (selk_ref, sel_ref, bins_ref, nid_ref, vals_ref,
+             newid_ref, out_ref, outf_ref, oh_ref, vn_ref,
+             ohf_ref) = refs
+        else:
+            (sel_ref, bins_ref, nid_ref, vals_ref,
+             newid_ref, out_ref, oh_ref, vn_ref) = refs
         c = pl.program_id(0)
         f = pl.program_id(1)
 
         @pl.when((c == 0) & (f == 0))
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
+            if refine:
+                outf_ref[...] = jnp.zeros_like(outf_ref)
 
         C = bins_ref.shape[2]
         B = oh_ref.shape[0] // ft
@@ -399,6 +435,21 @@ def _make_fused_kernel(ft: int, shift: int = 0):
             tiled = jnp.concatenate([vals_ref[...]] * S, axis=1)
             vn_ref[...] = jnp.where(bslot[:, None] == lane_j, tiled,
                                     jnp.zeros_like(tiled))
+            if refine:
+                # fine-K histograms off the SAME slot-masked values: the
+                # separate refine pass re-read bins, re-derived slots and
+                # re-built vn — here it costs one extra one-hot + matmul
+                K = selk_ref.shape[0]
+                Bf = ohf_ref.shape[0] // K
+                iota_f = lax.broadcasted_iota(jnp.int32, (Bf, C), 0)
+                for k in range(K):
+                    bk = selk_ref[k, :]
+                    ohf_ref[k * Bf:(k + 1) * Bf, :] = (
+                        iota_f == bk[None, :]).astype(jnp.int8)
+                fcontrib = lax.dot_general(
+                    ohf_ref[...], vn_ref[...], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                outf_ref[...] += fcontrib[None]
 
         iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
         for k in range(ft):
@@ -435,8 +486,10 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                           n_slots: int,
                           total_bins: int,
                           hist_shift: int = 0,
+                          sel_k: jnp.ndarray = None,   # (K, N) int32 refined
                           interpret: bool = False):
-    """One pass: → (new_node_id (N,), hists (n_slots, F, Bh, 3)).
+    """One pass: → (new_node_id (N,), hists (n_slots, F, Bh, 3)[,
+    fine_hists (n_slots, K, B, 3) when ``sel_k`` is given]).
 
     Routing per slot: rows of ``sel`` (the split columns' bin rows,
     pre-gathered by the caller: ``jnp.take(bins_flat, cols, axis=0)``)
@@ -446,10 +499,13 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
 
     ``hist_shift`` > 0 (two-level mode) histograms at the COARSE
     ``bin >> hist_shift`` resolution (Bh = :func:`coarse_bins`) while
-    routing stays at fine resolution — the grower refines a top-K feature
-    subset at full resolution in a separate narrow pass."""
+    routing stays at fine resolution.  ``sel_k`` (the refined features'
+    pre-gathered bin rows) additionally builds their FULL-resolution
+    histograms in the same pass, off the same routing and slot-masked
+    value matrix — one bins read and one vn build for both levels."""
     B = total_bins
     Bh = coarse_bins(B, hist_shift) if hist_shift else B
+    refine = sel_k is not None
     bins_r, F, G, ft, N = _bins_tiles(bins_t, B)
     geo = fused_geometry(F, B, n_slots)
     assert geo is not None, (
@@ -459,32 +515,49 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
     assert ft_geo == ft, (ft_geo, ft)
     assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
     VN = n_slots * SLOT_LANES
+    in_specs = [
+        pl.BlockSpec((n_slots, chunk), lambda c, f, *_: (0, c)),
+        pl.BlockSpec((1, ft, chunk), lambda c, f, *_: (f, 0, c)),
+        pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
+        pl.BlockSpec((chunk, VALS), lambda c, f, *_: (c, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
+        pl.BlockSpec((G, ft * Bh, VN), lambda c, f, *_: (0, 0, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((1, N), jnp.int32),
+                 jax.ShapeDtypeStruct((G, ft * Bh, VN), jnp.int32)]
+    scratch = [pltpu.VMEM((ft * Bh, chunk), jnp.int8),
+               pltpu.VMEM((chunk, VN), jnp.int8)]
+    operands = [sel, bins_r, node_id[None, :], vals]
+    if refine:
+        K = sel_k.shape[0]
+        in_specs.insert(0, pl.BlockSpec((K, chunk), lambda c, f, *_: (0, c)))
+        out_specs.append(pl.BlockSpec((1, K * B, VN),
+                                      lambda c, f, *_: (0, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((1, K * B, VN), jnp.int32))
+        scratch.append(pltpu.VMEM((K * B, chunk), jnp.int8))
+        operands.insert(0, sel_k)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=7,
         grid=(N // chunk, G),
-        in_specs=[
-            pl.BlockSpec((n_slots, chunk), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((1, ft, chunk), lambda c, f, *_: (f, 0, c)),
-            pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((chunk, VALS), lambda c, f, *_: (c, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, chunk), lambda c, f, *_: (0, c)),
-            pl.BlockSpec((G, ft * Bh, VN),
-                         lambda c, f, *_: (0, 0, 0)),
-        ],
-        scratch_shapes=[pltpu.VMEM((ft * Bh, chunk), jnp.int8),
-                        pltpu.VMEM((chunk, VN), jnp.int8)],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
-    new_id, out = pl.pallas_call(
-        _make_fused_kernel(ft, hist_shift),
+    res = pl.pallas_call(
+        _make_fused_kernel(ft, hist_shift, refine),
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((1, N), jnp.int32),
-                   jax.ShapeDtypeStruct((G, ft * Bh, VN), jnp.int32)],
+        out_shape=out_shape,
         interpret=interpret,
-    )(leaf, t1, rlo, rhi, dflt, l_id, r_id,
-      sel, bins_r, node_id[None, :], vals)
+    )(leaf, t1, rlo, rhi, dflt, l_id, r_id, *operands)
 
+    new_id, out = res[0], res[1]
     out = out.reshape(G * ft, Bh, n_slots, SLOT_LANES)[:F]
     out = jnp.moveaxis(out, 2, 0)                      # (S, F, Bh, 8)
-    return new_id[0], _reconstruct(out, scales)
+    hists = _reconstruct(out, scales)
+    if not refine:
+        return new_id[0], hists
+    outf = res[2].reshape(K, B, n_slots, SLOT_LANES)
+    fine = _reconstruct(jnp.moveaxis(outf, 2, 0), scales)
+    return new_id[0], hists, fine

@@ -1040,11 +1040,18 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     # pinned path (EFB bit-identity, monotone refresh re-picks) at full
     # resolution; "auto" additionally requires big data so small-data
     # tests keep exact-255 semantics
-    from .pallas_hist import coarse_bins
+    from .pallas_hist import coarse_bins, fused_refine_fits
     tl = (p.refine_k > 0 and p.two_level != "off"
           and bundle_map is None and mono_c is None
           and B >= 128 and F > p.refine_k
-          and (p.two_level == "on" or N >= TWO_LEVEL_MIN_ROWS))
+          and (p.two_level == "on" or N >= TWO_LEVEL_MIN_ROWS)
+          # the fused pass carries the K refined features' full-res
+          # scratch/accumulator in VMEM — an uncapped refine_features
+          # falls back to full-resolution growth instead of failing at
+          # Mosaic compile time
+          and (not use_pallas
+               or fused_refine_fits(F, B, S, TWO_LEVEL_SHIFT,
+                                    p.refine_k)))
     SH = TWO_LEVEL_SHIFT
     Bc = coarse_bins(B, SH)
     Bh = Bc if tl else B                   # stored-histogram width
@@ -1125,14 +1132,15 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         topk = lax.top_k(fgain0[0], K)[1].astype(jnp.int32)
         # gather + layout the K refined feature rows ONCE per tree (a
         # contiguous feature-axis row copy, NOT the pathological per-row
-        # gather); the wave loop closes over the result
-        bins_kp = jnp.take(bins_t, topk, axis=0)
+        # gather); the wave loop closes over the result.  ``sel_k`` is
+        # the flat (K, N) form the fused kernel streams per chunk.
+        sel_k = jnp.take(bins_t, topk, axis=0)
         if use_pallas:
             from .pallas_hist import prepare_feature_tiles
-            bins_kp = prepare_feature_tiles(bins_kp, B, K)
+            bins_kp = prepare_feature_tiles(sel_k, B, K)
         else:
-            bins_kp = bins_kp + (jnp.arange(K, dtype=jnp.int32)
-                                 * B)[:, None]
+            bins_kp = sel_k + (jnp.arange(K, dtype=jnp.int32)
+                               * B)[:, None]
         rslot0 = jnp.where(row_valid > 0, 0, -1).astype(jnp.int32)
         root_fine = ar(build_fine_k(bins_kp, rslot0, 1))   # (1, K, B, 3)
         rbest = _tl_final_pick(cg0, ccum0, root_fine, topk,
@@ -1201,16 +1209,23 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         rt_col, rt_t1, rt_lo, rt_hi, rt_df = _slot_route_params(
             s["best_feat"][parents], s["best_bin"][parents], B, bundle_map)
         leaves_after = (s["num_nodes"] + 1) // 2 + n_valid
+        lf = None
         if use_pallas:
             from .pallas_hist import route_and_hist_pallas
 
             def fused_wave(_):
-                return route_and_hist_pallas(
+                out = route_and_hist_pallas(
                     bins_pl, s["node_id"], parents,
                     jnp.take(bins_t, rt_col, axis=0), rt_t1, rt_lo,
                     rt_hi, rt_df, l_ids, r_ids, vals8, scales, S, B,
                     hist_shift=(SH if tl else 0),
+                    sel_k=(sel_k if tl else None),
                     interpret=(use_pallas == "interpret"))
+                # under tl the SAME pass also emits the refined features'
+                # full-resolution left-child histograms (one bins read,
+                # one routing, one slot-masked value build for both
+                # levels — a separate refine pass cost ~2.8 ms/wave)
+                return out if tl else out + (jnp.zeros(0, jnp.float32),)
 
             def route_only(_):
                 # this wave fills the leaf budget: its child histograms can
@@ -1226,11 +1241,16 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                 new = (jnp.sum(jnp.where(inleaf & gl, l_ids[:, None], 0), 0)
                        + jnp.sum(jnp.where(inleaf & ~gl, r_ids[:, None], 0), 0)
                        + jnp.where(jnp.any(inleaf, 0), 0, s["node_id"]))
-                return new, jnp.zeros((S, F, Bh, 3), jnp.float32)
+                zf_ = (jnp.zeros((S, K, B, 3), jnp.float32) if tl
+                       else jnp.zeros(0, jnp.float32))
+                return new, jnp.zeros((S, F, Bh, 3), jnp.float32), zf_
 
-            new_node_id, l_hists = lax.cond(leaves_after >= L,
-                                            route_only, fused_wave, None)
+            new_node_id, l_hists, lf = lax.cond(leaves_after >= L,
+                                                route_only, fused_wave,
+                                                None)
             l_hists = ar(l_hists)
+            if tl:
+                lf = ar(lf)
         else:
             slot_of_leaf = jnp.full(M, -1, jnp.int32).at[parents].set(
                 jnp.where(valid, jidx, -1))
@@ -1276,19 +1296,23 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             cgm, ccum, _ = _tl_coarse_gains(
                 child_hists, cg, ch, cc, cd, c_lo, c_hi,
                 num_bins_c, feature_mask, p)
-            lslot = (jnp.full(M, -1, jnp.int32)
-                     .at[l_ids].set(jidx).at[JUNK].set(-1))
+            if lf is None:
+                # XLA fallback: the fused kernel isn't in play, so the
+                # refine histograms need their own (budget-gated) build
+                lslot = (jnp.full(M, -1, jnp.int32)
+                         .at[l_ids].set(jidx).at[JUNK].set(-1))
 
-            def fine(_):
-                return build_fine_k(bins_kp, lslot[new_node_id], S)
+                def fine(_):
+                    return build_fine_k(bins_kp, lslot[new_node_id], S)
 
-            def fine_zeros(_):
-                # budget-filling wave: the children never split again, so
-                # the refine pass is skipped like the coarse route_only
-                # shortcut (zero hists fail min_data and pick -inf)
-                return jnp.zeros((S, K, B, 3), jnp.float32)
+                def fine_zeros(_):
+                    # budget-filling wave: the children never split
+                    # again — skip like the coarse route_only shortcut
+                    # (zero hists fail min_data and pick -inf)
+                    return jnp.zeros((S, K, B, 3), jnp.float32)
 
-            lf = ar(lax.cond(leaves_after >= L, fine_zeros, fine, None))
+                lf = ar(lax.cond(leaves_after >= L, fine_zeros, fine,
+                                 None))
             lf_flat = lf.reshape(S, K * B, 3)
             rf_flat = s["hist_f"][pslot] - lf_flat
             f_hists = jnp.concatenate([lf_flat.reshape(S, K, B, 3),

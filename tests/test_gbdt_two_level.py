@@ -183,3 +183,12 @@ def test_two_level_odd_bin_count():
                                       two_level_hist="on"))
     Xh, yh = _data(n=20_000, seed=9)
     assert float(auc(yh, b.predict_margin(Xh))) > 0.75
+
+
+def test_fused_refine_vmem_gate():
+    """The fused coarse+refine pass models its OWN VMEM need: the bench
+    shape fits, an uncapped refine_features does not (and the grower
+    then falls back to full resolution instead of failing in Mosaic)."""
+    from synapseml_tpu.models.gbdt.pallas_hist import fused_refine_fits
+    assert fused_refine_fits(28, 256, 16, 3, 8)
+    assert not fused_refine_fits(100, 256, 16, 3, 32)
