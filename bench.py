@@ -264,18 +264,19 @@ def _gbdt_data():
     return X, _gbdt_labels(rng, X)
 
 
-def bench_gbdt(X, y, max_bin=GBDT_MAX_BIN):
+def bench_gbdt(X, y, max_bin=GBDT_MAX_BIN, two_level=None):
     from synapseml_tpu.models.gbdt import BoostingConfig, train
     from synapseml_tpu.models.gbdt.metrics import auc
 
+    tl_kw = {} if two_level is None else {"two_level_hist": two_level}
     cfg = BoostingConfig(objective="binary", num_iterations=2, num_leaves=31,
-                         max_bin=max_bin)
+                         max_bin=max_bin, **tl_kw)
     t0 = time.perf_counter()
     train(X, y, cfg)                                  # compile + 2 iters
     warm = time.perf_counter() - t0
 
     cfg = BoostingConfig(objective="binary", num_iterations=GBDT_ITERS,
-                         num_leaves=31, max_bin=max_bin)
+                         num_leaves=31, max_bin=max_bin, **tl_kw)
     train(X, y, cfg)     # compile the scanned whole-run program off-window
     # MEDIAN of five measured runs (same estimator as the BERT windows and
     # the CPU anchor): co-tenant windows on the shared chip swing up to
@@ -825,6 +826,21 @@ def main():
     except Exception as e:
         print(f"[secondary] GBDT max_bin=255 bench failed: {e}",
               file=sys.stderr)
+    gbdt_255_off = None
+    try:
+        if gbdt_ips255 is not None:
+            # the two-level on/off contrast ON the record: the OFF leg
+            # runs the IDENTICAL protocol (bench_gbdt: warm compile +
+            # median-of-5 at GBDT_ITERS) immediately after the ON leg —
+            # back-to-back windows, symmetric estimator
+            gbdt_255_off = bench_gbdt(X, y, max_bin=255, two_level="off")
+            print(f"[secondary] GBDT @1Mx{GBDT_FEATURES} max_bin=255 "
+                  f"two_level=OFF (contrast): {gbdt_255_off[0]:.2f} "
+                  f"full-wall, {gbdt_255_off[1]:.2f} steady it/s",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] two-level-off contrast failed: {e}",
+              file=sys.stderr)
     try:
         if gbdt_ips is not None:
             anchors, anchor_cores = bench_gbdt_anchor(X, y)
@@ -924,6 +940,8 @@ def main():
             round(spec_target["pipelined_tokens_per_sec"]
                   / spec_target["plain_pipelined_tokens_per_sec"], 3)
             if spec_target else None),
+        "gbdt_steady_iters_per_sec_255_two_level_off": (
+            round(gbdt_255_off[1], 3) if gbdt_255_off else None),
         "gbdt_streamed_ingest_rows_per_sec": (
             round(gbdt_streamed["ingest_rows_per_sec"], 0)
             if gbdt_streamed else None),
