@@ -1090,17 +1090,25 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         # threshold with device count) and the pallas decision are known:
         # on the XLA scatter fallback two-level only ADDS work (fine
         # hists get built then pooled) while coarsening non-top-K splits,
-        # so auto requires the fused pallas path.  Must resolve BEFORE
-        # the warm-compile thread below — GrowthParams is the jit/lru
-        # cache key, so a thread warming the 'auto' config would compile
-        # a program the run never uses.  (The EFB re-gate further down
-        # can only flip use_pallas when enable_bundle is set, and EFB
+        # so auto requires a pallas grower that implements it — the
+        # fused depthwise path, or the single-device/data-parallel
+        # lossguide path (per-tile nodes kernel).  feature/voting
+        # parallel growers ignore two_level, so auto must stay "off"
+        # there (a stale "on" would also fork the GrowthParams jit key
+        # for an identical program).  Must resolve BEFORE the
+        # warm-compile thread below — GrowthParams is the jit/lru cache
+        # key, so a thread warming the 'auto' config would compile a
+        # program the run never uses.  (The EFB re-gate further down can
+        # only flip use_pallas when enable_bundle is set, and EFB
         # structurally disables two-level in the grower anyway.)
         from .trainer import TWO_LEVEL_MIN_ROWS
+        _tl_lossguide = (config.growth_policy == "lossguide"
+                         and not featpar
+                         and config.parallelism != "voting_parallel")
         config = dataclasses.replace(
             config,
             two_level_hist=("on" if (n >= TWO_LEVEL_MIN_ROWS and use_pallas
-                                     and uses_fused)
+                                     and (uses_fused or _tl_lossguide))
                             else "off"))
 
     # -- compile/transfer overlap ------------------------------------------

@@ -198,7 +198,7 @@ def hist_pad_multiple() -> int:
 SLOT_LANES = 8
 
 
-def _make_hist_nodes_kernel(ft: int):
+def _make_hist_nodes_kernel(ft: int, shift: int = 0):
     def kernel(bins_ref, slot_ref, vals_ref, out_ref, oh_ref):
         """Grid (G, N//chunk) — c fastest.  bins block (1, ft, C) int32;
         slot block (1, C) int32 (row's node slot, -1 = no slot); vals block
@@ -219,6 +219,9 @@ def _make_hist_nodes_kernel(ft: int):
         iota_b = lax.broadcasted_iota(jnp.int32, (B, C), 0)
         for k in range(ft):
             b = bins_ref[0, k, :]
+            if shift:
+                # two-level mode: coarse (bin >> shift) histograms
+                b = b >> shift
             oh_ref[k * B:(k + 1) * B, :] = (iota_b == b[None, :]).astype(
                 jnp.int8)
         # slot-masked value matrix in ONE wide compare against the lane's
@@ -280,38 +283,43 @@ def _bins_tiles(bins_t: jnp.ndarray, total_bins: int) -> tuple:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_slots", "total_bins", "interpret"))
+                   static_argnames=("n_slots", "total_bins", "hist_shift",
+                                    "interpret"))
 def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                             slot: jnp.ndarray,     # (N,) int32 in [-1, n_slots)
                             vals: jnp.ndarray,     # (N, 8) int8 limbs
                             scales: jnp.ndarray,   # (2,) f32 from prep_hist_vals
                             n_slots: int,
                             total_bins: int,
+                            hist_shift: int = 0,
                             interpret: bool = False) -> jnp.ndarray:
-    """→ (n_slots, F, B, 3) float32 [grad, hess, count] histograms."""
+    """→ (n_slots, F, Bh, 3) float32 [grad, hess, count] histograms
+    (Bh = :func:`coarse_bins` when ``hist_shift`` > 0 — the leaf-wise
+    grower's two-level coarse build)."""
     B = total_bins
+    Bh = coarse_bins(B, hist_shift) if hist_shift else B
     bins_r, F, G, ft, N = _bins_tiles(bins_t, B)
     _, chunk = _tile_for(B)
     assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
     VN = n_slots * SLOT_LANES
 
     out = pl.pallas_call(
-        _make_hist_nodes_kernel(ft),
+        _make_hist_nodes_kernel(ft, hist_shift),
         grid=(G, N // chunk),
         in_specs=[
             pl.BlockSpec((1, ft, chunk), lambda f, c: (f, 0, c)),
             pl.BlockSpec((1, chunk), lambda f, c: (0, c)),
             pl.BlockSpec((chunk, VALS), lambda f, c: (c, 0)),
         ],
-        out_specs=pl.BlockSpec((1, ft * B, VN), lambda f, c: (f, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((G, ft * B, VN), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((ft * B, chunk), jnp.int8)],
+        out_specs=pl.BlockSpec((1, ft * Bh, VN), lambda f, c: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((G, ft * Bh, VN), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((ft * Bh, chunk), jnp.int8)],
         interpret=interpret,
     )(bins_r, slot[None, :], vals)
 
-    # (G, ft·B, S·8) → (F, B, S, 8) → (S, F, B, 3)
-    out = out.reshape(G * ft, B, n_slots, SLOT_LANES)[:F]
-    out = jnp.moveaxis(out, 2, 0)                      # (S, F, B, 8)
+    # (G, ft·Bh, S·8) → (F, Bh, S, 8) → (S, F, Bh, 3)
+    out = out.reshape(G * ft, Bh, n_slots, SLOT_LANES)[:F]
+    out = jnp.moveaxis(out, 2, 0)                      # (S, F, Bh, 8)
     return _reconstruct(out, scales)
 
 

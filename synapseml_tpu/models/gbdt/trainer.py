@@ -124,8 +124,10 @@ def _leaf_output(g, h, l1, l2):
 
 
 def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas,
-                vals8=None, scales=None):
-    """Histogram for masked rows → (F*B, 3) f32 [grad, hess, count].
+                vals8=None, scales=None, hist_shift=0):
+    """Histogram for masked rows → (F*Bh, 3) f32 [grad, hess, count]
+    (Bh = coarse width when ``hist_shift`` > 0 — the leaf-wise grower's
+    two-level coarse build).
 
     ``mask`` is the row weight (bag/GOSS amplification); the count channel
     counts rows with mask>0 exactly once so GOSS amplification never
@@ -140,17 +142,24 @@ def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas,
     from the depthwise grower's global scale and flip near-tie splits;
     ``mask`` then only selects node membership."""
     if use_pallas:
-        from .pallas_hist import build_hist_nodes_pallas
+        from .pallas_hist import build_hist_nodes_pallas, coarse_bins
         assert vals8 is not None, "pallas path requires per-tree vals8/scales"
         slot = jnp.where(mask > 0, 0, -1).astype(jnp.int32)
+        Bh = coarse_bins(B, hist_shift) if hist_shift else B
         return build_hist_nodes_pallas(
-            bins_t, slot, vals8, scales, 1, B,
-            interpret=(use_pallas == "interpret"))[0].reshape(F * B, 3)
+            bins_t, slot, vals8, scales, 1, B, hist_shift=hist_shift,
+            interpret=(use_pallas == "interpret"))[0].reshape(F * Bh, 3)
     count = (mask > 0).astype(jnp.float32)
     upd = jnp.stack([grad * mask, hess * mask, count], axis=-1)           # (N,3)
     upd = jnp.broadcast_to(upd[None, :, :], (F,) + upd.shape)             # (F,N,3)
     hist = jnp.zeros((F * B, 3), jnp.float32)
-    return hist.at[flat_bins].add(upd)
+    hist = hist.at[flat_bins].add(upd)
+    if hist_shift:
+        from .pallas_hist import coarse_bins
+        Bh = coarse_bins(B, hist_shift)
+        hist = _pool_coarse(hist.reshape(F, B, 3), Bh,
+                            hist_shift).reshape(F * Bh, 3)
+    return hist
 
 
 def _mono_penalty_factor(node_depth, penalty: float):
@@ -353,6 +362,40 @@ def _tl_final_pick(cg, ccum, f_hists, topk, sum_g, sum_h, sum_c, depth,
             jnp.where(use_f, fgl, cgl),
             jnp.where(use_f, fhl, chl),
             jnp.where(use_f, fcl, ccl))
+
+
+def _tl_root_pick(root_hist, root_g, root_h, root_c, num_bins, num_bins_c,
+                  feature_mask, p: GrowthParams, shift: int, K: int,
+                  bins_t, B: int, use_pallas, build_fine_root, ar):
+    """Shared two-level ROOT setup for both growers: coarse gains → the
+    per-tree top-K feature set → gathered/prepared refined-feature
+    layouts → root fine histograms → merged root pick.
+
+    ``build_fine_root(bins_kp) -> (1, K, B, 3)`` is the grower-specific
+    fine build (fused-path tiles vs flat XLA ids both prepared here).
+    → (topk, sel_k, bins_kp, root_fine, (bg, bf, bb, bgl, bhl, bcl))."""
+    z1 = jnp.zeros((1,), jnp.int32)
+    ninf1 = jnp.full((1,), -jnp.inf)
+    inf1 = jnp.full((1,), jnp.inf)
+    cg0, ccum0, fgain0 = _tl_coarse_gains(
+        root_hist[None], root_g[None], root_h[None], root_c[None],
+        z1, ninf1, inf1, num_bins_c, feature_mask, p)
+    topk = lax.top_k(fgain0[0], K)[1].astype(jnp.int32)
+    # gather + layout the K refined feature rows ONCE per tree (a
+    # contiguous feature-axis row copy, NOT the pathological per-row
+    # gather); the split loops close over the result
+    sel_k = jnp.take(bins_t, topk, axis=0)
+    if use_pallas:
+        from .pallas_hist import prepare_feature_tiles
+        bins_kp = prepare_feature_tiles(sel_k, B, K)
+    else:
+        bins_kp = sel_k + (jnp.arange(K, dtype=jnp.int32) * B)[:, None]
+    root_fine = ar(build_fine_root(bins_kp))               # (1, K, B, 3)
+    rbest = _tl_final_pick(cg0, ccum0, root_fine, topk,
+                           root_g[None], root_h[None], root_c[None],
+                           z1, ninf1, inf1, num_bins, feature_mask,
+                           p, shift)
+    return topk, sel_k, bins_kp, root_fine, tuple(x[0] for x in rbest)
 
 
 def _mono_vec(p: GrowthParams, F: int):
@@ -666,6 +709,22 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     F_search = num_bins.shape[0]           # ORIGINAL feature count
     mono_c = _mono_vec(p, F_search)
 
+    # two-level (coarse-then-refine) histograms for strict leaf-wise
+    # growth: same scheme as the depthwise grower (module comment above
+    # _pool_coarse) — per-split coarse build + root-chosen fine-K refine;
+    # the per-tile nodes kernel needs no extra VMEM gate (its scratch is
+    # bounded by the ft cap regardless of K)
+    from .pallas_hist import coarse_bins
+    tl = (p.refine_k > 0 and p.two_level != "off"
+          and bundle_map is None and mono_c is None and not voting
+          and B >= 128 and F > p.refine_k
+          and (p.two_level == "on" or N >= TWO_LEVEL_MIN_ROWS))
+    SH = TWO_LEVEL_SHIFT
+    Bc = coarse_bins(B, SH)
+    Bh = Bc if tl else B                   # stored-histogram width
+    K = p.refine_k
+    num_bins_c = -(-num_bins // (1 << SH))
+
     def ar(x):
         return lax.psum(x, axis_name) if (axis_name and not voting) else x
 
@@ -709,19 +768,42 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     # root
     root_hist = ar(_build_hist(bins_pl, flat_bins, grad, hess,
                                row_valid, F, B, use_pallas,
-                               vals8, scales)).reshape(F, B, 3)
+                               vals8, scales,
+                               hist_shift=(SH if tl else 0))
+                   ).reshape(F, Bh, 3)
     root_stats = jnp.sum(root_hist[0], axis=0)
     if voting:
         root_stats = lax.psum(root_stats, axis_name)
     root_g, root_h, root_c = root_stats[0], root_stats[1], root_stats[2]
+
+    topk = None
+    root_fine = None
+    if tl:
+        def build_fine_k(bkp, mask):
+            """(1, K, B, 3) fine histograms of the refined features for
+            the masked rows."""
+            if use_pallas:
+                from .pallas_hist import build_hist_nodes_pallas
+                slot = jnp.where(mask > 0, 0, -1).astype(jnp.int32)
+                return build_hist_nodes_pallas(
+                    bkp, slot, vals8, scales, 1, B,
+                    interpret=(use_pallas == "interpret"))
+            return _build_hist_nodes_xla(
+                bkp, grad, hess, mask,
+                jnp.where(mask > 0, 0, -1).astype(jnp.int32), 1, K, B)
+
+        topk, sel_k, bins_kp, root_fine, rbest0 = _tl_root_pick(
+            root_hist, root_g, root_h, root_c, num_bins, num_bins_c,
+            feature_mask, p, SH, K, bins_t, B, use_pallas,
+            lambda bkp: build_fine_k(bkp, row_valid), ar)
 
     # per-node state
     zi = jnp.zeros(M, jnp.int32)
     zf = jnp.zeros(M, jnp.float32)
     state = dict(
         node_id=jnp.zeros(N, jnp.int32),
-        hist=jnp.zeros((L + 1, F * B, 3), jnp.float32).at[0].set(
-            root_hist.reshape(F * B, 3)),
+        hist=jnp.zeros((L + 1, F * Bh, 3), jnp.float32).at[0].set(
+            root_hist.reshape(F * Bh, 3)),
         slot=zi,                                   # node -> hist slot
         sum_g=zf.at[0].set(root_g),
         sum_h=zf.at[0].set(root_h),
@@ -742,10 +824,15 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         node_lo=jnp.full(M, -jnp.inf, jnp.float32),
         node_hi=jnp.full(M, jnp.inf, jnp.float32),
     )
-
-    bg, bf_, bb, bgl, bhl, bcl = pick(root_hist, root_g, root_h, root_c,
-                                      jnp.zeros((), jnp.int32),
-                                      -jnp.inf, jnp.inf)
+    if tl:
+        state["hist_f"] = jnp.zeros((L + 1, K * B, 3), jnp.float32).at[
+            0].set(root_fine[0].reshape(K * B, 3))
+        bg, bf_, bb, bgl, bhl, bcl = rbest0
+    else:
+        bg, bf_, bb, bgl, bhl, bcl = pick(root_hist, root_g, root_h,
+                                          root_c,
+                                          jnp.zeros((), jnp.int32),
+                                          -jnp.inf, jnp.inf)
     state["best_gain"] = state["best_gain"].at[0].set(bg)
     state["best_feat"] = state["best_feat"].at[0].set(bf_)
     state["best_bin"] = state["best_bin"].at[0].set(bb)
@@ -770,7 +857,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         # left child hist by one device pass, right by subtraction
         lmask = (new_node_id == l_id).astype(jnp.float32) * row_valid
         l_hist = ar(_build_hist(bins_pl, flat_bins, grad, hess, lmask, F, B,
-                                use_pallas, vals8, scales))
+                                use_pallas, vals8, scales,
+                                hist_shift=(SH if tl else 0)))
         parent_slot = s["slot"][leaf]
         r_hist = s["hist"][parent_slot] - l_hist
         r_slot = s["next_slot"]
@@ -785,10 +873,31 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
             None if mono_c is None else mono_c[feat],
             p_lo, p_hi, lg, lh, rg, rh, p)
 
-        lbg, lbf, lbb, lbgl, lbhl, lbcl = pick(
-            l_hist.reshape(F, B, 3), lg, lh, lc, cdepth, l_lo, l_hi)
-        rbg, rbf, rbb, rbgl, rbhl, rbcl = pick(
-            r_hist.reshape(F, B, 3), rg, rh, rc, cdepth, r_lo, r_hi)
+        hist_f = None
+        if tl:
+            lf = ar(build_fine_k(bins_kp, lmask))[0].reshape(K * B, 3)
+            rf = s["hist_f"][parent_slot] - lf
+            hist_f = (s["hist_f"].at[parent_slot].set(lf)
+                      .at[r_slot].set(rf))
+            c_hists = jnp.stack([l_hist, r_hist]).reshape(2, F, Bh, 3)
+            f_hists = jnp.stack([lf, rf]).reshape(2, K, B, 3)
+            cgm, ccum, _ = _tl_coarse_gains(
+                c_hists, jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                jnp.stack([lc, rc]), jnp.stack([cdepth, cdepth]),
+                jnp.stack([l_lo, r_lo]), jnp.stack([l_hi, r_hi]),
+                num_bins_c, feature_mask, p)
+            cb = _tl_final_pick(
+                cgm, ccum, f_hists, topk, jnp.stack([lg, rg]),
+                jnp.stack([lh, rh]), jnp.stack([lc, rc]),
+                jnp.stack([cdepth, cdepth]), jnp.stack([l_lo, r_lo]),
+                jnp.stack([l_hi, r_hi]), num_bins, feature_mask, p, SH)
+            (lbg, rbg), (lbf, rbf), (lbb, rbb) = cb[0], cb[1], cb[2]
+            (lbgl, rbgl), (lbhl, rbhl), (lbcl, rbcl) = cb[3], cb[4], cb[5]
+        else:
+            lbg, lbf, lbb, lbgl, lbhl, lbcl = pick(
+                l_hist.reshape(F, B, 3), lg, lh, lc, cdepth, l_lo, l_hi)
+            rbg, rbf, rbb, rbgl, rbhl, rbcl = pick(
+                r_hist.reshape(F, B, 3), rg, rh, rc, cdepth, r_lo, r_hi)
 
         thr = jnp.where(sbin >= 1, upper_bounds[feat, jnp.maximum(sbin - 1, 0)],
                         -jnp.inf)
@@ -819,6 +928,7 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
             next_slot=s["next_slot"] + 1,
             node_lo=s["node_lo"].at[l_id].set(l_lo).at[r_id].set(r_lo),
             node_hi=s["node_hi"].at[l_id].set(l_hi).at[r_id].set(r_hi),
+            **({"hist_f": hist_f} if tl else {}),
         )
 
     def maybe_intermediate_split(s):
@@ -1123,31 +1233,12 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
         # parent's stored fine-K histograms — a per-wave adaptive set
         # needs both children built fresh (2S lanes), which doubles the
         # refine matmul and was measured to eat the coarse pass's win
-        z1 = jnp.zeros((1,), jnp.int32)
-        ninf1 = jnp.full((1,), -jnp.inf)
-        inf1 = jnp.full((1,), jnp.inf)
-        cg0, ccum0, fgain0 = _tl_coarse_gains(
-            root_hist[None], root_g[None], root_h[None], root_c[None],
-            z1, ninf1, inf1, num_bins_c, feature_mask, p)
-        topk = lax.top_k(fgain0[0], K)[1].astype(jnp.int32)
-        # gather + layout the K refined feature rows ONCE per tree (a
-        # contiguous feature-axis row copy, NOT the pathological per-row
-        # gather); the wave loop closes over the result.  ``sel_k`` is
-        # the flat (K, N) form the fused kernel streams per chunk.
-        sel_k = jnp.take(bins_t, topk, axis=0)
-        if use_pallas:
-            from .pallas_hist import prepare_feature_tiles
-            bins_kp = prepare_feature_tiles(sel_k, B, K)
-        else:
-            bins_kp = sel_k + (jnp.arange(K, dtype=jnp.int32)
-                               * B)[:, None]
         rslot0 = jnp.where(row_valid > 0, 0, -1).astype(jnp.int32)
-        root_fine = ar(build_fine_k(bins_kp, rslot0, 1))   # (1, K, B, 3)
-        rbest = _tl_final_pick(cg0, ccum0, root_fine, topk,
-                               root_g[None], root_h[None], root_c[None],
-                               z1, ninf1, inf1, num_bins, feature_mask,
-                               p, SH)
-        bg, bf_, bb, bgl, bhl, bcl = (x[0] for x in rbest)
+        topk, sel_k, bins_kp, root_fine, rbest0 = _tl_root_pick(
+            root_hist, root_g, root_h, root_c, num_bins, num_bins_c,
+            feature_mask, p, SH, K, bins_t, B, use_pallas,
+            lambda bkp: build_fine_k(bkp, rslot0, 1), ar)
+        bg, bf_, bb, bgl, bhl, bcl = rbest0
     else:
         bg, bf_, bb, bgl, bhl, bcl = pick(
             unb(root_hist, root_g, root_h, root_c),

@@ -135,15 +135,14 @@ def test_two_level_quality_parity():
 
 def test_two_level_structural_gates():
     """Structurally excluded configurations (EFB, monotone constraints,
-    low max_bin, lossguide) silently train at full resolution — same
-    margins as an explicit 'off' run even when forced 'on'."""
+    low max_bin) silently train at full resolution — same margins as an
+    explicit 'off' run even when forced 'on'."""
     X, y = _data(n=20_000, F=8)
     base = dict(objective="binary", num_iterations=6, num_leaves=15)
     cases = [
         dict(max_bin=63),                                   # B < 128
         dict(max_bin=255, enable_bundle=True),              # EFB
         dict(max_bin=255, monotone_constraints=[1] + [0] * 7),
-        dict(max_bin=255, growth_policy="lossguide"),
     ]
     for extra in cases:
         b_on, _ = train(X, y, BoostingConfig(two_level_hist="on",
@@ -192,3 +191,45 @@ def test_fused_refine_vmem_gate():
     from synapseml_tpu.models.gbdt.pallas_hist import fused_refine_fits
     assert fused_refine_fits(28, 256, 16, 3, 8)
     assert not fused_refine_fits(100, 256, 16, 3, 32)
+
+
+def test_two_level_lossguide_interpret_matches_xla():
+    """Two-level in the strict leaf-wise grower: pallas kernels
+    (interpret — coarse nodes build + fine-K refine) grow the identical
+    tree to the XLA fallback."""
+    import jax.numpy as jnp
+    from synapseml_tpu.models.gbdt.trainer import GrowthParams, grow_tree
+
+    rng = np.random.default_rng(6)
+    N, F, B = 8192, 9, 256
+    bins_t = rng.integers(0, B, (F, N)).astype(np.int32)
+    grad = rng.normal(size=N).astype(np.float32)
+    hess = (np.abs(grad) * 0.5 + 0.2).astype(np.float32)
+    p = GrowthParams(num_leaves=15, min_data_in_leaf=5.0, total_bins=B,
+                     two_level="on", refine_k=4)
+    ub = np.sort(rng.normal(size=(F, B - 1)).astype(np.float32), axis=1)
+    args = (jnp.asarray(bins_t), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones(N, jnp.float32), jnp.ones(F, bool), jnp.asarray(ub),
+            jnp.full(F, B, jnp.int32), 0.1)
+    t_x, nid_x = grow_tree(*args, p=p, use_pallas=False)
+    t_p, nid_p = grow_tree(*args, p=p, use_pallas="interpret")
+    np.testing.assert_array_equal(np.asarray(nid_x), np.asarray(nid_p))
+    for f in ("split_feature", "left_child", "right_child", "num_nodes"):
+        np.testing.assert_array_equal(np.asarray(getattr(t_x, f)),
+                                      np.asarray(getattr(t_p, f)),
+                                      err_msg=f)
+
+
+def test_two_level_lossguide_quality_parity():
+    """Forced two-level lossguide training matches full-resolution AUC,
+    like the depthwise case."""
+    from synapseml_tpu.models.gbdt.metrics import auc
+    X, y = _data(n=60_000)
+    kw = dict(objective="binary", num_iterations=15, num_leaves=31,
+              max_bin=255, growth_policy="lossguide")
+    b_on, _ = train(X, y, BoostingConfig(two_level_hist="on", **kw))
+    b_off, _ = train(X, y, BoostingConfig(two_level_hist="off", **kw))
+    Xh, yh = _data(n=30_000, seed=9)
+    a_on = float(auc(yh, b_on.predict_margin(Xh)))
+    a_off = float(auc(yh, b_off.predict_margin(Xh)))
+    assert abs(a_on - a_off) < 0.005, (a_on, a_off)
