@@ -97,8 +97,10 @@ class BoostingConfig:
     #: root's coarse gains — are refined at full resolution every wave.
     #: Faster wide-bin training; split quality is preserved unless a
     #: feature outside the root-chosen top-K wins only on a
-    #: sub-coarse-boundary cut.  Structurally off for EFB, monotone
-    #: constraints, lossguide, voting/feature parallelism, max_bin < 127
+    #: sub-coarse-boundary cut.  Implemented for depthwise (fused wave
+    #: kernel) AND strict leaf-wise growth (per-split nodes-kernel
+    #: builds); structurally off for EFB, monotone constraints,
+    #: voting/feature parallelism, max_bin < 127
     two_level_hist: str = "auto"
     #: features refined at full resolution under two_level_hist
     refine_features: int = 8
