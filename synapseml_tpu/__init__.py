@@ -33,12 +33,49 @@ if not _os.environ.get("SYNAPSEML_TPU_NO_COMPILE_CACHE"):
     except Exception:  # never let cache setup break import
         pass
 
+# jax version compat: the codebase targets the modern top-level
+# ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)``;
+# on older jax that API lives at jax.experimental.shard_map with the
+# ``check_rep`` spelling — install an adapter so both environments work.
+# Deliberately a patch on the jax module (not an internal wrapper): the
+# package's call sites AND its test suite spell ``jax.shard_map``, and the
+# patch only installs where the modern name does not exist at all, so
+# modern environments are untouched.  Known tradeoff: on old jax, other
+# code in the process feature-detecting ``jax.shard_map`` will find this
+# adapter, which disables the (false-positive-prone) check_rep pass.
+try:
+    import jax as _jax
+    if not hasattr(_jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+        def _shard_map_compat(f, *, mesh, in_specs, out_specs,
+                              check_vma=True, **kw):
+            # old jax's check_rep has known false positives (e.g. scan
+            # carries under psum; its own error message suggests
+            # check_rep=False) — the modern check_vma flag has no faithful
+            # equivalent, so the compat path always disables the check
+            del check_vma
+            return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_rep=False,
+                                   **kw)
+
+        _jax.shard_map = _shard_map_compat
+    if not hasattr(_jax.lax, "axis_size"):
+        # lax.psum of a Python-int literal constant-folds to the concrete
+        # axis size — the documented pre-axis_size idiom
+        _jax.lax.axis_size = lambda axis_name: _jax.lax.psum(1, axis_name)
+except Exception:  # pragma: no cover - jax absent/newer layout
+    pass
+
+from . import telemetry
 from .core.dataset import Dataset
 from .core.params import Params
 from .core.pipeline import (Estimator, Evaluator, Model, Pipeline,
                             PipelineModel, PipelineStage, Transformer)
+from .telemetry import get_registry, span
 
 __all__ = [
     "Dataset", "Params", "Estimator", "Evaluator", "Model", "Pipeline",
     "PipelineModel", "PipelineStage", "Transformer", "__version__",
+    "telemetry", "get_registry", "span",
 ]

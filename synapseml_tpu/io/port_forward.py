@@ -98,11 +98,13 @@ def forward_port_to_remote(username: str, ssh_host: str,
             time.sleep(min(0.1, max(settle_s, 0.01)))
         if proc.poll() is None:
             # long-lived ssh with an undrained stderr PIPE blocks once
-            # the OS buffer fills — drain it forever on a daemon thread
-            threading.Thread(
-                target=lambda s=proc.stderr: [None for _ in iter(
-                    lambda: s.read(65536), b"")],
-                daemon=True).start()
+            # the OS buffer fills — drain it forever on a daemon thread,
+            # discarding each chunk (no list that grows an element per
+            # 64 KB for the tunnel's lifetime)
+            def _drain(s=proc.stderr):
+                for _ in iter(lambda: s.read(65536), b""):
+                    pass
+            threading.Thread(target=_drain, daemon=True).start()
             return ForwardSession(proc, port)
         last_err = (proc.stderr.read() or b"").decode(errors="replace")
     raise RuntimeError(

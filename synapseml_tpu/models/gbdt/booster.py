@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import logging as _logging
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -31,6 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ... import telemetry as _telemetry
 from ...parallel.mesh import DATA_AXIS, batch_sharding, replicated
 from . import metrics as metrics_mod
 from .binning import BinMapper, FeatureBundler, fit_bin_mapper
@@ -803,6 +805,41 @@ def _write_checkpoint(directory: str, booster: Booster,
             pass
 
 
+def _available_host_bytes() -> int:
+    """Best-effort available host memory (MemAvailable, then sysconf),
+    0 when neither source exists."""
+    import os
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        return os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (AttributeError, OSError, ValueError):
+        return 0
+
+
+def _advanced_mask_budget_bytes(config: "BoostingConfig") -> int:
+    """Byte budget for the advanced-monotone (M, M, F) overlap masks.
+
+    Priority: ``pass_through={"advanced_mask_bytes": ...}`` kwarg, then
+    the ``SYNAPSEML_TPU_ADV_MONO_MASK_BYTES`` env var (both taken
+    verbatim), then a quarter of the host's available memory clamped to
+    [1 GiB (the historical fixed guard), 8 GiB] — the mask estimate
+    excludes XLA's compile/temp headroom, so the auto budget stays well
+    inside even a big host and anything larger must be opted into."""
+    import os
+    override = config.pass_through.get(
+        "advanced_mask_bytes",
+        os.environ.get("SYNAPSEML_TPU_ADV_MONO_MASK_BYTES"))
+    if override is not None:
+        return int(float(override))
+    return min(max(1 << 30, _available_host_bytes() // 4), 8 << 30)
+
+
 def _placeholder_mapper(m: BinMapper) -> bool:
     return bool(np.all(m.num_bins <= 1)) and bool(np.all(np.isinf(m.upper_bounds)))
 
@@ -909,21 +946,29 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         if config.monotone_constraints_method == "advanced":
             # the advanced refresh materializes (M, M, F) overlap masks
             # (bool + int32 reductions, ~5 bytes/entry) inside the jitted
-            # per-wave refresh — guard the O(M^2 F) memory here so a big
-            # num_leaves × wide-F config fails fast instead of OOMing or
-            # stalling compilation mid-train
+            # per-wave refresh — guard the O(M^2 F) memory here so a
+            # config that cannot fit fails fast instead of OOMing or
+            # stalling compilation mid-train.  The budget scales with the
+            # host's available memory (not a fixed 1 GiB), so big hosts
+            # degrade to slow instead of refusing (ADVICE r5 item 2);
+            # SYNAPSEML_TPU_ADV_MONO_MASK_BYTES or
+            # pass_through={"advanced_mask_bytes": ...} overrides it
             from .trainer import max_nodes
             m_nodes = max_nodes(config.num_leaves)
             adv_bytes = 5 * m_nodes * m_nodes * F
-            if adv_bytes > 1 << 30:
+            budget = _advanced_mask_budget_bytes(config)
+            if adv_bytes > budget:
                 raise ValueError(
                     f"monotone_constraints_method='advanced' with "
                     f"num_leaves={config.num_leaves} and {F} features "
                     f"needs ~{adv_bytes / 2**30:.1f} GiB of (M, M, F) "
-                    f"constraint masks per refresh (M={m_nodes} nodes); "
+                    f"constraint masks per refresh (M={m_nodes} nodes), "
+                    f"over this host's {budget / 2**30:.1f} GiB budget; "
                     "use monotone_constraints_method='intermediate' "
                     "(a provable superset of the advanced constraint "
-                    "set) for models this size")
+                    "set) for models this size, or raise the budget via "
+                    "SYNAPSEML_TPU_ADV_MONO_MASK_BYTES / "
+                    "pass_through={'advanced_mask_bytes': ...}")
 
     # distributed lambdarank: pack WHOLE groups onto shards up front (the
     # reference's query-rows-share-a-partition rule); rows permute into
@@ -1107,11 +1152,34 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         _tl_lossguide = (config.growth_policy == "lossguide"
                          and not featpar
                          and config.parallelism != "voting_parallel")
-        config = dataclasses.replace(
-            config,
-            two_level_hist=("on" if (n >= TWO_LEVEL_MIN_ROWS and use_pallas
-                                     and (uses_fused or _tl_lossguide))
-                            else "off"))
+        _tl_resolved = ("on" if (n >= TWO_LEVEL_MIN_ROWS and use_pallas
+                                 and (uses_fused or _tl_lossguide))
+                        else "off")
+        if _tl_resolved == "on":
+            # 'auto' flipping to coarse-then-refine CHANGES split-search
+            # semantics (non-top-K features split only on coarse-bin
+            # boundaries) — say so once, visibly, so a user can tell
+            # which semantics produced a model (ADVICE r5 item 1)
+            _logging.getLogger("synapseml_tpu.gbdt").info(
+                "two_level_hist='auto' resolved to 'on' (%d rows >= %d, "
+                "pallas grower): histograms build coarse and only the top "
+                "%d features refine at full resolution; set "
+                "two_level_hist='off' for exact full-resolution splits",
+                n, TWO_LEVEL_MIN_ROWS, config.refine_features)
+        config = dataclasses.replace(config, two_level_hist=_tl_resolved)
+    # set on EVERY fit (not just the 'auto' branch), else an explicit
+    # 'on'/'off' fit would leave the previous fit's resolution standing;
+    # unlabeled on purpose — a per-policy label would leave the OTHER
+    # policy's series stale across fits.  Guarded: telemetry must never
+    # break training (same contract as _publish_measures/_tl_gauge).
+    try:
+        _telemetry.get_registry().gauge(
+            "gbdt_two_level_resolved",
+            "1 when the current fit's two_level_hist (after 'auto' "
+            "resolution) requests coarse-then-refine histograms").set(
+                1.0 if config.two_level_hist in ("on", True) else 0.0)
+    except Exception:
+        pass
 
     # -- compile/transfer overlap ------------------------------------------
     # the jitted step's first compile (cold: tens of seconds, warm cache:
@@ -1779,11 +1847,53 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         tree_class = init_model.tree_class + tree_class
         tree_weights = init_model.tree_weights + tree_weights
     measures.total_s = _time.perf_counter() - _t0
+    _publish_measures(measures, config, n_rows=n, n_features=F)
     booster = Booster(trees, tree_class, tree_weights, K, config.objective,
                       init_sc, mapper, feature_names, config,
                       best_iteration=best_iter, bundler=bundler)
     booster.measures = measures
     return booster, eval_history
+
+
+#: per-phase wall-clock buckets: sub-second phases through multi-minute fits
+_PHASE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0,
+                  120.0, 300.0, 600.0)
+
+
+def _publish_measures(measures: "InstrumentationMeasures",
+                      config: "BoostingConfig", n_rows: int,
+                      n_features: int) -> None:
+    """Mirror one fit's InstrumentationMeasures into the process
+    telemetry: a per-phase histogram (the round-over-round "which boost
+    phase regressed" answer), an iteration counter, the resolved
+    two-level-mode gauge, and one retrospective ``gbdt.train`` span."""
+    try:
+        reg = _telemetry.get_registry()
+        hist = reg.histogram(
+            "gbdt_phase_seconds", "per-phase wall clock of gbdt fits",
+            ("phase",), buckets=_PHASE_BUCKETS)
+        for phase, secs in (("binning", measures.binning_s),
+                            ("data_prep", measures.data_prep_s),
+                            ("compile", measures.compile_s),
+                            ("training", measures.training_s),
+                            ("eval", measures.eval_s),
+                            ("total", measures.total_s)):
+            hist.observe(secs, phase=phase)
+        reg.counter("gbdt_iterations_total",
+                    "boosting iterations trained").inc(
+                        max(measures.iterations, 0))
+        reg.gauge("gbdt_two_level_active",
+                  "1 when the finished fit trained with coarse-then-"
+                  "refine histograms", ()).set(
+                      1.0 if config.two_level_hist in ("on", True) else 0.0)
+        _telemetry.get_tracer().record(
+            "gbdt.train", measures.total_s, rows=n_rows,
+            features=n_features, objective=config.objective,
+            two_level=str(config.two_level_hist),
+            **{k: round(v, 4) for k, v in measures.as_dict().items()
+               if isinstance(v, float)})
+    except Exception:    # telemetry must never break training
+        pass
 
 
 def _to_device_tree(t: Tree) -> Tree:

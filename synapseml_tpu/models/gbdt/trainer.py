@@ -37,6 +37,24 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ... import telemetry as _telemetry
+from ...parallel.collectives import psum as _c_psum
+
+
+def _tl_gauge(grower: str, active: bool) -> None:
+    """Record the FINAL per-program two-level decision (the growers apply
+    structural exclusions train() cannot see — EFB, monotone, voting,
+    VMEM fit), so the gauge answers "which split-search semantics is this
+    program actually using".  Runs at trace/step-construction time."""
+    try:
+        _telemetry.get_registry().gauge(
+            "gbdt_two_level_grower_active",
+            "1 when the grower program traced with coarse-then-refine "
+            "histograms, by growth policy", ("grower",)).set(
+                1.0 if active else 0.0, grower=grower)
+    except Exception:
+        pass
+
 
 class GrowthParams(NamedTuple):
     """Static growth hyperparameters (hashable → part of the jit key)."""
@@ -719,6 +737,7 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
           and bundle_map is None and mono_c is None and not voting
           and B >= 128 and F > p.refine_k
           and (p.two_level == "on" or N >= TWO_LEVEL_MIN_ROWS))
+    _tl_gauge("lossguide", tl)
     SH = TWO_LEVEL_SHIFT
     Bc = coarse_bins(B, SH)
     Bh = Bc if tl else B                   # stored-histogram width
@@ -726,7 +745,10 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     num_bins_c = -(-num_bins // (1 << SH))
 
     def ar(x):
-        return lax.psum(x, axis_name) if (axis_name and not voting) else x
+        # routed through the instrumented wrapper so the histogram
+        # allreduce — THE data-parallel hot collective — shows up in
+        # collective_{calls,bytes}_total (recorded per traced program)
+        return _c_psum(x, axis_name) if (axis_name and not voting) else x
 
     def unb(hist3, g, h, c):
         if bundle_map is None:
@@ -1122,7 +1144,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     rows = jnp.arange(N)
 
     def ar(x):
-        return lax.psum(x, axis_name) if axis_name else x
+        return _c_psum(x, axis_name) if axis_name else x
 
     vals8, scales = (prep_hist_vals(grad, hess, row_valid) if use_pallas
                      else (None, None))
@@ -1162,6 +1184,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
           and (not use_pallas
                or fused_refine_fits(F, B, S, TWO_LEVEL_SHIFT,
                                     p.refine_k)))
+    _tl_gauge("depthwise", tl)
     SH = TWO_LEVEL_SHIFT
     Bc = coarse_bins(B, SH)
     Bh = Bc if tl else B                   # stored-histogram width

@@ -14,46 +14,86 @@ Use inside ``shard_map``/``pjit`` bodies with the axis names from
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..telemetry import get_registry
 from .mesh import DATA_AXIS
 
 
+def _record(op: str, axis, x) -> None:
+    """EQuARX-style per-collective accounting (arXiv:2506.17615): count +
+    payload bytes per (op, axis) into the process metrics registry.
+
+    These wrappers run under jit TRACING, so for compiled code each
+    series counts collectives per traced program, weighted by the
+    per-shard payload the op moves — the number that answers "how many
+    bytes does this step's program hand to the ICI" — not per execution.
+    Telemetry must never break a trace, hence the blanket except."""
+    try:
+        nbytes = 0
+        for leaf in jax.tree_util.tree_leaves(x):
+            size, dtype = getattr(leaf, "size", None), getattr(leaf, "dtype",
+                                                               None)
+            if size is not None and dtype is not None:
+                nbytes += int(size) * np.dtype(dtype).itemsize
+        reg = get_registry()
+        labels = dict(op=op, axis=str(axis))
+        reg.counter("collective_calls_total",
+                    "collective ops traced, by op and mesh axis",
+                    ("op", "axis")).inc(1, **labels)
+        reg.counter("collective_bytes_total",
+                    "per-shard payload bytes handed to collectives, "
+                    "by op and mesh axis", ("op", "axis")).inc(
+                        nbytes, **labels)
+    except Exception:
+        pass
+
+
 def psum(x, axis: str = DATA_AXIS):
+    _record("psum", axis, x)
     return lax.psum(x, axis_name=axis)
 
 
 def pmean(x, axis: str = DATA_AXIS):
+    _record("pmean", axis, x)
     return lax.pmean(x, axis_name=axis)
 
 def pmax(x, axis: str = DATA_AXIS):
+    _record("pmax", axis, x)
     return lax.pmax(x, axis_name=axis)
 
 
 def pmin(x, axis: str = DATA_AXIS):
+    _record("pmin", axis, x)
     return lax.pmin(x, axis_name=axis)
 
 
 def all_gather(x, axis: str = DATA_AXIS, *, tiled: bool = False):
+    _record("all_gather", axis, x)
     return lax.all_gather(x, axis_name=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str = DATA_AXIS, *, scatter_dimension: int = 0):
+    _record("reduce_scatter", axis, x)
     return lax.psum_scatter(x, axis_name=axis,
                             scatter_dimension=scatter_dimension, tiled=True)
 
 
 def ppermute(x, perm: Sequence[tuple], axis: str = DATA_AXIS):
+    _record("ppermute", axis, x)
     return lax.ppermute(x, axis_name=axis, perm=list(perm))
 
 
 def ring_shift(x, axis: str = DATA_AXIS, *, reverse: bool = False):
     """Send to the next rank on the ring (the ring-attention building block)."""
+    _record("ring_shift", axis, x)
     n = lax.axis_size(axis)
     if reverse:
         perm = [(i, (i - 1) % n) for i in range(n)]
@@ -73,6 +113,7 @@ def barrier(x, axis: str = DATA_AXIS):
     Returns ``x`` data-dependent on a cross-replica collective, so XLA cannot
     reorder work on ``x`` before the sync or dead-code-eliminate the
     collective (a bare unused psum would be DCE'd)."""
+    _record("barrier", axis, jnp.ones((), jnp.int32))
     token = lax.psum(jnp.ones((), jnp.int32), axis_name=axis)
     gated, _ = lax.optimization_barrier((x, token))
     return gated
@@ -100,6 +141,7 @@ def ring_allreduce(x, axis: str = DATA_AXIS):
     ``x``: equal-shape per-rank value whose leading dim is divisible by the
     axis size.  Returns the SUM over ranks, replicated (== lax.psum).
     """
+    _record("ring_allreduce", axis, x)
     n = lax.axis_size(axis)
     if n == 1:
         return x
@@ -137,6 +179,7 @@ def hierarchical_psum(x, inner_axis: str, outer_axis: str):
     ICI — cross-DCN traffic shrinks by the inner axis size versus a flat
     psum over both axes.  Leading dim must divide the inner axis size.
     Returns the global sum, replicated on both axes (== psum over both)."""
+    _record("hierarchical_psum", f"{inner_axis}+{outer_axis}", x)
     scattered = lax.psum_scatter(x, axis_name=inner_axis,
                                  scatter_dimension=0, tiled=True)
     scattered = lax.psum(scattered, axis_name=outer_axis)
@@ -150,6 +193,7 @@ def tree_psum_bucketed(tree, axis: str = DATA_AXIS,
     collective (latency-bound regime) while huge ones keep their own
     (bandwidth-bound regime) — Horovod's tensor-fusion strategy
     (the NCCL path behind dl/utils.py:31-46) expressed in XLA."""
+    _record("tree_psum_bucketed", axis, tree)
     leaves, treedef = jax.tree.flatten(tree)
     # buckets are per-dtype so the fused buffer sums at each leaf's OWN
     # precision — a float32 detour would silently round f64/int leaves
@@ -187,11 +231,31 @@ def tree_psum_bucketed(tree, axis: str = DATA_AXIS,
 def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
     """jitted allreduce over the data axis: input is per-rank values stacked
     on dim 0 (shape (num_ranks, *H)), output is their sum (shape (*H)).
-    The LightGBM histogram-allreduce replacement."""
+    The LightGBM histogram-allreduce replacement.
+
+    The returned callable is host-dispatched (unlike the in-jit wrappers
+    above), so each call ALSO lands one sample in the
+    ``collective_latency_seconds`` histogram — dispatch latency under
+    async execution, true op latency when the caller synchronizes."""
     @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P())
     def _allreduce(x):
         # x.sum(0) handles both one and several stacked values per shard
         return lax.psum(x.sum(0), axis_name=axis)
-    return _allreduce
+
+    latency = get_registry().histogram(
+        "collective_latency_seconds",
+        "host-observed latency of host-dispatched collectives",
+        ("op", "axis"))
+
+    @functools.wraps(_allreduce)
+    def timed(x):
+        _record("allreduce_fn", axis, x)
+        t0 = time.perf_counter()
+        out = _allreduce(x)
+        latency.observe(time.perf_counter() - t0, op="allreduce_fn",
+                        axis=str(axis))
+        return out
+
+    return timed

@@ -17,7 +17,10 @@ from __future__ import annotations
 
 import socket
 import struct
+import time
 from typing import Iterable, List, Optional, Tuple
+
+from ..telemetry import get_registry
 
 
 class ContinuousClient:
@@ -30,6 +33,14 @@ class ContinuousClient:
 
     def __init__(self, host: str, port: int, path: str = "/",
                  timeout_s: float = 30.0):
+        reg = get_registry()
+        self._m_records = reg.counter(
+            "serving_continuous_client_records_total",
+            "frames exchanged through ContinuousClient", ("path",))
+        self._m_rps = reg.gauge(
+            "serving_continuous_client_records_per_sec",
+            "last request_many window's end-to-end records/sec", ("path",))
+        self._path = path or "/"
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -72,12 +83,15 @@ class ContinuousClient:
     def request(self, payload: bytes) -> Tuple[int, bytes]:
         """One synchronous round trip (send + recv)."""
         self.send(payload)
-        return self.recv()
+        reply = self.recv()
+        self._m_records.inc(1, path=self._path)
+        return reply
 
     def request_many(self, payloads: Iterable[bytes],
                      window: int = 64) -> List[Tuple[int, bytes]]:
         """Pipelined exchange: keep up to ``window`` frames in flight,
         collect every reply in request order."""
+        t0 = time.perf_counter()
         out: List[Tuple[int, bytes]] = []
         for p in payloads:
             while self._in_flight >= max(1, window):
@@ -85,6 +99,10 @@ class ContinuousClient:
             self.send(p)
         while self._in_flight:
             out.append(self.recv())
+        dt = time.perf_counter() - t0
+        self._m_records.inc(len(out), path=self._path)
+        if out and dt > 0:
+            self._m_rps.set(len(out) / dt, path=self._path)
         return out
 
     def close(self) -> None:

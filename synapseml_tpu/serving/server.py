@@ -17,6 +17,11 @@ JVM hosting MULTIPLE named APIs).  Here the source/sink pair is explicit:
 - :class:`MultiPipelineServer` runs several named pipelines on one
   server, one serving loop per API (the multi-API routing of
   HTTPSourceV2's ServiceInfo registry).
+- ``GET /metrics`` is a RESERVED path on every listener: it exposes the
+  process-wide :mod:`synapseml_tpu.telemetry` registry as Prometheus
+  text (JSON with ``?format=json``), and serving loops feed it
+  per-API record counters, batch-size histograms, and a records/sec
+  throughput gauge.
 """
 
 from __future__ import annotations
@@ -35,6 +40,8 @@ import numpy as np
 
 from ..core.dataset import Dataset
 from ..core.pipeline import Transformer
+from ..telemetry import (PROMETHEUS_CONTENT_TYPE, get_registry, render_json,
+                         render_prometheus)
 
 
 @dataclass
@@ -503,6 +510,24 @@ class ServingServer:
 
     async def _dispatch(self, method: str, path: str,
                         headers: Dict[str, str], body: bytes):
+        bare, _, query = path.partition("?")
+        if bare.rstrip("/") == "/metrics" and method in ("GET", "HEAD"):
+            # reserved exposition path (served before API routing): the
+            # process metrics registry as Prometheus text, or JSON with
+            # ?format=json / an application/json Accept header.  HEAD
+            # gets an empty body — the generic writer emits whatever body
+            # we return, and body bytes after a HEAD reply desync the
+            # keep-alive connection
+            want_json = ("format=json" in query
+                         or "application/json" in headers.get("accept", ""))
+            if want_json:
+                body, ctype = (render_json().encode("utf-8"),
+                               "application/json")
+            else:
+                body, ctype = (render_prometheus().encode("utf-8"),
+                               PROMETHEUS_CONTENT_TYPE)
+            return 200, (b"" if method == "HEAD" else body), {
+                "Content-Type": ctype}
         api = self._route(path)
         if api is None:
             return 404, b'{"error": "no API registered at this path"}', {}
@@ -611,6 +636,18 @@ class _ApiLoop:
         #: 503 — under overload the tail stays bounded instead of every
         #: request slowly timing out (None: no shedding)
         self.max_queue_wait_s = max_queue_wait_s
+        reg = get_registry()
+        self._m_records = reg.counter(
+            "serving_records_total", "records replied 200", ("api",))
+        self._m_rps = reg.gauge(
+            "serving_records_per_sec",
+            "last-batch records/sec through transform+reply", ("api",))
+        self._m_batch = reg.histogram(
+            "serving_batch_size", "records per micro-batch", ("api",),
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        self._m_errors = reg.counter(
+            "serving_errors_total", "batches failed (500) or shed (503)",
+            ("api", "kind"))
         self._stop = threading.Event()
         #: >1 workers drain one queue concurrently: while one worker's
         #: transform holds the device/CPU (releasing the GIL), another
@@ -635,11 +672,14 @@ class _ApiLoop:
                                        f"{self.max_queue_wait_s}s"}).encode()
                     for req in stale:
                         self.api.reply(req.id, ServingReply(503, body))
+                    self._m_errors.inc(len(stale), api=self.api.path,
+                                       kind="shed")
                     batch = [r for r in batch
                              if now - r.enqueued_at <= self.max_queue_wait_s]
                     if not batch:
                         continue
             try:
+                t0 = time.perf_counter()
                 rows = [self.input_parser(r) for r in batch]
                 ds = Dataset.from_rows(rows)
                 out = self.model.transform(ds)
@@ -648,7 +688,13 @@ class _ApiLoop:
                     self.api.reply(req.id, ServingReply(
                         200, self.output_formatter(val),
                         {"Content-Type": "application/json"}))
+                dt = time.perf_counter() - t0
+                self._m_records.inc(len(batch), api=self.api.path)
+                self._m_batch.observe(len(batch), api=self.api.path)
+                if dt > 0:
+                    self._m_rps.set(len(batch) / dt, api=self.api.path)
             except Exception as e:  # noqa: BLE001 — serving must not die
+                self._m_errors.inc(1, api=self.api.path, kind="transform")
                 body = json.dumps({"error": str(e)}).encode()
                 for req in batch:
                     self.api.reply(req.id, ServingReply(500, body))

@@ -1,0 +1,57 @@
+"""Unified telemetry: metrics registry, span tracing, exposition, and
+atomic bench artifacts.
+
+The observability spine of the TPU-native stack — the analogue (and
+superset) of the reference's ``SynapseMLLogging`` structured verb
+telemetry plus ``LightGBMPerformance.scala`` phase measures:
+
+- :mod:`.registry` — process-wide ``Counter``/``Gauge``/``Histogram``
+  with label sets; thread-safe, resettable (``get_registry()``).
+- :mod:`.tracing` — nested host-side spans with Chrome-trace export
+  (``span(name, **attrs)``, ``get_tracer()``).
+- :mod:`.exposition` — Prometheus text + JSON rendering; served by
+  ``ServingServer`` at ``GET /metrics``.
+- :mod:`.artifact` — atomic, round-trip-verified JSON artifact writes
+  (``write_json``), used by ``bench.py`` so a truncated ``BENCH_*.json``
+  cannot recur.
+
+Everything here is stdlib-only and safe to import before jax.
+
+Instrumented layers (all write into the default registry):
+
+====================================  =====================================
+``parallel.collectives``              ``collective_calls_total`` /
+                                      ``collective_bytes_total`` per op+axis
+                                      (trace-time for jitted code),
+                                      ``collective_latency_seconds`` for the
+                                      host-dispatched allreduce
+``models.gbdt`` (booster/trainer)     ``gbdt_phase_seconds`` per phase,
+                                      ``gbdt_two_level_active`` gauge,
+                                      ``gbdt_iterations_total``
+``models.dl.training``                ``dl_train_samples_total`` /
+                                      ``dl_train_tokens_total`` counters,
+                                      ``dl_train_samples_per_sec`` gauge
+``serving`` (server/continuous)       ``serving_records_total``,
+                                      ``serving_records_per_sec``,
+                                      ``serving_batch_size``,
+                                      ``serving_errors_total``, client-side
+                                      continuous-mode counters
+====================================  =====================================
+"""
+
+from .artifact import (SchemaError, check_schema, dumps_checked, read_json,
+                       write_json)
+from .exposition import (PROMETHEUS_CONTENT_TYPE, render_json,
+                         render_prometheus)
+from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                       MetricsRegistry, get_registry)
+from .tracing import Span, Tracer, get_tracer, span
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "DEFAULT_BUCKETS",
+    "Span", "Tracer", "get_tracer", "span",
+    "render_prometheus", "render_json", "PROMETHEUS_CONTENT_TYPE",
+    "SchemaError", "check_schema", "dumps_checked", "write_json",
+    "read_json",
+]

@@ -1,0 +1,178 @@
+"""Span tracing: nested, queryable, Chrome-trace-exportable.
+
+Horovod's timeline (Sergeev & Del Balso, arXiv:1802.05799) made the
+per-op schedule of a distributed run *visible*; the analogue here is a
+host-side span tracer: ``with span("gbdt.train", rows=n):`` produces an
+in-memory record with parent/child nesting (thread-local stack),
+host/process-index attribution, and wall+monotonic timestamps, and the
+whole trace exports as Chrome-trace JSON (load in ``chrome://tracing``
+or Perfetto).
+
+Device-side op scheduling stays the job of
+:func:`synapseml_tpu.core.profiling.trace` (the XLA profiler); spans
+cover everything the profiler cannot see — host phases, serving loops,
+binning, checkpoint writes — cheaply enough to stay on in production.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = ["Span", "Tracer", "get_tracer", "span"]
+
+_ids = itertools.count(1)
+_tls = threading.local()
+
+
+def _process_index() -> int:
+    """jax.process_index() when jax is up, else 0 — resolved lazily so
+    importing telemetry never drags in (or initializes) jax."""
+    try:
+        import sys
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return 0
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+@dataclass
+class Span:
+    """One finished (or live) span."""
+    name: str
+    span_id: int
+    parent_id: Optional[int]
+    start_wall_s: float                  # epoch seconds (chrome ts base)
+    start_s: float                       # perf_counter
+    end_s: Optional[float] = None        # perf_counter; None while live
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    thread_id: int = 0
+    process_index: int = 0
+    host: str = ""
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_s or time.perf_counter()) - self.start_s
+
+
+class Tracer:
+    """Bounded in-memory trace; one per process is plenty."""
+
+    def __init__(self, max_spans: int = 100_000):
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._dropped = 0
+        self._host = socket.gethostname()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        stack: List[Span] = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        sp = Span(name=name, span_id=next(_ids),
+                  parent_id=stack[-1].span_id if stack else None,
+                  start_wall_s=time.time(), start_s=time.perf_counter(),
+                  attrs=dict(attrs), thread_id=threading.get_ident(),
+                  process_index=_process_index(), host=self._host)
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            stack.pop()
+            sp.end_s = time.perf_counter()
+            with self._lock:
+                if len(self._spans) < self.max_spans:
+                    self._spans.append(sp)
+                else:
+                    self._dropped += 1
+
+    def record(self, name: str, duration_s: float, *,
+               start_wall_s: Optional[float] = None,
+               parent_id: Optional[int] = None, **attrs) -> Span:
+        """Append an already-measured interval as a finished span — for
+        call sites that keep their own perf_counter bookkeeping (e.g. the
+        GBDT ``InstrumentationMeasures``) and publish retrospectively."""
+        now_perf = time.perf_counter()
+        if start_wall_s is None:
+            start_wall_s = time.time() - duration_s
+        sp = Span(name=name, span_id=next(_ids), parent_id=parent_id,
+                  start_wall_s=start_wall_s,
+                  start_s=now_perf - duration_s, end_s=now_perf,
+                  attrs=dict(attrs), thread_id=threading.get_ident(),
+                  process_index=_process_index(), host=self._host)
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(sp)
+            else:
+                self._dropped += 1
+        return sp
+
+    # -- queries -----------------------------------------------------------
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def children(self, parent: Span) -> List[Span]:
+        return [s for s in self.spans() if s.parent_id == parent.span_id]
+
+    def roots(self) -> List[Span]:
+        return [s for s in self.spans() if s.parent_id is None]
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    # -- export ------------------------------------------------------------
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome-trace ("Trace Event Format") dict: complete ("X")
+        events, pid = process index, tid = OS thread id, ts/dur in us."""
+        events = []
+        for s in self.spans():
+            if s.end_s is None:
+                continue
+            events.append({
+                "name": s.name, "ph": "X", "cat": "host",
+                "ts": s.start_wall_s * 1e6,
+                "dur": (s.end_s - s.start_s) * 1e6,
+                "pid": s.process_index, "tid": s.thread_id,
+                "args": {**s.attrs, "host": s.host,
+                         "span_id": s.span_id,
+                         "parent_id": s.parent_id},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str) -> Dict[str, Any]:
+        """Atomically write the Chrome-trace JSON to ``path`` (via the
+        artifact writer, so a crash cannot leave a truncated trace)."""
+        from .artifact import write_json
+        return write_json(path, self.chrome_trace(),
+                          schema=("traceEvents",))
+
+
+_default_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default_tracer
+
+
+def span(name: str, **attrs):
+    """``with span("phase", key=val):`` on the process-default tracer."""
+    return _default_tracer.span(name, **attrs)

@@ -328,10 +328,12 @@ def test_monotone_refresh_methods_feature_parallel(method):
                                b_1.predict_margin(X[:1024]), atol=1e-4)
 
 
-def test_advanced_memory_guard_rejects_huge_configs():
+def test_advanced_memory_guard_rejects_huge_configs(monkeypatch):
     """The advanced refresh materializes (M, M, F) masks; a config whose
-    masks would exceed ~1 GiB must fail fast with a message pointing at
+    masks would exceed the host-scaled budget (auto-capped at 8 GiB — this
+    one needs ~21 GiB) must fail fast with a message pointing at
     'intermediate' instead of OOMing mid-compile."""
+    monkeypatch.delenv("SYNAPSEML_TPU_ADV_MONO_MASK_BYTES", raising=False)
     F = 4096
     X = np.zeros((32, F), np.float32)
     y = np.zeros(32)
@@ -341,3 +343,35 @@ def test_advanced_memory_guard_rejects_huge_configs():
                          monotone_constraints_method="advanced")
     with pytest.raises(ValueError, match="intermediate"):
         train(X, y, cfg)
+
+
+def test_advanced_memory_guard_scales_and_overrides(monkeypatch):
+    """The guard budget scales with the host instead of the old fixed
+    1 GiB, and both override channels (pass_through kwarg, env var) take
+    precedence — a tiny override makes even a small config refuse, which
+    pins the plumbing without training anything big."""
+    from synapseml_tpu.models.gbdt.booster import _advanced_mask_budget_bytes
+
+    monkeypatch.delenv("SYNAPSEML_TPU_ADV_MONO_MASK_BYTES", raising=False)
+    base = BoostingConfig(objective="regression",
+                          monotone_constraints_method="advanced")
+    assert (1 << 30) <= _advanced_mask_budget_bytes(base) <= (8 << 30)
+
+    kw_cfg = BoostingConfig(
+        objective="regression", monotone_constraints_method="advanced",
+        pass_through={"advanced_mask_bytes": 4096})
+    assert _advanced_mask_budget_bytes(kw_cfg) == 4096
+
+    monkeypatch.setenv("SYNAPSEML_TPU_ADV_MONO_MASK_BYTES", "123456")
+    assert _advanced_mask_budget_bytes(base) == 123456
+    monkeypatch.delenv("SYNAPSEML_TPU_ADV_MONO_MASK_BYTES")
+
+    X = np.zeros((64, 8), np.float32)
+    y = np.zeros(64)
+    small = BoostingConfig(objective="regression", num_iterations=1,
+                           num_leaves=15, min_data_in_leaf=1,
+                           monotone_constraints=[1] * 8,
+                           monotone_constraints_method="advanced",
+                           pass_through={"advanced_mask_bytes": 16})
+    with pytest.raises(ValueError, match="advanced_mask_bytes"):
+        train(X, y, small)

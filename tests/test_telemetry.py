@@ -1,0 +1,458 @@
+"""Telemetry subsystem tests: registry semantics, span tracing,
+Prometheus exposition through the serving server, collectives counters
+on the simulated mesh, instrumented trainers, and artifact-writer
+crash-safety (the BENCH_r05 truncation regression class)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.telemetry import (MetricsRegistry, SchemaError, Tracer,
+                                     dumps_checked, get_registry, get_tracer,
+                                     read_json, render_prometheus, span,
+                                     write_json)
+
+
+# -- registry ----------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_labels_and_values(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests", ("api", "code"))
+        c.inc(api="/a", code="200")
+        c.inc(2, api="/a", code="200")
+        c.inc(api="/b", code="500")
+        assert c.value(api="/a", code="200") == 3
+        assert c.value(api="/b", code="500") == 1
+        assert c.value(api="/c", code="200") == 0        # untouched series
+
+    def test_counter_rejects_decrease_and_wrong_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "", ("op",))
+        with pytest.raises(ValueError):
+            c.inc(-1, op="x")
+        with pytest.raises(ValueError):
+            c.inc(1)                                     # missing label
+        with pytest.raises(ValueError):
+            c.inc(1, op="x", extra="y")                  # extra label
+
+    def test_get_or_create_and_kind_mismatch(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("same", "", ("a",))
+        assert reg.counter("same", "", ("a",)) is c1
+        with pytest.raises(ValueError):
+            reg.gauge("same")                            # kind mismatch
+        with pytest.raises(ValueError):
+            reg.counter("same", "", ("b",))              # label mismatch
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g")
+        g.set(5.0)
+        g.inc(2)
+        g.dec(3)
+        assert g.value() == 4.0
+
+    def test_histogram_cumulative_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", "", (), buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 5, 50, 500):
+            h.observe(v)
+        st = h.stats()
+        assert st["buckets"] == [1, 2, 3]                # cumulative <= bound
+        assert st["count"] == 4
+        assert st["sum"] == pytest.approx(555.5)
+
+    def test_concurrent_increments_lose_nothing(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n_total", "", ("t",))
+        h = reg.histogram("lat", "", (), buckets=(0.5,))
+
+        def work():
+            for _ in range(1000):
+                c.inc(t="x")
+                h.observe(0.1)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(t="x") == 8000
+        assert h.stats()["count"] == 8000
+
+    def test_reset_zeroes_but_keeps_registration(self):
+        reg = MetricsRegistry()
+        c = reg.counter("r_total", "", ("k",))
+        c.inc(5, k="a")
+        reg.reset()
+        assert c.value(k="a") == 0
+        c.inc(k="a")                                     # old handle works
+        assert reg.counter("r_total", "", ("k",)).value(k="a") == 1
+
+    def test_snapshot_is_jsonable(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "", ("x",)).inc(2, x="1")
+        reg.histogram("b", "", (), buckets=(1,)).observe(0.5)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["a_total"]["series"][0]["value"] == 2
+        assert snap["b"]["series"][0]["count"] == 1
+
+    def test_histogram_bucket_mismatch_rejected(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("hb", "", (), buckets=(1.0, 2.0))
+        assert reg.histogram("hb", "", ()) is h          # None: no claim
+        assert reg.histogram("hb", "", (), buckets=(2.0, 1.0)) is h  # same set
+        with pytest.raises(ValueError):
+            reg.histogram("hb", "", (), buckets=(1.0, 3.0))
+
+    def test_invalid_names_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("bad name")
+        with pytest.raises(ValueError):
+            reg.counter("ok", "", ("bad-label",))
+
+
+# -- prometheus exposition ---------------------------------------------------
+
+class TestExposition:
+    def test_text_format(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "help text", ("op",)).inc(3, op='a"b\nc')
+        reg.gauge("g").set(2.5)
+        reg.histogram("h", "", (), buckets=(1.0,)).observe(0.5)
+        text = render_prometheus(reg)
+        assert "# TYPE x_total counter" in text
+        assert 'x_total{op="a\\"b\\nc"} 3' in text
+        assert "g 2.5" in text
+        assert 'h_bucket{le="1"} 1' in text
+        assert 'h_bucket{le="+Inf"} 1' in text
+        assert "h_sum 0.5" in text and "h_count 1" in text
+
+    def test_nonfinite_gauge_renders_not_raises(self):
+        # a poisoned gauge must not kill every subsequent /metrics scrape
+        reg = MetricsRegistry()
+        reg.gauge("bad").set(float("nan"))
+        reg.gauge("worse").set(float("-inf"))
+        text = render_prometheus(reg)
+        assert "bad NaN" in text and "worse -Inf" in text
+
+
+# -- span tracing ------------------------------------------------------------
+
+class TestTracing:
+    def test_nesting_and_attribution(self):
+        tr = Tracer()
+        with tr.span("outer", phase="fit"):
+            with tr.span("inner"):
+                time.sleep(0.01)
+        outer, = tr.spans("outer")
+        inner, = tr.spans("inner")
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id is None
+        assert inner.duration_s >= 0.01
+        assert outer.duration_s >= inner.duration_s
+        assert outer.attrs == {"phase": "fit"}
+        assert outer.host and isinstance(outer.process_index, int)
+        assert tr.children(outer) == [inner]
+        assert tr.roots() == [outer]
+
+    def test_sibling_threads_do_not_nest(self):
+        tr = Tracer()
+        done = threading.Event()
+
+        def other():
+            with tr.span("t2"):
+                pass
+            done.set()
+
+        with tr.span("t1"):
+            threading.Thread(target=other).start()
+            assert done.wait(5)
+        assert tr.spans("t2")[0].parent_id is None
+
+    def test_chrome_trace_export(self, tmp_path):
+        tr = Tracer()
+        with tr.span("a", n=1):
+            pass
+        tr.record("b", 0.25, rows=10)
+        path = str(tmp_path / "trace.json")
+        exported = tr.export_chrome(path)
+        on_disk = json.load(open(path))
+        assert on_disk == exported
+        events = {e["name"]: e for e in on_disk["traceEvents"]}
+        assert events["a"]["ph"] == "X" and events["a"]["args"]["n"] == 1
+        assert events["b"]["dur"] == pytest.approx(0.25e6)
+
+    def test_bounded_and_resettable(self):
+        tr = Tracer(max_spans=2)
+        for _ in range(4):
+            with tr.span("s"):
+                pass
+        assert len(tr.spans()) == 2 and tr.dropped == 2
+        tr.reset()
+        assert tr.spans() == [] and tr.dropped == 0
+
+    def test_module_level_span_uses_default_tracer(self):
+        before = len(get_tracer().spans("default_span_test"))
+        with span("default_span_test"):
+            pass
+        assert len(get_tracer().spans("default_span_test")) == before + 1
+
+
+# -- artifact writer ---------------------------------------------------------
+
+class TestArtifact:
+    def test_round_trip_and_schema(self, tmp_path):
+        path = str(tmp_path / "a.json")
+        obj = {"metric": "x", "value": 1.5, "nested": {"k": [1, 2]}}
+        parsed = write_json(path, obj, schema=("metric", "value"))
+        assert parsed == obj
+        assert read_json(path) == obj
+
+    def test_schema_rejects_before_touching_disk(self, tmp_path):
+        path = str(tmp_path / "a.json")
+        write_json(path, {"metric": "x"}, schema=("metric",))
+        with pytest.raises(SchemaError):
+            write_json(path, {"wrong": 1}, schema=("metric",))
+        assert read_json(path) == {"metric": "x"}        # old file intact
+        assert os.listdir(tmp_path) == ["a.json"]        # no tmp litter
+
+    def test_callable_schema(self):
+        def must_be_positive(obj):
+            if obj["v"] <= 0:
+                raise SchemaError("v must be positive")
+        assert json.loads(dumps_checked({"v": 1}, must_be_positive)) == {"v": 1}
+        with pytest.raises(SchemaError):
+            dumps_checked({"v": 0}, must_be_positive)
+
+    def test_nan_rejected_not_emitted(self, tmp_path):
+        # NaN would serialize as the non-JSON token `NaN` and poison every
+        # later parse — exactly the "unparseable artifact" class
+        with pytest.raises(ValueError):
+            write_json(str(tmp_path / "n.json"), {"v": float("nan")})
+
+    def test_numpy_scalars_serialize(self, tmp_path):
+        parsed = write_json(str(tmp_path / "np.json"),
+                            {"a": np.float32(1.5), "b": np.int64(3),
+                             "c": np.arange(3)})
+        assert parsed == {"a": 1.5, "b": 3, "c": [0, 1, 2]}
+
+    def test_failed_write_leaves_old_file(self, tmp_path, monkeypatch):
+        import synapseml_tpu.telemetry.artifact as art
+        path = str(tmp_path / "a.json")
+        write_json(path, {"v": 1})
+
+        def boom(*a, **k):
+            raise OSError("disk gone")
+        monkeypatch.setattr(art.os, "replace", boom)
+        with pytest.raises(OSError):
+            write_json(path, {"v": 2})
+        monkeypatch.undo()
+        assert read_json(path) == {"v": 1}
+        assert os.listdir(tmp_path) == ["a.json"]
+
+    def test_kill_mid_write_never_corrupts(self, tmp_path):
+        """SIGKILL a child that rewrites the artifact in a tight loop; at
+        every instant the destination must be absent or fully parseable
+        (the atomic-rename guarantee BENCH_r05 lacked)."""
+        path = str(tmp_path / "bench.json")
+        child = subprocess.Popen(
+            [sys.executable, "-c", (
+                "import sys\n"
+                f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+                "from synapseml_tpu.telemetry.artifact import write_json\n"
+                "payload = {'metric': 'x', 'blob': 'y' * 200000}\n"
+                "i = 0\n"
+                "while True:\n"
+                "    payload['i'] = i\n"
+                "    write_json(sys.argv[1], payload, schema=('metric',))\n"
+                "    i += 1\n"), path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.monotonic() + 10
+            while not os.path.exists(path):
+                assert time.monotonic() < deadline, "child never wrote"
+                assert child.poll() is None, "child died early"
+                time.sleep(0.01)
+            time.sleep(0.1)                  # let a few rewrites happen
+        finally:
+            child.kill()
+            child.wait(timeout=10)
+        obj = read_json(path, schema=("metric", "blob"))
+        assert obj["metric"] == "x" and len(obj["blob"]) == 200000
+
+
+# -- /metrics exposition through the serving server --------------------------
+
+class TestServingMetrics:
+    def test_metrics_endpoint_and_serving_gauges(self, devices8):
+        """The acceptance surface: ONE /metrics scrape must carry a
+        collective counter, a GBDT phase histogram, and a serving
+        throughput gauge — the registry is process-wide, so training and
+        serving in the same process expose through the same endpoint."""
+        from synapseml_tpu import Dataset
+        from synapseml_tpu.models.gbdt import BoostingConfig, train
+        from synapseml_tpu.parallel import allreduce_fn
+        from synapseml_tpu.parallel.mesh import make_mesh
+        from synapseml_tpu.serving import ContinuousClient, PipelineServer
+
+        # populate the non-serving families this scrape must include
+        np.asarray(allreduce_fn(make_mesh({"data": 8}, devices8))(
+            np.ones((8, 4), np.float32)))
+        rng = np.random.default_rng(0)
+        Xg = rng.normal(size=(300, 4)).astype(np.float32)
+        train(Xg, (Xg[:, 0] > 0).astype(np.float64),
+              BoostingConfig(objective="binary", num_iterations=2,
+                             num_leaves=5))
+
+        class _Doubler:
+            def transform(self, ds):
+                x = np.asarray([float(v) for v in ds["x"]])
+                return Dataset({"x": ds["x"], "prediction": 2.0 * x})
+
+        ps = PipelineServer(_Doubler(), lambda r: {"x": r.json()["x"]})
+        try:
+            req = urllib.request.Request(
+                ps.server.url, data=b'{"x": 2.0}', method="POST")
+            assert json.loads(urllib.request.urlopen(
+                req, timeout=10).read())["prediction"] == 4.0
+            with ContinuousClient(*ps.server.address, "/") as c:
+                replies = c.request_many([b'{"x": 1.0}'] * 16)
+                assert all(s == 200 for s, _ in replies)
+
+            url = ps.server.url_for("/metrics")
+            text = urllib.request.urlopen(url, timeout=10).read().decode()
+            assert "# TYPE serving_records_total counter" in text
+            assert 'serving_records_total{api="/"}' in text
+            assert "# TYPE serving_records_per_sec gauge" in text
+            assert "serving_batch_size_bucket" in text
+            # client-side continuous counters ride the same registry
+            assert ("serving_continuous_client_records_total"
+                    in text)
+            # the cross-layer acceptance criterion: collective counter +
+            # gbdt phase histogram + serving throughput gauge, one scrape
+            assert 'collective_calls_total{op="allreduce_fn",axis="data"}' \
+                in text
+            assert "gbdt_phase_seconds_bucket" in text
+            assert 'serving_records_per_sec{api="/"}' in text
+
+            j = json.loads(urllib.request.urlopen(
+                url + "?format=json", timeout=10).read())
+            total = sum(s["value"]
+                        for s in j["serving_records_total"]["series"])
+            assert total >= 17
+        finally:
+            ps.close()
+
+
+# -- collectives instrumentation on the simulated mesh -----------------------
+
+class TestCollectivesMetrics:
+    def test_allreduce_fn_counts_bytes_and_latency(self, devices8):
+        import jax
+        from synapseml_tpu.parallel import allreduce_fn
+        from synapseml_tpu.parallel.mesh import make_mesh
+
+        reg = get_registry()
+        calls = reg.counter("collective_calls_total", "", ("op", "axis"))
+        nbytes = reg.counter("collective_bytes_total", "", ("op", "axis"))
+        c0 = calls.value(op="allreduce_fn", axis="data")
+        b0 = nbytes.value(op="allreduce_fn", axis="data")
+
+        mesh = make_mesh({"data": 8}, devices8)
+        fn = allreduce_fn(mesh)
+        x = np.ones((8, 16), np.float32)
+        out = np.asarray(fn(x))
+        assert out.shape == (16,) and np.all(out == 8)
+
+        assert calls.value(op="allreduce_fn", axis="data") == c0 + 1
+        assert nbytes.value(op="allreduce_fn", axis="data") == b0 + 8 * 16 * 4
+        lat = reg.histogram("collective_latency_seconds", "",
+                            ("op", "axis"))
+        assert lat.stats(op="allreduce_fn", axis="data")["count"] >= 1
+
+    def test_in_jit_psum_records_at_trace_time(self, devices8):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from synapseml_tpu.parallel import psum, shard_map_over
+        from synapseml_tpu.parallel.mesh import make_mesh
+
+        reg = get_registry()
+        calls = reg.counter("collective_calls_total", "", ("op", "axis"))
+        c0 = calls.value(op="psum", axis="data")
+
+        mesh = make_mesh({"data": 8}, devices8)
+        fn = jax.jit(shard_map_over(mesh, P("data"), P())(
+            lambda x: psum(x.sum(0), "data")))
+        x = np.ones((8, 4), np.float32)
+        np.asarray(fn(x))
+        np.asarray(fn(x))                       # second call: cached trace
+        c_after = calls.value(op="psum", axis="data")
+        assert c_after >= c0 + 1                # traced at least once
+        assert c_after <= c0 + 2                # not once per execution
+
+
+# -- instrumented trainers ---------------------------------------------------
+
+class TestTrainerMetrics:
+    def test_gbdt_phase_histogram_and_two_level_gauge(self):
+        from synapseml_tpu.models.gbdt import BoostingConfig, train
+
+        reg = get_registry()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        booster, _ = train(X, y, BoostingConfig(
+            objective="binary", num_iterations=3, num_leaves=7))
+
+        hist = reg.get("gbdt_phase_seconds")
+        assert hist is not None
+        for phase in ("binning", "compile", "training", "total"):
+            assert hist.stats(phase=phase)["count"] >= 1
+        iters = reg.get("gbdt_iterations_total")
+        assert iters.value() >= 3
+        # 400 rows on the CPU fallback: auto must have resolved to off
+        tl = reg.get("gbdt_two_level_resolved")
+        assert tl is not None and tl.value() == 0.0
+        assert reg.get("gbdt_two_level_active").value() == 0.0
+        # the retrospective span carries the fit's attribution
+        spans = [s for s in get_tracer().spans("gbdt.train")
+                 if s.attrs.get("rows") == 400]
+        assert spans and spans[-1].attrs["objective"] == "binary"
+
+    def test_dl_step_counters(self, devices8):
+        import flax.linen as nn
+        import jax
+        from synapseml_tpu.models.dl.training import (DLTrainer,
+                                                      OptimizerConfig,
+                                                      make_dl_mesh)
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, deterministic=True):
+                return nn.Dense(2)(x)
+
+        reg = get_registry()
+        s0 = reg.counter("dl_train_samples_total").value()
+        mesh = make_dl_mesh(num_devices=8)
+        tr = DLTrainer(Tiny(), OptimizerConfig(), mesh)
+        x = np.ones((16, 4), np.float32)
+        yl = np.zeros(16, np.int64)
+        state = tr.init_state(0, x)
+        step = tr.train_step()
+        bi, bl = tr.shard_batch((x, yl))
+        state, m = step(state, (bi,), bl, jax.random.PRNGKey(0))
+        state, m = step(state, (bi,), bl, jax.random.PRNGKey(0))
+        float(np.asarray(m["loss"]))
+        assert reg.counter("dl_train_samples_total").value() == s0 + 32
+        assert reg.gauge("dl_train_samples_per_sec").value() > 0
