@@ -67,15 +67,17 @@ try:
 except Exception:  # pragma: no cover - jax absent/newer layout
     pass
 
-from . import telemetry
+from . import resilience, telemetry
 from .core.dataset import Dataset
 from .core.params import Params
 from .core.pipeline import (Estimator, Evaluator, Model, Pipeline,
                             PipelineModel, PipelineStage, Transformer)
+from .resilience import (CircuitBreaker, Deadline, RetryPolicy, get_faults)
 from .telemetry import get_registry, span
 
 __all__ = [
     "Dataset", "Params", "Estimator", "Evaluator", "Model", "Pipeline",
     "PipelineModel", "PipelineStage", "Transformer", "__version__",
     "telemetry", "get_registry", "span",
+    "resilience", "RetryPolicy", "Deadline", "CircuitBreaker", "get_faults",
 ]

@@ -53,6 +53,36 @@ def generate_docs(stages: Dict[str, type], out_dir: str) -> List[str]:
         index.append(f"- [`{module}`]({fname}) — "
                      f"{len(classes)} stages")
         paths.append(path)
+    # hand-maintained (non-stage) pages already in out_dir survive
+    # regeneration and self-register in the index: anything *.md the
+    # generator did not just write gets linked with its first-heading
+    # one-liner (previously these links were manual post-edits that every
+    # regeneration silently wiped)
+    import re
+    generated = {os.path.basename(p) for p in paths} | {"index.md"}
+    #: a generated page's first line is exactly "# `<module>`" — a file
+    #: matching it but absent from this run is a STALE generated page
+    #: (its stage module was removed/renamed), not a hand-maintained one
+    _generated_head = re.compile(r"^# `[\w.]+`$")
+    manual = []
+    for fname in sorted(os.listdir(out_dir)):
+        if not fname.endswith(".md") or fname in generated:
+            continue
+        title = fname[:-3]
+        try:
+            with open(os.path.join(out_dir, fname)) as f:
+                first = f.readline().rstrip("\n")
+        except OSError:
+            first = ""
+        if _generated_head.match(first.strip()):
+            continue                      # stale generated page: skip
+        if first.lstrip("#").strip():
+            title = first.lstrip("#").strip()
+        manual.append((title, fname))
+    if manual:
+        index += ["", "Hand-maintained (non-stage) module pages:", ""]
+        for title, fname in manual:
+            index.append(f"- [{title}]({fname})")
     index_path = os.path.join(out_dir, "index.md")
     with open(index_path, "w") as f:
         f.write("\n".join(index) + "\n")

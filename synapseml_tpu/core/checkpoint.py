@@ -27,6 +27,8 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from ..resilience.faults import get_faults
+
 _STEP_RE = re.compile(r"^step_(\d{10})$")
 
 
@@ -85,6 +87,10 @@ class CheckpointManager:
         tmp = tempfile.mkdtemp(prefix=".tmp_ckpt_", dir=self.directory)
         try:
             np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+            # SIGKILL here must leave only the tmp dir (invisible to
+            # discovery) — the fault site that proves the atomicity claim
+            get_faults().kill_point("checkpoint.save.pre_publish",
+                                    step=step)
             with open(os.path.join(tmp, "structure.pkl"), "wb") as f:
                 pickle.dump({"treedef_bytes": treedef_bytes,
                              "others_bytes": others_bytes,
@@ -96,6 +102,7 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        get_faults().kill_point("checkpoint.save.post_publish", step=step)
         self._prune()
         return final
 

@@ -29,11 +29,14 @@ def retry_with_timeout(fn: Callable[[], T],
                        backoffs_ms: Iterable[int] = DEFAULT_BACKOFFS_MS) -> T:
     """Run ``fn`` with per-attempt timeout, retrying on failure with the
     reference's backoff schedule."""
+    from ..resilience.faults import get_faults
     backoffs = list(backoffs_ms)
     last_exc: Optional[BaseException] = None
     for i, backoff in enumerate(backoffs):
         if backoff:
-            time.sleep(backoff / 1e3)
+            # routed through the fault registry so the schedule is
+            # recorded alongside every other backoff in the stack
+            get_faults().sleep(backoff / 1e3, site="core.retry")
         try:
             if timeout_s is None:
                 return fn()
@@ -52,11 +55,12 @@ def retry_with_timeout(fn: Callable[[], T],
 def retry(fn: Callable[[], T], times: List[int]) -> T:
     """HandlingUtils.retry analogue: try, sleep head of list, recurse on tail
     — i.e. len(times)+1 attempts, last error rethrown."""
+    from ..resilience.faults import get_faults
     for backoff in times:
         try:
             return fn()
         except BaseException:
-            time.sleep(backoff / 1e3)
+            get_faults().sleep(backoff / 1e3, site="core.retry")
     return fn()
 
 

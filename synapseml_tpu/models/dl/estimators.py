@@ -101,6 +101,11 @@ class _DLParamsBase(Params):
                                 "automatically if it holds checkpoints)")
     checkpointInterval = IntParam(doc="save every N optimizer steps "
                                   "(0 = off)", default=0)
+    checkpointManager = PyObjectParam(
+        doc="core.checkpoint.CheckpointManager to save/resume through "
+            "(overrides checkpointDir) — the preemption-tolerant fit "
+            "surface: re-fit with the same manager resumes from "
+            "latest_step")
 
     def _checkpoint_loop(self, trainer: "DLTrainer",
                          state: "TrainState") -> "_CheckpointLoop":
@@ -140,10 +145,13 @@ class _CheckpointLoop:
         self._config = {k: float(est.get_or_default(k))
                         for k in self._CONFIG_KEYS}
         self._config["shards"] = float(trainer.mesh.shape["data"])
+        manager = est.get("checkpointManager")
         ckpt_dir = est.get("checkpointDir")
-        if not ckpt_dir:
+        if manager is None and not ckpt_dir:
             return
-        self.manager = CheckpointManager(ckpt_dir)
+        self.manager = (manager if manager is not None
+                        else CheckpointManager(ckpt_dir))
+        ckpt_dir = self.manager.directory
         latest = self.manager.latest_step()
         if latest is None:
             return
@@ -172,6 +180,11 @@ class _CheckpointLoop:
         if self.manager and self.interval and gstep % self.interval == 0:
             self.manager.save(gstep, jax.device_get(state),
                               metrics=self._config)
+            # preemption point: a kill/preempt fault lands exactly where
+            # a real TPU eviction would — after a durable step, before
+            # the next one
+            from ...resilience.faults import get_faults
+            get_faults().kill_point("dl.checkpoint", step=gstep)
 
 
 class DeepTextClassifier(_DLParamsBase, Estimator):

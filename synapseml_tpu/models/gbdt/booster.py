@@ -788,13 +788,19 @@ def _write_checkpoint(directory: str, booster: Booster,
                       keep: int = 3) -> None:
     import os
     import re as _re
+
+    from ...resilience.faults import get_faults
     os.makedirs(directory, exist_ok=True)
     n = booster.num_trees // max(booster.num_class, 1)
     path = os.path.join(directory, f"iter_{n:08d}.json")
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(booster.to_dict(), f)
+    # a SIGKILL between write and publish must leave only the tmp file,
+    # which _latest_checkpoint never matches — resume sees the prior step
+    get_faults().kill_point("gbdt.checkpoint.pre_publish", iteration=n)
     os.replace(tmp, path)
+    get_faults().kill_point("gbdt.checkpoint", iteration=n)
     matches = (_re.match(r"iter_(\d+)\.json$", x)
                for x in os.listdir(directory))
     steps = sorted(int(m.group(1)) for m in matches if m)
@@ -844,6 +850,37 @@ def _placeholder_mapper(m: BinMapper) -> bool:
     return bool(np.all(m.num_bins <= 1)) and bool(np.all(np.isinf(m.upper_bounds)))
 
 
+def _replay_margin(b: Booster, X: np.ndarray) -> np.ndarray:
+    """Warm-start margin re-based in the TRAINING accumulation order.
+
+    The train loop advances scores one f32 add per tree
+    (``scores + leaf_value[node_id]``); ``predict_margin``'s fused
+    traversal reassociates the tree sum, which drifts by ulps and makes
+    an otherwise-deterministic gbdt/goss resume diverge from the
+    uninterrupted run on near-tie splits.  Replaying per-tree leaf values
+    sequentially in f32 reproduces training's exact rounding, so the
+    resumed run continues bit-identically.  dart/rf reweight trees at
+    predict time — their resume is documented-approximate, use the fused
+    path."""
+    if b.config.boosting_type not in ("gbdt", "goss") \
+            or any(w != 1.0 for w in b.tree_weights):
+        return b.predict_margin(X)
+    _, leaves = b.predict_margin(X, return_leaves=True)
+    n = len(X)
+    K = max(b.num_class, 1)
+    cols = []
+    for k in range(K):
+        base = b.init_score[min(k, len(b.init_score) - 1)]
+        m = np.full(n, np.float32(base), np.float32)
+        ids = leaves[k]                          # (T_k, n) leaf node ids
+        ktrees = [t for t, kc in zip(b.trees, b.tree_class) if kc == k] \
+            if K > 1 else b.trees
+        for t, tree in enumerate(ktrees):
+            m = m + np.asarray(tree.leaf_value, np.float32)[ids[t]]
+        cols.append(m)
+    return cols[0] if K == 1 else np.stack(cols, axis=1)
+
+
 def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
           sample_weight: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = None,
@@ -884,6 +921,12 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     import time as _time
     measures = InstrumentationMeasures()
     _t0 = _time.perf_counter()
+    # ``checkpoint_dir`` also accepts a core.checkpoint.CheckpointManager
+    # (anything carrying ``.directory``): preemption-tolerant callers hand
+    # the same manager to every trainer and the booster writes its
+    # iteration checkpoints into its directory
+    if checkpoint_dir is not None and not isinstance(checkpoint_dir, str):
+        checkpoint_dir = getattr(checkpoint_dir, "directory", checkpoint_dir)
     if checkpoint_dir and checkpoint_interval > 0:
         # dart resume uses the warm-start (init_model) semantics LightGBM
         # itself documents as APPROXIMATE: the carried trees are frozen
@@ -1069,7 +1112,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 [init_model.predict_margin(cx)
                  for cx, _, _ in source.iter_chunks()])
         else:
-            base_margin = init_model.predict_margin(X)
+            base_margin = _replay_margin(init_model, X)
         init_sc = init_model.init_score
     elif (config.boost_from_average
           and config.objective not in ("multiclass", "multiclassova")):

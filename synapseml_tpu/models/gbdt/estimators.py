@@ -101,6 +101,11 @@ class GBDTParams(Params):
             "reference's numBatches warm start)")
     checkpointInterval = IntParam(doc="save every N boosting iterations "
                                       "(0 = off)", default=0)
+    checkpointManager = PyObjectParam(
+        doc="core.checkpoint.CheckpointManager to write iteration "
+            "checkpoints through (overrides checkpointDir) — the "
+            "preemption-tolerant fit surface: re-fit with the same "
+            "manager resumes from its latest step")
     monotoneConstraints = ListParam(
         doc="per-feature monotone direction {-1, 0, 1} "
             "(monotoneConstraints parity, params/LightGBMParams.scala:"
@@ -272,7 +277,9 @@ class GBDTClassifier(GBDTParams, Estimator):
 
         booster, history = _train_batched(
             X, y, cfg, w, valid, self.numBatches, self._mesh(len(X)),
-            seed=self.seed, checkpoint_dir=self.get("checkpointDir"),
+            seed=self.seed,
+            checkpoint_dir=(self.get("checkpointManager")
+                            or self.get("checkpointDir")),
             checkpoint_interval=int(self.checkpointInterval))
         model = GBDTClassificationModel(
             boosterModel=booster,
@@ -363,7 +370,9 @@ class GBDTRegressor(GBDTParams, Estimator):
                      if self.weightCol else None)
         booster, history = _train_batched(
             X, y, cfg, w, valid, self.numBatches, self._mesh(len(X)),
-            seed=self.seed, checkpoint_dir=self.get("checkpointDir"),
+            seed=self.seed,
+            checkpoint_dir=(self.get("checkpointManager")
+                            or self.get("checkpointDir")),
             checkpoint_interval=int(self.checkpointInterval))
         model = GBDTRegressionModel(
             boosterModel=booster,
@@ -427,7 +436,10 @@ class GBDTRanker(GBDTParams, Estimator):
         booster, history = train(
             X, y, cfg, sample_weight=w, valid=valid,
             mesh=self._mesh(len(X)),   # whole groups pack onto shards
-            group=counts, valid_group=vgroups)
+            group=counts, valid_group=vgroups,
+            checkpoint_dir=(self.get("checkpointManager")
+                            or self.get("checkpointDir")),
+            checkpoint_interval=int(self.checkpointInterval))
         model = GBDTRankerModel(
             boosterModel=booster,
             featuresCol=self.featuresCol,

@@ -14,6 +14,15 @@ This is how multi-host tests and the distributed-serving harness execute for
 real on one machine: N processes x M virtual CPU devices per process form a
 genuine cross-process mesh (gloo collectives), the same code path a multi-host
 TPU pod takes (PJRT collectives over ICI/DCN).
+
+Failure handling: a failed attempt raises :class:`WorkerFailure` carrying a
+structured per-rank cause map (``timeout`` / ``exit <code>`` / ``no
+result``) with every rank's log tail — the reference's NetworkManager
+retries its rendezvous socket (NetworkManager.scala:294-340) and so does
+this driver: pass a :class:`~synapseml_tpu.resilience.RetryPolicy` and the
+whole launch (fresh coordinator port, fresh processes) retries under its
+backoff, since a partial cluster cannot be patched rank-by-rank once
+``jax.distributed`` has formed.
 """
 
 from __future__ import annotations
@@ -26,6 +35,9 @@ import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
+
+from ..resilience import RetryPolicy, get_faults
+from ..telemetry import get_registry
 
 #: marker the worker prints in front of its JSON result line
 RESULT_MARKER = "SMLMP_RESULT:"
@@ -40,35 +52,47 @@ def find_free_port() -> int:
         return s.getsockname()[1]
 
 
-class WorkerFailure(RuntimeError):
-    """A worker exited non-zero or produced no result."""
+def _rank_causes(returncodes: Dict[int, Optional[int]],
+                 timed_out: Sequence[int],
+                 missing_result: Sequence[int]) -> Dict[int, str]:
+    """Structured per-rank failure causes (only failed ranks appear)."""
+    causes: Dict[int, str] = {}
+    for r in timed_out:
+        causes[r] = "timeout"
+    for r, rc in returncodes.items():
+        if r not in causes and rc not in (0, None):
+            causes[r] = f"exit {rc}"
+    for r in missing_result:
+        causes.setdefault(r, "no result")
+    return causes
 
-    def __init__(self, msg: str, logs: Dict[int, str]):
+
+class WorkerFailure(RuntimeError):
+    """A worker exited non-zero, timed out, or produced no result.
+
+    ``causes`` maps failed rank → cause string; ``logs`` maps every rank
+    → its captured output."""
+
+    def __init__(self, msg: str, logs: Dict[int, str],
+                 causes: Optional[Dict[int, str]] = None):
+        self.causes = dict(causes or {})
+        if self.causes:
+            msg += "\nper-rank causes: " + ", ".join(
+                f"rank {r}: {c}" for r, c in sorted(self.causes.items()))
         super().__init__(msg + "\n" + "\n".join(
             f"--- rank {r} log (tail) ---\n{t[-4000:]}" for r, t in logs.items()))
         self.logs = logs
 
 
-def run_on_local_cluster(task: str,
-                         n_processes: int = 2,
-                         devices_per_process: int = 2,
-                         task_args: Any = None,
-                         timeout_s: float = 300.0,
-                         env_extra: Optional[Dict[str, str]] = None,
-                         ) -> List[Any]:
-    """Run ``module:function`` on a real N-process JAX cluster; return the
-    per-rank results (rank order).
-
-    Each rank is an OS process that rendezvouses through
-    ``initialize_cluster`` (parallel/distributed.py) against a localhost
-    coordinator, sees the global ``n_processes * devices_per_process``-device
-    table, and runs ``function(task_args)`` with collectives live across
-    process boundaries.  The function must return something JSON-serializable.
-
-    This mirrors the reference driver's role in every local multi-task test
-    (NetworkManager.scala:294-340): spawn workers, hand them the coordinator,
-    wait, surface failures with worker logs attached.
-    """
+def _launch_once(task: str, n_processes: int, devices_per_process: int,
+                 task_args: Any, timeout_s: float,
+                 env_extra: Optional[Dict[str, str]]) -> List[Any]:
+    """One rendezvous attempt: spawn, wait, collect (or WorkerFailure)."""
+    # fault site: an armed rule here stands in for a failed rendezvous
+    # without burning real subprocess spawns in tests
+    if get_faults().check("launcher.attempt") is not None:
+        raise WorkerFailure("injected rendezvous failure", {},
+                            causes={r: "injected" for r in range(n_processes)})
     port = find_free_port()
     coordinator = f"127.0.0.1:{port}"
     procs: List[subprocess.Popen] = []
@@ -120,21 +144,75 @@ def run_on_local_cluster(task: str,
                     p.kill()
         for t in readers:
             t.join(timeout=10.0)
+        returncodes = {r: p.returncode for r, p in enumerate(procs)}
         if timed_out:
             raise WorkerFailure(
-                f"ranks {timed_out} timed out after {timeout_s:.0f}s", logs)
+                f"ranks {timed_out} timed out after {timeout_s:.0f}s", logs,
+                causes=_rank_causes(returncodes, timed_out, []))
+        failed = [r for r, rc in returncodes.items() if rc != 0]
+        if failed:
+            raise WorkerFailure(
+                f"ranks {failed} exited non-zero", logs,
+                causes=_rank_causes(returncodes, [], []))
         results: List[Any] = []
+        missing: List[int] = []
         for rank, p in enumerate(procs):
-            if p.returncode != 0:
-                raise WorkerFailure(
-                    f"rank {rank} exited {p.returncode}", logs)
             lines = [ln for ln in logs[rank].splitlines()
                      if ln.startswith(RESULT_MARKER)]
             if not lines:
-                raise WorkerFailure(f"rank {rank} produced no result", logs)
-            results.append(json.loads(lines[-1][len(RESULT_MARKER):]))
+                missing.append(rank)
+                results.append(None)
+            else:
+                results.append(json.loads(lines[-1][len(RESULT_MARKER):]))
+        if missing:
+            raise WorkerFailure(
+                f"ranks {missing} produced no result", logs,
+                causes=_rank_causes(returncodes, [], missing))
         return results
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def run_on_local_cluster(task: str,
+                         n_processes: int = 2,
+                         devices_per_process: int = 2,
+                         task_args: Any = None,
+                         timeout_s: float = 300.0,
+                         env_extra: Optional[Dict[str, str]] = None,
+                         retry_policy: Optional[RetryPolicy] = None,
+                         ) -> List[Any]:
+    """Run ``module:function`` on a real N-process JAX cluster; return the
+    per-rank results (rank order).
+
+    Each rank is an OS process that rendezvouses through
+    ``initialize_cluster`` (parallel/distributed.py) against a localhost
+    coordinator, sees the global ``n_processes * devices_per_process``-device
+    table, and runs ``function(task_args)`` with collectives live across
+    process boundaries.  The function must return something JSON-serializable.
+
+    ``retry_policy``: on :class:`WorkerFailure` the WHOLE launch retries
+    (fresh port, fresh processes) under the policy's backoff — a formed
+    ``jax.distributed`` cluster cannot re-admit a replacement rank, so
+    whole-gang restart is the only sound retry unit.  The raised failure
+    (when retries exhaust) is the LAST attempt's, with per-rank causes.
+    """
+    attempts = 1 + (retry_policy.max_retries if retry_policy else 0)
+    reg = get_registry()
+    m_retries = reg.counter("launcher_rendezvous_retries_total",
+                            "whole-gang launch retries", ("task",))
+    last: Optional[WorkerFailure] = None
+    for attempt in range(attempts):
+        try:
+            return _launch_once(task, n_processes, devices_per_process,
+                                task_args, timeout_s, env_extra)
+        except WorkerFailure as e:
+            last = e
+            if retry_policy is None or attempt >= attempts - 1 \
+                    or not retry_policy.acquire_retry():
+                raise
+            m_retries.inc(1, task=task)
+            retry_policy.sleep(retry_policy.backoff_s(attempt),
+                               site="launcher.backoff")
+    raise last  # pragma: no cover — loop always returns or raises

@@ -1,0 +1,44 @@
+"""Unified resilience: retry/deadline policies, circuit breakers,
+deterministic fault injection, and serving health/drain.
+
+One subsystem every layer routes failures through (the counterpart of
+:mod:`synapseml_tpu.telemetry` for the failure path):
+
+- :mod:`.policy` — :class:`RetryPolicy` (exponential backoff + full
+  jitter, ``Retry-After`` honoring, shared :class:`RetryBudget`) and
+  :class:`Deadline` objects that propagate remaining time through
+  nested calls.
+- :mod:`.breaker` — per-endpoint :class:`CircuitBreaker`
+  (closed → open → half-open) exported to ``/metrics``.
+- :mod:`.faults` — the seeded :class:`FaultRegistry` behind
+  ``SML_FAULTS``: injectable 429/503s, socket resets, slow responses,
+  and mid-write SIGKILL points, with a recorded sleep schedule so every
+  robustness claim is a tier-1 assertion.
+- :mod:`.health` — ``/healthz`` + ``/readyz`` reserved paths, queue-depth
+  ``Retry-After`` hints, and the graceful-drain state machine behind
+  ``ServingServer.drain()``.
+
+Stdlib-only; safe to import before (or without) jax.
+
+Consumers: ``io.http.HTTPClient`` / ``HTTPTransformer`` (policy, breaker,
+deadline), ``services.base.RemoteServiceTransformer`` (policy, breaker),
+``serving`` (health, drain, client reconnect), ``parallel.launcher``
+(rendezvous retry), ``core.checkpoint`` + the GBDT/DL trainers
+(preemption kill points, resume).
+"""
+
+from .breaker import CircuitBreaker, CircuitOpenError, breaker_for
+from .faults import (FAULTS_ENV, FAULTS_SEED_ENV, FaultRegistry, FaultRule,
+                     PreemptionError, get_faults)
+from .health import HealthState, retry_after_from_depth
+from .policy import (RETRY_STATUSES, Deadline, RetryBudget, RetryPolicy,
+                     parse_retry_after)
+
+__all__ = [
+    "RetryPolicy", "RetryBudget", "Deadline", "RETRY_STATUSES",
+    "parse_retry_after",
+    "CircuitBreaker", "CircuitOpenError", "breaker_for",
+    "FaultRegistry", "FaultRule", "PreemptionError", "get_faults",
+    "FAULTS_ENV", "FAULTS_SEED_ENV",
+    "HealthState", "retry_after_from_depth",
+]

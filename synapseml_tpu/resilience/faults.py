@@ -1,0 +1,277 @@
+"""Deterministic, seedable fault injection.
+
+The robustness claims of this stack — retries converge, checkpoints
+survive SIGKILL mid-write, drains drop nothing — are only claims until a
+test can MAKE the failure happen on demand.  This registry is the one
+place failures are manufactured: call sites (``io.http``, ``serving``,
+``core.checkpoint``, the trainers, the launcher) consult it at named
+**sites**, and a test (or the ``SML_FAULTS`` env var) arms rules that
+fire deterministically — same seed + same call order ⇒ same schedule.
+
+Inactive cost is one attribute read per site (no rules ⇒ ``check``
+returns immediately), so the hooks stay in production code paths.
+
+Fault kinds:
+
+==============  ============================================================
+``http_429``    synthetic 429 response (optionally with ``retry_after``)
+``http_503``    synthetic 503 response (optionally with ``retry_after``)
+``http_500``    synthetic 500 response
+``reset``       ``ConnectionResetError`` at the site
+``broken_pipe``  ``BrokenPipeError`` at the site
+``error``       generic ``OSError`` (the site decides how to surface it)
+``slow``        sleep ``delay`` seconds before proceeding normally
+``preempt``     raise :class:`PreemptionError` (a soft TPU preemption)
+``kill``        ``SIGKILL`` the current process (a hard preemption)
+==============  ============================================================
+
+Rule grammar (``SML_FAULTS``, rules joined by ``;``)::
+
+    site=kind[:key=value[:key=value...]]
+
+with keys ``times`` (max firings, default unlimited), ``after`` (skip the
+first N matching calls), ``p`` (firing probability, drawn from the seeded
+RNG), ``delay`` (seconds, for ``slow``), ``status`` (override the HTTP
+code) and ``retry_after`` (seconds, emitted as a ``Retry-After`` header).
+``SML_FAULTS_SEED`` seeds the RNG (default 0).  Example::
+
+    SML_FAULTS="http.send=http_503:times=2:retry_after=0.05;gbdt.checkpoint=kill:after=1:times=1"
+
+Sites are matched with ``fnmatch`` globs, so ``http.*`` arms every HTTP
+site.  Every backoff in the stack routes through :meth:`FaultRegistry.
+sleep`, which records ``(site, seconds)`` into :attr:`sleep_log` — tests
+assert the retry schedule itself (jitter bounds, Retry-After honoring)
+instead of wall-clocking it.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["FaultRule", "FaultRegistry", "PreemptionError", "get_faults",
+           "FAULTS_ENV", "FAULTS_SEED_ENV"]
+
+FAULTS_ENV = "SML_FAULTS"
+FAULTS_SEED_ENV = "SML_FAULTS_SEED"
+
+#: kinds that surface as synthetic HTTP responses
+HTTP_KINDS = {"http_429": 429, "http_503": 503, "http_500": 500}
+
+
+class PreemptionError(RuntimeError):
+    """Injected soft preemption — the in-process stand-in for the SIGKILL
+    a real TPU preemption delivers (tests that need the hard version use
+    kind ``kill`` in a subprocess)."""
+
+
+@dataclass
+class FaultRule:
+    """One armed fault: fire ``kind`` at calls matching ``site``."""
+    site: str
+    kind: str
+    times: Optional[int] = None      # max firings (None = unlimited)
+    after: int = 0                   # skip the first N matching calls
+    p: float = 1.0                   # firing probability (seeded RNG)
+    delay_s: float = 0.0             # for kind="slow"
+    status: Optional[int] = None     # HTTP code override
+    retry_after_s: Optional[float] = None
+    #: bookkeeping (mutated under the registry lock)
+    matched: int = 0
+    fired: int = 0
+
+
+class FaultRegistry:
+    """Process-wide fault switchboard (see module docstring)."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.RLock()
+        self._rules: List[FaultRule] = []
+        self._rng = random.Random(seed)
+        self._seed = seed
+        #: (site, seconds) of every routed sleep, in call order
+        self.sleep_log: List[Tuple[str, float]] = []
+        #: True ⇒ record sleeps without actually sleeping (fast tests)
+        self.no_sleep = False
+        self._env_loaded = False
+
+    # -- arming ------------------------------------------------------------
+    def inject(self, site: str, kind: str, times: Optional[int] = None,
+               after: int = 0, p: float = 1.0, delay_s: float = 0.0,
+               status: Optional[int] = None,
+               retry_after_s: Optional[float] = None) -> FaultRule:
+        rule = FaultRule(site, kind, times, after, p, delay_s, status,
+                         retry_after_s)
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def configure(self, spec: str, seed: Optional[int] = None) -> None:
+        """Arm rules from an ``SML_FAULTS``-grammar string."""
+        if seed is not None:
+            self.seed(seed)
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            site, _, rest = part.partition("=")
+            bits = rest.split(":")
+            kind = bits[0].strip()
+            kw: Dict[str, object] = {}
+            for opt in bits[1:]:
+                k, _, v = opt.partition("=")
+                k = k.strip()
+                if k == "times":
+                    kw["times"] = int(v)
+                elif k == "after":
+                    kw["after"] = int(v)
+                elif k == "p":
+                    kw["p"] = float(v)
+                elif k == "delay":
+                    kw["delay_s"] = float(v)
+                elif k == "status":
+                    kw["status"] = int(v)
+                elif k == "retry_after":
+                    kw["retry_after_s"] = float(v)
+                else:
+                    raise ValueError(f"unknown fault option {k!r} in {part!r}")
+            self.inject(site.strip(), kind, **kw)
+
+    def configure_from_env(self) -> None:
+        """(Re)load rules from ``SML_FAULTS`` / ``SML_FAULTS_SEED``."""
+        spec = os.environ.get(FAULTS_ENV, "")
+        seed = int(os.environ.get(FAULTS_SEED_ENV, "0") or 0)
+        if spec:
+            self.configure(spec, seed=seed)
+        self._env_loaded = True
+
+    def seed(self, n: int) -> None:
+        with self._lock:
+            self._seed = n
+            self._rng = random.Random(n)
+
+    def clear(self) -> None:
+        """Drop every rule and the sleep log (registrations in telemetry
+        are untouched); re-seeds the RNG so schedules restart."""
+        with self._lock:
+            self._rules = []
+            self.sleep_log = []
+            self.no_sleep = False
+            self._rng = random.Random(self._seed)
+
+    @property
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    def rules(self) -> List[FaultRule]:
+        with self._lock:
+            return list(self._rules)
+
+    # -- firing ------------------------------------------------------------
+    def check(self, site: str, **ctx) -> Optional[FaultRule]:
+        """First armed rule firing at this call of ``site`` (None when
+        nothing fires).  Deterministic: match counters advance per rule,
+        probability draws come from the seeded RNG in call order."""
+        if not self._rules:            # fast inactive path, no lock
+            return None
+        with self._lock:
+            for rule in self._rules:
+                if not fnmatch.fnmatch(site, rule.site):
+                    continue
+                rule.matched += 1
+                if rule.matched <= rule.after:
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.p < 1.0 and self._rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                return rule
+        return None
+
+    def raise_point(self, site: str, **ctx) -> None:
+        """Fire raise-style kinds at this site (``reset``, ``broken_pipe``,
+        ``error``, ``preempt``); ``slow`` sleeps; HTTP kinds are ignored
+        here (they only make sense where a response can be fabricated)."""
+        rule = self.check(site, **ctx)
+        if rule is None:
+            return
+        self._execute_raise(site, rule)
+
+    def kill_point(self, site: str, **ctx) -> None:
+        """Fire process-death kinds at this site: ``kill`` SIGKILLs the
+        process (no cleanup, no atexit — exactly a preemption), ``preempt``
+        raises :class:`PreemptionError`; other raise kinds also apply."""
+        rule = self.check(site, **ctx)
+        if rule is None:
+            return
+        if rule.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._execute_raise(site, rule)
+
+    def _execute_raise(self, site: str, rule: FaultRule) -> None:
+        if rule.kind == "slow":
+            self.sleep(rule.delay_s, site=site)
+        elif rule.kind == "reset":
+            raise ConnectionResetError(f"injected connection reset at {site}")
+        elif rule.kind == "broken_pipe":
+            raise BrokenPipeError(f"injected broken pipe at {site}")
+        elif rule.kind == "error":
+            raise OSError(f"injected fault at {site}")
+        elif rule.kind == "preempt":
+            raise PreemptionError(f"injected preemption at {site}")
+
+    def http_fault(self, site: str, **ctx) -> Optional[Tuple[int, Dict[str, str]]]:
+        """HTTP-shaped firing: returns ``(status, headers)`` for a
+        synthetic error response, raises for connection kinds, sleeps for
+        ``slow`` (then returns None so the real request proceeds)."""
+        rule = self.check(site, **ctx)
+        if rule is None:
+            return None
+        if rule.kind in HTTP_KINDS:
+            status = rule.status or HTTP_KINDS[rule.kind]
+            headers: Dict[str, str] = {}
+            if rule.retry_after_s is not None:
+                headers["Retry-After"] = str(rule.retry_after_s)
+            return status, headers
+        self._execute_raise(site, rule)
+        return None
+
+    # -- recorded sleep ----------------------------------------------------
+    def sleep(self, seconds: float, site: str = "backoff") -> None:
+        """The stack's ONE sleep primitive for backoff: records the
+        schedule (always) and sleeps (unless ``no_sleep``).  Tests assert
+        jitter bounds and Retry-After honoring on :attr:`sleep_log`."""
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            self.sleep_log.append((site, seconds))
+        if seconds > 0 and not self.no_sleep:
+            time.sleep(seconds)
+
+    def sleeps_for(self, site: str) -> List[float]:
+        with self._lock:
+            return [s for (st, s) in self.sleep_log
+                    if fnmatch.fnmatch(st, site)]
+
+
+_registry: Optional[FaultRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_faults() -> FaultRegistry:
+    """The process-wide registry; arms ``SML_FAULTS`` rules on first use."""
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                reg = FaultRegistry(
+                    seed=int(os.environ.get(FAULTS_SEED_ENV, "0") or 0))
+                reg.configure_from_env()
+                _registry = reg
+    return _registry

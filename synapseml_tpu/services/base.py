@@ -18,10 +18,12 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.dataset import Dataset
-from ..core.params import DictParam, IntParam, Param, StringParam
+from ..core.params import (DictParam, IntParam, Param, PyObjectParam,
+                           StringParam)
 from ..core.pipeline import Transformer
 from ..io.http import (HTTPClient, HTTPRequestData, HTTPResponseData,
                        HTTPTransformer, JSONOutputParser)
+from ..resilience import breaker_for
 
 
 class ServiceParam(Param):
@@ -90,6 +92,12 @@ class RemoteServiceTransformer(HasServiceParams, Transformer):
     errorCol = StringParam(doc="error column", default="errors")
     concurrency = IntParam(doc="concurrent requests", default=1)
     retries = IntParam(doc="retry count on 429/5xx", default=3)
+    retryPolicy = PyObjectParam(
+        doc="RetryPolicy overriding `retries` (exponential backoff + full "
+            "jitter, Retry-After honoring, optional shared RetryBudget)")
+    breaker = PyObjectParam(
+        doc="CircuitBreaker for this endpoint; True = share the "
+            "process-wide breaker keyed by the service URL")
 
     #: subclasses whose response entity is not JSON (audio, thumbnails)
     #: set this True to surface raw bytes in ``outputCol``
@@ -113,9 +121,14 @@ class RemoteServiceTransformer(HasServiceParams, Transformer):
             req = self.prepare_request(row)
             req.headers.update(self._auth_headers(row))
             reqs[i] = req
+        breaker = self.get("breaker")
+        if breaker is True:          # opt into the per-endpoint shared one
+            breaker = breaker_for(self.url or type(self).__name__)
         http = HTTPTransformer(inputCol="_req", outputCol="_resp",
                                concurrency=int(self.concurrency),
-                               retries=int(self.retries))
+                               retries=int(self.retries),
+                               retryPolicy=self.get("retryPolicy"),
+                               breaker=breaker)
         scored = http.transform(ds.with_column("_req", reqs))
         parse_json = JSONOutputParser()
         out = np.empty(ds.num_rows, dtype=object)

@@ -22,6 +22,13 @@ JVM hosting MULTIPLE named APIs).  Here the source/sink pair is explicit:
   text (JSON with ``?format=json``), and serving loops feed it
   per-API record counters, batch-size histograms, and a records/sec
   throughput gauge.
+- ``GET /healthz`` and ``GET /readyz`` are likewise RESERVED
+  (:mod:`synapseml_tpu.resilience.health`): liveness is the listener
+  answering at all; readiness flips to 503 + ``Retry-After`` while
+  draining.  Load-shedding 503s (saturated queue, stale batch) carry a
+  ``Retry-After`` computed from queue depth over the observed drain
+  rate, and :meth:`ServingServer.drain` stops accepting, flushes every
+  accepted in-flight exchange, then closes — zero dropped work.
 """
 
 from __future__ import annotations
@@ -40,6 +47,7 @@ import numpy as np
 
 from ..core.dataset import Dataset
 from ..core.pipeline import Transformer
+from ..resilience.health import HealthState, retry_after_from_depth
 from ..telemetry import (PROMETHEUS_CONTENT_TYPE, get_registry, render_json,
                          render_prometheus)
 
@@ -157,6 +165,10 @@ class ServingServer:
     balancer).  The single-API constructor arguments keep the original
     one-endpoint usage working unchanged."""
 
+    #: process-wide instance counter — names each server's health series
+    _instances = 0
+    _instances_lock = threading.Lock()
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout_s: float = 30.0,
                  max_queue: int = 1024,
@@ -167,6 +179,11 @@ class ServingServer:
         self.api_path = api_path.rstrip("/") or "/"
         self._apis: Dict[str, ApiHandle] = {}
         self._apis_lock = threading.Lock()
+        with ServingServer._instances_lock:
+            ServingServer._instances += 1
+            self.health = HealthState(f"serving-{ServingServer._instances}")
+        #: accepted exchanges not yet fully written back (loop-thread only)
+        self._inflight = 0
         self._default = self.register_api(self.api_path, max_queue,
                                           reply_timeout_s)
         self._addr: Tuple[str, int] = (host, port)
@@ -261,51 +278,60 @@ class ServingServer:
                         await self._write_413(writer)
                         break
                     body = await reader.readexactly(length) if length else b""
-                status, rbody, rheaders = await self._dispatch(
-                    method, path, headers, body)
-                keep = headers.get("connection", "").lower() != "close"
-                reason = _http_reasons.get(status, "Unknown")
-                head = [f"HTTP/1.1 {status} {reason}"]
-                ctype_set = False
-                for k, v in rheaders.items():
-                    head.append(f"{k}: {v}")
-                    ctype_set = ctype_set or k.lower() == "content-type"
-                if not ctype_set:
-                    head.append("Content-Type: application/json")
-                if isinstance(rbody, (bytes, bytearray)):
-                    head.append(f"Content-Length: {len(rbody)}")
-                    head.append("Connection: " + ("keep-alive" if keep
-                                                  else "close"))
-                    writer.write(("\r\n".join(head) + "\r\n\r\n")
-                                 .encode("latin1") + bytes(rbody))
-                    await writer.drain()
-                else:
-                    # streaming reply: an ITERABLE body goes out with
-                    # chunked transfer-encoding (the reference's
-                    # continuous-mode reply stream)
-                    head.append("Transfer-Encoding: chunked")
-                    head.append("Connection: " + ("keep-alive" if keep
-                                                  else "close"))
-                    writer.write(("\r\n".join(head) + "\r\n\r\n")
-                                 .encode("latin1"))
-                    # pull chunks on a worker thread: a generator that
-                    # blocks between yields (live token streams) must not
-                    # stall the event loop for every other connection
-                    it = iter(rbody)
-                    _end = object()
-                    while True:
-                        chunk = await self._loop.run_in_executor(
-                            None, next, it, _end)
-                        if chunk is _end:
-                            break
-                        chunk = bytes(chunk)
-                        if not chunk:
-                            continue
-                        writer.write(f"{len(chunk):x}\r\n".encode("latin1")
-                                     + chunk + b"\r\n")
+                # in-flight from dispatch until the reply is fully written:
+                # drain() waits on this so an accepted exchange can never
+                # lose the race between computing its reply and the
+                # listener closing
+                self._inflight += 1
+                try:
+                    status, rbody, rheaders = await self._dispatch(
+                        method, path, headers, body)
+                    keep = headers.get("connection", "").lower() != "close"
+                    reason = _http_reasons.get(status, "Unknown")
+                    head = [f"HTTP/1.1 {status} {reason}"]
+                    ctype_set = False
+                    for k, v in rheaders.items():
+                        head.append(f"{k}: {v}")
+                        ctype_set = ctype_set or k.lower() == "content-type"
+                    if not ctype_set:
+                        head.append("Content-Type: application/json")
+                    if isinstance(rbody, (bytes, bytearray)):
+                        head.append(f"Content-Length: {len(rbody)}")
+                        head.append("Connection: " + ("keep-alive" if keep
+                                                      else "close"))
+                        writer.write(("\r\n".join(head) + "\r\n\r\n")
+                                     .encode("latin1") + bytes(rbody))
                         await writer.drain()
-                    writer.write(b"0\r\n\r\n")
-                    await writer.drain()
+                    else:
+                        # streaming reply: an ITERABLE body goes out with
+                        # chunked transfer-encoding (the reference's
+                        # continuous-mode reply stream)
+                        head.append("Transfer-Encoding: chunked")
+                        head.append("Connection: " + ("keep-alive" if keep
+                                                      else "close"))
+                        writer.write(("\r\n".join(head) + "\r\n\r\n")
+                                     .encode("latin1"))
+                        # pull chunks on a worker thread: a generator that
+                        # blocks between yields (live token streams) must
+                        # not stall the event loop for every other
+                        # connection
+                        it = iter(rbody)
+                        _end = object()
+                        while True:
+                            chunk = await self._loop.run_in_executor(
+                                None, next, it, _end)
+                            if chunk is _end:
+                                break
+                            chunk = bytes(chunk)
+                            if not chunk:
+                                continue
+                            writer.write(f"{len(chunk):x}\r\n".encode("latin1")
+                                         + chunk + b"\r\n")
+                            await writer.drain()
+                        writer.write(b"0\r\n\r\n")
+                        await writer.drain()
+                finally:
+                    self._inflight -= 1
                 if not keep:
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError,
@@ -376,24 +402,27 @@ class ServingServer:
                 item = await fifo.get()
                 if item is None:
                     return
-                if item[0] == "now":
-                    status, body = item[1]
-                else:
-                    try:
-                        rep = await self._await_reply(api, item[1])
-                        status = rep.status if rep else 500
-                        body = (rep.body if rep
-                                else b'{"error": "empty reply"}')
-                        if not isinstance(body, (bytes, bytearray)):
-                            # frames are single messages; stream bodies
-                            # (iterables) concatenate
-                            body = b"".join(bytes(c) for c in body)
-                    except asyncio.TimeoutError:
-                        status = 504
-                        body = b'{"error": "serving pipeline timeout"}'
-                writer.write(struct.pack("<IH", 2 + len(body), status)
-                             + bytes(body))
-                await writer.drain()
+                try:
+                    if item[0] == "now":
+                        status, body = item[1]
+                    else:
+                        try:
+                            rep = await self._await_reply(api, item[1])
+                            status = rep.status if rep else 500
+                            body = (rep.body if rep
+                                    else b'{"error": "empty reply"}')
+                            if not isinstance(body, (bytes, bytearray)):
+                                # frames are single messages; stream bodies
+                                # (iterables) concatenate
+                                body = b"".join(bytes(c) for c in body)
+                        except asyncio.TimeoutError:
+                            status = 504
+                            body = b'{"error": "serving pipeline timeout"}'
+                    writer.write(struct.pack("<IH", 2 + len(body), status)
+                                 + bytes(body))
+                    await writer.drain()
+                finally:
+                    self._inflight -= 1        # enqueued by the read loop
 
         wtask = asyncio.ensure_future(write_replies())
 
@@ -436,9 +465,19 @@ class ServingServer:
                 (ln,) = struct.unpack("<I", hdr)
                 if ln > self.max_body_bytes:
                     if not wtask.done():
-                        await fifo_put(("now", (413, b"")))
+                        self._inflight += 1
+                        if not await fifo_put(("now", (413, b""))):
+                            self._inflight -= 1
                     break
                 payload = await reader.readexactly(ln) if ln else b""
+                if not self.health.ready:      # draining: shed new frames
+                    self._inflight += 1
+                    if not await fifo_put(
+                            ("now", (503, b'{"error": "server '
+                                          b'draining"}'))):
+                        self._inflight -= 1
+                        break
+                    continue
                 req = ServingRequest(id=f"{conn}:{seq}", method="FRAME",
                                      path=path, headers={}, body=payload)
                 seq += 1
@@ -448,12 +487,16 @@ class ServingServer:
                         api.forget(req.id)
                     break
                 if ex is None:                          # backpressure
+                    self._inflight += 1
                     if not await fifo_put(
                             ("now", (503, b'{"error": "serving queue '
                                           b'saturated"}'))):
+                        self._inflight -= 1
                         break
                     continue
+                self._inflight += 1
                 if not await fifo_put(("ex", ex)):      # writer died
+                    self._inflight -= 1
                     api.forget(req.id)
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError,
@@ -472,8 +515,10 @@ class ServingServer:
                 # runs even when wtask re-raises something unexpected
                 while not fifo.empty():
                     item = fifo.get_nowait()
-                    if item is not None and item[0] == "ex":
-                        api.forget(item[1].request.id)
+                    if item is not None:
+                        self._inflight -= 1     # writer never consumed it
+                        if item[0] == "ex":
+                            api.forget(item[1].request.id)
 
     async def _write_413(self, writer: asyncio.StreamWriter) -> None:
         writer.write(b"HTTP/1.1 413 Payload Too Large\r\n"
@@ -508,9 +553,44 @@ class ServingServer:
                 break
         return b"".join(parts)
 
+    # -- health / load-shedding helpers ------------------------------------
+    def _queue_depth(self) -> int:
+        """Accepted-but-unanswered work across every API.  ``_pending``
+        alone is exact: submit registers there BEFORE the queue put and
+        entries leave only on reply/forget, so queued exchanges are a
+        subset (adding ``_queue.qsize()`` would double-count them and
+        inflate Retry-After hints up to 2x)."""
+        with self._apis_lock:
+            handles = list(self._apis.values())
+        return sum(len(h._pending) for h in handles)
+
+    def _drain_rps(self) -> float:
+        """Best observed per-API throughput — the denominator of the
+        Retry-After hint (0 when nothing has been served yet)."""
+        g = get_registry().get("serving_records_per_sec")
+        best = 0.0
+        if g is not None:
+            for _, val in g.series().items():
+                try:
+                    best = max(best, float(val))  # type: ignore[arg-type]
+                except (TypeError, ValueError):
+                    pass
+        return best
+
+    def _shed_headers(self) -> Dict[str, str]:
+        ra = retry_after_from_depth(self._queue_depth(), self._drain_rps())
+        return {"Retry-After": str(ra)}
+
     async def _dispatch(self, method: str, path: str,
                         headers: Dict[str, str], body: bytes):
         bare, _, query = path.partition("?")
+        if bare.rstrip("/") == "/healthz" and method in ("GET", "HEAD"):
+            status, hbody, hheaders = self.health.healthz()
+            return status, (b"" if method == "HEAD" else hbody), hheaders
+        if bare.rstrip("/") == "/readyz" and method in ("GET", "HEAD"):
+            status, hbody, hheaders = self.health.readyz(
+                self._queue_depth(), self._drain_rps())
+            return status, (b"" if method == "HEAD" else hbody), hheaders
         if bare.rstrip("/") == "/metrics" and method in ("GET", "HEAD"):
             # reserved exposition path (served before API routing): the
             # process metrics registry as Prometheus text, or JSON with
@@ -531,11 +611,15 @@ class ServingServer:
         api = self._route(path)
         if api is None:
             return 404, b'{"error": "no API registered at this path"}', {}
+        if not self.health.ready:                      # draining: shed new
+            return (503, b'{"error": "server draining"}',
+                    self._shed_headers())
         req = ServingRequest(id=uuid.uuid4().hex, method=method, path=path,
                              headers=headers, body=body)
         ex = api.submit(req)
         if ex is None:                                 # backpressure
-            return 503, b'{"error": "serving queue saturated"}', {}
+            return (503, b'{"error": "serving queue saturated"}',
+                    self._shed_headers())
         try:
             rep = await self._await_reply(api, ex)
         except asyncio.TimeoutError:
@@ -595,17 +679,82 @@ class ServingServer:
         return any(h.reply(request_id, reply) for h in handles
                    if h is not self._default)
 
+    #: drain must observe queues+inflight idle for this long before
+    #: closing — covers request bytes in transit that have not reached
+    #: dispatch yet (sampling a single idle instant would close under
+    #: them; a starved event loop can sit on unread requests for well
+    #: over 100 ms, so the window is generous).  A request that still
+    #: races the close gets a prompt connection-close — a retryable
+    #: transport error, which HTTPClient's policy absorbs.
+    _DRAIN_SETTLE_S = 0.2
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: immediately stop accepting NEW connections
+        (listener closed) and shed new requests/frames on existing ones
+        (503 + ``Retry-After``; readyz → 503), wait until every ACCEPTED
+        exchange has been answered and written back (queues empty,
+        pending maps empty, no reply mid-write — held for a settle
+        window), then close.
+
+        Returns True when fully drained, False when ``timeout_s`` expired
+        with work still in flight (the listener closes either way — a
+        drain must terminate)."""
+        self.health.begin_drain()
+
+        def _stop_listener():
+            if self._aserver is not None:
+                self._aserver.close()
+        try:
+            self._loop.call_soon_threadsafe(_stop_listener)
+        except RuntimeError:
+            pass                         # loop already gone
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        drained = False
+        quiet_since: Optional[float] = None
+        while True:
+            now = time.monotonic()
+            if self._queue_depth() == 0 and self._inflight == 0:
+                if quiet_since is None:
+                    quiet_since = now
+                elif now - quiet_since >= self._DRAIN_SETTLE_S:
+                    drained = True
+                    break
+            else:
+                quiet_since = None
+            if now >= deadline:
+                break
+            time.sleep(0.005)
+        self.health.finish_drain()
+        self.close()
+        return drained
+
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        self.health.mark_closed()
 
         def _stop():
             if self._aserver is not None:
                 self._aserver.close()
-            for task in asyncio.all_tasks(self._loop):
+            tasks = [t for t in asyncio.all_tasks(self._loop)
+                     if t is not asyncio.current_task(self._loop)]
+            for task in tasks:
                 task.cancel()
-            self._loop.stop()
+
+            async def _finish():
+                # let the cancellations unwind BEFORE stopping the loop:
+                # each handler's finally closes its transport, so racing
+                # clients see a prompt connection-close instead of a
+                # socket that leaks open until process exit (observed as
+                # full client-side timeouts).  Bounded: a handler parked
+                # in run_in_executor (a blocked streaming generator)
+                # cannot be interrupted by cancel — stop the loop anyway
+                # after the wait instead of hanging close() on it
+                if tasks:
+                    await asyncio.wait(tasks, timeout=2.0)
+                self._loop.stop()
+            asyncio.ensure_future(_finish(), loop=self._loop)
         try:
             self._loop.call_soon_threadsafe(_stop)
         except RuntimeError:      # loop already gone (failed start)
@@ -743,6 +892,14 @@ class PipelineServer:
     def url(self) -> str:
         return self.server.url
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: the serving loop keeps replying while the
+        server sheds new work and flushes accepted exchanges, THEN the
+        loop stops (stopping it first would deadlock the flush)."""
+        drained = self.server.drain(timeout_s)
+        self._loop.stop()
+        return drained
+
     def close(self) -> None:
         self._loop.stop()
         self.server.close()
@@ -782,6 +939,12 @@ class MultiPipelineServer:
 
     def url_for(self, path: str) -> str:
         return self.server.url_for(path)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        drained = self.server.drain(timeout_s)
+        for loop in self._loops:
+            loop.stop()
+        return drained
 
     def close(self) -> None:
         for loop in self._loops:

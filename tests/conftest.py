@@ -99,6 +99,7 @@ MODULE_COST_S = {
     "test_recommendation": 1, "test_nn": 2, "test_cyber": 2,
     "test_io_files": 2, "test_online_generic": 2, "test_core": 2,
     "test_onnx": 3, "test_io_serving": 4, "test_checkpoint": 5,
+    "test_resilience": 25,
     "test_causal": 6, "test_telemetry": 6, "test_explainers": 7,
     "test_online": 9, "test_dl": 13, "test_gbdt_categorical": 14,
     "test_pipeline_parallel": 17, "test_ops": 18,
@@ -146,6 +147,22 @@ def pytest_collection_modifyitems(config, items):
         if deselected:
             config.hook.pytest_deselected(items=deselected)
             items[:] = kept
+
+
+@pytest.fixture
+def fault_registry():
+    """The process-wide fault registry, cleared and re-seeded around each
+    test so injection schedules (probability draws, jittered backoffs
+    recorded in ``sleep_log``) are reproducible run to run.  ``no_sleep``
+    records backoffs without sleeping them — fault tests assert the
+    schedule, not the wall clock."""
+    from synapseml_tpu.resilience import get_faults
+    reg = get_faults()
+    reg.clear()
+    reg.seed(20260803)
+    reg.no_sleep = True
+    yield reg
+    reg.clear()
 
 
 @pytest.fixture(scope="session")
