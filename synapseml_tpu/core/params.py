@@ -212,11 +212,19 @@ class Params:
     # -- declaration introspection ----------------------------------------
     @classmethod
     def param_objs(cls) -> Dict[str, Param]:
+        # cached per class (params are class declarations, so the walk is
+        # invariant); cls.__dict__ lookup keeps subclasses from aliasing
+        # their parent's cache.  Callers treat the dict as read-only —
+        # this sits on the per-row hot path of the pipeline guard.
+        cached = cls.__dict__.get("_param_objs_cache")
+        if cached is not None:
+            return cached
         out: Dict[str, Param] = {}
         for klass in reversed(cls.__mro__):
             for key, val in vars(klass).items():
                 if isinstance(val, Param):
                     out[val.name] = val
+        cls._param_objs_cache = out
         return out
 
     @property

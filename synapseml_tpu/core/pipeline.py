@@ -26,8 +26,10 @@ import numpy as np
 
 from .dataset import Dataset
 from .params import (ComplexParam, DatasetParam, EstimatorParam, Param, Params,
-                     PyObjectParam, TransformerParam, UDFParam)
+                     PyObjectParam, StringParam, TransformerParam, UDFParam)
 from .logging import log_verb
+from ..resilience.rowguard import (HANDLE_INVALID_MODES, guard_context,
+                                   guarded_fit, guarded_transform)
 
 _STAGE_REGISTRY: Dict[str, type] = {}
 
@@ -54,7 +56,56 @@ def lookup_stage(name: str) -> type:
 
 
 class PipelineStage(Params):
-    """Common base: params + save/load."""
+    """Common base: params + save/load + row-level fault policy.
+
+    Every stage carries the Spark ML ``handleInvalid`` contract, enforced
+    at ``fit``/``transform`` entry by
+    :mod:`synapseml_tpu.resilience.rowguard`: ``"error"`` (default) is a
+    strict pass-through, ``"skip"`` drops rows that fail the stage
+    (NaN/Inf screens on declared input columns + poison-batch bisection
+    on stage exceptions), ``"quarantine"`` additionally dead-letters them
+    with source-row provenance for later :meth:`Quarantine.replay`.
+    """
+
+    handleInvalid = StringParam(
+        doc="row-level fault mode: 'error' raises on the first bad row "
+            "(Spark default), 'skip' drops bad rows, 'quarantine' routes "
+            "them to the dead-letter store",
+        default="error", allowed=HANDLE_INVALID_MODES)
+    quarantineDir = StringParam(
+        doc="dead-letter directory for handleInvalid='quarantine' "
+            "(default: $SML_QUARANTINE_DIR, else ./sml_quarantine)")
+
+    #: params whose values name input columns the row guard
+    #: contract-checks (existence) and screens (NaN/Inf/None) — extend
+    #: per stage family when the input lives under another name
+    _guard_input_params = ("inputCol", "inputCols")
+    _guard_fit_params = ("labelCol",)
+    #: stages whose JOB is consuming NaN (imputers, NaN-native trainers)
+    #: opt out of the NaN/Inf screen; bisection still applies
+    _guard_screen_nan = True
+    #: containers (Pipeline) that propagate the policy to their children
+    #: instead of being guarded themselves
+    _guard_exempt = False
+
+    def guard_input_columns(self, for_fit: bool = False) -> List[str]:
+        """Columns the row guard requires + screens for this invocation,
+        resolved from the declared ``_guard_input_params`` (plus
+        ``_guard_fit_params`` for ``fit``)."""
+        names = self._guard_input_params
+        if for_fit:
+            names = tuple(names) + tuple(self._guard_fit_params)
+        po = self.param_objs()
+        cols: List[str] = []
+        for name in names:
+            if name not in po:
+                continue
+            v = self.get_or_default(name)
+            if isinstance(v, str) and v:
+                cols.append(v)
+            elif isinstance(v, (list, tuple)):
+                cols.extend(c for c in v if isinstance(c, str) and c)
+        return cols
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -185,11 +236,13 @@ def load_dataset(path: str) -> Dataset:
 
 
 class Transformer(PipelineStage):
-    """ds -> ds map. Subclasses implement ``_transform``."""
+    """ds -> ds map. Subclasses implement ``_transform``; the public
+    ``transform`` routes through the row guard (a pass-through in the
+    default ``handleInvalid='error'`` mode)."""
 
     def transform(self, ds: Dataset) -> Dataset:
         with log_verb(self, "transform", n_rows=ds.num_rows):
-            return self._transform(ds)
+            return guarded_transform(self, ds)
 
     def _transform(self, ds: Dataset) -> Dataset:
         raise NotImplementedError
@@ -199,11 +252,13 @@ class Transformer(PipelineStage):
 
 
 class Estimator(PipelineStage):
-    """ds -> Model. Subclasses implement ``_fit``."""
+    """ds -> Model. Subclasses implement ``_fit``; the public ``fit``
+    routes through the row guard (a pass-through in the default
+    ``handleInvalid='error'`` mode)."""
 
     def fit(self, ds: Dataset) -> "Model":
         with log_verb(self, "fit", n_rows=ds.num_rows):
-            model = self._fit(ds)
+            model = guarded_fit(self, ds)
         model._parent_uid = self.uid
         return model
 
@@ -231,16 +286,37 @@ class Evaluator(Params):
 
 
 class Pipeline(Estimator):
-    """Sequential stage composition (Spark ML Pipeline semantics)."""
+    """Sequential stage composition (Spark ML Pipeline semantics).
+
+    A ``handleInvalid``/``quarantineDir`` set on the Pipeline propagates
+    to every stage invocation (stages with their own explicit setting
+    win), and source-row provenance is attached at entry so a row
+    quarantined N stages deep still names the PIPELINE-input row that
+    produced it."""
 
     stages = PyObjectParam(doc="ordered list of pipeline stages")
+    #: the pipeline is not itself bisected — it propagates the policy to
+    #: its children, which are
+    _guard_exempt = True
 
     def __init__(self, stages: Optional[Sequence[PipelineStage]] = None, **kw):
         super().__init__(**kw)
         if stages is not None:
             self.set("stages", list(stages))
 
+    def _guard_ctx(self):
+        mode = self._paramMap.get("handleInvalid")
+        qdir = self._paramMap.get("quarantineDir")
+        return guard_context(mode, qdir) if (mode or qdir) else None
+
     def _fit(self, ds: Dataset) -> "PipelineModel":
+        ctx = self._guard_ctx()
+        if ctx is None:
+            return self._fit_stages(ds)
+        with ctx:
+            return self._fit_stages(ds.with_source_index())
+
+    def _fit_stages(self, ds: Dataset) -> "PipelineModel":
         fitted: List[Transformer] = []
         cur = ds
         stages = self.get_or_default("stages") or []
@@ -256,11 +332,16 @@ class Pipeline(Estimator):
                     cur = stage.transform(cur)
             else:
                 raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
-        return PipelineModel(fitted)
+        model = PipelineModel(fitted)
+        for name in ("handleInvalid", "quarantineDir"):
+            if self.is_set(name):         # policy rides along to serving
+                model.set(name, self.get(name))
+        return model
 
 
 class PipelineModel(Model):
     stages = PyObjectParam(doc="ordered list of fitted transformers")
+    _guard_exempt = True
 
     def __init__(self, stages: Optional[Sequence[Transformer]] = None, **kw):
         super().__init__(**kw)
@@ -268,7 +349,15 @@ class PipelineModel(Model):
             self.set("stages", list(stages))
 
     def _transform(self, ds: Dataset) -> Dataset:
-        cur = ds
-        for stage in self.get_or_default("stages") or []:
-            cur = stage.transform(cur)
-        return cur
+        mode = self._paramMap.get("handleInvalid")
+        qdir = self._paramMap.get("quarantineDir")
+        if not (mode or qdir):
+            cur = ds
+            for stage in self.get_or_default("stages") or []:
+                cur = stage.transform(cur)
+            return cur
+        with guard_context(mode, qdir):
+            cur = ds.with_source_index()
+            for stage in self.get_or_default("stages") or []:
+                cur = stage.transform(cur)
+            return cur

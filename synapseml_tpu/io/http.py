@@ -39,6 +39,7 @@ from ..core.params import (DictParam, FloatParam, IntParam, ListParam,
 from ..core.pipeline import Transformer
 from ..resilience import (Deadline, RetryPolicy, get_faults,
                           parse_retry_after)
+from ..resilience.rowguard import HasErrorCol
 from ..telemetry import get_registry
 
 
@@ -330,16 +331,17 @@ class CustomOutputParser:
 
 
 
-class SimpleHTTPTransformer(Transformer):
+class SimpleHTTPTransformer(HasErrorCol, Transformer):
     """JSON-in / JSON-out service call per row
     (reference: SimpleHTTPTransformer.scala:65): selected input columns
     become the JSON body; the JSON response lands in ``outputCol``.
-    ``errorCol`` collects status line for failed rows (reference
-    ``HasErrorCol`` pattern)."""
+    The shared :class:`HasErrorCol` mixin collects the status line for
+    failed rows (``errorCol``, default ``"errors"``) — and under
+    ``handleInvalid='skip'/'quarantine'`` those rows leave the output via
+    the row guard instead of flowing downstream."""
 
     inputCols = ListParam(doc="columns forming the JSON request body")
     outputCol = StringParam(doc="parsed JSON output column", default="output")
-    errorCol = StringParam(doc="error column", default="errors")
     url = StringParam(doc="service endpoint")
     method = StringParam(doc="HTTP method", default="POST")
     headers = DictParam(doc="extra headers", default=None)
@@ -368,6 +370,5 @@ class SimpleHTTPTransformer(Transformer):
         errors = np.empty(ds.num_rows, dtype=object)
         for i, resp in enumerate(scored["_resp"]):
             out[i] = out_parser(resp)
-            errors[i] = (None if 200 <= resp.status_code < 300
-                         else f"{resp.status_code} {resp.reason}")
+            errors[i] = self.response_error(resp)
         return ds.with_columns({self.outputCol: out, self.errorCol: errors})

@@ -75,7 +75,31 @@ def _host_params(state: TrainState):
     return jax.tree.map(np.asarray, unboxed)
 
 
+def _batched_infer(key: str, n: int, batch_size: int,
+                   infer_chunk) -> np.ndarray:
+    """OOM-adaptive inference loop shared by the DL model transforms:
+    runs ``infer_chunk(start, size, bs)`` over ``[0, n)`` windows of
+    ``batch_size`` rows, and on XLA ``RESOURCE_EXHAUSTED`` halves the
+    batch size and reruns instead of dying (safe size remembered per
+    stage in the ``rowguard_safe_batch_size`` gauge)."""
+    from ...resilience.rowguard import oom_fault_point, run_adaptive
+
+    def run(bs: int) -> np.ndarray:
+        outs = []
+        for start in range(0, n, bs):
+            size = min(bs, n - start)
+            oom_fault_point(key, size)
+            outs.append(infer_chunk(start, size, bs))
+        return np.concatenate(outs)
+
+    return run_adaptive(key, batch_size, run)
+
+
 class _DLParamsBase(Params):
+    #: the DL stages name their inputs textCol/imageCol — declare them to
+    #: the row guard so contract checks + None screens cover them
+    _guard_input_params = ("inputCol", "inputCols", "textCol", "imageCol")
+
     labelCol = StringParam(doc="label column", default="label")
     predictionCol = StringParam(doc="prediction column", default="prediction")
     probabilityCol = StringParam(doc="probability column", default="probability")
@@ -359,19 +383,23 @@ class DeepTextModel(Model):
             return model.apply(variables, ids, mask, deterministic=True)
 
         n = len(texts)
-        bs = self.batchSize
-        logits_all = []
-        for start in range(0, n, bs):
-            chunk_ids = ids[start:start + bs]
-            chunk_mask = mask[start:start + bs]
-            if len(chunk_ids) < bs and n > bs:     # pad tail: static shapes
-                padn = bs - len(chunk_ids)
+
+        def infer_chunk(start, size, bs):
+            chunk_ids = ids[start:start + size]
+            chunk_mask = mask[start:start + size]
+            if size < bs and n > bs:               # pad tail: static shapes
+                padn = bs - size
                 chunk_ids = np.concatenate([chunk_ids, np.zeros((padn, ids.shape[1]), ids.dtype)])
                 chunk_mask = np.concatenate([chunk_mask, np.zeros((padn, mask.shape[1]), mask.dtype)])
-                logits_all.append(np.asarray(infer(chunk_ids, chunk_mask))[:bs - padn])
-            else:
-                logits_all.append(np.asarray(infer(chunk_ids, chunk_mask)))
-        logits = np.concatenate(logits_all)
+                return np.asarray(infer(chunk_ids, chunk_mask))[:size]
+            return np.asarray(infer(chunk_ids, chunk_mask))
+
+        # structural OOM key (not uid): a reloaded model keeps its
+        # discovered safe batch size, and the gauge stays bounded by the
+        # number of distinct architectures
+        key = (f"dl:text:{cfg.num_layers}l{cfg.d_model}d"
+               f"{cfg.vocab_size}v:{self.maxTokenLen}t")
+        logits = _batched_infer(key, n, int(self.batchSize), infer_chunk)
         e = np.exp(logits - logits.max(-1, keepdims=True))
         proba = e / e.sum(-1, keepdims=True)
         pred = classes[np.argmax(proba, axis=1)]
@@ -484,18 +512,19 @@ class DeepVisionModel(Model):
             return model.apply(variables, x, train=False)
 
         n = len(imgs)
-        bs = self.batchSize
-        logits_all = []
-        for start in range(0, n, bs):
-            chunk = imgs[start:start + bs]
-            if len(chunk) < bs and n > bs:
-                padn = bs - len(chunk)
+
+        def infer_chunk(start, size, bs):
+            chunk = imgs[start:start + size]
+            if size < bs and n > bs:
+                padn = bs - size
                 chunk = np.concatenate([chunk, np.zeros((padn,) + chunk.shape[1:],
                                                         chunk.dtype)])
-                logits_all.append(np.asarray(infer(chunk))[:bs - padn])
-            else:
-                logits_all.append(np.asarray(infer(chunk)))
-        logits = np.concatenate(logits_all)
+                return np.asarray(infer(chunk))[:size]
+            return np.asarray(infer(chunk))
+
+        key = (f"dl:vision:{payload['backbone']}:{len(classes)}c:"
+               f"{'x'.join(str(d) for d in imgs.shape[1:])}")
+        logits = _batched_infer(key, n, int(self.batchSize), infer_chunk)
         e = np.exp(logits - logits.max(-1, keepdims=True))
         proba = e / e.sum(-1, keepdims=True)
         pred = classes[np.argmax(proba, axis=1)]

@@ -9,11 +9,58 @@ on input shapes, so fixed-size minibatches compile exactly once.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+#: ops that MIX rows when applied over axis 0 (or over all axes, the
+#: Reduce* default) — chunking the batch through them would silently
+#: change results, so such graphs keep the raise-on-OOM behavior
+_ROW_MIXING_OPS = frozenset((
+    "ReduceSum", "ReduceMean", "ReduceMax", "ReduceMin", "ReduceProd",
+    "ReduceL1", "ReduceL2", "ReduceLogSum", "ReduceLogSumExp",
+    "ReduceSumSquare", "Softmax", "LogSoftmax", "Hardmax", "Mean",
+    "CumSum", "LpNormalization", "TopK", "ArgMax", "ArgMin",
+))
+
+
+def _mixes_batch_rows(graph) -> bool:
+    """True when any node plausibly combines values ACROSS axis 0 —
+    chunked execution would compute per-chunk statistics instead of
+    whole-batch ones.  Conservative: a hit only disables OOM chunking
+    (the call then fails like the unchunked path would)."""
+    for n in getattr(graph, "nodes", ()):
+        if n.op_type not in _ROW_MIXING_OPS:
+            continue
+        axis = n.attrs.get("axis")
+        axes = n.attrs.get("axes")
+        if axis == 0:
+            return True
+        if axes is not None and 0 in np.atleast_1d(axes):
+            return True
+        if (axis is None and axes is None
+                and n.op_type.startswith("Reduce")):
+            return True                  # Reduce* default: ALL axes
+    return False
+
+
+def _graph_oom_key(graph) -> str:
+    """Stable structural key for the OOM-safe-batch memory: the same
+    model reloaded into a fresh ``OnnxFunction`` keeps its discovered
+    safe batch size, and the process-wide memory/gauge stays bounded by
+    the number of DISTINCT graphs (``id(self)`` aliased after GC reuse;
+    a per-instance sequence forgot the size on every reload)."""
+    sig = "|".join((
+        getattr(graph, "name", "") or "graph",
+        str(len(getattr(graph, "nodes", ()))),
+        ",".join(n.op_type for n in getattr(graph, "nodes", ())[:64]),
+        ",".join(graph.input_names), ",".join(graph.output_names),
+    ))
+    return "onnx:" + hashlib.sha1(sig.encode()).hexdigest()[:12]
 
 from .graph import Graph, load_graph
 from .ops import OpCall, lower
@@ -64,7 +111,19 @@ def evaluate(graph: Graph, inputs: Dict[str, Any],
 
 
 class OnnxFunction:
-    """A compiled ONNX graph: ``fn(**inputs) -> dict`` with jit caching."""
+    """A compiled ONNX graph: ``fn(**inputs) -> dict`` with jit caching.
+
+    Calls are OOM-adaptive: when the single-dispatch path dies with XLA
+    ``RESOURCE_EXHAUSTED`` and every input shares a leading batch
+    dimension, the batch is bisected into chunks that fit (safe size
+    remembered per graph in the ``rowguard_safe_batch_size`` gauge)
+    and per-output results concatenate along axis 0 — the standard
+    batch-major, row-independent inference layout (the same assumption
+    ORT-style dynamic batching makes).  Graphs that visibly combine
+    values across axis 0 (axis-0 softmax/reductions, all-axes Reduce*)
+    are detected and never chunked — their OOM re-raises — and
+    non-batch outputs fail loudly on the concatenate rather than
+    silently mixing axes."""
 
     def __init__(self, graph: Graph, outputs: Optional[Sequence[str]] = None,
                  dtype: Optional[Any] = None):
@@ -72,6 +131,8 @@ class OnnxFunction:
         self.input_names = graph.input_names
         self.output_names = list(outputs) if outputs else graph.output_names
         self.dtype = dtype
+        self._oom_key = _graph_oom_key(graph)
+        self._chunkable = not _mixes_batch_rows(graph)
 
         def _run(inputs: Dict[str, Any]) -> Dict[str, Any]:
             out = evaluate(self.graph, inputs, self.output_names, dtype=dtype)
@@ -80,12 +141,37 @@ class OnnxFunction:
         self._jitted = jax.jit(_run)
 
     def __call__(self, **inputs) -> Dict[str, Any]:
+        from ...resilience.rowguard import oom_fault_point, run_adaptive
+
         # device arrays pass through untouched — np.asarray on a jax array
         # would DOWNLOAD it and the dispatch would re-upload (a full
         # round trip over the host<->device link per call)
         arrays = {k: v if isinstance(v, jax.Array) else np.asarray(v)
                   for k, v in inputs.items()}
-        return dict(self._jitted(arrays))
+        dims = {v.shape[0] for v in arrays.values()
+                if getattr(v, "ndim", 0) >= 1}
+        if len(dims) != 1 or next(iter(dims)) <= 1 or not self._chunkable:
+            # no shared batch axis to bisect (or the graph combines
+            # values across rows, so chunking would change results) —
+            # dispatch as-is and let an OOM surface
+            oom_fault_point(self._oom_key, 1)
+            return dict(self._jitted(arrays))
+        n = next(iter(dims))
+
+        def run(bs: int) -> Dict[str, Any]:
+            if bs >= n:
+                oom_fault_point(self._oom_key, n)
+                return dict(self._jitted(arrays))
+            outs = []
+            for s in range(0, n, bs):
+                chunk = {k: (v[s:s + bs] if getattr(v, "ndim", 0) >= 1
+                             else v) for k, v in arrays.items()}
+                oom_fault_point(self._oom_key, min(bs, n - s))
+                outs.append(self._jitted(chunk))
+            return {k: jnp.concatenate([o[k] for o in outs], axis=0)
+                    for k in outs[0]}
+
+        return run_adaptive(self._oom_key, n, run)
 
     def trace(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         """Traceable call for embedding in larger jitted programs."""

@@ -80,6 +80,11 @@ class CleanMissingData(Estimator):
     """Fill NaN/None per column with mean/median/custom
     (reference: featurize/CleanMissingData.scala)."""
 
+    #: this stage's JOB is consuming NaN — the row guard must not screen
+    #: its inputs or a pipeline-level handleInvalid='quarantine' would
+    #: dead-letter exactly the rows it exists to repair
+    _guard_screen_nan = False
+
     inputCols = ListParam(doc="columns to clean")
     outputCols = ListParam(doc="cleaned output columns")
     cleaningMode = StringParam(doc="Mean|Median|Custom", default="Mean",
@@ -112,6 +117,8 @@ class CleanMissingData(Estimator):
 
 
 class CleanMissingDataModel(Model):
+    _guard_screen_nan = False          # NaN is this model's input domain
+
     inputCols = ListParam(doc="columns to clean")
     outputCols = ListParam(doc="cleaned output columns")
     fillValues = ListParam(doc="per-column fill values")

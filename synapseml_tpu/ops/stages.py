@@ -228,7 +228,10 @@ class Explode(Transformer):
                 continue
             cols[name] = ds[name][idx]
         cols[out_name] = exploded
-        return Dataset(cols, ds.num_partitions)
+        # each exploded row descends from its parent row — quarantining
+        # an element still names the source row that carried the list
+        ri = ds.source_index[idx] if ds.has_source_index else None
+        return Dataset(cols, ds.num_partitions, row_index=ri)
 
 
 class EnsembleByKey(Transformer):

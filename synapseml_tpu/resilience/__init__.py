@@ -17,8 +17,15 @@ One subsystem every layer routes failures through (the counterpart of
 - :mod:`.health` — ``/healthz`` + ``/readyz`` reserved paths, queue-depth
   ``Retry-After`` hints, and the graceful-drain state machine behind
   ``ServingServer.drain()``.
+- :mod:`.rowguard` — row-level fault isolation for the DATA plane:
+  ``handleInvalid`` (error|skip|quarantine) enforcement on every stage,
+  poison-batch bisection, the dead-letter :class:`Quarantine` store with
+  ``replay``, OOM-adaptive batching, and the shared
+  :class:`ErrorRecord`/:class:`HasErrorCol` error schema.
 
-Stdlib-only; safe to import before (or without) jax.
+Stdlib-only at import time; safe to import before (or without) jax.
+(:mod:`.rowguard` needs numpy + the core Dataset, so its names load
+lazily on first attribute access.)
 
 Consumers: ``io.http.HTTPClient`` / ``HTTPTransformer`` (policy, breaker,
 deadline), ``services.base.RemoteServiceTransformer`` (policy, breaker),
@@ -29,16 +36,35 @@ deadline), ``services.base.RemoteServiceTransformer`` (policy, breaker),
 
 from .breaker import CircuitBreaker, CircuitOpenError, breaker_for
 from .faults import (FAULTS_ENV, FAULTS_SEED_ENV, FaultRegistry, FaultRule,
-                     PreemptionError, get_faults)
+                     PoisonRowError, PreemptionError,
+                     ResourceExhaustedError, get_faults)
 from .health import HealthState, retry_after_from_depth
 from .policy import (RETRY_STATUSES, Deadline, RetryBudget, RetryPolicy,
                      parse_retry_after)
+
+#: rowguard names resolved lazily (the module pulls in numpy + Dataset;
+#: eager import would break this package's import-before-jax guarantee)
+_ROWGUARD_NAMES = (
+    "ErrorRecord", "HasErrorCol", "Quarantine", "QUARANTINE_DIR_ENV",
+    "RowGuardError", "StageContractError", "default_quarantine_dir",
+    "guard_context", "guarded_fit", "guarded_transform", "is_oom_error",
+    "oom_fault_point", "run_adaptive", "safe_batch_size",
+)
 
 __all__ = [
     "RetryPolicy", "RetryBudget", "Deadline", "RETRY_STATUSES",
     "parse_retry_after",
     "CircuitBreaker", "CircuitOpenError", "breaker_for",
-    "FaultRegistry", "FaultRule", "PreemptionError", "get_faults",
+    "FaultRegistry", "FaultRule", "PreemptionError",
+    "ResourceExhaustedError", "PoisonRowError", "get_faults",
     "FAULTS_ENV", "FAULTS_SEED_ENV",
     "HealthState", "retry_after_from_depth",
+    *_ROWGUARD_NAMES,
 ]
+
+
+def __getattr__(name):
+    if name in _ROWGUARD_NAMES:
+        from . import rowguard
+        return getattr(rowguard, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

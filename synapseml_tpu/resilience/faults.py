@@ -23,6 +23,10 @@ Fault kinds:
 ``slow``        sleep ``delay`` seconds before proceeding normally
 ``preempt``     raise :class:`PreemptionError` (a soft TPU preemption)
 ``kill``        ``SIGKILL`` the current process (a hard preemption)
+``oom``         raise :class:`ResourceExhaustedError` (an XLA
+                ``RESOURCE_EXHAUSTED`` stand-in — device out of memory)
+``poison``      raise :class:`PoisonRowError` (a data-dependent row
+                failure, for the ``rowguard.poison_row`` site)
 ==============  ============================================================
 
 Rule grammar (``SML_FAULTS``, rules joined by ``;``)::
@@ -42,6 +46,15 @@ site.  Every backoff in the stack routes through :meth:`FaultRegistry.
 sleep`, which records ``(site, seconds)`` into :attr:`sleep_log` — tests
 assert the retry schedule itself (jitter bounds, Retry-After honoring)
 instead of wall-clocking it.
+
+Programmatic rules (``inject``) additionally take a ``when`` predicate
+over the call's context dict, so a fault can fire only for calls
+touching specific data — e.g. arm ``rowguard.poison_row`` to fail every
+stage invocation whose batch CONTAINS source row 3, which is exactly how
+the row guard's bisection is exercised without real poison data.  When
+:attr:`record_calls` is set, :meth:`note` appends ``(site, ctx)`` to
+:attr:`call_log` — the row-guard tests assert their O(log n) bisection
+bound on this log.
 """
 
 from __future__ import annotations
@@ -55,7 +68,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["FaultRule", "FaultRegistry", "PreemptionError", "get_faults",
+__all__ = ["FaultRule", "FaultRegistry", "PreemptionError",
+           "ResourceExhaustedError", "PoisonRowError", "get_faults",
            "FAULTS_ENV", "FAULTS_SEED_ENV"]
 
 FAULTS_ENV = "SML_FAULTS"
@@ -71,6 +85,18 @@ class PreemptionError(RuntimeError):
     kind ``kill`` in a subprocess)."""
 
 
+class ResourceExhaustedError(RuntimeError):
+    """Injected device out-of-memory — message carries the literal
+    ``RESOURCE_EXHAUSTED`` marker so it walks the same detection path as
+    a real ``XlaRuntimeError`` (see ``rowguard.is_oom_error``)."""
+
+
+class PoisonRowError(ValueError):
+    """Injected data-dependent row failure — what the ``poison`` kind
+    raises at ``rowguard.poison_row`` so bisection tests need no real
+    poison data."""
+
+
 @dataclass
 class FaultRule:
     """One armed fault: fire ``kind`` at calls matching ``site``."""
@@ -82,6 +108,10 @@ class FaultRule:
     delay_s: float = 0.0             # for kind="slow"
     status: Optional[int] = None     # HTTP code override
     retry_after_s: Optional[float] = None
+    #: programmatic-only context predicate — the rule fires only for
+    #: calls whose ctx satisfies it (a non-matching call does not even
+    #: count toward ``after``)
+    when: Optional[object] = None
     #: bookkeeping (mutated under the registry lock)
     matched: int = 0
     fired: int = 0
@@ -99,15 +129,21 @@ class FaultRegistry:
         self.sleep_log: List[Tuple[str, float]] = []
         #: True ⇒ record sleeps without actually sleeping (fast tests)
         self.no_sleep = False
+        #: (site, ctx) of every :meth:`note` while ``record_calls`` is set
+        self.call_log: List[Tuple[str, Dict[str, object]]] = []
+        #: True ⇒ record instrumented call sites into :attr:`call_log`
+        #: (off by default: long-lived servers must not grow the log)
+        self.record_calls = False
         self._env_loaded = False
 
     # -- arming ------------------------------------------------------------
     def inject(self, site: str, kind: str, times: Optional[int] = None,
                after: int = 0, p: float = 1.0, delay_s: float = 0.0,
                status: Optional[int] = None,
-               retry_after_s: Optional[float] = None) -> FaultRule:
+               retry_after_s: Optional[float] = None,
+               when=None) -> FaultRule:
         rule = FaultRule(site, kind, times, after, p, delay_s, status,
-                         retry_after_s)
+                         retry_after_s, when)
         with self._lock:
             self._rules.append(rule)
         return rule
@@ -162,7 +198,9 @@ class FaultRegistry:
         with self._lock:
             self._rules = []
             self.sleep_log = []
+            self.call_log = []
             self.no_sleep = False
+            self.record_calls = False
             self._rng = random.Random(self._seed)
 
     @property
@@ -184,6 +222,8 @@ class FaultRegistry:
             for rule in self._rules:
                 if not fnmatch.fnmatch(site, rule.site):
                     continue
+                if rule.when is not None and not rule.when(ctx):
+                    continue           # ctx miss: not a matching call at all
                 rule.matched += 1
                 if rule.matched <= rule.after:
                     continue
@@ -226,6 +266,11 @@ class FaultRegistry:
             raise OSError(f"injected fault at {site}")
         elif rule.kind == "preempt":
             raise PreemptionError(f"injected preemption at {site}")
+        elif rule.kind == "oom":
+            raise ResourceExhaustedError(
+                f"RESOURCE_EXHAUSTED: injected out-of-memory at {site}")
+        elif rule.kind == "poison":
+            raise PoisonRowError(f"injected poison row at {site}")
 
     def http_fault(self, site: str, **ctx) -> Optional[Tuple[int, Dict[str, str]]]:
         """HTTP-shaped firing: returns ``(status, headers)`` for a
@@ -242,6 +287,23 @@ class FaultRegistry:
             return status, headers
         self._execute_raise(site, rule)
         return None
+
+    # -- recorded calls ----------------------------------------------------
+    def note(self, site: str, **ctx) -> None:
+        """Record an instrumented call (no fault fires here).  A no-op
+        unless :attr:`record_calls` is set — the row guard notes every
+        guarded stage invocation through this, so tests can assert call
+        counts (e.g. the bisection's O(log n) bound) without wrapping
+        stages themselves."""
+        if not self.record_calls:
+            return
+        with self._lock:
+            self.call_log.append((site, ctx))
+
+    def calls_for(self, site: str) -> List[Dict[str, object]]:
+        with self._lock:
+            return [ctx for (st, ctx) in self.call_log
+                    if fnmatch.fnmatch(st, site)]
 
     # -- recorded sleep ----------------------------------------------------
     def sleep(self, seconds: float, site: str = "backoff") -> None:

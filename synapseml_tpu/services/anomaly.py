@@ -81,7 +81,8 @@ class SimpleDetectAnomalies(_AnomalyBase):
                 max_workers=max(1, int(self.concurrency))) as pool:
             results = list(pool.map(run_group, groups.values()))
         for order, resp in results:
-            if 200 <= resp.status_code < 300:
+            err = self.response_error(resp)   # shared HasErrorCol format
+            if err is None:
                 body = json.loads(resp.entity.decode())
                 flags = body.get("isAnomaly", [])
                 for pos, i in enumerate(order):
@@ -91,7 +92,7 @@ class SimpleDetectAnomalies(_AnomalyBase):
             else:
                 for i in order:
                     out[i] = None
-                    errors[i] = f"{resp.status_code} {resp.reason}"
+                    errors[i] = err
         return ds.with_columns({self.outputCol: out, self.errorCol: errors})
 
 

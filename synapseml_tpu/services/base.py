@@ -24,6 +24,7 @@ from ..core.pipeline import Transformer
 from ..io.http import (HTTPClient, HTTPRequestData, HTTPResponseData,
                        HTTPTransformer, JSONOutputParser)
 from ..resilience import breaker_for
+from ..resilience.rowguard import HasErrorCol
 
 
 class ServiceParam(Param):
@@ -79,17 +80,20 @@ class HasServiceParams:
         return p.resolve(self, row, default)
 
 
-class RemoteServiceTransformer(HasServiceParams, Transformer):
+class RemoteServiceTransformer(HasServiceParams, HasErrorCol, Transformer):
     """Base for remote-call stages (reference: CognitiveServicesBase).
 
     Subclasses implement ``prepare_request(row) -> HTTPRequestData`` and
-    optionally ``parse_response(json_value) -> value``.
+    optionally ``parse_response(json_value) -> value``.  Per-row failures
+    land in the shared :class:`HasErrorCol` ``errorCol`` (default
+    ``"errors"``, value ``"<status> <reason>"``) — byte-compatible with
+    the three formerly hand-rolled sites, and routed through
+    ``handleInvalid`` by the row guard.
     """
 
     url = StringParam(doc="service endpoint")
     subscriptionKey = ServiceParam(doc="auth key (value or column)")
     outputCol = StringParam(doc="parsed output column", default="output")
-    errorCol = StringParam(doc="error column", default="errors")
     concurrency = IntParam(doc="concurrent requests", default=1)
     retries = IntParam(doc="retry count on 429/5xx", default=3)
     retryPolicy = PyObjectParam(
@@ -134,11 +138,10 @@ class RemoteServiceTransformer(HasServiceParams, Transformer):
         out = np.empty(ds.num_rows, dtype=object)
         errors = np.empty(ds.num_rows, dtype=object)
         for i, resp in enumerate(scored["_resp"]):
-            if 200 <= resp.status_code < 300:
+            errors[i] = self.response_error(resp)
+            if errors[i] is None:
                 out[i] = resp.entity if self.binary_output \
                     else self.parse_response(parse_json(resp))
-                errors[i] = None
             else:
                 out[i] = None
-                errors[i] = f"{resp.status_code} {resp.reason}"
         return ds.with_columns({self.outputCol: out, self.errorCol: errors})

@@ -762,8 +762,28 @@ class ServingServer:
         self._thread.join(timeout=5)
 
 
+class _BatchAlignmentError(RuntimeError):
+    """Model output rows cannot be mapped back onto requests (row count
+    changed with no provenance) — a deployment bug, not poison data, so
+    it must NOT enter the bisection path."""
+
+
 class _ApiLoop:
-    """One API's continuous loop: batch → transform → reply."""
+    """One API's continuous loop: batch → transform → reply.
+
+    Row-level fault isolation (the serving face of
+    :mod:`synapseml_tpu.resilience.rowguard`):
+
+    - a record whose ``input_parser`` throws answers 400 for ITSELF;
+      the rest of the batch proceeds;
+    - a poison record that makes ``transform`` throw is isolated by
+      recursive batch halving and answers 500 for itself — clean
+      records in the same micro-batch still get their 200s;
+    - an XLA ``RESOURCE_EXHAUSTED`` halves the batch and retries both
+      halves; the safe size is remembered (``rowguard_safe_batch_size``
+      gauge) and caps every later micro-batch pull, so one oversized
+      burst degrades throughput instead of killing the loop.
+    """
 
     def __init__(self, server: ServingServer, api: ApiHandle,
                  model: Transformer,
@@ -807,9 +827,15 @@ class _ApiLoop:
         for t in self._threads:
             t.start()
 
+    @property
+    def _oom_key(self) -> str:
+        return f"serving:{self.api.path}"
+
     def _loop(self) -> None:
+        from ..resilience.rowguard import safe_batch_size
         while not self._stop.is_set():
-            batch = self.api.get_batch(self.batch_size, self.batch_timeout_s)
+            pull = safe_batch_size(self._oom_key, self.batch_size)
+            batch = self.api.get_batch(pull, self.batch_timeout_s)
             if not batch:
                 continue
             if self.max_queue_wait_s is not None:
@@ -820,33 +846,161 @@ class _ApiLoop:
                     body = json.dumps({"error": "queue wait exceeded "
                                        f"{self.max_queue_wait_s}s"}).encode()
                     for req in stale:
-                        self.api.reply(req.id, ServingReply(503, body))
+                        self._safe_reply(req.id, ServingReply(503, body))
                     self._m_errors.inc(len(stale), api=self.api.path,
                                        kind="shed")
                     batch = [r for r in batch
                              if now - r.enqueued_at <= self.max_queue_wait_s]
                     if not batch:
                         continue
-            try:
-                t0 = time.perf_counter()
-                rows = [self.input_parser(r) for r in batch]
-                ds = Dataset.from_rows(rows)
-                out = self.model.transform(ds)
-                col = out[self.output_col]
-                for req, val in zip(batch, col):
-                    self.api.reply(req.id, ServingReply(
-                        200, self.output_formatter(val),
-                        {"Content-Type": "application/json"}))
-                dt = time.perf_counter() - t0
-                self._m_records.inc(len(batch), api=self.api.path)
-                self._m_batch.observe(len(batch), api=self.api.path)
+            # per-record parse: a malformed record 400s ITSELF only
+            rows, good = [], []
+            for req in batch:
+                try:
+                    rows.append(self.input_parser(req))
+                    good.append(req)
+                except Exception as e:  # noqa: BLE001 — isolated to record
+                    self._m_errors.inc(1, api=self.api.path, kind="parse")
+                    self._safe_reply(req.id, ServingReply(400, json.dumps(
+                        {"error": f"unparseable record: {e}"}).encode()))
+            if not good:
+                continue
+            t0 = time.perf_counter()
+            served = self._transform_reply(good, rows)
+            dt = time.perf_counter() - t0
+            if served:
+                self._m_records.inc(served, api=self.api.path)
+                self._m_batch.observe(served, api=self.api.path)
                 if dt > 0:
-                    self._m_rps.set(len(batch) / dt, api=self.api.path)
-            except Exception as e:  # noqa: BLE001 — serving must not die
-                self._m_errors.inc(1, api=self.api.path, kind="transform")
-                body = json.dumps({"error": str(e)}).encode()
-                for req in batch:
-                    self.api.reply(req.id, ServingReply(500, body))
+                    self._m_rps.set(served / dt, api=self.api.path)
+
+    def _safe_reply(self, request_id: str, rep: ServingReply) -> bool:
+        """api.reply that cannot kill the worker thread: after drain/
+        close the asyncio loop is gone and call_soon_threadsafe raises —
+        the exchange is already lost either way, the loop must live."""
+        try:
+            return self.api.reply(request_id, rep)
+        except Exception:  # noqa: BLE001 — serving must not die
+            return False
+
+    def _reply_all(self, reqs: List[ServingRequest], status: int,
+                   e: Exception, kind: str) -> None:
+        self._m_errors.inc(len(reqs), api=self.api.path, kind=kind)
+        body = json.dumps({"error": str(e)}).encode()
+        for req in reqs:
+            self._safe_reply(req.id, ServingReply(status, body))
+
+    def _format_reply(self, req: ServingRequest, val: Any,
+                      to_send: List) -> None:
+        """Format one record's 200 (a formatter failure 500s only that
+        record — formatting is per-record work, not batch work)."""
+        try:
+            body = self.output_formatter(val)
+        except Exception as e:  # noqa: BLE001 — isolated to the record
+            self._m_errors.inc(1, api=self.api.path, kind="format")
+            to_send.append((req, ServingReply(500, json.dumps(
+                {"error": f"output formatting failed: {e}"}).encode())))
+            return
+        to_send.append((req, ServingReply(
+            200, body, {"Content-Type": "application/json"})))
+
+    def _transform_reply(self, reqs: List[ServingRequest],
+                         rows: List[Dict[str, Any]],
+                         budget: Optional[List[int]] = None) -> int:
+        """Transform + reply with row-level isolation; returns the number
+        of records answered 200.  No reply leaves inside the try: a
+        late exception after partial sends would otherwise re-answer
+        already-answered records from the bisection path."""
+        from ..resilience.faults import PreemptionError
+        from ..resilience.rowguard import (is_oom_error, isolation_budget,
+                                           oom_fault_point,
+                                           record_safe_batch)
+        if budget is None:
+            # bounds isolation work for batch-INDEPENDENT failures (a
+            # broken model fails both halves of every split): after the
+            # shared budget the remaining batch 500s wholesale — the
+            # pre-isolation behavior — instead of burning 2n-1
+            # transforms on a model that was never going to answer
+            budget = [isolation_budget(len(reqs))]
+        budget[0] -= 1
+        to_send: List[Tuple[ServingRequest, ServingReply]] = []
+        rejected = 0
+        try:
+            oom_fault_point(self._oom_key, len(rows))
+            ds = Dataset.from_rows(rows)
+            out = self.model.transform(ds)
+            col = out[self.output_col]
+            if out.num_rows != len(reqs):
+                # a guarded model (handleInvalid='skip'/'quarantine')
+                # dropped poisoned rows: re-align replies through the
+                # guard's source-row provenance — positional zip would
+                # hand every later record its neighbor's prediction
+                if not out.has_source_index:
+                    raise _BatchAlignmentError(
+                        f"model returned {out.num_rows} rows for "
+                        f"{len(reqs)} records without row provenance; "
+                        "replies cannot be aligned")
+                idx = [int(p) for p in out.source_index]
+                if (len(set(idx)) != len(idx)
+                        or not all(0 <= p < len(reqs) for p in idx)):
+                    # a row-EXPANDING model (Explode-style duplicate
+                    # provenance) or foreign provenance: answering one
+                    # request several times would race the exchange —
+                    # fail loudly instead
+                    raise _BatchAlignmentError(
+                        "model output rows do not map 1:1 onto records "
+                        "(duplicate or out-of-range source rows)")
+                answered = set(idx)
+                for pos, val in zip(idx, col):
+                    self._format_reply(reqs[pos], val, to_send)
+                body = json.dumps({"error": "record rejected by the "
+                                   "model's handleInvalid policy"}).encode()
+                for i, req in enumerate(reqs):
+                    if i not in answered:
+                        rejected += 1
+                        to_send.append((req, ServingReply(422, body)))
+            else:
+                for req, val in zip(reqs, col):
+                    self._format_reply(req, val, to_send)
+        except PreemptionError as e:
+            # control plane, never row-attributable (rowguard's
+            # _NON_ROW_ERRORS contract): the process is being evicted —
+            # shed the batch retryably instead of bisecting it
+            self._reply_all(reqs, 503, e, "preempt")
+            return 0
+        except _BatchAlignmentError as e:
+            self._reply_all(reqs, 500, e, "transform")
+            return 0
+        except Exception as e:  # noqa: BLE001 — serving must not die
+            if getattr(e, "all_rows_invalid", False):
+                # the model's OWN row guard rejected every record in
+                # this (sub-)batch — that's a data verdict, not a model
+                # failure: same 422 the provenance-aligned path answers
+                self._reply_all(reqs, 422, e, "rejected")
+                return 0
+            oom = is_oom_error(e)
+            if len(reqs) == 1 or (budget[0] <= 0 and not oom):
+                self._reply_all(reqs, 500, e, "oom" if oom else "transform")
+                return 0
+            mid = len(reqs) // 2
+            if oom:
+                # batch-size failure: remember the size that fits so
+                # later micro-batch pulls stay under it
+                record_safe_batch(self._oom_key, max(1, mid))
+                self._m_errors.inc(1, api=self.api.path, kind="oom")
+            # halve either way: OOM retries smaller, a poison record is
+            # cornered in O(log n) transforms while clean ones still
+            # answer 200
+            return (self._transform_reply(reqs[:mid], rows[:mid], budget)
+                    + self._transform_reply(reqs[mid:], rows[mid:], budget))
+        if rejected:
+            self._m_errors.inc(rejected, api=self.api.path, kind="rejected")
+        served = 0
+        for req, rep in to_send:
+            self._safe_reply(req.id, rep)
+            if rep.status == 200:
+                served += 1
+        return served
 
     def stop(self) -> None:
         self._stop.set()

@@ -34,8 +34,17 @@ Instrumented layers (all write into the default registry):
 ``serving`` (server/continuous)       ``serving_records_total``,
                                       ``serving_records_per_sec``,
                                       ``serving_batch_size``,
-                                      ``serving_errors_total``, client-side
-                                      continuous-mode counters
+                                      ``serving_errors_total`` (kinds now
+                                      include ``parse`` and ``oom``),
+                                      client-side continuous-mode counters
+``resilience.rowguard``               ``rowguard_stage_calls_total``,
+                                      ``rowguard_rows_total`` per outcome,
+                                      ``rowguard_bisection_probes_total``,
+                                      ``rowguard_oom_events_total``,
+                                      ``rowguard_safe_batch_size`` gauge,
+                                      ``quarantine_batches_total`` /
+                                      ``quarantine_rows_total``,
+                                      ``dataset_all_nan_columns_total``
 ====================================  =====================================
 """
 

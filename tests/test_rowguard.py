@@ -1,0 +1,885 @@
+"""Row-level fault isolation (tier-1).
+
+The tentpole claims, each pinned here: ``handleInvalid`` matches Spark
+semantics (error raises / skip drops / quarantine dead-letters), poison-
+batch bisection isolates one seeded bad row in ≤ ⌈log2 n⌉ + 1 EXTRA
+stage calls (asserted on the fault registry's call log), the quarantine
+append is SIGKILL-atomic, ``Quarantine.replay`` round-trips, OOM
+bisection converges under an injected ``RESOURCE_EXHAUSTED``, serving
+isolates poison records to their own 500s, and a ≥3-stage pipeline over
+poisoned data (NaN/Inf, bad dtype, service 4xx) completes in quarantine
+mode with bit-identical clean-row outputs and a fully-attributed
+dead-letter store.
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from synapseml_tpu import Dataset
+from synapseml_tpu.core.pipeline import Pipeline, PipelineModel
+from synapseml_tpu.io import SimpleHTTPTransformer
+from synapseml_tpu.ops.featurize import CleanMissingData
+from synapseml_tpu.ops.stages import UDFTransformer
+from synapseml_tpu.resilience.faults import (PreemptionError,
+                                             ResourceExhaustedError)
+from synapseml_tpu.resilience.rowguard import (ErrorRecord, HasErrorCol,
+                                               Quarantine, RowGuardError,
+                                               StageContractError,
+                                               guard_context, is_oom_error,
+                                               oom_fault_point,
+                                               reset_safe_batch, run_adaptive,
+                                               safe_batch_size)
+
+pytestmark = pytest.mark.guard
+
+
+def nan_intolerant(inputCol="x", outputCol="y", **kw):
+    """A vectorized stage that chokes on non-finite input — the classic
+    poison-batch victim."""
+
+    def udf(x):
+        if not np.isfinite(np.asarray(x, dtype=np.float64)).all():
+            raise ValueError("non-finite value in batch")
+        return np.asarray(x, dtype=np.float64) * 2.0
+
+    return UDFTransformer(inputCol=inputCol, outputCol=outputCol, udf=udf,
+                          **kw)
+
+
+def value_poisoned(poison, inputCol="x", outputCol="y", **kw):
+    """Fails on a specific VALUE — invisible to the NaN screen, so only
+    bisection can isolate it."""
+
+    def udf(x):
+        if (np.asarray(x) == poison).any():
+            raise ValueError(f"poison value {poison}")
+        return np.asarray(x, dtype=np.float64) + 1.0
+
+    return UDFTransformer(inputCol=inputCol, outputCol=outputCol, udf=udf,
+                          **kw)
+
+
+# --------------------------------------------------------------------------
+# handleInvalid semantics (Spark contract)
+# --------------------------------------------------------------------------
+
+
+class TestHandleInvalidSemantics:
+    def _poisoned(self, n=12, bad=(3, 7)):
+        x = np.arange(float(n))
+        for b in bad:
+            x[b] = np.nan
+        return Dataset({"x": x}), x
+
+    def test_error_mode_raises(self):
+        ds, _ = self._poisoned()
+        with pytest.raises(ValueError, match="non-finite"):
+            nan_intolerant().transform(ds)
+
+    def test_error_mode_is_default(self):
+        stage = nan_intolerant()
+        assert stage.get_or_default("handleInvalid") == "error"
+
+    def test_skip_drops_only_bad_rows(self):
+        ds, x = self._poisoned()
+        out = nan_intolerant(handleInvalid="skip").transform(ds)
+        keep = np.isfinite(x)
+        assert out.num_rows == int(keep.sum())
+        np.testing.assert_array_equal(out["y"], x[keep] * 2.0)
+        np.testing.assert_array_equal(out.source_index,
+                                      np.flatnonzero(keep))
+
+    def test_quarantine_stores_rows_with_provenance(self, tmp_path):
+        ds, x = self._poisoned()
+        stage = nan_intolerant(handleInvalid="quarantine",
+                               quarantineDir=str(tmp_path))
+        out = stage.transform(ds)
+        assert out.num_rows == 10
+        store = Quarantine(str(tmp_path))
+        recs = store.records(stage.uid)
+        assert sorted(r.row_index for r in recs) == [3, 7]
+        assert all(r.stage_uid == stage.uid for r in recs)
+        assert all(r.error_class == "StageContractError" for r in recs)
+        rows = store.rows(stage.uid)
+        assert rows.num_rows == 2
+        assert sorted(rows.source_index) == [3, 7]
+
+    def test_clean_path_identical_across_modes(self, tmp_path):
+        ds = Dataset({"x": np.arange(32.0)})
+        outs = []
+        for mode in ("error", "skip", "quarantine"):
+            stage = nan_intolerant(handleInvalid=mode,
+                                   quarantineDir=str(tmp_path))
+            outs.append(stage.transform(ds))
+        for out in outs[1:]:
+            assert out.num_rows == outs[0].num_rows
+            np.testing.assert_array_equal(out["y"], outs[0]["y"])
+        assert Quarantine(str(tmp_path)).stage_uids() == []
+
+    def test_missing_input_column_is_contract_error(self):
+        ds = Dataset({"other": np.arange(4.0)})
+        with pytest.raises(StageContractError, match="requires input"):
+            nan_intolerant(handleInvalid="skip").transform(ds)
+
+    def test_all_rows_poison_raises_rowguard_error(self, tmp_path):
+        ds = Dataset({"x": np.full(4, np.nan)})
+        stage = nan_intolerant(handleInvalid="quarantine",
+                               quarantineDir=str(tmp_path))
+        with pytest.raises(RowGuardError, match="no rows survived") as ei:
+            stage.transform(ds)
+        assert len(ei.value.records) == 4
+        # ... but the rows still reached the dead-letter store first
+        assert Quarantine(str(tmp_path)).rows(stage.uid).num_rows == 4
+
+    def test_pipeline_mode_propagates_to_stages(self):
+        ds, x = self._poisoned()
+        model = PipelineModel(stages=[nan_intolerant(),
+                                      value_poisoned(poison=8.0,
+                                                     inputCol="y",
+                                                     outputCol="z")],
+                              handleInvalid="skip")
+        out = model.transform(ds)
+        # NaN rows skipped at stage 1; y==8 means x==4 → skipped at stage 2
+        keep = np.isfinite(x) & (x != 4.0)
+        assert out.num_rows == int(keep.sum())
+        np.testing.assert_array_equal(out.source_index, np.flatnonzero(keep))
+        np.testing.assert_array_equal(out["z"], x[keep] * 2.0 + 1.0)
+
+    def test_explicit_stage_setting_beats_pipeline_mode(self):
+        ds, _ = self._poisoned()
+        strict = nan_intolerant(handleInvalid="error")
+        model = PipelineModel(stages=[strict], handleInvalid="skip")
+        with pytest.raises(ValueError, match="non-finite"):
+            model.transform(ds)
+
+    def test_guard_context_nesting_inner_wins(self):
+        with guard_context("skip"):
+            with guard_context("quarantine"):
+                from synapseml_tpu.resilience.rowguard import effective_mode
+                assert effective_mode(nan_intolerant()) == "quarantine"
+            from synapseml_tpu.resilience.rowguard import effective_mode
+            assert effective_mode(nan_intolerant()) == "skip"
+
+    def test_nan_consumers_opt_out_of_screen(self):
+        # CleanMissingData's JOB is NaN — pipeline-level quarantine must
+        # not steal its input rows
+        x = np.arange(8.0)
+        x[2] = np.nan
+        ds = Dataset({"x": x})
+        pipe = Pipeline(stages=[CleanMissingData(inputCols=["x"],
+                                                 outputCols=["x"])],
+                        handleInvalid="skip")
+        out = pipe.fit(ds).transform(ds)
+        assert out.num_rows == 8
+        assert np.isfinite(out["x"]).all()      # imputed, not dropped
+
+    def test_empty_error_mode_unaffected(self):
+        ds = Dataset({"x": np.arange(4.0)})
+        out = nan_intolerant().transform(ds)
+        assert out.num_rows == 4
+
+
+# --------------------------------------------------------------------------
+# poison-batch bisection
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.fault
+class TestBisection:
+    def test_single_poison_isolated_within_log2_bound(self, fault_registry):
+        fault_registry.record_calls = True
+        n = 64
+        x = np.arange(float(n))
+        stage = value_poisoned(poison=13.0, handleInvalid="skip")
+        out = stage.transform(Dataset({"x": x}))
+        assert out.num_rows == n - 1
+        np.testing.assert_array_equal(out["y"], np.delete(x, 13) + 1.0)
+        calls = [c for c in fault_registry.calls_for("rowguard.call")
+                 if c["stage"] == stage.uid]
+        extra = len(calls) - 1          # one call is the normal clean one
+        assert extra <= math.ceil(math.log2(n)) + 1, \
+            f"{extra} extra calls for n={n}"
+
+    def test_injected_poison_row_site(self, fault_registry):
+        # no real poison data: the rowguard.poison_row fault site fails
+        # every batch whose source rows contain 5
+        fault_registry.record_calls = True
+        fault_registry.inject("rowguard.poison_row", "poison",
+                              when=lambda c: 5 in c["rows"])
+        stage = UDFTransformer(inputCol="x", outputCol="y",
+                               udf=lambda x: x * 3.0, handleInvalid="skip")
+        out = stage.transform(Dataset({"x": np.arange(16.0)}))
+        assert out.num_rows == 15
+        assert 5 not in out.source_index
+        calls = fault_registry.calls_for("rowguard.call")
+        assert len(calls) - 1 <= math.ceil(math.log2(16)) + 1
+
+    def test_multiple_poison_rows_all_isolated(self, tmp_path):
+        n = 32
+        x = np.arange(float(n))
+        stage = UDFTransformer(
+            inputCol="x", outputCol="y",
+            udf=lambda v: (_ for _ in ()).throw(ValueError("poison"))
+            if (np.isin(v, (5.0, 21.0))).any() else v * 2.0,
+            handleInvalid="quarantine", quarantineDir=str(tmp_path))
+        out = stage.transform(Dataset({"x": x}))
+        assert out.num_rows == n - 2
+        recs = Quarantine(str(tmp_path)).records(stage.uid)
+        assert sorted(r.row_index for r in recs) == [5, 21]
+
+    def test_oom_never_attributed_to_rows(self, tmp_path):
+        stage = UDFTransformer(
+            inputCol="x", outputCol="y",
+            udf=lambda v: (_ for _ in ()).throw(
+                ResourceExhaustedError("RESOURCE_EXHAUSTED: oom")),
+            handleInvalid="quarantine", quarantineDir=str(tmp_path))
+        with pytest.raises(ResourceExhaustedError):
+            stage.transform(Dataset({"x": np.arange(8.0)}))
+        assert Quarantine(str(tmp_path)).stage_uids() == []
+
+    def test_batch_independent_failure_bounded(self, fault_registry,
+                                               tmp_path):
+        # a stage that fails for EVERY input must not burn O(n log n)
+        # invocations quarantining the whole dataset row by row
+        fault_registry.record_calls = True
+        n = 256
+        stage = UDFTransformer(
+            inputCol="x", outputCol="y",
+            udf=lambda v: (_ for _ in ()).throw(RuntimeError("broken")),
+            handleInvalid="quarantine", quarantineDir=str(tmp_path))
+        with pytest.raises(RowGuardError, match="batch-independently"):
+            stage.transform(Dataset({"x": np.arange(float(n))}))
+        calls = fault_registry.calls_for("rowguard.call")
+        assert len(calls) <= 4 * math.ceil(math.log2(n)) + 16
+        # the few rows blamed before giving up still reached the store
+        recs = Quarantine(str(tmp_path)).records(stage.uid)
+        assert 0 < len(recs) < 10
+
+    def test_preemption_reraised_not_quarantined(self, tmp_path):
+        stage = UDFTransformer(
+            inputCol="x", outputCol="y",
+            udf=lambda v: (_ for _ in ()).throw(PreemptionError("evicted")),
+            handleInvalid="quarantine", quarantineDir=str(tmp_path))
+        with pytest.raises(PreemptionError):
+            stage.transform(Dataset({"x": np.arange(8.0)}))
+        assert Quarantine(str(tmp_path)).stage_uids() == []
+
+
+# --------------------------------------------------------------------------
+# dead-letter quarantine store
+# --------------------------------------------------------------------------
+
+
+class TestQuarantine:
+    def test_append_and_read_mixed_dtypes(self, tmp_path):
+        store = Quarantine(str(tmp_path))
+        ds = Dataset({"f32": np.arange(3, dtype=np.float32),
+                      "f64": np.arange(3, dtype=np.float64),
+                      "txt": ["a", "b", "c"]},
+                     row_index=np.asarray([10, 20, 30]))
+        recs = [ErrorRecord("u1", "T", i, "ValueError", f"bad {i}")
+                for i in (10, 20, 30)]
+        store.add("u1", ds, recs, stage_class="T")
+        back = store.rows("u1")
+        assert back.columns == ["f32", "f64", "txt"]
+        np.testing.assert_array_equal(back["f32"], ds["f32"])
+        np.testing.assert_array_equal(back["f64"], ds["f64"])
+        assert list(back["txt"]) == ["a", "b", "c"]
+        np.testing.assert_array_equal(back.source_index, [10, 20, 30])
+        got = store.records("u1")
+        assert [r.error_message for r in got] == ["bad 10", "bad 20",
+                                                 "bad 30"]
+
+    @pytest.mark.fault
+    def test_sigkill_mid_write_leaves_no_partial_batch(self, tmp_path):
+        qdir = str(tmp_path / "q")
+        code = (
+            "import numpy as np\n"
+            "from synapseml_tpu.core.dataset import Dataset\n"
+            "from synapseml_tpu.resilience.rowguard import (Quarantine,\n"
+            "    ErrorRecord)\n"
+            f"store = Quarantine({qdir!r})\n"
+            "ds = Dataset({'x': np.arange(3.0)}).with_source_index()\n"
+            "store.add('u1', ds, [ErrorRecord('u1', 'T', 0, 'E', 'm')])\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SML_FAULTS="quarantine.write=kill:times=1")
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, timeout=120)
+        assert p.returncode == -signal.SIGKILL, p.stderr.decode()
+        store = Quarantine(qdir)
+        # the torn batch is invisible: only a tmp- staging dir remains
+        assert store.batches("u1") == []
+        assert store.records("u1") == []
+        # and the NEXT append commits normally beside the debris
+        env.pop("SML_FAULTS")
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, timeout=120)
+        assert p.returncode == 0, p.stderr.decode()
+        assert len(store.batches("u1")) == 1
+        assert store.rows("u1").num_rows == 3
+
+    def test_replay_round_trips_and_clears(self, tmp_path):
+        ds, x = TestHandleInvalidSemantics()._poisoned(n=10, bad=(2, 6))
+        broken = nan_intolerant(handleInvalid="quarantine",
+                                quarantineDir=str(tmp_path))
+        broken.transform(ds)
+        store = Quarantine(str(tmp_path))
+        assert store.rows(broken.uid).num_rows == 2
+
+        # the "fixed" stage tolerates NaN (imputes 0 first)
+        fixed = UDFTransformer(
+            inputCol="x", outputCol="y",
+            udf=lambda v: np.nan_to_num(np.asarray(v, np.float64)) * 2.0)
+        out = store.replay(fixed, stage_uid=broken.uid)
+        assert out.num_rows == 2
+        np.testing.assert_array_equal(sorted(out.source_index), [2, 6])
+        np.testing.assert_array_equal(out["y"], [0.0, 0.0])
+        # replayed batches are gone; a second replay finds nothing
+        assert store.rows(broken.uid) is None
+        assert store.replay(fixed, stage_uid=broken.uid) is None
+
+
+# --------------------------------------------------------------------------
+# OOM-adaptive batching
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.fault
+class TestOOMAdaptive:
+    def test_converges_under_injected_resource_exhausted(self,
+                                                         fault_registry):
+        fault_registry.inject("oom", "oom",
+                              when=lambda c: c["batch"] > 4)
+        seen = []
+
+        def run(bs):
+            for start in range(0, 32, bs):
+                oom_fault_point("test:conv", min(bs, 32 - start))
+            seen.append(bs)
+            return bs
+
+        try:
+            final = run_adaptive("test:conv", 32, run)
+            assert final == 4
+            assert seen == [4]               # halved 32→16→8→4, ran once
+            assert safe_batch_size("test:conv", 32) == 4
+        finally:
+            reset_safe_batch("test:conv")
+
+    def test_oom_at_batch_one_reraises(self, fault_registry):
+        fault_registry.inject("oom", "oom")
+
+        def run(bs):
+            oom_fault_point("test:dead", bs)
+            return bs
+
+        with pytest.raises(ResourceExhaustedError):
+            run_adaptive("test:dead", 8, run)
+        reset_safe_batch("test:dead")
+
+    def test_non_oom_errors_propagate(self):
+        def run(bs):
+            raise KeyError("not an oom")
+
+        with pytest.raises(KeyError):
+            run_adaptive("test:other", 8, run)
+
+    def test_small_request_does_not_shrink_remembered_ceiling(self):
+        from synapseml_tpu.resilience.rowguard import record_safe_batch
+        try:
+            record_safe_batch("test:ceiling", 512)   # OOM-discovered
+            out = run_adaptive("test:ceiling", 4, lambda bs: bs)
+            assert out == 4                          # ran at its own size
+            # ...but the remembered device ceiling is untouched
+            assert safe_batch_size("test:ceiling", 10_000) == 512
+        finally:
+            reset_safe_batch("test:ceiling")
+
+    def test_is_oom_error_detection(self):
+        assert is_oom_error(ResourceExhaustedError("RESOURCE_EXHAUSTED: x"))
+        assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: out of "
+                                         "memory allocating 2.5G"))
+        assert is_oom_error(MemoryError())
+        assert not is_oom_error(ValueError("bad row"))
+
+    def test_onnx_runner_bisects_batch(self, fault_registry):
+        from synapseml_tpu.models.onnx.graph import GraphBuilder
+        from synapseml_tpu.models.onnx import compile_onnx
+        b = GraphBuilder("g")
+        xin = b.input("x", (None, 3))
+        b.output(b.node("Relu", [xin]))
+        fn = compile_onnx(b.build())
+        x = np.linspace(-1, 1, 24, dtype=np.float32).reshape(8, 3)
+        want = np.maximum(x, 0.0)
+        full = np.asarray(fn(x=x)[fn.output_names[0]])
+        np.testing.assert_array_equal(full, want)
+        fault_registry.inject(
+            "oom", "oom",
+            when=lambda c: str(c["key"]).startswith("onnx:")
+            and c["batch"] > 2)
+        try:
+            chunked = np.asarray(fn(x=x)[fn.output_names[0]])
+        finally:
+            reset_safe_batch()
+        np.testing.assert_array_equal(chunked, want)
+
+
+# --------------------------------------------------------------------------
+# serving: record-level isolation
+# --------------------------------------------------------------------------
+
+
+class _ServingModel:
+    """Doubles x; raises on the poison value (not a jitted model — these
+    tests measure the serving isolation path, not XLA)."""
+
+    def __init__(self, poison=None):
+        self.poison = poison
+
+    def transform(self, ds):
+        x = np.asarray([float(v) for v in ds["x"]])
+        if self.poison is not None and (x == self.poison).any():
+            raise ValueError(f"poison record {self.poison}")
+        return Dataset({"x": ds["x"], "prediction": 2.0 * x})
+
+
+class TestServingIsolation:
+    def _post(self, url, body, timeout=15):
+        req = urllib.request.Request(url, data=body, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_poison_record_500s_only_itself(self):
+        from synapseml_tpu.serving import PipelineServer
+        ps = PipelineServer(_ServingModel(poison=13.0),
+                            lambda r: {"x": float(r.json()["x"])},
+                            batch_timeout_s=0.05, batch_size=8)
+        try:
+            results = {}
+
+            def call(i):
+                body = json.dumps({"x": i}).encode()
+                results[i] = self._post(ps.url, body)
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in (11, 12, 13, 14)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert results[13][0] == 500
+            assert b"poison" in results[13][1]
+            for i in (11, 12, 14):
+                status, body = results[i]
+                assert status == 200, (i, body)
+                assert json.loads(body)["prediction"] == 2.0 * i
+        finally:
+            ps.close()
+
+    def test_unparseable_record_400s_only_itself(self):
+        from synapseml_tpu.serving import PipelineServer
+        ps = PipelineServer(_ServingModel(),
+                            lambda r: {"x": float(r.json()["x"])},
+                            batch_timeout_s=0.05)
+        try:
+            status, body = self._post(ps.url, b"{not json")
+            assert status == 400
+            assert b"unparseable" in body
+            status, body = self._post(ps.url, json.dumps({"x": 4}).encode())
+            assert status == 200
+            assert json.loads(body)["prediction"] == 8.0
+        finally:
+            ps.close()
+
+    def test_guarded_model_drops_align_via_provenance(self):
+        # a model running handleInvalid='skip' returns FEWER rows than
+        # records: replies must re-align through provenance (422 for the
+        # dropped record), never shift onto the neighbor's prediction
+        from synapseml_tpu.serving import PipelineServer, ServingRequest
+        model = PipelineModel(
+            stages=[nan_intolerant(outputCol="prediction")],
+            handleInvalid="skip")
+        ps = PipelineServer(model, lambda r: {"x": float(r.json()["x"])},
+                            batch_timeout_s=0.05)
+        loop = ps._loop
+        replies = {}
+        loop.api.reply = lambda rid, rep: replies.__setitem__(rid, rep)
+        try:
+            reqs = [ServingRequest(id=f"r{i}", method="POST", path="/",
+                                   headers={}, body=b"") for i in range(5)]
+            rows = [{"x": float(i)} for i in range(5)]
+            rows[2]["x"] = float("nan")
+            served = loop._transform_reply(reqs, rows)
+            assert served == 4
+            assert replies["r2"].status == 422
+            for i in (0, 1, 3, 4):
+                rep = replies[f"r{i}"]
+                assert rep.status == 200
+                assert json.loads(rep.body)["prediction"] == 2.0 * i
+        finally:
+            ps.close()
+
+    def test_batch_independent_failure_bounded_isolation(self):
+        # a model that ALWAYS fails must not cost 2n-1 transforms per
+        # batch: the isolation budget caps probing at O(log n), then the
+        # remainder 500s wholesale
+        from synapseml_tpu.serving import PipelineServer, ServingRequest
+
+        calls = []
+
+        class _Broken:
+            def transform(self, ds):
+                calls.append(ds.num_rows)
+                raise RuntimeError("model is broken")
+
+        ps = PipelineServer(_Broken(), lambda r: {"x": 1.0},
+                            batch_timeout_s=0.05)
+        loop = ps._loop
+        replies = {}
+        loop.api.reply = lambda rid, rep: replies.__setitem__(rid, rep)
+        try:
+            n = 64
+            reqs = [ServingRequest(id=f"r{i}", method="POST", path="/",
+                                   headers={}, body=b"") for i in range(n)]
+            rows = [{"x": float(i)} for i in range(n)]
+            served = loop._transform_reply(reqs, rows)
+            assert served == 0
+            # far below the 2n-1 = 127 un-budgeted halving would cost
+            assert len(calls) <= 4 * math.ceil(math.log2(n)) + 16
+            assert len(replies) == n          # every record answered
+            assert all(r.status == 500 for r in replies.values())
+        finally:
+            ps.close()
+
+    def test_preemption_sheds_batch_without_bisection(self):
+        # control-plane eviction must not masquerade as poison data:
+        # ONE transform attempt, then the whole batch 503s (retryable)
+        from synapseml_tpu.serving import PipelineServer, ServingRequest
+
+        calls = []
+
+        class _Preempted:
+            def transform(self, ds):
+                calls.append(ds.num_rows)
+                raise PreemptionError("evicted")
+
+        ps = PipelineServer(_Preempted(), lambda r: {"x": 1.0},
+                            batch_timeout_s=0.05)
+        loop = ps._loop
+        replies = {}
+        loop.api.reply = lambda rid, rep: replies.__setitem__(rid, rep)
+        try:
+            reqs = [ServingRequest(id=f"r{i}", method="POST", path="/",
+                                   headers={}, body=b"") for i in range(8)]
+            served = loop._transform_reply(reqs, [{"x": 1.0}] * 8)
+            assert served == 0
+            assert calls == [8]           # no halving on preemption
+            assert len(replies) == 8
+            assert all(r.status == 503 for r in replies.values())
+        finally:
+            ps.close()
+
+    @pytest.mark.fault
+    def test_oom_bisects_batch_and_remembers_safe_size(self,
+                                                       fault_registry):
+        from synapseml_tpu.serving import PipelineServer, ServingRequest
+        ps = PipelineServer(_ServingModel(),
+                            lambda r: {"x": float(r.json()["x"])},
+                            batch_timeout_s=0.05, batch_size=64)
+        loop = ps._loop
+        fault_registry.inject(
+            "oom", "oom",
+            when=lambda c: str(c["key"]).startswith("serving:")
+            and c["batch"] > 2)
+        try:
+            reqs = [ServingRequest(id=f"r{i}", method="POST", path="/",
+                                   headers={}, body=b"") for i in range(8)]
+            rows = [{"x": float(i)} for i in range(8)]
+            served = loop._transform_reply(reqs, rows)
+            assert served == 8           # every record answered 200
+            # the safe size now caps later micro-batch pulls
+            assert safe_batch_size(loop._oom_key, 64) <= 4
+        finally:
+            reset_safe_batch()
+            ps.close()
+
+
+# --------------------------------------------------------------------------
+# ingest hardening (Dataset.from_csv / from_rows)
+# --------------------------------------------------------------------------
+
+
+class TestIngestHardening:
+    CSV = ("a,b\n"
+           "1,2\n"
+           "3,4,5\n"          # ragged
+           "oops,6\n"         # unparseable
+           "7,8\n")
+
+    def test_error_mode_unchanged_on_clean_file(self, tmp_path):
+        p = tmp_path / "clean.csv"
+        p.write_text("a,b\n1,2\n3,4\n")
+        strict = Dataset.from_csv(str(p))
+        permissive = Dataset.from_csv(str(p), handle_invalid="skip")
+        np.testing.assert_array_equal(strict["a"], permissive["a"])
+        np.testing.assert_array_equal(strict["b"], permissive["b"])
+
+    def test_permissive_skips_ragged_and_unparseable(self, tmp_path):
+        p = tmp_path / "dirty.csv"
+        p.write_text(self.CSV)
+        ds = Dataset.from_csv(str(p), handle_invalid="skip")
+        assert ds.num_rows == 2
+        np.testing.assert_array_equal(ds["a"], [1.0, 7.0])
+        # provenance: surviving rows name their data-row positions
+        np.testing.assert_array_equal(ds.source_index, [0, 3])
+
+    def test_permissive_quarantines_with_line_numbers(self, tmp_path):
+        p = tmp_path / "dirty.csv"
+        p.write_text(self.CSV)
+        store = Quarantine(str(tmp_path / "q"))
+        ds = Dataset.from_csv(str(p), handle_invalid="quarantine",
+                              quarantine=store)
+        assert ds.num_rows == 2
+        recs = store.records("Dataset.from_csv")
+        assert len(recs) == 2
+        msgs = " | ".join(r.error_message for r in recs)
+        assert "line 3" in msgs and "line 4" in msgs
+        raw = store.rows("Dataset.from_csv")
+        assert list(raw["raw"]) == ["3,4,5", "oops,6"]
+
+    def test_all_nan_columns_reported(self, tmp_path, caplog):
+        import logging
+        p = tmp_path / "allnan.csv"
+        p.write_text("a,b\n1,\n2,\n")
+        with caplog.at_level(logging.WARNING, logger="synapseml_tpu"):
+            ds = Dataset.from_csv(str(p), handle_invalid="skip")
+        assert ds.num_rows == 2
+        assert "all-NaN" in caplog.text and "'b'" in caplog.text
+
+    def test_from_rows_non_dict_first_row(self):
+        # the schema comes from the first DICT row — a junk row 0 is
+        # exactly what permissive mode exists to tolerate
+        rows = [["not", "a", "dict"], {"x": 1.0}, {"x": 2.0}]
+        ds = Dataset.from_rows(rows, handle_invalid="skip")
+        assert ds.num_rows == 2
+        np.testing.assert_array_equal(ds["x"], [1.0, 2.0])
+        np.testing.assert_array_equal(ds.source_index, [1, 2])
+
+    def test_from_rows_permissive(self, tmp_path):
+        rows = [{"x": 1, "y": 2}, {"x": 3}, {"x": 4, "y": 5, "z": 6},
+                {"x": 7, "y": 8}]
+        with pytest.raises(KeyError):
+            Dataset.from_rows(rows)
+        # extra keys (row 2's 'z') are fine — the strict path ignores
+        # them too; only the MISSING-key row 1 is ragged
+        ds = Dataset.from_rows(rows, handle_invalid="skip")
+        assert ds.num_rows == 3
+        np.testing.assert_array_equal(ds["x"], [1, 4, 7])
+        np.testing.assert_array_equal(ds.source_index, [0, 2, 3])
+        store = Quarantine(str(tmp_path / "q"))
+        Dataset.from_rows(rows, handle_invalid="quarantine",
+                          quarantine=store)
+        recs = store.records("Dataset.from_rows")
+        assert sorted(r.row_index for r in recs) == [1]
+
+
+# --------------------------------------------------------------------------
+# shared errorCol schema (dedup satellite)
+# --------------------------------------------------------------------------
+
+
+class TestErrorColDedup:
+    def test_byte_compatible_defaults(self):
+        from synapseml_tpu.services.base import RemoteServiceTransformer
+        from synapseml_tpu.services.anomaly import SimpleDetectAnomalies
+        for cls in (SimpleHTTPTransformer, SimpleDetectAnomalies):
+            assert issubclass(cls, HasErrorCol)
+            assert cls.param_objs()["errorCol"].default == "errors"
+        assert issubclass(RemoteServiceTransformer, HasErrorCol)
+
+    def test_response_error_format(self):
+        class R:
+            status_code = 418
+            reason = "I'm a teapot"
+
+        assert HasErrorCol.response_error(R()) == "418 I'm a teapot"
+        R.status_code = 204
+        assert HasErrorCol.response_error(R()) is None
+
+    @pytest.mark.fault
+    def test_service_4xx_routes_through_guard(self, fault_registry,
+                                              tmp_path):
+        # every send answers an injected 404 (off-network): all rows
+        # route to the dead-letter store and the guard reports it
+        fault_registry.inject("http.send", "http_500", status=404)
+        stage = SimpleHTTPTransformer(
+            url="http://127.0.0.1:9/unused", inputCols=["x"], retries=0,
+            handleInvalid="quarantine", quarantineDir=str(tmp_path))
+        out = stage.transform(Dataset({"x": np.arange(3.0)}))
+        # the transform itself succeeded — the output is just empty,
+        # with a valid schema (errorCol routing is post-transform)
+        assert out.num_rows == 0
+        recs = Quarantine(str(tmp_path)).records(stage.uid)
+        assert len(recs) == 3
+        assert all(r.error_class == "ServiceError" for r in recs)
+        assert all("404" in r.error_message for r in recs)
+
+    @pytest.mark.fault
+    def test_service_error_provenance_on_untracked_input(
+            self, fault_registry, tmp_path):
+        # a SINGLE injected 404 on the third send of a standalone
+        # (provenance-free) transform must still name source row 2
+        fault_registry.inject("http.send", "http_500", status=404,
+                              after=2, times=1)
+        fault_registry.inject("http.send", "http_500", status=204)
+        stage = SimpleHTTPTransformer(
+            url="http://127.0.0.1:9/unused", inputCols=["x"], retries=0,
+            handleInvalid="quarantine", quarantineDir=str(tmp_path))
+        out = stage.transform(Dataset({"x": np.arange(4.0)}))
+        assert out.num_rows == 3
+        np.testing.assert_array_equal(out.source_index, [0, 1, 3])
+        recs = Quarantine(str(tmp_path)).records(stage.uid)
+        assert [r.row_index for r in recs] == [2]
+        rows = Quarantine(str(tmp_path)).rows(stage.uid)
+        assert float(rows["x"][0]) == 2.0
+
+
+# --------------------------------------------------------------------------
+# registry sweep (CI satellite)
+# --------------------------------------------------------------------------
+
+
+def test_registry_sweep_every_stage_carries_handle_invalid():
+    from synapseml_tpu.codegen.discovery import discover_stages
+    ALLOWLIST: set = set()       # stages exempt from the contract (none)
+    missing = [qual for qual, cls in discover_stages().items()
+               if "handleInvalid" not in cls.param_objs()
+               and qual not in ALLOWLIST]
+    assert not missing, f"stages without handleInvalid: {missing}"
+
+
+# --------------------------------------------------------------------------
+# acceptance: 3-stage pipeline over poisoned data, quarantine mode
+# --------------------------------------------------------------------------
+
+
+class _AcceptanceEcho(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = json.loads(self.rfile.read(length) or b"{}")
+        data = json.dumps({"echo": body}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture()
+def echo_url():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _AcceptanceEcho)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}/echo"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+@pytest.mark.fault
+class TestAcceptancePipeline:
+    """The issue's acceptance scenario: NaN/Inf + bad-dtype + service-4xx
+    poison through a 3-stage pipeline in quarantine mode."""
+
+    N = 12
+
+    def _data(self, poisoned):
+        x = np.arange(float(self.N))
+        tags = [f"{v:.0f}" for v in x]
+        if poisoned:
+            x[2] = np.nan                 # stage-1 poison (screen)
+            x[5] = np.inf                 # stage-1 poison (screen)
+            tags[8] = "oops"              # stage-2 poison (bisection)
+        return Dataset({"x": x, "tag": tags})
+
+    def _pipeline(self, url, mode, qdir):
+        scale = UDFTransformer(inputCol="x", outputCol="x2",
+                               udf=lambda v: v * 1.5)
+        parse_tag = UDFTransformer(
+            inputCol="tag", outputCol="tagnum",
+            udf=lambda v: np.asarray([float(s) for s in v]))
+        call = SimpleHTTPTransformer(url=url, inputCols=["x2"],
+                                     outputCol="resp", retries=0)
+        kw = {"handleInvalid": mode}
+        if qdir:
+            kw["quarantineDir"] = qdir
+        return PipelineModel(stages=[scale, parse_tag, call], **kw), \
+            (scale, parse_tag, call)
+
+    def test_poisoned_pipeline_completes_with_full_attribution(
+            self, fault_registry, tmp_path, echo_url):
+        qdir = str(tmp_path / "dead")
+        model, (scale, parse_tag, call) = self._pipeline(
+            echo_url, "quarantine", qdir)
+        # stage-3 poison: the 5th surviving row's service call answers
+        # 404.  Survivors of rows {2,5,8} are [0,1,3,4,6,...] → row 6.
+        fault_registry.inject("http.send", "http_500", status=404,
+                              after=4, times=1)
+        out = model.transform(self._data(poisoned=True))
+
+        survived = sorted(int(i) for i in out.source_index)
+        assert survived == [0, 1, 3, 4, 7, 9, 10, 11]
+        # clean rows transformed correctly end to end
+        np.testing.assert_array_equal(
+            out["x2"], np.asarray(survived, dtype=np.float64) * 1.5)
+        for i, resp in zip(survived, out["resp"]):
+            assert resp == {"echo": {"x2": i * 1.5}}
+        assert all(e is None for e in out["errors"])
+
+        # dead-letter store: every poison row, right stage, right source
+        store = Quarantine(qdir)
+        by_stage = {uid: sorted(r.row_index for r in store.records(uid))
+                    for uid in store.stage_uids()}
+        assert by_stage == {scale.uid: [2, 5],
+                            parse_tag.uid: [8],
+                            call.uid: [6]}
+        rec404 = store.records(call.uid)[0]
+        assert "404" in rec404.error_message
+        # the quarantined row carries the stage-INPUT values for replay
+        row6 = store.rows(call.uid)
+        assert float(row6["x2"][0]) == 9.0
+
+    def test_clean_rows_bit_identical_to_unpoisoned_run(
+            self, fault_registry, tmp_path, echo_url):
+        qdir = str(tmp_path / "dead")
+        model, _ = self._pipeline(echo_url, "quarantine", qdir)
+        fault_registry.inject("http.send", "http_500", status=404,
+                              after=4, times=1)
+        out = model.transform(self._data(poisoned=True))
+
+        ref_model, _ = self._pipeline(echo_url, "error", None)
+        ref = ref_model.transform(self._data(poisoned=False))
+
+        idx = np.asarray(out.source_index)
+        np.testing.assert_array_equal(out["x2"], ref["x2"][idx])
+        np.testing.assert_array_equal(out["tagnum"], ref["tagnum"][idx])
+        for resp, want in zip(out["resp"], ref["resp"][idx]):
+            assert resp == want
