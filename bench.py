@@ -546,6 +546,63 @@ def bench_guard_overhead():
     return overhead, base_ms, guard_ms
 
 
+def bench_gang_recovery():
+    """Gang fault-tolerance cost, measured by making the fault happen:
+    SIGKILL one rank of an elastic checkpointing job and clock the wall
+    time from failure detection to the relaunched gang re-reaching the
+    killed attempt's best step (``GangSupervisor.last_recovery_s``).
+    Also contrasts clean-path launches with heartbeats on vs off
+    (alternating pairs, median of per-pair differences) — the
+    supervision overhead bar is < 3%.
+
+    → (gang_recovery_seconds, hb_overhead_pct, clean_launch_s)."""
+    import tempfile
+
+    from synapseml_tpu.parallel import GangSupervisor
+    from synapseml_tpu.resilience import RetryPolicy
+
+    # the elastic_counter task lives in tests/ (the launcher propagates
+    # sys.path to workers, so the driver only needs it importable here)
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+
+    task_args = {"steps": 6, "step_sleep_s": 0.2}
+
+    def launch(hb_s, faults=None, ckpt=None):
+        sup = GangSupervisor(
+            "mp_tasks:elastic_counter", n_processes=1,
+            devices_per_process=1, task_args=task_args, timeout_s=120.0,
+            heartbeat_interval_s=hb_s,
+            retry_policy=RetryPolicy(max_retries=3, base_s=0.01, seed=2),
+            checkpoint_dir=ckpt,
+            env_extra={"SML_FAULTS": faults} if faults else None)
+        t0 = time.perf_counter()
+        sup.run()
+        return time.perf_counter() - t0, sup
+
+    # recovery: kill after the 3rd durable step, relaunch, resume
+    with tempfile.TemporaryDirectory() as ckpt:
+        _, sup = launch(0.1, faults="mp.step=kill_rank:rank=0:after=2",
+                        ckpt=ckpt)
+    recovery_s = sup.last_recovery_s
+    assert recovery_s is not None and sup.restarts >= 1
+
+    # clean-path overhead: alternating hb-on/hb-off pairs, median diff
+    deltas, bases = [], []
+    for i in range(3):
+        first, second = (1.0, 0.0) if i % 2 == 0 else (0.0, 1.0)
+        a, _ = launch(first)
+        b, _ = launch(second)
+        on_s, off_s = (a, b) if i % 2 == 0 else (b, a)
+        bases.append(off_s)
+        deltas.append(on_s - off_s)
+    base_s = sorted(bases)[1]
+    delta_s = sorted(deltas)[1]
+    return recovery_s, delta_s / base_s * 100.0, base_s
+
+
 def bench_resnet50():
     """ResNet-50 ONNX batch inference img/s/chip at f32 and bf16
     (BASELINE config #2; reference path: ONNXModel.scala:242-251 over ONNX
@@ -963,6 +1020,17 @@ def main():
     except Exception as e:
         print(f"[secondary] serving bench failed: {e}", file=sys.stderr)
 
+    gang_recovery_s = gang_hb_pct = gang_launch_s = None
+    try:
+        gang_recovery_s, gang_hb_pct, gang_launch_s = bench_gang_recovery()
+        print(f"[secondary] gang recovery (SIGKILL → resumed step): "
+              f"{gang_recovery_s:.2f} s; heartbeat clean-path overhead "
+              f"{gang_hb_pct:+.2f}% on a {gang_launch_s:.2f} s launch",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] gang-recovery bench failed: {e}",
+              file=sys.stderr)
+
     guard_pct = guard_base_ms = guard_guarded_ms = None
     try:
         guard_pct, guard_base_ms, guard_guarded_ms = bench_guard_overhead()
@@ -1059,6 +1127,13 @@ def main():
             round(serving_marg_ms, 4) if serving_marg_ms else None),
         "serving_solo_rtt_ms": (round(serving_solo_ms, 3)
                                 if serving_solo_ms else None),
+        "gang_recovery_seconds": (
+            round(gang_recovery_s, 3) if gang_recovery_s is not None
+            else None),
+        "gang_hb_overhead_pct": (
+            round(gang_hb_pct, 3) if gang_hb_pct is not None else None),
+        "gang_clean_launch_seconds": (
+            round(gang_launch_s, 3) if gang_launch_s is not None else None),
         "rowguard_clean_overhead_pct": (
             round(guard_pct, 3) if guard_pct is not None else None),
         "rowguard_unguarded_transform_ms": (

@@ -800,6 +800,11 @@ def _write_checkpoint(directory: str, booster: Booster,
     # which _latest_checkpoint never matches — resume sees the prior step
     get_faults().kill_point("gbdt.checkpoint.pre_publish", iteration=n)
     os.replace(tmp, path)
+    # the published step is this rank's durable position: report it on
+    # the heartbeat channel so the gang supervisor's verdicts (and the
+    # elastic-resume recovery clock) carry real training progress
+    from ...parallel.heartbeat import beat
+    beat(step=n)
     get_faults().kill_point("gbdt.checkpoint", iteration=n)
     matches = (_re.match(r"iter_(\d+)\.json$", x)
                for x in os.listdir(directory))

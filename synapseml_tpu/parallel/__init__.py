@@ -1,10 +1,13 @@
-from .collectives import (all_gather, allreduce_fn, axis_index, barrier,
+from .collectives import (CollectiveTimeout, all_gather, allreduce_fn,
+                          axis_index, barrier, dispatch_watchdog,
                           hierarchical_psum, pmax, pmean, pmin, ppermute,
                           psum, reduce_scatter, ring_allreduce, ring_shift,
                           shard_map_over, tree_psum_bucketed)
 from .distributed import ClusterConfig, initialize_cluster, shutdown_cluster
-from .launcher import WorkerFailure, find_free_port, run_on_local_cluster
+from .launcher import (ReservedPort, WorkerFailure, find_free_port,
+                       run_on_local_cluster)
 from .selfcheck import cluster_report
+from .supervisor import GangSupervisor, HeartbeatMonitor
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    batch_sharding, data_parallel_mesh, dp_ep_mesh, dp_sp_tp_mesh,
                    dp_tp_mesh, local_mesh_devices, make_mesh, pad_to_multiple,

@@ -14,8 +14,9 @@ Use inside ``shard_map``/``pjit`` bodies with the axis names from
 from __future__ import annotations
 
 import functools
+import threading
 import time
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -23,8 +24,95 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..resilience.faults import get_faults
 from ..telemetry import get_registry
 from .mesh import DATA_AXIS
+
+
+class CollectiveTimeout(RuntimeError):
+    """A host-dispatched collective (or the cluster rendezvous) blocked
+    past its deadline.
+
+    A rank stuck in an allreduce whose peer died would otherwise freeze
+    silently until the gang's global timeout; this converts the freeze
+    into a structured failure carrying enough to diagnose it — the op,
+    the mesh axis, the per-shard payload, and the deadline that expired —
+    and the gang supervisor treats it as a whole-gang failure (the
+    blocked native dispatch itself cannot be cancelled; the raising
+    process exits and the supervisor relaunches)."""
+
+    def __init__(self, op: str, axis, timeout_s: float,
+                 payload_bytes: Optional[int] = None):
+        extra = (f", {payload_bytes} payload bytes"
+                 if payload_bytes is not None else "")
+        super().__init__(
+            f"collective {op!r} over axis {axis!r} still blocked after "
+            f"{timeout_s:.3f}s{extra}")
+        self.op = op
+        self.axis = str(axis)
+        self.timeout_s = float(timeout_s)
+        self.payload_bytes = payload_bytes
+
+
+def _payload_bytes(x) -> int:
+    nbytes = 0
+    for leaf in jax.tree_util.tree_leaves(x):
+        size, dtype = getattr(leaf, "size", None), getattr(leaf, "dtype",
+                                                           None)
+        if size is not None and dtype is not None:
+            nbytes += int(size) * np.dtype(dtype).itemsize
+    return nbytes
+
+
+def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
+                      deadline=None, timeout_s: Optional[float] = None,
+                      payload_bytes: Optional[int] = None, **kw):
+    """Run a blocking dispatch under a host-side watchdog timer.
+
+    ``deadline`` (a :class:`~synapseml_tpu.resilience.Deadline`) and/or
+    ``timeout_s`` bound the wait; with neither, the call runs inline
+    (zero overhead — no thread).  On expiry the caller gets a
+    :class:`CollectiveTimeout` and ``collective_timeouts_total{op,axis}``
+    ticks; the worker thread stays parked on the un-cancellable native
+    call (daemon — it dies with the process, which is the supervisor's
+    next move anyway).
+
+    The ``collective.dispatch`` fault site fires INSIDE the watched
+    thread, so an armed ``hang`` rule wedges the dispatch exactly where
+    a lost peer would.
+    """
+    if deadline is not None:
+        timeout_s = deadline.limit(timeout_s)
+    if timeout_s is None:
+        get_faults().raise_point("collective.dispatch", op=op,
+                                 axis=str(axis))
+        return fn(*args, **kw)
+    box: dict = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            get_faults().raise_point("collective.dispatch", op=op,
+                                     axis=str(axis))
+            box["value"] = fn(*args, **kw)
+        except BaseException as e:      # surfaced on the caller's thread
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"collective-{op}")
+    t.start()
+    if not done.wait(timeout=max(0.0, float(timeout_s))):
+        get_registry().counter(
+            "collective_timeouts_total",
+            "host-dispatched collectives that blocked past their "
+            "deadline", ("op", "axis")).inc(1, op=op, axis=str(axis))
+        raise CollectiveTimeout(op, axis, float(timeout_s),
+                                payload_bytes=payload_bytes)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 def _record(op: str, axis, x) -> None:
@@ -236,7 +324,12 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
     The returned callable is host-dispatched (unlike the in-jit wrappers
     above), so each call ALSO lands one sample in the
     ``collective_latency_seconds`` histogram — dispatch latency under
-    async execution, true op latency when the caller synchronizes."""
+    async execution, true op latency when the caller synchronizes.
+
+    Hang-proofing: pass ``deadline=`` (a :class:`~synapseml_tpu.
+    resilience.Deadline`) or ``timeout_s=`` per call and an
+    indefinitely-blocked dispatch raises :class:`CollectiveTimeout`
+    instead of freezing the rank (see :func:`dispatch_watchdog`)."""
     @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P())
@@ -250,10 +343,20 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
         ("op", "axis"))
 
     @functools.wraps(_allreduce)
-    def timed(x):
+    def timed(x, *, deadline=None, timeout_s=None):
         _record("allreduce_fn", axis, x)
         t0 = time.perf_counter()
-        out = _allreduce(x)
+        if deadline is None and timeout_s is None:
+            out = _allreduce(x)
+        else:
+            # the watched leg must SYNCHRONIZE: under async dispatch the
+            # bare call returns before the ring moves a byte, and a hung
+            # collective would block some later consumer instead of here
+            out = dispatch_watchdog(
+                lambda v: jax.block_until_ready(_allreduce(v)), x,
+                op="allreduce_fn", axis=axis,
+                deadline=deadline, timeout_s=timeout_s,
+                payload_bytes=_payload_bytes(x))
         latency.observe(time.perf_counter() - t0, op="allreduce_fn",
                         axis=str(axis))
         return out

@@ -53,7 +53,24 @@ def _configure_backend(cfg: ClusterConfig) -> None:
     if cfg.platform == "cpu":
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         if cfg.local_device_count:
-            jax.config.update("jax_num_cpu_devices", cfg.local_device_count)
+            try:
+                jax.config.update("jax_num_cpu_devices",
+                                  cfg.local_device_count)
+            except AttributeError:
+                # jax 0.4.x has no jax_num_cpu_devices; the XLA flag does
+                # the same job as long as it lands before backend creation
+                # (we are before it — that is this function's contract).
+                # An inherited count (the driver's test harness sets one)
+                # must be REPLACED, not kept — this process's share of the
+                # mesh is cfg.local_device_count, nothing else.
+                import os
+                import re
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "",
+                    os.environ.get("XLA_FLAGS", ""))
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{cfg.local_device_count}").strip()
 
 
 def initialize_cluster(config: Optional[ClusterConfig] = None,

@@ -15,20 +15,29 @@ real on one machine: N processes x M virtual CPU devices per process form a
 genuine cross-process mesh (gloo collectives), the same code path a multi-host
 TPU pod takes (PJRT collectives over ICI/DCN).
 
-Failure handling: a failed attempt raises :class:`WorkerFailure` carrying a
-structured per-rank cause map (``timeout`` / ``exit <code>`` / ``no
-result``) with every rank's log tail — the reference's NetworkManager
-retries its rendezvous socket (NetworkManager.scala:294-340) and so does
-this driver: pass a :class:`~synapseml_tpu.resilience.RetryPolicy` and the
-whole launch (fresh coordinator port, fresh processes) retries under its
-backoff, since a partial cluster cannot be patched rank-by-rank once
+Supervision: every worker emits ``SMLMP_HB`` heartbeat lines on the same
+pipe as ``RESULT_MARKER``; the driver's watch loop feeds them to a
+:class:`~synapseml_tpu.parallel.supervisor.HeartbeatMonitor` so a dead OR
+hung rank is declared failed in O(heartbeat interval), not O(global
+timeout).  A failed attempt tears the whole gang down (SIGTERM → grace →
+SIGKILL) and raises :class:`WorkerFailure` with a structured per-rank
+cause map (``timeout`` / ``exit <code>`` / ``no result`` / ``hang at step
+N`` / ``no heartbeat`` / advisory ``straggler``) plus every rank's
+ring-buffered log tail.  Pass a :class:`~synapseml_tpu.resilience.
+RetryPolicy` and the whole launch relaunches elastically (fresh
+coordinator port, fresh processes) via :class:`~synapseml_tpu.parallel.
+supervisor.GangSupervisor` — with ``checkpoint_dir`` threaded through,
+checkpointing trainers resume from the last complete step instead of
+step 0, since a partial cluster cannot be patched rank-by-rank once
 ``jax.distributed`` has formed.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -38,27 +47,85 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ..resilience import RetryPolicy, get_faults
 from ..telemetry import get_registry
+from .heartbeat import HB_INTERVAL_ENV, parse_heartbeat
 
 #: marker the worker prints in front of its JSON result line
 RESULT_MARKER = "SMLMP_RESULT:"
 
+#: ring-buffer depth of retained log lines per rank (a chatty rank must
+#: not grow the driver without bound; failures surface only the tail)
+DEFAULT_TAIL_LINES = 400
+#: per-line retention cap — one enormous line must not defeat the ring
+_MAX_LINE_CHARS = 4096
+
+#: env var carrying the checkpoint directory to every worker
+CKPT_DIR_ENV = "SMLTPU_CKPT_DIR"
+#: env var carrying the worker-side rendezvous watchdog deadline
+RENDEZVOUS_TIMEOUT_ENV = "SMLTPU_RENDEZVOUS_TIMEOUT_S"
+
+
+class ReservedPort:
+    """A free TCP port that STAYS bound until :meth:`release`.
+
+    The old ``find_free_port`` close-then-rebind dance had a race: between
+    the driver closing its probe socket and rank 0's ``jax.distributed``
+    service binding the port, any other process could grab it.  Holding
+    the socket (``SO_REUSEADDR`` + ``SO_REUSEPORT`` where available)
+    keeps the kernel from handing the port to anyone else for the whole
+    spawn window; the driver releases it only after every worker process
+    exists, leaving just the unavoidable sliver between release and the
+    coordinator's own bind (rank 0 still has its multi-second interpreter
+    + jax import ahead of it at that point)."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if hasattr(socket, "SO_REUSEPORT"):
+            try:
+                self._sock.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEPORT, 1)
+            except OSError:
+                pass
+        self._sock.bind((host, 0))
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+
+    @property
+    def held(self) -> bool:
+        return self._sock is not None
+
+    def release(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self) -> "ReservedPort":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
 
 def find_free_port() -> int:
-    """Ask the kernel for a free TCP port (the driver's ServerSocket bind,
-    NetworkManager.scala:299 — there the socket is kept open; here the
-    coordinator re-binds it immediately so a race is possible but unlikely)."""
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    """Ask the kernel for a free TCP port.  Kept for compatibility;
+    prefer :class:`ReservedPort`, which holds the bind open instead of
+    close-then-rebind (the race this function cannot avoid)."""
+    with ReservedPort() as rp:
+        return rp.port
 
 
 def _rank_causes(returncodes: Dict[int, Optional[int]],
                  timed_out: Sequence[int],
-                 missing_result: Sequence[int]) -> Dict[int, str]:
-    """Structured per-rank failure causes (only failed ranks appear)."""
-    causes: Dict[int, str] = {}
+                 missing_result: Sequence[int],
+                 extra: Optional[Dict[int, str]] = None) -> Dict[int, str]:
+    """Structured per-rank failure causes (only failed ranks appear).
+    ``extra`` (heartbeat verdicts / straggler advisories) wins over the
+    generic exit-code causes — 'hang at step 3' beats 'exit -9'."""
+    causes: Dict[int, str] = dict(extra or {})
     for r in timed_out:
-        causes[r] = "timeout"
+        causes.setdefault(r, "timeout")
     for r, rc in returncodes.items():
         if r not in causes and rc not in (0, None):
             causes[r] = f"exit {rc}"
@@ -68,10 +135,10 @@ def _rank_causes(returncodes: Dict[int, Optional[int]],
 
 
 class WorkerFailure(RuntimeError):
-    """A worker exited non-zero, timed out, or produced no result.
+    """A worker exited non-zero, timed out, hung, or produced no result.
 
     ``causes`` maps failed rank → cause string; ``logs`` maps every rank
-    → its captured output."""
+    → its captured output tail (ring-buffered)."""
 
     def __init__(self, msg: str, logs: Dict[int, str],
                  causes: Optional[Dict[int, str]] = None):
@@ -84,95 +151,237 @@ class WorkerFailure(RuntimeError):
         self.logs = logs
 
 
+class _RankReader(threading.Thread):
+    """Per-rank pipe drain: parses heartbeat/result markers on the fly
+    and retains only a bounded tail of raw lines.
+
+    A rank that fills the OS pipe buffer mid-collective would deadlock
+    the whole cluster if nobody read its pipe, and on failure we want
+    EVERY rank's tail, not just the first one waited on — but a chatty
+    rank streaming millions of lines must not grow the driver without
+    limit, hence the ring buffer."""
+
+    def __init__(self, rank: int, proc: subprocess.Popen,
+                 monitor=None, tail_lines: int = DEFAULT_TAIL_LINES):
+        super().__init__(name=f"rank-reader-{rank}", daemon=True)
+        self.rank = rank
+        self.proc = proc
+        self.monitor = monitor
+        self.tail: "collections.deque[str]" = collections.deque(
+            maxlen=max(1, tail_lines))
+        self.result_line: Optional[str] = None
+        self.dropped = 0
+
+    def run(self) -> None:
+        stream = self.proc.stdout
+        if stream is None:
+            return
+        for line in stream:
+            line = line.rstrip("\n")
+            hb = parse_heartbeat(line)
+            if hb is not None:
+                if self.monitor is not None:
+                    self.monitor.observe(self.rank, step=hb.get("step"),
+                                         ts=hb.get("ts"))
+                continue                       # beats never enter the tail
+            if line.startswith(RESULT_MARKER):
+                # the result must survive any amount of later chatter,
+                # so it is captured out-of-band from the ring
+                self.result_line = line
+            if len(self.tail) == self.tail.maxlen:
+                self.dropped += 1
+            self.tail.append(line[:_MAX_LINE_CHARS])
+
+    def text(self) -> str:
+        head = (f"... ({self.dropped} earlier lines dropped)\n"
+                if self.dropped else "")
+        return head + "\n".join(self.tail)
+
+
+def _teardown_gang(procs: List[subprocess.Popen],
+                   term_grace_s: float = 2.0) -> None:
+    """SIGTERM every live rank, give the gang ``term_grace_s`` to unwind
+    (flush logs, run finally blocks), then SIGKILL whatever remains — a
+    rank blocked inside a native collective never sees the SIGTERM, which
+    is exactly why the KILL follows."""
+    faults = get_faults()
+    alive = [p for p in procs if p.poll() is None]
+    for p in alive:
+        try:
+            p.send_signal(signal.SIGTERM)
+            faults.note("gang.teardown", pid=p.pid, sig="SIGTERM")
+        except OSError:
+            pass
+    deadline = time.monotonic() + max(0.0, term_grace_s)
+    while alive and time.monotonic() < deadline:
+        alive = [p for p in alive if p.poll() is None]
+        if alive:
+            time.sleep(0.02)
+    for p in alive:
+        if p.poll() is None:
+            try:
+                p.kill()
+                faults.note("gang.teardown", pid=p.pid, sig="SIGKILL")
+            except OSError:
+                pass
+
+
 def _launch_once(task: str, n_processes: int, devices_per_process: int,
                  task_args: Any, timeout_s: float,
-                 env_extra: Optional[Dict[str, str]]) -> List[Any]:
-    """One rendezvous attempt: spawn, wait, collect (or WorkerFailure)."""
+                 env_extra: Optional[Dict[str, str]], *,
+                 monitor=None, heartbeat_interval_s: float = 0.0,
+                 checkpoint_dir: Optional[str] = None,
+                 term_grace_s: float = 2.0,
+                 tail_lines: int = DEFAULT_TAIL_LINES) -> List[Any]:
+    """One rendezvous attempt: spawn, watch (heartbeats + exits + global
+    deadline), collect (or tear down and raise WorkerFailure)."""
     # fault site: an armed rule here stands in for a failed rendezvous
     # without burning real subprocess spawns in tests
     if get_faults().check("launcher.attempt") is not None:
         raise WorkerFailure("injected rendezvous failure", {},
                             causes={r: "injected" for r in range(n_processes)})
-    port = find_free_port()
-    coordinator = f"127.0.0.1:{port}"
+    reserved = ReservedPort()
+    coordinator = f"{reserved.host}:{reserved.port}"
     procs: List[subprocess.Popen] = []
-    logs: Dict[int, str] = {}
+    readers: List[_RankReader] = []
     args_json = json.dumps(task_args)
     pythonpath = os.pathsep.join(
         [p for p in sys.path if p and os.path.isdir(p)])
+    reg = get_registry()
+    g_hb_age = reg.gauge("rank_heartbeat_age_seconds",
+                         "seconds since each rank's last heartbeat "
+                         "(live gang attempts only)", ("rank",))
     try:
-        for rank in range(n_processes):
-            env = dict(os.environ)
-            env.update(env_extra or {})
-            env.update({
-                "SMLTPU_COORDINATOR": coordinator,
-                "SMLTPU_NUM_PROCESSES": str(n_processes),
-                "SMLTPU_PROCESS_ID": str(rank),
-                "SMLTPU_PLATFORM": "cpu",
-                "SMLTPU_LOCAL_DEVICES": str(devices_per_process),
-                "SMLTPU_TASK": task,
-                "SMLTPU_TASK_ARGS": args_json,
-                "PYTHONPATH": pythonpath,
-            })
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "synapseml_tpu.parallel.worker"],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, env=env))
-        # drain every rank's pipe on its own thread: a rank that fills the
-        # OS pipe buffer mid-collective would otherwise deadlock the whole
-        # cluster, and on failure we want EVERY rank's log, not just the
-        # first one waited on
-        readers = []
-        for rank, p in enumerate(procs):
-            t = threading.Thread(
-                target=lambda r=rank, pr=p: logs.__setitem__(
-                    r, pr.stdout.read() or ""),
-                daemon=True)
-            t.start()
-            readers.append(t)
+        try:
+            for rank in range(n_processes):
+                env = dict(os.environ)
+                env.update(env_extra or {})
+                env.update({
+                    "SMLTPU_COORDINATOR": coordinator,
+                    "SMLTPU_NUM_PROCESSES": str(n_processes),
+                    "SMLTPU_PROCESS_ID": str(rank),
+                    "SMLTPU_PLATFORM": "cpu",
+                    "SMLTPU_LOCAL_DEVICES": str(devices_per_process),
+                    "SMLTPU_TASK": task,
+                    "SMLTPU_TASK_ARGS": args_json,
+                    "PYTHONPATH": pythonpath,
+                })
+                if heartbeat_interval_s > 0:
+                    env[HB_INTERVAL_ENV] = str(heartbeat_interval_s)
+                    env.setdefault(RENDEZVOUS_TIMEOUT_ENV, str(timeout_s))
+                if checkpoint_dir:
+                    env[CKPT_DIR_ENV] = str(checkpoint_dir)
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "synapseml_tpu.parallel.worker"],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, env=env)
+                procs.append(p)
+                r = _RankReader(rank, p, monitor=monitor,
+                                tail_lines=tail_lines)
+                r.start()
+                readers.append(r)
+        finally:
+            # the port stays reserved for the whole spawn window; only
+            # once every worker exists (each still facing its multi-second
+            # jax import before rank 0 binds) is it handed over
+            reserved.release()
+
         deadline = time.monotonic() + timeout_s
-        timed_out = []
-        for rank, p in enumerate(procs):
-            remaining = max(0.1, deadline - time.monotonic())
-            try:
-                p.wait(timeout=remaining)
-            except subprocess.TimeoutExpired:
-                timed_out.append(rank)
-        if timed_out:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        for t in readers:
-            t.join(timeout=10.0)
-        returncodes = {r: p.returncode for r, p in enumerate(procs)}
+        poll_s = (min(0.25, heartbeat_interval_s / 4.0)
+                  if heartbeat_interval_s > 0 else 0.05)
+        timed_out: List[int] = []
+        hb_causes: Dict[int, str] = {}
+        while True:
+            running = []
+            failed_exit = False
+            for rank, p in enumerate(procs):
+                rc = p.poll()
+                if rc is None:
+                    running.append(rank)
+                elif rc == 0:
+                    if monitor is not None:
+                        monitor.mark_done(rank)
+                else:
+                    failed_exit = True
+            if failed_exit:
+                # one dead rank wedges every peer inside its blocked
+                # collectives: fail the gang NOW, not at the timeout
+                break
+            if not running:
+                break
+            if monitor is not None:
+                for rank, age in monitor.ages().items():
+                    g_hb_age.set(age, rank=str(rank))
+                hb_causes = monitor.verdicts()
+                if hb_causes:
+                    break
+            if time.monotonic() >= deadline:
+                timed_out = running
+                break
+            time.sleep(poll_s)
+
+        # snapshot exits BEFORE tearing down: a rank WE kill must not be
+        # blamed with its teardown signal in the cause map
+        returncodes = {rank: p.poll() for rank, p in enumerate(procs)}
+        if timed_out or hb_causes or any(
+                rc not in (0, None) for rc in returncodes.values()):
+            _teardown_gang(procs, term_grace_s=term_grace_s)
+        for r in readers:
+            r.join(timeout=10.0)
+        logs = {r.rank: r.text() for r in readers}
+
+        stragglers = monitor.stragglers() if monitor is not None else {}
+
+        def _with_steps(causes: Dict[int, str]) -> Dict[int, str]:
+            # every verdict carries the rank's last-known step, so the
+            # relaunch decision (and the human) knows how much work died
+            if monitor is None:
+                return causes
+            steps = monitor.last_steps()
+            return {r: (c if "step" in c or steps.get(r) is None
+                        else f"{c} (last step {steps[r]})")
+                    for r, c in causes.items()}
+
+        if hb_causes:
+            raise WorkerFailure(
+                f"ranks {sorted(hb_causes)} declared failed by the "
+                "heartbeat detector", logs,
+                causes=_with_steps(_rank_causes(
+                    returncodes, [], [],
+                    extra={**stragglers, **hb_causes})))
         if timed_out:
             raise WorkerFailure(
                 f"ranks {timed_out} timed out after {timeout_s:.0f}s", logs,
-                causes=_rank_causes(returncodes, timed_out, []))
-        failed = [r for r, rc in returncodes.items() if rc != 0]
+                causes=_with_steps(_rank_causes(returncodes, timed_out, [],
+                                                extra=stragglers)))
+        # rc None = still running at snapshot time (torn down by us, not
+        # a failure of its own)
+        failed = [r for r, rc in returncodes.items() if rc not in (0, None)]
         if failed:
             raise WorkerFailure(
                 f"ranks {failed} exited non-zero", logs,
-                causes=_rank_causes(returncodes, [], []))
+                causes=_with_steps(_rank_causes(returncodes, [], [],
+                                                extra=stragglers)))
         results: List[Any] = []
         missing: List[int] = []
-        for rank, p in enumerate(procs):
-            lines = [ln for ln in logs[rank].splitlines()
-                     if ln.startswith(RESULT_MARKER)]
-            if not lines:
-                missing.append(rank)
+        for r in readers:
+            if r.result_line is None:
+                missing.append(r.rank)
                 results.append(None)
             else:
-                results.append(json.loads(lines[-1][len(RESULT_MARKER):]))
+                results.append(json.loads(
+                    r.result_line[len(RESULT_MARKER):]))
         if missing:
             raise WorkerFailure(
                 f"ranks {missing} produced no result", logs,
                 causes=_rank_causes(returncodes, [], missing))
         return results
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+        reserved.release()
+        _teardown_gang(procs, term_grace_s=0.0)
+        if monitor is not None:
+            for rank in range(n_processes):
+                g_hb_age.set(0.0, rank=str(rank))
 
 
 def run_on_local_cluster(task: str,
@@ -182,6 +391,13 @@ def run_on_local_cluster(task: str,
                          timeout_s: float = 300.0,
                          env_extra: Optional[Dict[str, str]] = None,
                          retry_policy: Optional[RetryPolicy] = None,
+                         heartbeat_interval_s: float = 1.0,
+                         hang_intervals: float = 3.0,
+                         startup_grace_s: float = 120.0,
+                         straggler_lag_steps: Optional[int] = None,
+                         checkpoint_dir: Optional[Any] = None,
+                         term_grace_s: float = 2.0,
+                         tail_lines: int = DEFAULT_TAIL_LINES,
                          ) -> List[Any]:
     """Run ``module:function`` on a real N-process JAX cluster; return the
     per-rank results (rank order).
@@ -192,27 +408,24 @@ def run_on_local_cluster(task: str,
     table, and runs ``function(task_args)`` with collectives live across
     process boundaries.  The function must return something JSON-serializable.
 
-    ``retry_policy``: on :class:`WorkerFailure` the WHOLE launch retries
-    (fresh port, fresh processes) under the policy's backoff — a formed
-    ``jax.distributed`` cluster cannot re-admit a replacement rank, so
-    whole-gang restart is the only sound retry unit.  The raised failure
-    (when retries exhaust) is the LAST attempt's, with per-rank causes.
+    Supervision is on by default (``heartbeat_interval_s=1.0``): every
+    rank emits heartbeats, and a dead/hung rank fails the attempt within
+    ``hang_intervals`` beats.  ``retry_policy``: on :class:`WorkerFailure`
+    the WHOLE launch retries (fresh port, fresh processes) under the
+    policy's backoff — a formed ``jax.distributed`` cluster cannot
+    re-admit a replacement rank, so whole-gang restart is the only sound
+    retry unit.  ``checkpoint_dir`` (a path or ``CheckpointManager``)
+    reaches every worker as ``SMLTPU_CKPT_DIR`` so checkpointing trainers
+    resume instead of restarting.  The raised failure (when retries
+    exhaust) is the LAST attempt's, with per-rank causes.
     """
-    attempts = 1 + (retry_policy.max_retries if retry_policy else 0)
-    reg = get_registry()
-    m_retries = reg.counter("launcher_rendezvous_retries_total",
-                            "whole-gang launch retries", ("task",))
-    last: Optional[WorkerFailure] = None
-    for attempt in range(attempts):
-        try:
-            return _launch_once(task, n_processes, devices_per_process,
-                                task_args, timeout_s, env_extra)
-        except WorkerFailure as e:
-            last = e
-            if retry_policy is None or attempt >= attempts - 1 \
-                    or not retry_policy.acquire_retry():
-                raise
-            m_retries.inc(1, task=task)
-            retry_policy.sleep(retry_policy.backoff_s(attempt),
-                               site="launcher.backoff")
-    raise last  # pragma: no cover — loop always returns or raises
+    from .supervisor import GangSupervisor
+    return GangSupervisor(
+        task, n_processes=n_processes,
+        devices_per_process=devices_per_process, task_args=task_args,
+        timeout_s=timeout_s, env_extra=env_extra, retry_policy=retry_policy,
+        heartbeat_interval_s=heartbeat_interval_s,
+        hang_intervals=hang_intervals, startup_grace_s=startup_grace_s,
+        straggler_lag_steps=straggler_lag_steps,
+        checkpoint_dir=checkpoint_dir, term_grace_s=term_grace_s,
+        tail_lines=tail_lines).run()

@@ -7,6 +7,20 @@ half of the reference's handshake (NetworkManager.scala:123-169 — there the
 worker phones the driver's ServerSocket and blocks on the machine-list
 reply; here ``jax.distributed.initialize`` is both legs).
 
+Gang supervision hooks (all driver-controlled via env):
+
+- ``SMLTPU_HB_INTERVAL_S`` > 0 starts the heartbeat emitter thread FIRST,
+  so the driver distinguishes "still importing jax" (beats flowing, no
+  step) from "process wedged" (beats stopped) from "boot failure" (no
+  beat at all).
+- ``SMLTPU_RENDEZVOUS_TIMEOUT_S`` arms a host-side watchdog around the
+  blocking ``initialize_cluster`` call: a coordinator that never answers
+  becomes a structured :class:`~synapseml_tpu.parallel.collectives.
+  CollectiveTimeout` (op ``rendezvous``) and a fast non-zero exit, not an
+  indefinitely-hung rank.
+- ``SMLTPU_CKPT_DIR`` names the gang's checkpoint directory; tasks read
+  it to resume elastically after a relaunch.
+
 Run as ``python -m synapseml_tpu.parallel.worker`` with the SMLTPU_* env
 set by ``launcher.run_on_local_cluster``.
 """
@@ -28,23 +42,46 @@ def main() -> int:
     task = os.environ["SMLTPU_TASK"]
     task_args = json.loads(os.environ.get("SMLTPU_TASK_ARGS", "null"))
 
+    # heartbeats first: the gang supervisor must see this rank alive
+    # before (and during) the slow rendezvous below
+    from synapseml_tpu.parallel import heartbeat
+    emitter = heartbeat.start_emitter(rank)
+
     from synapseml_tpu.parallel.distributed import (ClusterConfig,
                                                     initialize_cluster,
                                                     shutdown_cluster)
-    initialize_cluster(ClusterConfig(
+    cfg = ClusterConfig(
         coordinator_address=coordinator,
         num_processes=n_procs,
         process_id=rank,
         platform=platform,
         local_device_count=local_devices,
-    ))
+    )
+    rdv_timeout = float(
+        os.environ.get("SMLTPU_RENDEZVOUS_TIMEOUT_S", "0") or 0)
+    if rdv_timeout > 0:
+        from synapseml_tpu.parallel.collectives import dispatch_watchdog
+        dispatch_watchdog(initialize_cluster, cfg,
+                          op="rendezvous", axis="-",
+                          timeout_s=rdv_timeout)
+    else:
+        initialize_cluster(cfg)
+    heartbeat.beat(step=0)        # rendezvoused: step 0 is reachable
 
     mod_name, fn_name = task.split(":", 1)
     fn = getattr(importlib.import_module(mod_name), fn_name)
     result = fn(task_args)
-    # marker line is the contract with launcher.run_on_local_cluster
-    print("SMLMP_RESULT:" + json.dumps(result), flush=True)
+    # marker line is the contract with launcher.run_on_local_cluster —
+    # a single write call so the heartbeat thread's lines cannot land
+    # between the result text and its newline
+    sys.stdout.write("SMLMP_RESULT:" + json.dumps(result) + "\n")
+    sys.stdout.flush()
+    # keep beating THROUGH the distributed shutdown: it can take longer
+    # than the hang threshold, and a rank finishing cleanly must not be
+    # declared hung in its last second
     shutdown_cluster()
+    if emitter is not None:
+        emitter.stop()
     return 0
 
 

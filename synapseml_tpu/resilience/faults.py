@@ -27,6 +27,13 @@ Fault kinds:
                 ``RESOURCE_EXHAUSTED`` stand-in — device out of memory)
 ``poison``      raise :class:`PoisonRowError` (a data-dependent row
                 failure, for the ``rowguard.poison_row`` site)
+``hang``        block the calling thread for ``delay`` seconds (forever
+                when no delay is given) — a wedged collective / silent
+                rank, detectable only by a watchdog or heartbeat gap
+``kill_rank``   ``SIGKILL`` the current process, but only on the process
+                whose registry rank matches the rule's ``rank`` — the
+                per-rank form of ``kill`` for gang tests
+``slow_rank``   recorded sleep of ``delay`` seconds (a straggler rank)
 ==============  ============================================================
 
 Rule grammar (``SML_FAULTS``, rules joined by ``;``)::
@@ -35,8 +42,12 @@ Rule grammar (``SML_FAULTS``, rules joined by ``;``)::
 
 with keys ``times`` (max firings, default unlimited), ``after`` (skip the
 first N matching calls), ``p`` (firing probability, drawn from the seeded
-RNG), ``delay`` (seconds, for ``slow``), ``status`` (override the HTTP
-code) and ``retry_after`` (seconds, emitted as a ``Retry-After`` header).
+RNG), ``delay`` (seconds, for ``slow``/``slow_rank``/``hang``), ``status``
+(override the HTTP code), ``retry_after`` (seconds, emitted as a
+``Retry-After`` header) and ``rank`` (the rule fires only on the process
+whose :attr:`FaultRegistry.rank` matches — workers set it from
+``SMLTPU_PROCESS_ID``, so one ``SML_FAULTS`` string shared by a whole
+gang can target a single rank).
 ``SML_FAULTS_SEED`` seeds the RNG (default 0).  Example::
 
     SML_FAULTS="http.send=http_503:times=2:retry_after=0.05;gbdt.checkpoint=kill:after=1:times=1"
@@ -108,6 +119,8 @@ class FaultRule:
     delay_s: float = 0.0             # for kind="slow"
     status: Optional[int] = None     # HTTP code override
     retry_after_s: Optional[float] = None
+    #: only fire on the process whose registry rank matches (gang tests)
+    rank: Optional[int] = None
     #: programmatic-only context predicate — the rule fires only for
     #: calls whose ctx satisfies it (a non-matching call does not even
     #: count toward ``after``)
@@ -134,6 +147,15 @@ class FaultRegistry:
         #: True ⇒ record instrumented call sites into :attr:`call_log`
         #: (off by default: long-lived servers must not grow the log)
         self.record_calls = False
+        #: this process's gang rank (``rank=``-gated rules only fire when
+        #: it matches); workers inherit it from ``SMLTPU_PROCESS_ID``
+        self.rank: Optional[int] = None
+        rank_env = os.environ.get("SMLTPU_PROCESS_ID")
+        if rank_env is not None:
+            try:
+                self.rank = int(rank_env)
+            except ValueError:
+                pass
         self._env_loaded = False
 
     # -- arming ------------------------------------------------------------
@@ -141,9 +163,9 @@ class FaultRegistry:
                after: int = 0, p: float = 1.0, delay_s: float = 0.0,
                status: Optional[int] = None,
                retry_after_s: Optional[float] = None,
-               when=None) -> FaultRule:
+               rank: Optional[int] = None, when=None) -> FaultRule:
         rule = FaultRule(site, kind, times, after, p, delay_s, status,
-                         retry_after_s, when)
+                         retry_after_s, rank, when)
         with self._lock:
             self._rules.append(rule)
         return rule
@@ -175,6 +197,8 @@ class FaultRegistry:
                     kw["status"] = int(v)
                 elif k == "retry_after":
                     kw["retry_after_s"] = float(v)
+                elif k == "rank":
+                    kw["rank"] = int(v)
                 else:
                     raise ValueError(f"unknown fault option {k!r} in {part!r}")
             self.inject(site.strip(), kind, **kw)
@@ -222,6 +246,8 @@ class FaultRegistry:
             for rule in self._rules:
                 if not fnmatch.fnmatch(site, rule.site):
                     continue
+                if rule.rank is not None and rule.rank != self.rank:
+                    continue           # another rank's fault, not ours
                 if rule.when is not None and not rule.when(ctx):
                     continue           # ctx miss: not a matching call at all
                 rule.matched += 1
@@ -256,8 +282,23 @@ class FaultRegistry:
         self._execute_raise(site, rule)
 
     def _execute_raise(self, site: str, rule: FaultRule) -> None:
-        if rule.kind == "slow":
+        if rule.kind in ("slow", "slow_rank"):
             self.sleep(rule.delay_s, site=site)
+        elif rule.kind == "hang":
+            # a wedged thread, NOT a recorded backoff: honors neither
+            # no_sleep nor the sleep log — the whole point is that only a
+            # watchdog timeout or a heartbeat gap can observe it
+            threading.Event().wait(
+                rule.delay_s if rule.delay_s > 0 else None)
+        elif rule.kind == "kill_rank":
+            # record the kill before dying so a driver-shared call log
+            # (record_calls in-process) sees the event even though the
+            # process never returns
+            if self.record_calls:
+                with self._lock:
+                    self.call_log.append((site, {"kind": "kill_rank",
+                                                 "rank": self.rank}))
+            os.kill(os.getpid(), signal.SIGKILL)
         elif rule.kind == "reset":
             raise ConnectionResetError(f"injected connection reset at {site}")
         elif rule.kind == "broken_pipe":

@@ -1,10 +1,13 @@
 """Model serving (reference: core Spark Serving layer)."""
 
 from .continuous import ContinuousClient
-from .distributed import DistributedServingServer, exchange_routing_table
+from .distributed import (DistributedServingServer, NoHealthyReplicaError,
+                          ReplicaRouter, exchange_routing_table,
+                          probe_replica)
 from .server import (ApiHandle, MultiPipelineServer, PipelineServer,
                      ServingReply, ServingRequest, ServingServer)
 
 __all__ = ["ApiHandle", "ContinuousClient", "DistributedServingServer",
-           "MultiPipelineServer", "PipelineServer", "ServingReply",
-           "ServingRequest", "ServingServer", "exchange_routing_table"]
+           "MultiPipelineServer", "NoHealthyReplicaError", "PipelineServer",
+           "ReplicaRouter", "ServingReply", "ServingRequest",
+           "ServingServer", "exchange_routing_table", "probe_replica"]

@@ -11,17 +11,47 @@ table is rendezvoused over the mesh itself — each process contributes its
 collective fabric that carries training gradients also publishes the
 serving topology.  Any rank (or an external balancer) can then route
 requests to every host.
+
+Failover: the gathered table is a *topology*, not a liveness claim — a
+replica can die or drain at any time.  :class:`ReplicaRouter` layers the
+PR-2 health contract on top: per-replica ``/healthz``+``/readyz`` probes,
+per-replica circuit breakers (``breaker_for``), and a :meth:`~
+ReplicaRouter.route` that round-robins over replicas while skipping
+dead/draining ones and NEVER returning a replica whose breaker is open.
+After an elastic gang restart, :meth:`DistributedServingServer.
+refresh_routing_table` re-gathers the table over the re-formed mesh and
+rebuilds the router.  Health is exported as
+``serving_replicas_healthy{router}``.
 """
 
 from __future__ import annotations
 
 import socket
 import struct
+import threading
+import urllib.error
+import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import breaker_for
+from ..resilience.faults import get_faults
+from ..telemetry import get_registry
 from .server import ServingServer
+
+#: replica probe states
+HEALTHY, DRAINING, DEAD = "healthy", "draining", "dead"
+
+
+class NoHealthyReplicaError(RuntimeError):
+    """Every replica is dead, draining, or breaker-open."""
+
+    def __init__(self, statuses: Dict[int, str]):
+        super().__init__(
+            "no routable replica: " + ", ".join(
+                f"rank {r}: {s}" for r, s in sorted(statuses.items())))
+        self.statuses = dict(statuses)
 
 
 def _encode_addr(host: str, port: int) -> Tuple[int, int]:
@@ -35,10 +65,18 @@ def _decode_addr(ip_u32: int, port: int) -> Tuple[str, int]:
         int(port)
 
 
-def exchange_routing_table(host: str, port: int) -> List[Tuple[str, int]]:
+def exchange_routing_table(host: str, port: int,
+                           deadline=None,
+                           timeout_s: Optional[float] = None
+                           ) -> List[Tuple[str, int]]:
     """All-gather this process's listener address over the global device
     mesh → ``[(host, port)]`` indexed by process.  Single-process: the
-    local address alone (no collective)."""
+    local address alone (no collective).
+
+    ``deadline``/``timeout_s`` bound the gather itself: when a peer died
+    mid-restart the collective would block forever, and the bound turns
+    that into a :class:`~synapseml_tpu.parallel.collectives.
+    CollectiveTimeout` the gang supervisor handles."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -46,7 +84,8 @@ def exchange_routing_table(host: str, port: int) -> List[Tuple[str, int]]:
     if jax.process_count() == 1:
         return [(host, port)]
     from ..parallel.mesh import DATA_AXIS
-    from ..parallel.collectives import all_gather, shard_map_over
+    from ..parallel.collectives import (all_gather, dispatch_watchdog,
+                                        shard_map_over)
 
     devs = jax.devices()
     mesh = Mesh(np.array(devs), (DATA_AXIS,))
@@ -62,8 +101,15 @@ def exchange_routing_table(host: str, port: int) -> List[Tuple[str, int]]:
                      local[:, 1], local[:, 2]], axis=1).astype(np.int32)
     garr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P(DATA_AXIS)), rows, (n, 4))
-    gathered = jax.jit(shard_map_over(mesh, P(DATA_AXIS), P(DATA_AXIS))(
-        lambda x: all_gather(x, tiled=True)))(garr)
+    gathered_fn = jax.jit(shard_map_over(mesh, P(DATA_AXIS), P(DATA_AXIS))(
+        lambda x: all_gather(x, tiled=True)))
+    if deadline is not None or timeout_s is not None:
+        gathered = dispatch_watchdog(
+            lambda a: jax.block_until_ready(gathered_fn(a)), garr,
+            op="all_gather", axis=DATA_AXIS, deadline=deadline,
+            timeout_s=timeout_s, payload_bytes=int(rows.nbytes))
+    else:
+        gathered = gathered_fn(garr)
     table_rows = np.asarray(
         jax.device_get(gathered.addressable_shards[0].data))[:n]
     by_proc: Dict[int, Tuple[str, int]] = {}
@@ -73,6 +119,168 @@ def exchange_routing_table(host: str, port: int) -> List[Tuple[str, int]]:
     return [by_proc[i] for i in sorted(by_proc)]
 
 
+def probe_replica(host: str, port: int,
+                  timeout_s: float = 1.0) -> str:
+    """One replica's health, from its reserved paths: ``healthy`` (both
+    ``/healthz`` and ``/readyz`` answer 200), ``draining`` (alive but
+    readyz says stop routing — PR-2's drain/load-shed state), ``dead``
+    (unreachable or healthz failing)."""
+    base = f"http://{host}:{port}"
+    fault = get_faults().http_fault("serving.probe", host=host, port=port)
+    if fault is not None:
+        return DEAD if fault[0] >= 500 else DRAINING
+    try:
+        with urllib.request.urlopen(base + "/healthz",
+                                    timeout=timeout_s) as resp:
+            if resp.status != 200:
+                return DEAD
+    except Exception:
+        return DEAD
+    try:
+        with urllib.request.urlopen(base + "/readyz",
+                                    timeout=timeout_s) as resp:
+            return HEALTHY if resp.status == 200 else DRAINING
+    except urllib.error.HTTPError as e:
+        return DRAINING if e.code == 503 else DEAD
+    except Exception:
+        return DEAD
+
+
+class ReplicaRouter:
+    """Health-aware routing over a gathered replica table.
+
+    One breaker per replica (shared process-wide through ``breaker_for``,
+    keyed ``replica:<name>:<host>:<port>``): request failures reported via
+    :meth:`report` trip it open, and :meth:`route` NEVER returns a
+    replica whose breaker is open — an open replica only re-enters
+    rotation through the breaker's own half-open probe admission.
+    Probe results additionally mark replicas dead/draining so routing
+    skips them before a single request is risked.  Thread-safe.
+    """
+
+    def __init__(self, table: List[Tuple[str, int]], name: str = "serving",
+                 failure_threshold: int = 3, cooldown_s: float = 5.0,
+                 probe_timeout_s: float = 1.0):
+        self.name = name
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._g_healthy = get_registry().gauge(
+            "serving_replicas_healthy",
+            "replicas currently probed healthy with a non-open breaker",
+            ("router",))
+        self._apply_table(table)
+
+    def _apply_table(self, table: List[Tuple[str, int]]) -> None:
+        self.table = [(h, int(p)) for h, p in table]
+        # optimistic until probed: a fresh table names live listeners
+        self._status = {r: HEALTHY for r in range(len(self.table))}
+        self._breakers = {
+            r: breaker_for(f"replica:{self.name}:{h}:{p}",
+                           failure_threshold=self.failure_threshold,
+                           cooldown_s=self.cooldown_s)
+            for r, (h, p) in enumerate(self.table)}
+        self._update_gauge()
+
+    def _update_gauge(self) -> None:
+        healthy = sum(1 for r in self._status
+                      if self._status[r] == HEALTHY
+                      and self._breakers[r].state != "open")
+        self._g_healthy.set(healthy, router=self.name)
+
+    # -- probing -----------------------------------------------------------
+    def probe(self, rank: int) -> str:
+        with self._lock:
+            if rank >= len(self.table):
+                return DEAD            # refreshed away mid-probe-cycle
+            h, p = self.table[rank]
+        # network I/O outside the lock; writes re-validate the entry so a
+        # concurrent refresh() cannot receive a stale rank's result
+        status = probe_replica(h, p, timeout_s=self.probe_timeout_s)
+        with self._lock:
+            if rank < len(self.table) and self.table[rank] == (h, p):
+                self._status[rank] = status
+                b = self._breakers[rank]
+                if status == HEALTHY:
+                    # a health probe must not slam an OPEN breaker shut —
+                    # request failures opened it, and only its own
+                    # cooldown/half-open admission may reclose it.  Once
+                    # the cooldown has elapsed (state half-open) a
+                    # healthy probe counts as the reclosing success.
+                    if b.state != "open":
+                        b.record_success()
+                elif status == DEAD:
+                    b.record_failure()
+                # draining is deliberate, not a fault: no breaker signal
+                self._update_gauge()
+        get_faults().note("serving.replica_probe", rank=rank, status=status)
+        return status
+
+    def probe_all(self) -> Dict[int, str]:
+        with self._lock:
+            ranks = list(range(len(self.table)))
+        return {r: self.probe(r) for r in ranks}
+
+    def statuses(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._status)
+
+    def breaker(self, rank: int):
+        return self._breakers[rank]
+
+    # -- routing -----------------------------------------------------------
+    def url_for(self, rank: int, path: str = "/") -> str:
+        h, p = self.table[rank]
+        path = path.rstrip("/") or "/"
+        return f"http://{h}:{p}{'' if path == '/' else path}"
+
+    def route(self, path: str = "/") -> Tuple[int, str]:
+        """Next routable replica (round-robin) → ``(rank, url)``.
+
+        Skips replicas probed dead or draining and replicas whose
+        breaker refuses the call (open, or half-open past its probe
+        budget).  Raises :class:`NoHealthyReplicaError` with the full
+        per-rank status map when nothing is routable."""
+        with self._lock:
+            n = len(self.table)
+            start = self._rr
+            for i in range(n):
+                r = (start + i) % n
+                if self._status[r] != HEALTHY:
+                    continue
+                if not self._breakers[r].allow():
+                    continue
+                self._rr = (r + 1) % n
+                return r, self.url_for(r, path)
+            statuses = {
+                r: (self._status[r] if self._status[r] != HEALTHY
+                    else f"breaker {self._breakers[r].state}")
+                for r in range(n)}
+        raise NoHealthyReplicaError(statuses)
+
+    def report(self, rank: int, ok: bool) -> None:
+        """Outcome of a routed request — feeds the replica's breaker (a
+        breaker fed only by probes would take a whole probe cycle to
+        notice a flapping replica)."""
+        b = self._breakers[rank]
+        if ok:
+            b.record_success()
+        else:
+            b.record_failure()
+        with self._lock:
+            self._update_gauge()
+
+    def refresh(self, table: List[Tuple[str, int]]) -> None:
+        """Adopt a re-gathered table (after an elastic restart): statuses
+        reset optimistic; breakers persist per endpoint, so a replica
+        that came back on the same address keeps its history until its
+        cooldown admits a probe."""
+        with self._lock:
+            self._apply_table(table)
+
+
 class DistributedServingServer:
     """One listener on THIS host plus the cluster-wide routing table.
 
@@ -80,18 +288,30 @@ class DistributedServingServer:
     every host's listener address (``routing_table``), so requests can be
     balanced across the whole mesh while each host's pipeline serves its
     local replica.  Matches the role of one-server-per-executor
-    distributed serving (DistributedHTTPSource.scala:88)."""
+    distributed serving (DistributedHTTPSource.scala:88).
+
+    ``router`` (a :class:`ReplicaRouter` over the gathered table) adds
+    failover: :meth:`route` skips dead/draining/breaker-open replicas,
+    :meth:`probe_replicas` refreshes health from every replica's reserved
+    paths, and :meth:`refresh_routing_table` re-gathers the table after
+    an elastic gang restart."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", reply_timeout_s: float = 30.0,
                  max_queue: int = 1024,
-                 max_body_bytes: int = 16 * 1024 * 1024):
+                 max_body_bytes: int = 16 * 1024 * 1024,
+                 gather_timeout_s: Optional[float] = None):
         self.local = ServingServer(host=host, port=port, api_path=api_path,
                                    reply_timeout_s=reply_timeout_s,
                                    max_queue=max_queue,
                                    max_body_bytes=max_body_bytes)
         lh, lp = self.local.address
-        self.routing_table = exchange_routing_table(lh, lp)
+        self._gather_timeout_s = gather_timeout_s
+        self.routing_table = exchange_routing_table(
+            lh, lp, timeout_s=gather_timeout_s)
+        import jax
+        self.router = ReplicaRouter(
+            self.routing_table, name=f"dserv-p{jax.process_index()}")
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -101,6 +321,29 @@ class DistributedServingServer:
         h, p = self.routing_table[rank]
         path = path.rstrip("/") or "/"
         return f"http://{h}:{p}{'' if path == '/' else path}"
+
+    # -- failover ----------------------------------------------------------
+    def route(self, path: str = "/") -> Tuple[int, str]:
+        """Next healthy replica for a request (see
+        :meth:`ReplicaRouter.route`)."""
+        return self.router.route(path)
+
+    def probe_replicas(self) -> Dict[int, str]:
+        return self.router.probe_all()
+
+    def report_result(self, rank: int, ok: bool) -> None:
+        self.router.report(rank, ok)
+
+    def refresh_routing_table(
+            self, timeout_s: Optional[float] = None) -> List[Tuple[str, int]]:
+        """Re-gather the table over the (re-formed) mesh — call on every
+        process after an elastic restart, collectively — and rebuild the
+        router's view from it."""
+        lh, lp = self.local.address
+        self.routing_table = exchange_routing_table(
+            lh, lp, timeout_s=timeout_s or self._gather_timeout_s)
+        self.router.refresh(self.routing_table)
+        return self.routing_table
 
     # local-API passthroughs
     def register_api(self, *a, **kw):

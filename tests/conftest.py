@@ -107,7 +107,7 @@ MODULE_COST_S = {
     "test_recommendation": 1, "test_nn": 2, "test_cyber": 2,
     "test_io_files": 2, "test_online_generic": 2, "test_core": 2,
     "test_onnx": 3, "test_io_serving": 4, "test_checkpoint": 5,
-    "test_resilience": 25, "test_rowguard": 20,
+    "test_resilience": 25, "test_rowguard": 20, "test_gang": 30,
     "test_causal": 6, "test_telemetry": 6, "test_explainers": 7,
     "test_online": 9, "test_dl": 13, "test_gbdt_categorical": 14,
     "test_pipeline_parallel": 17, "test_ops": 18,
@@ -170,8 +170,10 @@ def fault_registry():
     reg.clear()
     reg.seed(20260803)
     reg.no_sleep = True
+    rank_before = reg.rank
     yield reg
     reg.clear()
+    reg.rank = rank_before   # rank-gating tests must not leak identity
 
 
 @pytest.fixture(scope="session")

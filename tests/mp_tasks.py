@@ -71,6 +71,119 @@ def distributed_serving_roundtrip(args):
             "results": results}
 
 
+def sleep_task(args):
+    """Sleep then echo — gang-supervision scaffolding: with a
+    ``heartbeat.emit=hang:rank=k`` fault armed via env, rank k's emitter
+    wedges and the driver must declare the hang long before this sleep
+    (or the global timeout) finishes."""
+    import time
+
+    import jax
+
+    args = args or {}
+    time.sleep(float(args.get("seconds", 30.0)))
+    return {"rank": jax.process_index(), "ok": True}
+
+
+def chatty_task(args):
+    """Print a flood of lines (then optionally fail) — pins the driver's
+    ring-buffered log tails: the WorkerFailure must carry only the tail,
+    and the driver must not have grown with the flood."""
+    import sys
+
+    import jax
+
+    args = args or {}
+    n = int(args.get("lines", 5000))
+    for i in range(n):
+        print(f"chatty line {i:07d}", flush=(i % 500 == 0))
+    sys.stdout.flush()
+    if args.get("fail"):
+        raise RuntimeError("chatty task failing as requested")
+    return {"rank": jax.process_index(), "lines": n}
+
+
+def elastic_counter(args):
+    """Deterministic synthetic trainer with step checkpoints — the
+    cheap elastic-relaunch pin (no XLA compile in the loop).
+
+    Each step evolves an integer state through a fixed recurrence, saves
+    a checkpoint, reports the step on the heartbeat channel, and passes
+    the ``mp.step`` kill point (arm ``kill_rank``/``preempt`` there to
+    die mid-train).  On relaunch the task restores the latest complete
+    checkpoint from the gang's ``SMLTPU_CKPT_DIR`` and continues, so the
+    final state must be bit-identical to a fault-free run.
+    """
+    import os
+    import time
+
+    import jax
+
+    from synapseml_tpu.core.checkpoint import CheckpointManager
+    from synapseml_tpu.parallel.heartbeat import beat
+    from synapseml_tpu.resilience import get_faults
+
+    args = args or {}
+    steps = int(args.get("steps", 8))
+    step_sleep_s = float(args.get("step_sleep_s", 0.0))
+    ckpt_dir = os.environ.get("SMLTPU_CKPT_DIR") or args.get("ckpt_dir")
+    # per-rank subdir: every rank checkpoints the (identical) state
+    # without racing the others' atomic publishes
+    if ckpt_dir:
+        ckpt_dir = os.path.join(ckpt_dir, f"rank{jax.process_index()}")
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=3) if ckpt_dir else None
+    state = np.int64(int(args.get("seed", 1)))
+    start = 0
+    if mgr is not None:
+        latest = mgr.latest_step()
+        if latest is not None:
+            state = np.int64(np.asarray(mgr.restore(latest)["state"]))
+            start = latest + 1
+    for step in range(start, steps):
+        state = np.int64((int(state) * 6364136223846793005 + 1442695040888963407)
+                         % (1 << 63))
+        if mgr is not None:
+            mgr.save(step, {"state": np.asarray(state)})
+        beat(step=step)
+        get_faults().kill_point("mp.step", step=step,
+                                rank=jax.process_index())
+        if step_sleep_s > 0:
+            time.sleep(step_sleep_s)
+    return {"rank": jax.process_index(), "state": int(state),
+            "resumed_from": start}
+
+
+def gbdt_elastic_digest(args):
+    """GBDT training that checkpoints every iteration into the gang's
+    ``SMLTPU_CKPT_DIR`` — the elastic-resume bit-exactness pin: SIGKILL
+    one rank mid-train, let the supervisor relaunch, and the final model
+    digest must equal the fault-free run's."""
+    import hashlib
+    import os
+
+    import jax
+
+    from synapseml_tpu.models.gbdt.booster import BoostingConfig, train
+    from synapseml_tpu.parallel import data_parallel_mesh
+
+    args = args or {}
+    X, y = _binary_data(n=int(args.get("n", 400)), f=int(args.get("f", 8)))
+    mesh = data_parallel_mesh(len(jax.devices()))
+    cfg = BoostingConfig(objective="binary",
+                         num_iterations=int(args.get("iters", 4)),
+                         num_leaves=7, min_data_in_leaf=5, max_bin=31)
+    ckpt_dir = os.environ.get("SMLTPU_CKPT_DIR") or args.get("ckpt_dir")
+    booster, _ = train(X, y, cfg, mesh=mesh,
+                       checkpoint_dir=ckpt_dir, checkpoint_interval=1)
+    text = booster.to_string()
+    margins = booster.predict_margin(X[:8])
+    return {
+        "rank": jax.process_index(),
+        "model_md5": hashlib.md5(text.encode()).hexdigest(),
+        "margins": [round(float(m), 6) for m in np.asarray(margins).ravel()],
+    }
+
+
 def gbdt_fit_digest(args):
     """Fit a GBDT over ALL global devices; return a bit-exact model digest.
 

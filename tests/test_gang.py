@@ -1,0 +1,560 @@
+"""Gang supervision tests: heartbeat failure detection, elastic
+checkpoint-resumed relaunch, hang-proof collectives, and serving
+failover.
+
+Every claim is pinned by MAKING the failure happen — wedged heartbeat
+threads, SIGKILLed ranks, blocked collectives, drained replicas — via
+the seeded ``SML_FAULTS`` registry (the same env string reaches every
+worker of a gang, with ``rank=`` gating which rank it hits), and the
+deterministic chaos soak drives a whole randomized kill/hang/preempt
+schedule through one job and still demands the bit-exact answer.
+"""
+
+import io
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.parallel import (CollectiveTimeout, GangSupervisor,
+                                    HeartbeatMonitor, ReservedPort,
+                                    WorkerFailure, dispatch_watchdog,
+                                    find_free_port, run_on_local_cluster)
+from synapseml_tpu.parallel.heartbeat import (HB_MARKER, HeartbeatEmitter,
+                                              beat, parse_heartbeat)
+from synapseml_tpu.parallel.launcher import _RankReader
+from synapseml_tpu.resilience import Deadline, RetryPolicy, get_faults
+from synapseml_tpu.telemetry import get_registry
+
+pytestmark = pytest.mark.gang
+
+
+# ---------------------------------------------------------------------------
+# heartbeat monitor (fake clock: deterministic timing)
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatMonitor:
+    def _mon(self, t, **kw):
+        kw.setdefault("hang_intervals", 3.0)
+        kw.setdefault("startup_grace_s", 5.0)
+        return HeartbeatMonitor(2, 0.5, clock=lambda: t[0], **kw)
+
+    def test_hang_declared_within_three_intervals(self):
+        t = [0.0]
+        m = self._mon(t)
+        m.observe(0), m.observe(1)
+        # just under 3 intervals of silence: still alive
+        t[0] = 1.4
+        assert m.verdicts() == {}
+        # at/over 3 intervals: declared, with the last known step
+        m.observe(0, step=7)
+        t[0] = 1.4 + 1.6
+        v = m.verdicts()
+        assert list(v) == [1]
+        assert "hang" in v[1] and "no heartbeat" in v[1]
+        t[0] = 1.4 + 10.0
+        v = m.verdicts()
+        assert "hang at step 7" in v[0]
+
+    def test_detector_adapts_to_observed_cadence(self):
+        """A host where beats genuinely arrive every 1s (loaded CI box)
+        must not be declared hung at 3 x the CONFIGURED 0.5s interval."""
+        t = [0.0]
+        m = self._mon(t)
+        for i in range(5):            # observed cadence: 1.0s
+            t[0] = float(i)
+            m.observe(0)
+        t[0] = 4.0 + 2.0              # 2s of silence = 2 observed intervals
+        assert 0 not in m.verdicts()
+        t[0] = 4.0 + 3.5              # 3.5 observed intervals: declared
+        assert 0 in m.verdicts()
+
+    def test_no_heartbeat_verdict_after_startup_grace(self):
+        t = [0.0]
+        m = self._mon(t)
+        m.observe(0)
+        t[0] = 5.5
+        v = m.verdicts()
+        assert "no heartbeat" in v[1] and 0 in v  # 0 hung, 1 never booted
+
+    def test_done_rank_is_not_hung(self):
+        t = [0.0]
+        m = self._mon(t)
+        m.observe(0), m.observe(1)
+        m.mark_done(1)
+        t[0] = 100.0
+        assert list(m.verdicts()) == [0]
+
+    def test_straggler_advisory(self):
+        t = [0.0]
+        m = self._mon(t, straggler_lag_steps=2)
+        m.observe(0, step=10)
+        m.observe(1, step=3)
+        s = m.stragglers()
+        assert list(s) == [1]
+        assert "straggler at step 3" in s[1] and "leader at step 10" in s[1]
+        assert m.verdicts() == {}      # advisory, not a failure by itself
+
+    def test_suspicion_and_ages(self):
+        t = [0.0]
+        m = self._mon(t)
+        m.observe(0, step=1)
+        t[0] = 1.0
+        assert m.suspicion(0) == pytest.approx(2.0)
+        assert m.ages()[0] == pytest.approx(1.0)
+        assert m.max_step() == 1
+
+
+# ---------------------------------------------------------------------------
+# heartbeat emitter (real thread, in-memory stream)
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatEmitter:
+    def test_emits_marker_lines_with_steps(self):
+        from synapseml_tpu.parallel.heartbeat import reset_step
+        reset_step()
+        buf = io.StringIO()
+        em = HeartbeatEmitter(rank=3, interval_s=0.02, stream=buf)
+        beat(step=41)
+        em.start()
+        time.sleep(0.15)
+        beat(step=42)
+        time.sleep(0.1)
+        em.stop()
+        em.join(timeout=2)
+        beats = [parse_heartbeat(ln) for ln in buf.getvalue().splitlines()]
+        assert all(b is not None for b in beats) and len(beats) >= 3
+        assert all(b["rank"] == 3 for b in beats)
+        assert beats[0]["step"] >= 41 and beats[-1]["step"] == 42
+
+    def test_hang_fault_silences_emitter(self, fault_registry):
+        fault_registry.no_sleep = False
+        fault_registry.inject("heartbeat.emit", "hang", after=2,
+                              delay_s=30.0)
+        buf = io.StringIO()
+        em = HeartbeatEmitter(rank=0, interval_s=0.01, stream=buf)
+        em.start()
+        time.sleep(0.25)
+        n = len(buf.getvalue().splitlines())
+        assert n == 2                  # two beats, then wedged mid-emit
+        em.stop()                      # thread stays parked (daemon)
+
+    def test_beat_keeps_monotonic_max(self):
+        from synapseml_tpu.parallel.heartbeat import current_step, reset_step
+        reset_step()
+        beat(step=10)
+        beat(step=4)                   # stale report must not regress
+        assert current_step() == 10
+
+
+# ---------------------------------------------------------------------------
+# hang-proof collectives
+# ---------------------------------------------------------------------------
+
+class TestCollectiveTimeout:
+    def test_structured_timeout_from_hung_dispatch(self, fault_registry):
+        fault_registry.inject("collective.dispatch", "hang")
+        c = get_registry().counter("collective_timeouts_total", "",
+                                   ("op", "axis"))
+        before = c.value(op="allreduce_fn", axis="data")
+        with pytest.raises(CollectiveTimeout) as ei:
+            dispatch_watchdog(lambda: 1, op="allreduce_fn", axis="data",
+                              timeout_s=0.15, payload_bytes=4096)
+        e = ei.value
+        assert (e.op, e.axis, e.payload_bytes) == ("allreduce_fn", "data",
+                                                   4096)
+        assert e.timeout_s == pytest.approx(0.15)
+        assert "allreduce_fn" in str(e) and "4096" in str(e)
+        assert c.value(op="allreduce_fn", axis="data") == before + 1
+
+    def test_deadline_drives_the_watchdog(self, fault_registry):
+        fault_registry.inject("collective.dispatch", "hang")
+        d = Deadline(0.1)
+        t0 = time.monotonic()
+        with pytest.raises(CollectiveTimeout):
+            dispatch_watchdog(lambda: 1, op="psum", axis="data", deadline=d)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_no_deadline_runs_inline(self):
+        assert dispatch_watchdog(lambda a, b: a + b, 2, 3,
+                                 op="psum", axis="data") == 5
+
+    def test_inner_error_propagates(self):
+        with pytest.raises(ValueError, match="boom"):
+            dispatch_watchdog(lambda: (_ for _ in ()).throw(ValueError("boom")),
+                              op="psum", axis="data", timeout_s=5.0)
+
+    def test_allreduce_fn_with_timeout_still_correct(self, devices8):
+        import jax
+        from synapseml_tpu.parallel import allreduce_fn
+        from synapseml_tpu.parallel.mesh import data_parallel_mesh
+        mesh = data_parallel_mesh(8)
+        fn = allreduce_fn(mesh)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = fn(x, timeout_s=30.0)
+        assert float(np.asarray(out)[0]) == pytest.approx(28.0)
+
+
+# ---------------------------------------------------------------------------
+# fault kinds: rank gating, hang/kill_rank/slow_rank grammar
+# ---------------------------------------------------------------------------
+
+class TestRankGatedFaults:
+    def test_rank_gate(self, fault_registry):
+        fault_registry.rank = 0
+        fault_registry.inject("x.site", "error", rank=1)
+        fault_registry.raise_point("x.site")        # not our rank: no fire
+        fault_registry.rank = 1
+        with pytest.raises(OSError):
+            fault_registry.raise_point("x.site")
+
+    def test_grammar_parses_rank_and_new_kinds(self, fault_registry):
+        fault_registry.configure(
+            "a=kill_rank:rank=2;b=slow_rank:rank=0:delay=0.5;c=hang:delay=1")
+        rules = fault_registry.rules()
+        assert [(r.site, r.kind, r.rank) for r in rules] == [
+            ("a", "kill_rank", 2), ("b", "slow_rank", 0), ("c", "hang", None)]
+
+    def test_slow_rank_records_sleep(self, fault_registry):
+        fault_registry.rank = 0
+        fault_registry.inject("y.site", "slow_rank", rank=0, delay_s=0.25)
+        fault_registry.raise_point("y.site")
+        assert fault_registry.sleeps_for("y.site") == [0.25]
+
+
+# ---------------------------------------------------------------------------
+# launcher satellites: reserved port, ring-buffered tails
+# ---------------------------------------------------------------------------
+
+class TestReservedPort:
+    def test_distinct_while_held_then_reusable(self):
+        a, b = ReservedPort(), ReservedPort()
+        try:
+            assert a.port != b.port and a.held and b.held
+        finally:
+            a.release(), b.release()
+        assert not a.held
+        # released port is genuinely free again
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", a.port))
+        s.close()
+
+    def test_find_free_port_compat(self):
+        assert 0 < find_free_port() < 65536
+
+
+class _FakeProc:
+    def __init__(self, lines):
+        self.stdout = io.StringIO("\n".join(lines) + "\n")
+
+
+class TestRankReaderRingBuffer:
+    def test_tail_bounded_and_result_survives_chatter(self):
+        result = "SMLMP_RESULT:" + json.dumps({"ok": 1})
+        lines = [result] + [f"noise {i}" for i in range(5000)]
+        r = _RankReader(0, _FakeProc(lines), tail_lines=100)
+        r.run()                        # synchronous: fake pipe, no thread
+        assert r.result_line == result
+        assert len(r.tail) == 100
+        assert r.dropped == 4901       # 5001 lines through a 100-ring
+        text = r.text()
+        assert text.startswith("... (4901 earlier lines dropped)")
+        assert "noise 4999" in text and "noise 0" not in text
+
+    def test_heartbeats_feed_monitor_not_tail(self):
+        t = [0.0]
+        m = HeartbeatMonitor(1, 0.5, clock=lambda: t[0])
+        hb = HB_MARKER + json.dumps({"rank": 0, "step": 5, "ts": 1.0})
+        r = _RankReader(0, _FakeProc([hb, "plain line"]), monitor=m,
+                        tail_lines=10)
+        r.run()
+        assert m.last_steps()[0] == 5
+        assert list(r.tail) == ["plain line"]
+
+    def test_garbage_heartbeat_is_just_a_log_line(self):
+        r = _RankReader(0, _FakeProc([HB_MARKER + "{not json"]),
+                        tail_lines=10)
+        r.run()                        # must not raise
+        assert len(r.tail) == 1
+
+
+# ---------------------------------------------------------------------------
+# gang supervisor: retries without real subprocesses
+# ---------------------------------------------------------------------------
+
+class TestGangSupervisorUnit:
+    def test_retries_then_raises_last_failure(self, fault_registry):
+        fault_registry.inject("launcher.attempt", "error")  # every attempt
+        fault_registry.record_calls = True
+        sup = GangSupervisor("mp_tasks:never_runs", n_processes=2,
+                             retry_policy=RetryPolicy(max_retries=2, seed=3))
+        with pytest.raises(WorkerFailure) as ei:
+            sup.run()
+        assert sup.restarts == 2
+        assert ei.value.causes == {0: "injected", 1: "injected"}
+        assert len(fault_registry.sleeps_for("launcher.backoff")) == 2
+        restarts = fault_registry.calls_for("gang.restart")
+        assert [c["attempt"] for c in restarts] == [1, 2]
+
+    def test_no_policy_is_single_shot(self, fault_registry):
+        fault_registry.inject("launcher.attempt", "error")
+        sup = GangSupervisor("mp_tasks:never_runs", n_processes=1)
+        with pytest.raises(WorkerFailure):
+            sup.run()
+        assert sup.restarts == 0
+        assert fault_registry.sleeps_for("launcher.backoff") == []
+
+
+# ---------------------------------------------------------------------------
+# serving failover
+# ---------------------------------------------------------------------------
+
+class TestServingFailover:
+    def _servers(self, n=2):
+        from synapseml_tpu.serving import ServingServer
+        return [ServingServer() for _ in range(n)]
+
+    def test_route_skips_drained_replica(self):
+        from synapseml_tpu.serving import ReplicaRouter
+        servers = self._servers()
+        try:
+            table = [s.address for s in servers]
+            router = ReplicaRouter(table, name="t-drain")
+            assert router.probe_all() == {0: "healthy", 1: "healthy"}
+            # replica 0 starts draining: readyz 503s, healthz stays 200
+            servers[0].health.begin_drain()
+            assert router.probe(0) == "draining"
+            for _ in range(4):         # round-robin must never pick 0
+                rank, url = router.route("/api")
+                assert rank == 1 and url.endswith("/api")
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_dead_replica_and_recovery_probe(self):
+        from synapseml_tpu.serving import ReplicaRouter
+        servers = self._servers()
+        table = [s.address for s in servers]
+        router = ReplicaRouter(table, name="t-dead", cooldown_s=60.0)
+        servers[0].close()
+        assert router.probe(0) == "dead"
+        assert all(router.route()[0] == 1 for _ in range(3))
+        servers[1].close()
+        assert router.probe(1) == "dead"
+        from synapseml_tpu.serving import NoHealthyReplicaError
+        with pytest.raises(NoHealthyReplicaError) as ei:
+            router.route()
+        assert ei.value.statuses == {0: "dead", 1: "dead"}
+
+    def test_route_never_returns_open_breaker(self):
+        """The tier-1 pin: failures trip a replica's breaker open, and
+        route() must not hand it out until the breaker itself re-admits
+        (half-open probe after cooldown)."""
+        from synapseml_tpu.serving import ReplicaRouter
+        servers = self._servers()
+        try:
+            table = [s.address for s in servers]
+            router = ReplicaRouter(table, name="t-breaker",
+                                   failure_threshold=3, cooldown_s=60.0)
+            for _ in range(3):         # trip replica 0's breaker open
+                router.report(0, ok=False)
+            assert router.breaker(0).state == "open"
+            for _ in range(10):
+                assert router.route()[0] == 1
+            # replica 1 also trips: nothing routable, structured error
+            for _ in range(3):
+                router.report(1, ok=False)
+            from synapseml_tpu.serving import NoHealthyReplicaError
+            with pytest.raises(NoHealthyReplicaError) as ei:
+                router.route()
+            assert "breaker open" in ei.value.statuses[0]
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_probe_does_not_heal_open_breaker(self):
+        """A replica whose reserved paths answer 200 but whose API calls
+        fail: request failures open the breaker, and a health probe must
+        NOT slam it shut — only the cooldown's half-open admission may."""
+        from synapseml_tpu.serving import ReplicaRouter
+        servers = self._servers()
+        try:
+            router = ReplicaRouter([s.address for s in servers],
+                                   name="t-noheal",
+                                   failure_threshold=2, cooldown_s=60.0)
+            router.report(0, ok=False), router.report(0, ok=False)
+            assert router.breaker(0).state == "open"
+            assert router.probe(0) == "healthy"     # paths answer fine
+            assert router.breaker(0).state == "open"  # ...breaker holds
+            assert all(router.route()[0] == 1 for _ in range(4))
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_healthy_gauge_tracks_probes(self):
+        from synapseml_tpu.serving import ReplicaRouter
+        servers = self._servers()
+        try:
+            router = ReplicaRouter([s.address for s in servers],
+                                   name="t-gauge")
+            g = get_registry().gauge("serving_replicas_healthy", "",
+                                     ("router",))
+            router.probe_all()
+            assert g.value(router="t-gauge") == 2
+            servers[0].health.begin_drain()
+            router.probe_all()
+            assert g.value(router="t-gauge") == 1
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_refresh_adopts_new_table(self):
+        from synapseml_tpu.serving import ReplicaRouter
+        servers = self._servers(3)
+        try:
+            router = ReplicaRouter([s.address for s in servers[:2]],
+                                   name="t-refresh")
+            router.refresh([s.address for s in servers])
+            assert len(router.table) == 3
+            assert sorted(router.statuses()) == [0, 1, 2]
+        finally:
+            for s in servers:
+                s.close()
+
+
+# ---------------------------------------------------------------------------
+# real gangs: hang detection, elastic resume, chaos (subprocess)
+# ---------------------------------------------------------------------------
+
+def _clean_registry():
+    reg = get_faults()
+    reg.clear()
+    return reg
+
+
+class TestGangSubprocess:
+    def test_hung_rank_declared_before_global_timeout(self, fault_registry,
+                                                      tmp_path):
+        """The tier-1 pin: rank 1's heartbeat thread wedges (beats stop,
+        process lives, task still sleeping) and the detector declares it
+        within ~3 heartbeat intervals — the 90s global timeout is never
+        approached."""
+        fault_registry.record_calls = True
+        t0 = time.monotonic()
+        with pytest.raises(WorkerFailure) as ei:
+            run_on_local_cluster(
+                "mp_tasks:sleep_task", n_processes=2,
+                devices_per_process=1, task_args={"seconds": 60.0},
+                timeout_s=90.0, heartbeat_interval_s=0.25,
+                env_extra={"SML_FAULTS":
+                           "heartbeat.emit=hang:rank=1:after=2"})
+        elapsed = time.monotonic() - t0
+        assert elapsed < 45.0, f"hang detection took {elapsed:.1f}s"
+        assert "hang" in ei.value.causes[1]
+        assert 0 not in ei.value.causes or "hang" not in ei.value.causes[0]
+        # the driver-side call log recorded the observed beats and the
+        # teardown kills — the supervision schedule is assertable
+        assert fault_registry.calls_for("gang.heartbeat")
+        assert fault_registry.calls_for("gang.teardown")
+
+    def test_sigkill_one_rank_elastic_resume_bit_exact(self, fault_registry,
+                                                       tmp_path):
+        """Kill a rank mid-train; the supervisor relaunches and the task
+        resumes from the last complete checkpoint — final state equals
+        the fault-free run bit for bit, and recovery is clocked."""
+        # step_sleep spaces the steps across heartbeats, so beats carry
+        # real step numbers (the recovery clock's input)
+        task_args = {"steps": 8, "step_sleep_s": 0.25}
+        clean = run_on_local_cluster(
+            "mp_tasks:elastic_counter", n_processes=1,
+            devices_per_process=1, task_args=task_args,
+            timeout_s=120.0, heartbeat_interval_s=0.2,
+            checkpoint_dir=str(tmp_path / "clean-unused"))
+        # fault-free run never checkpointed into OUR dir: fresh dir below
+        sup = GangSupervisor(
+            "mp_tasks:elastic_counter", n_processes=1,
+            devices_per_process=1, task_args=task_args,
+            timeout_s=120.0, heartbeat_interval_s=0.2,
+            retry_policy=RetryPolicy(max_retries=3, base_s=0.01, seed=1),
+            checkpoint_dir=str(tmp_path / "elastic"),
+            env_extra={"SML_FAULTS": "mp.step=kill_rank:rank=0:after=3"})
+        faulted = sup.run()
+        assert sup.restarts >= 1
+        assert faulted[0]["state"] == clean[0]["state"]
+        assert faulted[0]["resumed_from"] > 0        # genuinely resumed
+        # the monitor clocked kill-to-resumed-step recovery
+        assert sup.last_recovery_s is not None and sup.last_recovery_s > 0
+
+    def test_chatty_rank_tail_is_bounded(self, fault_registry):
+        with pytest.raises(WorkerFailure) as ei:
+            run_on_local_cluster(
+                "mp_tasks:chatty_task", n_processes=1,
+                devices_per_process=1,
+                task_args={"lines": 4000, "fail": True},
+                timeout_s=120.0, tail_lines=120)
+        log = ei.value.logs[0]
+        kept = log.splitlines()
+        assert len(kept) <= 121                     # ring + dropped header
+        assert "earlier lines dropped" in kept[0]
+        assert "chatty line 0003999" in log
+        assert "exit" in ei.value.causes[0]
+
+    @pytest.mark.slow
+    def test_gbdt_elastic_resume_bit_exact(self, fault_registry, tmp_path):
+        """SIGKILL one rank of a 2-process GBDT gang after its second
+        published checkpoint; the relaunched gang resumes from the last
+        complete iteration and the final model digest is bit-exact with
+        the fault-free run (the warm-start margin replay keeps resumed
+        boosting identical)."""
+        clean = run_on_local_cluster(
+            "mp_tasks:gbdt_elastic_digest", n_processes=2,
+            devices_per_process=1, timeout_s=300.0,
+            heartbeat_interval_s=0.5,
+            checkpoint_dir=str(tmp_path / "gbdt-clean"))
+        sup = GangSupervisor(
+            "mp_tasks:gbdt_elastic_digest", n_processes=2,
+            devices_per_process=1, timeout_s=300.0,
+            heartbeat_interval_s=0.5,
+            retry_policy=RetryPolicy(max_retries=2, base_s=0.01, seed=5),
+            checkpoint_dir=str(tmp_path / "gbdt-elastic"),
+            env_extra={"SML_FAULTS":
+                       "gbdt.checkpoint=kill_rank:rank=1:after=1:times=1"})
+        faulted = sup.run()
+        assert sup.restarts >= 1
+        assert faulted[0]["model_md5"] == clean[0]["model_md5"]
+        assert faulted[0]["margins"] == clean[0]["margins"]
+        assert faulted[0]["model_md5"] == faulted[1]["model_md5"]
+
+    @pytest.mark.slow
+    def test_chaos_soak_randomized_schedule_still_converges(
+            self, fault_registry, tmp_path):
+        """Deterministic chaos: a seeded randomized mix of rank kills,
+        heartbeat hangs and soft preemptions rains on an elastic job;
+        the supervisor keeps relaunching and the job still completes
+        with the bit-exact fault-free answer."""
+        clean = run_on_local_cluster(
+            "mp_tasks:elastic_counter", n_processes=2,
+            devices_per_process=1,
+            task_args={"steps": 10, "step_sleep_s": 0.15},
+            timeout_s=180.0, heartbeat_interval_s=0.2)
+        chaos = ";".join([
+            "mp.step=kill_rank:rank=0:after=4:times=1:p=0.8",
+            "mp.step=preempt:rank=1:after=6:times=1:p=0.5",
+            "heartbeat.emit=hang:rank=1:after=40:times=1:p=0.5",
+        ])
+        sup = GangSupervisor(
+            "mp_tasks:elastic_counter", n_processes=2,
+            devices_per_process=1,
+            task_args={"steps": 10, "step_sleep_s": 0.15},
+            timeout_s=180.0, heartbeat_interval_s=0.2,
+            hang_intervals=3.0,
+            retry_policy=RetryPolicy(max_retries=6, base_s=0.01, seed=11),
+            checkpoint_dir=str(tmp_path / "chaos"),
+            env_extra={"SML_FAULTS": chaos, "SML_FAULTS_SEED": "1234"})
+        out = sup.run()
+        assert [r["state"] for r in out] == [clean[0]["state"]] * 2
+        assert sup.restarts >= 1
