@@ -102,6 +102,8 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        from ..telemetry.flight import record as flight_record
+        flight_record("checkpoint", step=int(step), path=final)
         get_faults().kill_point("checkpoint.save.post_publish", step=step)
         self._prune()
         return final

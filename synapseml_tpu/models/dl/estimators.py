@@ -130,6 +130,12 @@ class _DLParamsBase(Params):
             "(overrides checkpointDir) — the preemption-tolerant fit "
             "surface: re-fit with the same manager resumes from "
             "latest_step")
+    stepProfiler = PyObjectParam(
+        doc="telemetry.gangplane.StepProfiler decomposing each train "
+            "step into data/compute/collective/other wall time "
+            "(train_step_seconds{model,segment}); with capture_xla=True "
+            "it also records the compiled step's XLA cost analysis for "
+            "the roofline summary")
 
     def _checkpoint_loop(self, trainer: "DLTrainer",
                          state: "TrainState") -> "_CheckpointLoop":
@@ -324,23 +330,43 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         gstep = 0
         history = []
         metrics = {}
-        for epoch in range(self.maxEpochs):
-            for idx in iterate_minibatches(n, self.batchSize, shards, rng):
-                gstep += 1
+        prof = self.get("stepProfiler")
+        try:
+            for epoch in range(self.maxEpochs):
+                for idx in iterate_minibatches(n, self.batchSize, shards, rng):
+                    gstep += 1
+                    if ckpt.skips(gstep):
+                        continue
+                    if prof is not None:
+                        prof.step_begin(gstep)
+                    bi, bm, bl = trainer.shard_batch(
+                        (ids[idx], mask[idx], labels[idx]))
+                    if prof is not None:
+                        prof.mark("data")
+                        if prof.capture_xla:
+                            prof.capture_cost("dl_text_step", step,
+                                              state, (bi, bm), bl, key)
+                    state, metrics = step(state, (bi, bm), bl, key)
+                    if prof is not None:
+                        # async dispatch returns immediately; sync so
+                        # "compute" times execution, not the enqueue
+                        jax.block_until_ready(metrics)
+                        prof.mark("compute")
+                    ckpt.after_step(gstep, state)
+                    if prof is not None:
+                        prof.step_end()       # checkpoint write → "other"
                 if ckpt.skips(gstep):
-                    continue
-                bi, bm, bl = trainer.shard_batch(
-                    (ids[idx], mask[idx], labels[idx]))
-                state, metrics = step(state, (bi, bm), bl, key)
-                ckpt.after_step(gstep, state)
-            if ckpt.skips(gstep):
-                continue  # whole epoch already covered by the checkpoint
-            record = {k: float(v) for k, v in metrics.items()}
-            if n_val:
-                vlogits = np.asarray(eval_step(state, (val_ids, val_mask)))
-                record["val_accuracy"] = float(
-                    (vlogits.argmax(-1) == val_labels).mean())
-            history.append(record)
+                    continue  # whole epoch already covered by the checkpoint
+                record = {k: float(v) for k, v in metrics.items()}
+                if n_val:
+                    vlogits = np.asarray(eval_step(state, (val_ids, val_mask)))
+                    record["val_accuracy"] = float(
+                        (vlogits.argmax(-1) == val_labels).mean())
+                history.append(record)
+        finally:
+            if prof is not None:
+                prof.finish()   # exception path: close the open
+                #                 step, restore the thread-local
 
         return DeepTextModel(
             modelPayload={
@@ -462,17 +488,37 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
         gstep = 0
         history = []
         metrics = {}
-        for epoch in range(self.maxEpochs):
-            for idx in iterate_minibatches(n, self.batchSize, shards, rng):
-                gstep += 1
+        prof = self.get("stepProfiler")
+        try:
+            for epoch in range(self.maxEpochs):
+                for idx in iterate_minibatches(n, self.batchSize, shards, rng):
+                    gstep += 1
+                    if ckpt.skips(gstep):
+                        continue
+                    if prof is not None:
+                        prof.step_begin(gstep)
+                    bi, bl = trainer.shard_batch((imgs[idx], labels[idx]))
+                    if prof is not None:
+                        prof.mark("data")
+                        if prof.capture_xla:
+                            prof.capture_cost("dl_vision_step", step,
+                                              state, (bi,), bl, key)
+                    state, metrics = step(state, (bi,), bl, key)
+                    if prof is not None:
+                        # async dispatch returns immediately; sync so
+                        # "compute" times execution, not the enqueue
+                        jax.block_until_ready(metrics)
+                        prof.mark("compute")
+                    ckpt.after_step(gstep, state)
+                    if prof is not None:
+                        prof.step_end()
                 if ckpt.skips(gstep):
                     continue
-                bi, bl = trainer.shard_batch((imgs[idx], labels[idx]))
-                state, metrics = step(state, (bi,), bl, key)
-                ckpt.after_step(gstep, state)
-            if ckpt.skips(gstep):
-                continue
-            history.append({k: float(v) for k, v in metrics.items()})
+                history.append({k: float(v) for k, v in metrics.items()})
+        finally:
+            if prof is not None:
+                prof.finish()   # exception path: close the open
+                #                 step, restore the thread-local
 
         return DeepVisionModel(
             modelPayload={

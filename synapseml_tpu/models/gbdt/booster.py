@@ -804,7 +804,9 @@ def _write_checkpoint(directory: str, booster: Booster,
     # the heartbeat channel so the gang supervisor's verdicts (and the
     # elastic-resume recovery clock) carry real training progress
     from ...parallel.heartbeat import beat
+    from ...telemetry.flight import record as _flight_record
     beat(step=n)
+    _flight_record("checkpoint", step=n, path=path)
     get_faults().kill_point("gbdt.checkpoint", iteration=n)
     matches = (_re.match(r"iter_(\d+)\.json$", x)
                for x in os.listdir(directory))
@@ -897,6 +899,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
           valid_group: Optional[np.ndarray] = None,
           checkpoint_dir: Optional[str] = None,
           checkpoint_interval: int = 0,
+          step_profiler=None,
           ) -> Tuple[Booster, List[EvalRecord]]:
     """Full training run (trainOneDataBatch analogue, LightGBMBase.scala:393).
 
@@ -922,6 +925,13 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     matrix and host memory stays O(chunk) — the StreamingPartitionTask
     ingestion model (StreamingPartitionTask.scala:101-422).  With a source
     carrying a label column, ``y=None`` reads labels from it.
+
+    ``step_profiler`` (a :class:`~synapseml_tpu.telemetry.gangplane.
+    StepProfiler`) decomposes each boosting iteration's wall time into
+    data (mask/bag prep) / compute (tree grow + download) / collective /
+    other (eval, checkpoint) segments.  Profiling forces the eager host
+    path — the fused ``lax.scan`` dispatch admits no per-iteration
+    boundary to time.
     """
     import time as _time
     measures = InstrumentationMeasures()
@@ -1246,7 +1256,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         # warm the program the run will actually use: the scanned
         # whole-run program for fire-and-forget fits, else the one-step
         _w_scan_ok = (not (config.boosting_type == "dart" or valid is not None
-                           or callbacks
+                           or callbacks or step_profiler is not None
                            or (checkpoint_dir and checkpoint_interval > 0))
                       and config.feature_fraction >= 1.0
                       and config.num_iterations >= SCAN_CHUNK)
@@ -1626,7 +1636,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     # and tree downloads deferred until after the last dispatch
     ckpt_every = (checkpoint_interval
                   if checkpoint_dir and checkpoint_interval > 0 else 0)
-    eager_host = is_dart or have_valid or bool(callbacks) or bool(ckpt_every)
+    eager_host = (is_dart or have_valid or bool(callbacks)
+                  or bool(ckpt_every) or step_profiler is not None)
     pending_stacks: List[Tuple[Tree, List[float]]] = []
     base_bag_dev = jnp.asarray(bag)     # pad-row mask, uploaded once
     bag_root_key = jax.random.PRNGKey(config.bagging_seed)
@@ -1731,143 +1742,167 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         scores = sc
         scan_start = n_scan_chunks * SCAN_CHUNK
 
-    for it in range(scan_start, config.num_iterations):
-        # bagging (bagging_fraction/freq semantics): the mask is drawn on
-        # device from this key; reusing a key across freq iterations
-        # reproduces the persist-until-refresh behavior
-        bag_key = jax.random.fold_in(
-            bag_root_key, (prior_iters + it) // max(config.bagging_freq, 1))
-        if config.feature_fraction < 1.0:
-            k = max(1, int(round(F * config.feature_fraction)))
-            feature_mask = np.zeros(Fp, bool)  # padded features stay off
-            feature_mask[rng.choice(F, k, replace=False)] = True
-            fmask_dev = None
-        elif fmask_dev is None:
-            feature_mask = np.zeros(Fp, bool)
-            feature_mask[:F] = True
-        if fmask_dev is None:
-            fmask_dev = jnp.asarray(feature_mask)
-            if featpar:
-                fmask_dev = jax.device_put(
-                    fmask_dev, NamedSharding(mesh, P(DATA_AXIS)))
+    # the whole boosting loop runs under the profiler guard: an
+    # escaping exception (e.g. an injected mid-checkpoint preemption)
+    # must close the open step and restore the thread-local active
+    # profiler, or later collectives on this thread would keep
+    # accumulating into a dead profiler's abandoned step
+    try:
+        for it in range(scan_start, config.num_iterations):
+            if step_profiler is not None:
+                step_profiler.step_begin(it)
+            # bagging (bagging_fraction/freq semantics): the mask is drawn on
+            # device from this key; reusing a key across freq iterations
+            # reproduces the persist-until-refresh behavior
+            bag_key = jax.random.fold_in(
+                bag_root_key, (prior_iters + it) // max(config.bagging_freq, 1))
+            if config.feature_fraction < 1.0:
+                k = max(1, int(round(F * config.feature_fraction)))
+                feature_mask = np.zeros(Fp, bool)  # padded features stay off
+                feature_mask[rng.choice(F, k, replace=False)] = True
+                fmask_dev = None
+            elif fmask_dev is None:
+                feature_mask = np.zeros(Fp, bool)
+                feature_mask[:F] = True
+            if fmask_dev is None:
+                fmask_dev = jnp.asarray(feature_mask)
+                if featpar:
+                    fmask_dev = jax.device_put(
+                        fmask_dev, NamedSharding(mesh, P(DATA_AXIS)))
 
-        # dart: drop trees, rebase scores
-        dropped: List[int] = []
-        if is_dart and trees and rng.random() >= config.skip_drop:
-            drop_mask = rng.random(len(trees)) < config.drop_rate
-            dropped = list(np.nonzero(drop_mask)[0][:config.max_drop])
-            for d in dropped:
-                contrib = (_dart_tree_predict(_to_device_tree(trees[d]))
-                           * tree_weights[d])
-                scores = _sub_scores(scores, contrib, tree_class[d], K)
+            # dart: drop trees, rebase scores
+            dropped: List[int] = []
+            if is_dart and trees and rng.random() >= config.skip_drop:
+                drop_mask = rng.random(len(trees)) < config.drop_rate
+                dropped = list(np.nonzero(drop_mask)[0][:config.max_drop])
+                for d in dropped:
+                    contrib = (_dart_tree_predict(_to_device_tree(trees[d]))
+                               * tree_weights[d])
+                    scores = _sub_scores(scores, contrib, tree_class[d], K)
 
-        # mask to 32 bits so looped and scanned runs derive identical keys
-        # even under jax_enable_x64 (the scan's seed_base is masked too)
-        key = jax.random.PRNGKey(
-            (config.seed * 100003 + prior_iters + it) & 0xffffffff)
-        tstack, new_scores = step(bins_t, scores, labels, weights,
-                                  (base_bag_dev, bag_key), fmask_dev,
-                                  key, upper_bounds, num_bins,
-                                  bundle_map_dev)
-        if eager_host:
-            new_trees = [Tree(*[np.asarray(a[k]) for a in tstack])
-                         for k in range(K)]
-        else:
-            new_trees = None                  # downloaded after the loop
-        if it == 0:
-            jax.block_until_ready(new_scores)
-            measures.compile_s = _time.perf_counter() - _t_train
+            # mask to 32 bits so looped and scanned runs derive identical keys
+            # even under jax_enable_x64 (the scan's seed_base is masked too)
+            key = jax.random.PRNGKey(
+                (config.seed * 100003 + prior_iters + it) & 0xffffffff)
+            if step_profiler is not None:
+                step_profiler.mark("data")
+                if step_profiler.capture_xla:
+                    step_profiler.capture_cost(
+                        "gbdt_step", step, bins_t, scores, labels, weights,
+                        (base_bag_dev, bag_key), fmask_dev, key,
+                        upper_bounds, num_bins, bundle_map_dev)
+            tstack, new_scores = step(bins_t, scores, labels, weights,
+                                      (base_bag_dev, bag_key), fmask_dev,
+                                      key, upper_bounds, num_bins,
+                                      bundle_map_dev)
+            if eager_host:
+                # the host-side download synchronizes, so the compute mark
+                # below times the executed tree grow, not just its dispatch
+                new_trees = [Tree(*[np.asarray(a[k]) for a in tstack])
+                             for k in range(K)]
+            else:
+                new_trees = None                  # downloaded after the loop
+            if it == 0:
+                jax.block_until_ready(new_scores)
+                measures.compile_s = _time.perf_counter() - _t_train
+            if step_profiler is not None:
+                step_profiler.mark("compute")
 
-        dropped_weight_changes = []
-        if is_dart and dropped:
-            # normalize: new trees weighted 1/(|D|+1); dropped scaled |D|/(|D|+1)
-            ndrop = len(dropped)
-            new_w = 1.0 / (ndrop + 1)
-            factor = ndrop / (ndrop + 1)
-            for k in range(K):
-                contrib = (_dart_tree_predict(_to_device_tree(new_trees[k]))
-                           * new_w)
-                scores = _add_scores(scores, contrib, k, K)
-            for d in dropped:
-                old_w = tree_weights[d]
-                tree_weights[d] = old_w * factor
-                dropped_weight_changes.append((d, old_w))
-                contrib = (_dart_tree_predict(_to_device_tree(trees[d]))
-                           * tree_weights[d])
-                scores = _add_scores(scores, contrib, tree_class[d], K)
-            weights_new = [new_w] * K
-        else:
-            scores = new_scores
-            weights_new = [1.0] * K
+            dropped_weight_changes = []
+            if is_dart and dropped:
+                # normalize: new trees weighted 1/(|D|+1); dropped scaled |D|/(|D|+1)
+                ndrop = len(dropped)
+                new_w = 1.0 / (ndrop + 1)
+                factor = ndrop / (ndrop + 1)
+                for k in range(K):
+                    contrib = (_dart_tree_predict(_to_device_tree(new_trees[k]))
+                               * new_w)
+                    scores = _add_scores(scores, contrib, k, K)
+                for d in dropped:
+                    old_w = tree_weights[d]
+                    tree_weights[d] = old_w * factor
+                    dropped_weight_changes.append((d, old_w))
+                    contrib = (_dart_tree_predict(_to_device_tree(trees[d]))
+                               * tree_weights[d])
+                    scores = _add_scores(scores, contrib, tree_class[d], K)
+                weights_new = [new_w] * K
+            else:
+                scores = new_scores
+                weights_new = [1.0] * K
 
-        if eager_host:
-            for k in range(K):
-                trees.append(new_trees[k])
-                tree_class.append(k)
-                tree_weights.append(weights_new[k])
-        else:
-            pending_stacks.append((tstack, weights_new))
-        if is_rf:
-            rf_denominator += 1
-            # rf: gradients always at init margin → reset scores (the
-            # reset array is device-resident once, reused every iteration)
-            if rf_reset_scores is None:
-                rf_reset_scores = init_scores_dev
-            scores = rf_reset_scores
-
-        # validation eval + early stopping (TrainUtils.scala:143-169)
-        if have_valid:
-            _t_eval = _time.perf_counter()
-            # incremental: new trees, plus weight deltas of dart-dropped trees
-            for k in range(K):
-                contrib = np.asarray(_predict_binned_tree(
-                    binned_v, _to_device_tree(new_trees[k]), depth_hint))
-                if K == 1:
-                    valid_contrib += contrib * weights_new[0]
-                else:
-                    valid_contrib[:, k] += contrib * weights_new[k]
-            for d, old_w in dropped_weight_changes:
-                contrib = np.asarray(_predict_binned_tree(
-                    binned_v, _to_device_tree(trees[d]), depth_hint))
-                delta_w = tree_weights[d] - old_w
-                if K == 1:
-                    valid_contrib += contrib * delta_w
-                else:
-                    valid_contrib[:, tree_class[d]] += contrib * delta_w
+            if eager_host:
+                for k in range(K):
+                    trees.append(new_trees[k])
+                    tree_class.append(k)
+                    tree_weights.append(weights_new[k])
+            else:
+                pending_stacks.append((tstack, weights_new))
             if is_rf:
-                # the final rf model averages over ALL trees (carried +
-                # new): un-average the carried model's margin and re-pool
-                base_ = (init_sc[0] if K == 1
-                         else np.asarray(init_sc)[None, :])
-                old_sum = (valid_init - base_) * prior_iters
-                vm = base_ + ((old_sum + valid_contrib)
-                              / max(prior_iters + rf_denominator, 1))
-            else:
-                vm = valid_init + valid_contrib
-            val = metric_fn(yv, vm, wv)
-            eval_history.append(EvalRecord(it, metric_name, val))
-            improved = (best_val is None
-                        or (val > best_val if larger_better else val < best_val))
-            if improved:
-                best_val, best_iter, rounds_no_improve = val, it, 0
-            else:
-                rounds_no_improve += 1
-                if (config.early_stopping_round > 0
-                        and rounds_no_improve >= config.early_stopping_round):
-                    measures.eval_s += _time.perf_counter() - _t_eval
-                    break
-            measures.eval_s += _time.perf_counter() - _t_eval
-        if callbacks:
-            for cb in callbacks:
-                cb(it, trees, eval_history)
-        if ckpt_every and (it + 1) % ckpt_every == 0:
-            pre_t, pre_c, pre_w = (
-                (init_model.trees, init_model.tree_class,
-                 init_model.tree_weights) if init_model else ([], [], []))
-            _write_checkpoint(checkpoint_dir, Booster(
-                pre_t + trees, pre_c + tree_class, pre_w + tree_weights,
-                K, config.objective, init_sc, mapper, feature_names,
-                config, bundler=bundler))
+                rf_denominator += 1
+                # rf: gradients always at init margin → reset scores (the
+                # reset array is device-resident once, reused every iteration)
+                if rf_reset_scores is None:
+                    rf_reset_scores = init_scores_dev
+                scores = rf_reset_scores
+
+            # validation eval + early stopping (TrainUtils.scala:143-169)
+            if have_valid:
+                _t_eval = _time.perf_counter()
+                # incremental: new trees, plus weight deltas of dart-dropped trees
+                for k in range(K):
+                    contrib = np.asarray(_predict_binned_tree(
+                        binned_v, _to_device_tree(new_trees[k]), depth_hint))
+                    if K == 1:
+                        valid_contrib += contrib * weights_new[0]
+                    else:
+                        valid_contrib[:, k] += contrib * weights_new[k]
+                for d, old_w in dropped_weight_changes:
+                    contrib = np.asarray(_predict_binned_tree(
+                        binned_v, _to_device_tree(trees[d]), depth_hint))
+                    delta_w = tree_weights[d] - old_w
+                    if K == 1:
+                        valid_contrib += contrib * delta_w
+                    else:
+                        valid_contrib[:, tree_class[d]] += contrib * delta_w
+                if is_rf:
+                    # the final rf model averages over ALL trees (carried +
+                    # new): un-average the carried model's margin and re-pool
+                    base_ = (init_sc[0] if K == 1
+                             else np.asarray(init_sc)[None, :])
+                    old_sum = (valid_init - base_) * prior_iters
+                    vm = base_ + ((old_sum + valid_contrib)
+                                  / max(prior_iters + rf_denominator, 1))
+                else:
+                    vm = valid_init + valid_contrib
+                val = metric_fn(yv, vm, wv)
+                eval_history.append(EvalRecord(it, metric_name, val))
+                improved = (best_val is None
+                            or (val > best_val if larger_better else val < best_val))
+                if improved:
+                    best_val, best_iter, rounds_no_improve = val, it, 0
+                else:
+                    rounds_no_improve += 1
+                    if (config.early_stopping_round > 0
+                            and rounds_no_improve >= config.early_stopping_round):
+                        measures.eval_s += _time.perf_counter() - _t_eval
+                        break
+                measures.eval_s += _time.perf_counter() - _t_eval
+            if callbacks:
+                for cb in callbacks:
+                    cb(it, trees, eval_history)
+            if ckpt_every and (it + 1) % ckpt_every == 0:
+                pre_t, pre_c, pre_w = (
+                    (init_model.trees, init_model.tree_class,
+                     init_model.tree_weights) if init_model else ([], [], []))
+                _write_checkpoint(checkpoint_dir, Booster(
+                    pre_t + trees, pre_c + tree_class, pre_w + tree_weights,
+                    K, config.objective, init_sc, mapper, feature_names,
+                    config, bundler=bundler))
+            if step_profiler is not None:
+                step_profiler.step_end()      # eval + checkpoint → "other"
+    finally:
+        if step_profiler is not None:
+            step_profiler.finish()    # early-stop break / exception path
 
     # deferred mode: one sync for the whole run, then download every tree in
     # ONE transfer per field (T, K, M) — per-stack downloads pay a tunnel/PCIe

@@ -26,6 +26,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..resilience.faults import get_faults
 from ..telemetry import get_registry
+from ..telemetry.flight import record as flight_record
+from ..telemetry.gangplane import observe_collective
 from .mesh import DATA_AXIS
 
 
@@ -84,9 +86,17 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
     if deadline is not None:
         timeout_s = deadline.limit(timeout_s)
     if timeout_s is None:
+        flight_record("collective.begin", op=op, axis=str(axis),
+                      nbytes=payload_bytes)
         get_faults().raise_point("collective.dispatch", op=op,
                                  axis=str(axis))
-        return fn(*args, **kw)
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        dt = time.perf_counter() - t0
+        flight_record("collective.end", op=op, axis=str(axis),
+                      nbytes=payload_bytes, seconds=round(dt, 6))
+        observe_collective(dt, payload_bytes or 0)
+        return out
     box: dict = {}
     done = threading.Event()
 
@@ -100,6 +110,9 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
         finally:
             done.set()
 
+    flight_record("collective.begin", op=op, axis=str(axis),
+                  nbytes=payload_bytes, timeout_s=float(timeout_s))
+    t0 = time.perf_counter()
     t = threading.Thread(target=_run, daemon=True,
                          name=f"collective-{op}")
     t.start()
@@ -108,10 +121,18 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
             "collective_timeouts_total",
             "host-dispatched collectives that blocked past their "
             "deadline", ("op", "axis")).inc(1, op=op, axis=str(axis))
+        flight_record("collective.timeout", op=op, axis=str(axis),
+                      nbytes=payload_bytes, timeout_s=float(timeout_s))
         raise CollectiveTimeout(op, axis, float(timeout_s),
                                 payload_bytes=payload_bytes)
+    dt = time.perf_counter() - t0
     if "error" in box:
+        # failed collectives leave the `begin` unpaired, matching the
+        # inline leg — a paired `end` means the op completed
         raise box["error"]
+    flight_record("collective.end", op=op, axis=str(axis),
+                  nbytes=payload_bytes, seconds=round(dt, 6))
+    observe_collective(dt, payload_bytes or 0)
     return box["value"]
 
 
@@ -348,6 +369,14 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
         t0 = time.perf_counter()
         if deadline is None and timeout_s is None:
             out = _allreduce(x)
+            # host-observed dispatch latency feeds the open train step's
+            # collective segment + the flight ring (the watched leg below
+            # goes through dispatch_watchdog, which does both itself)
+            dt = time.perf_counter() - t0
+            observe_collective(dt, _payload_bytes(x))
+            flight_record("collective.end", op="allreduce_fn",
+                          axis=str(axis), nbytes=_payload_bytes(x),
+                          seconds=round(dt, 6))
         else:
             # the watched leg must SYNCHRONIZE: under async dispatch the
             # bare call returns before the ring moves a byte, and a hung

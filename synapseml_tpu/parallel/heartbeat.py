@@ -101,11 +101,13 @@ class HeartbeatEmitter(threading.Thread):
 
     def _emit(self) -> None:
         from ..resilience.faults import get_faults
+        from ..telemetry.flight import record as flight_record
         step = current_step()
         faults = get_faults()
         # the silent-rank fault site: ``hang`` blocks right here
         faults.raise_point("heartbeat.emit", rank=self.rank, step=step)
         faults.note("heartbeat.emit", rank=self.rank, step=step)
+        flight_record("heartbeat", rank=self.rank, step=step)
         line = HB_MARKER + json.dumps(
             {"rank": self.rank, "step": step, "ts": time.time()})
         # ONE write call: print()'s text+newline pair could interleave

@@ -47,6 +47,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ..resilience import RetryPolicy, get_faults
 from ..telemetry import get_registry
+from ..telemetry.gangplane import (OBS_DIR_ENV, TM_INTERVAL_ENV,
+                                   parse_telemetry)
 from .heartbeat import HB_INTERVAL_ENV, parse_heartbeat
 
 #: marker the worker prints in front of its JSON result line
@@ -162,11 +164,13 @@ class _RankReader(threading.Thread):
     limit, hence the ring buffer."""
 
     def __init__(self, rank: int, proc: subprocess.Popen,
-                 monitor=None, tail_lines: int = DEFAULT_TAIL_LINES):
+                 monitor=None, plane=None,
+                 tail_lines: int = DEFAULT_TAIL_LINES):
         super().__init__(name=f"rank-reader-{rank}", daemon=True)
         self.rank = rank
         self.proc = proc
         self.monitor = monitor
+        self.plane = plane
         self.tail: "collections.deque[str]" = collections.deque(
             maxlen=max(1, tail_lines))
         self.result_line: Optional[str] = None
@@ -184,6 +188,13 @@ class _RankReader(threading.Thread):
                     self.monitor.observe(self.rank, step=hb.get("step"),
                                          ts=hb.get("ts"))
                 continue                       # beats never enter the tail
+            tm = parse_telemetry(line)
+            if tm is not None:
+                # telemetry batches feed the gang plane and never enter
+                # the tail (one batch can be tens of KB of metrics/spans)
+                if self.plane is not None:
+                    self.plane.ingest(self.rank, tm)
+                continue
             if line.startswith(RESULT_MARKER):
                 # the result must survive any amount of later chatter,
                 # so it is captured out-of-band from the ring
@@ -232,7 +243,9 @@ def _launch_once(task: str, n_processes: int, devices_per_process: int,
                  monitor=None, heartbeat_interval_s: float = 0.0,
                  checkpoint_dir: Optional[str] = None,
                  term_grace_s: float = 2.0,
-                 tail_lines: int = DEFAULT_TAIL_LINES) -> List[Any]:
+                 tail_lines: int = DEFAULT_TAIL_LINES,
+                 plane=None, tm_interval_s: float = 0.0,
+                 obs_dir: Optional[str] = None) -> List[Any]:
     """One rendezvous attempt: spawn, watch (heartbeats + exits + global
     deadline), collect (or tear down and raise WorkerFailure)."""
     # fault site: an armed rule here stands in for a failed rendezvous
@@ -271,12 +284,16 @@ def _launch_once(task: str, n_processes: int, devices_per_process: int,
                     env.setdefault(RENDEZVOUS_TIMEOUT_ENV, str(timeout_s))
                 if checkpoint_dir:
                     env[CKPT_DIR_ENV] = str(checkpoint_dir)
+                if tm_interval_s > 0:
+                    env[TM_INTERVAL_ENV] = str(tm_interval_s)
+                if obs_dir:
+                    env[OBS_DIR_ENV] = str(obs_dir)
                 p = subprocess.Popen(
                     [sys.executable, "-m", "synapseml_tpu.parallel.worker"],
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                     text=True, env=env)
                 procs.append(p)
-                r = _RankReader(rank, p, monitor=monitor,
+                r = _RankReader(rank, p, monitor=monitor, plane=plane,
                                 tail_lines=tail_lines)
                 r.start()
                 readers.append(r)
@@ -398,6 +415,8 @@ def run_on_local_cluster(task: str,
                          checkpoint_dir: Optional[Any] = None,
                          term_grace_s: float = 2.0,
                          tail_lines: int = DEFAULT_TAIL_LINES,
+                         observability_dir: Optional[str] = None,
+                         tm_interval_s: Optional[float] = None,
                          ) -> List[Any]:
     """Run ``module:function`` on a real N-process JAX cluster; return the
     per-rank results (rank order).
@@ -418,6 +437,15 @@ def run_on_local_cluster(task: str,
     reaches every worker as ``SMLTPU_CKPT_DIR`` so checkpointing trainers
     resume instead of restarting.  The raised failure (when retries
     exhaust) is the LAST attempt's, with per-rank causes.
+
+    Observability (see :mod:`synapseml_tpu.telemetry.gangplane`):
+    ``observability_dir`` turns the gang-wide plane on — workers export
+    metric/span/flight batches over the ``SMLMP_TM:`` wire (mirrored
+    into the coordinator's ``/metrics`` with a ``rank`` label), dump
+    their flight rings there on teardown, and a dead attempt leaves a
+    schema-checked ``postmortem.json`` bundle plus a stitched multi-lane
+    ``gang_trace.json``.  ``tm_interval_s`` overrides the export cadence
+    (defaults to the heartbeat interval).
     """
     from .supervisor import GangSupervisor
     return GangSupervisor(
@@ -428,4 +456,5 @@ def run_on_local_cluster(task: str,
         hang_intervals=hang_intervals, startup_grace_s=startup_grace_s,
         straggler_lag_steps=straggler_lag_steps,
         checkpoint_dir=checkpoint_dir, term_grace_s=term_grace_s,
-        tail_lines=tail_lines).run()
+        tail_lines=tail_lines, observability_dir=observability_dir,
+        tm_interval_s=tm_interval_s).run()

@@ -39,6 +39,7 @@ assert the supervision schedule itself.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -47,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..resilience import RetryPolicy
 from ..resilience.faults import get_faults
 from ..telemetry import get_registry
+from ..telemetry.gangplane import GangPlane, write_postmortem
 
 __all__ = ["HeartbeatMonitor", "GangSupervisor", "RankHealth"]
 
@@ -226,7 +228,10 @@ class GangSupervisor:
     ``last_failure`` (the last :class:`~synapseml_tpu.parallel.launcher.
     WorkerFailure`), ``last_recovery_s`` (seconds from failure detection
     to the relaunched gang re-reaching the failed attempt's best step —
-    the elastic-resume cost), ``monitor`` (the live attempt's detector).
+    the elastic-resume cost), ``monitor`` (the live attempt's detector),
+    ``plane`` (the attempt's merged cross-rank telemetry when the
+    observability plane is on), ``last_postmortem`` (path of the bundle
+    the last dead attempt left in ``observability_dir``).
     """
 
     def __init__(self, task: str, n_processes: int = 2,
@@ -240,7 +245,9 @@ class GangSupervisor:
                  straggler_lag_steps: Optional[int] = None,
                  checkpoint_dir: Optional[Any] = None,
                  term_grace_s: float = 2.0,
-                 tail_lines: int = 400):
+                 tail_lines: int = 400,
+                 observability_dir: Optional[str] = None,
+                 tm_interval_s: Optional[float] = None):
         self.task = task
         self.n_processes = int(n_processes)
         self.devices_per_process = int(devices_per_process)
@@ -260,11 +267,23 @@ class GangSupervisor:
         self.checkpoint_dir = checkpoint_dir
         self.term_grace_s = float(term_grace_s)
         self.tail_lines = int(tail_lines)
+        # the gang-wide observability plane: an obs dir turns wire export
+        # on (cadence defaulting to the heartbeat interval), collects
+        # flight dumps, and receives postmortem.json / gang_trace.json
+        self.observability_dir = observability_dir
+        if tm_interval_s is None:
+            tm_interval_s = (self.heartbeat_interval_s
+                             if observability_dir else 0.0)
+        self.tm_interval_s = float(tm_interval_s)
 
         self.restarts = 0
         self.last_failure: Optional[BaseException] = None
         self.last_recovery_s: Optional[float] = None
         self.monitor: Optional[HeartbeatMonitor] = None
+        #: the live (or last) attempt's merged cross-rank telemetry
+        self.plane: Optional[GangPlane] = None
+        #: path of the last written post-mortem bundle, if any
+        self.last_postmortem: Optional[str] = None
 
         reg = get_registry()
         self._c_restarts = reg.counter(
@@ -313,6 +332,65 @@ class GangSupervisor:
                 return kind
         return "other"
 
+    def _clear_flight_dumps(self) -> None:
+        """Remove a previous attempt's (or run's) on-disk flight rings
+        before launching: flight ``seq`` counters restart per process, so
+        a stale dump with a high ``last_seq`` would outrank the NEW
+        attempt's wire tail in the post-mortem gather and attribute the
+        wrong events to a dead rank."""
+        obs = self.observability_dir
+        if not obs or not os.path.isdir(obs):
+            return
+        for r in range(self.n_processes):
+            try:
+                os.unlink(os.path.join(obs, f"flight-rank{r}.json"))
+            except OSError:
+                pass
+
+    def _write_postmortem(self, attempt: int, failure) -> None:
+        """One dead attempt → schema-checked
+        ``postmortem-attempt<N>.json`` in the obs dir, with
+        ``postmortem.json`` always the LATEST attempt's bundle (plus the
+        stitched multi-lane trace of whatever spans the wire delivered
+        before the gang died).  Per-attempt files mean an early
+        attempt's verdict — often the root cause — survives later
+        retries.  Never raises: bundling evidence must not mask the
+        failure being bundled."""
+        obs = self.observability_dir
+        if not obs:
+            return
+        try:
+            os.makedirs(obs, exist_ok=True)
+            last_steps = (self.monitor.last_steps()
+                          if self.monitor is not None else {})
+            bundle = write_postmortem(
+                os.path.join(obs, f"postmortem-attempt{attempt}.json"),
+                task=self.task, causes=dict(failure.causes),
+                attempt=attempt, n_ranks=self.n_processes,
+                plane=self.plane, last_steps=last_steps, obs_dir=obs)
+            from ..telemetry.artifact import write_json
+            from ..telemetry.gangplane import check_postmortem
+            latest = os.path.join(obs, "postmortem.json")
+            write_json(latest, bundle, schema=check_postmortem)
+            # only after the write lands: a swallowed failure must not
+            # leave this pointing at a missing/stale file
+            self.last_postmortem = latest
+            if self.plane is not None:
+                self.plane.export_chrome(os.path.join(obs,
+                                                      "gang_trace.json"))
+        except Exception:
+            pass
+
+    def _export_trace(self) -> None:
+        obs = self.observability_dir
+        if obs and self.plane is not None:
+            try:
+                os.makedirs(obs, exist_ok=True)
+                self.plane.export_chrome(os.path.join(obs,
+                                                      "gang_trace.json"))
+            except Exception:
+                pass
+
     def run(self) -> List[Any]:
         """Launch (and relaunch) until a gang completes; per-rank results
         in rank order, or the LAST attempt's failure when retries
@@ -326,15 +404,23 @@ class GangSupervisor:
         last: Optional[WorkerFailure] = None
         for attempt in range(attempts):
             self.monitor = self._new_monitor(watermark, failed_at)
+            self.plane = (GangPlane(self.n_processes)
+                          if (self.tm_interval_s > 0
+                              or self.observability_dir) else None)
+            self._clear_flight_dumps()
             try:
-                return _launch_once(
+                results = _launch_once(
                     self.task, self.n_processes, self.devices_per_process,
                     self.task_args, self.timeout_s, self.env_extra,
                     monitor=self.monitor,
                     heartbeat_interval_s=self.heartbeat_interval_s,
                     checkpoint_dir=self.checkpoint_dir,
                     term_grace_s=self.term_grace_s,
-                    tail_lines=self.tail_lines)
+                    tail_lines=self.tail_lines,
+                    plane=self.plane, tm_interval_s=self.tm_interval_s,
+                    obs_dir=self.observability_dir)
+                self._export_trace()
+                return results
             except WorkerFailure as e:
                 last = e
                 self.last_failure = e
@@ -346,6 +432,7 @@ class GangSupervisor:
                         watermark = step
                 self._c_failures.inc(1, task=self.task,
                                      cause=self._cause_kind(e.causes))
+                self._write_postmortem(attempt, e)
                 if policy is None or attempt >= attempts - 1 \
                         or not policy.acquire_retry():
                     raise

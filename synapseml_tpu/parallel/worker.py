@@ -21,6 +21,20 @@ Gang supervision hooks (all driver-controlled via env):
 - ``SMLTPU_CKPT_DIR`` names the gang's checkpoint directory; tasks read
   it to resume elastically after a relaunch.
 
+Gang observability hooks (see :mod:`synapseml_tpu.telemetry.gangplane`):
+
+- ``SMLTPU_TM_INTERVAL_S`` > 0 starts the telemetry wire emitter beside
+  the heartbeat thread: one ``SMLMP_TM:`` line per interval carrying the
+  cumulative metric snapshot plus incremental completed spans and flight
+  events.  A FINAL batch flushes synchronously before the result marker,
+  so a clean exit drops no spans or metrics (satisfying the contract
+  that ``shutdown_cluster`` loses nothing a crash wouldn't).
+- ``SMLTPU_OBS_DIR`` names the observability directory: the flight
+  recorder's ring dumps there SIGKILL-atomically (``flight-rank<r>.json``)
+  on SIGTERM — the teardown signal a failing gang's healthy peers
+  receive — and again on clean exit, giving the driver's post-mortem
+  gather the full ring instead of the bounded wire tail.
+
 Run as ``python -m synapseml_tpu.parallel.worker`` with the SMLTPU_* env
 set by ``launcher.run_on_local_cluster``.
 """
@@ -30,7 +44,48 @@ from __future__ import annotations
 import importlib
 import json
 import os
+import signal
 import sys
+
+
+def _flight_dump_path(obs_dir: str, rank: int) -> str:
+    return os.path.join(obs_dir, f"flight-rank{rank}.json")
+
+
+def _install_flight_dump(rank: int):
+    """SIGTERM → dump the flight ring, then exit 143 without unwinding
+    (the rank may be parked in a dead collective no ``finally`` block
+    would ever reach).  Returns ``(dump, install)`` — the dump callable
+    for the clean path and the installer for re-arming — or None when no
+    obs dir is configured.  Re-arming matters: ``jax.distributed``'s
+    rendezvous registers XLA's own SIGTERM preemption notifier, which
+    would silently replace this handler, so the worker installs once
+    early (covers a teardown DURING rendezvous) and again right after
+    the cluster forms."""
+    from synapseml_tpu.telemetry.gangplane import OBS_DIR_ENV
+    obs_dir = os.environ.get(OBS_DIR_ENV)
+    if not obs_dir:
+        return None
+    from synapseml_tpu.telemetry.flight import get_flight
+
+    def dump() -> None:
+        try:
+            get_flight().dump(_flight_dump_path(obs_dir, rank), rank=rank)
+        except BaseException:
+            pass                # a failed dump must not mask the teardown
+
+    def on_term(signum, frame):  # pragma: no cover - signal path
+        dump()
+        os._exit(143)
+
+    def install() -> None:
+        try:
+            signal.signal(signal.SIGTERM, on_term)
+        except (ValueError, OSError):   # non-main thread / exotic platform
+            pass
+
+    install()
+    return dump, install
 
 
 def main() -> int:
@@ -46,6 +101,13 @@ def main() -> int:
     # before (and during) the slow rendezvous below
     from synapseml_tpu.parallel import heartbeat
     emitter = heartbeat.start_emitter(rank)
+    # telemetry wire export + the crash flight dump ride the same early
+    # start: the driver holds a near-current tail even for a rank that
+    # dies during the rendezvous
+    from synapseml_tpu.telemetry import gangplane
+    tm_emitter = gangplane.start_emitter(rank)
+    flight_hooks = _install_flight_dump(rank)
+    flight_dump = flight_hooks[0] if flight_hooks else None
 
     from synapseml_tpu.parallel.distributed import (ClusterConfig,
                                                     initialize_cluster,
@@ -67,10 +129,19 @@ def main() -> int:
     else:
         initialize_cluster(cfg)
     heartbeat.beat(step=0)        # rendezvoused: step 0 is reachable
+    if flight_hooks is not None:
+        flight_hooks[1]()         # re-arm: the rendezvous installed XLA's
+        #                           SIGTERM notifier over our dump handler
 
     mod_name, fn_name = task.split(":", 1)
     fn = getattr(importlib.import_module(mod_name), fn_name)
     result = fn(task_args)
+    # the final telemetry batch flushes BEFORE the result marker: clean
+    # exits must drop no spans or metrics (the periodic loop stops first
+    # so the flush cannot interleave with a concurrent emission)
+    if tm_emitter is not None:
+        tm_emitter.stop()
+        tm_emitter.emit_now(final=True)
     # marker line is the contract with launcher.run_on_local_cluster —
     # a single write call so the heartbeat thread's lines cannot land
     # between the result text and its newline
@@ -82,6 +153,8 @@ def main() -> int:
     shutdown_cluster()
     if emitter is not None:
         emitter.stop()
+    if flight_dump is not None:
+        flight_dump()             # clean-path dump: the full on-disk ring
     return 0
 
 

@@ -79,6 +79,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry.flight import record as _flight_record
+
 __all__ = ["FaultRule", "FaultRegistry", "PreemptionError",
            "ResourceExhaustedError", "PoisonRowError", "get_faults",
            "FAULTS_ENV", "FAULTS_SEED_ENV"]
@@ -243,6 +245,7 @@ class FaultRegistry:
         if not self._rules:            # fast inactive path, no lock
             return None
         with self._lock:
+            fired: Optional[FaultRule] = None
             for rule in self._rules:
                 if not fnmatch.fnmatch(site, rule.site):
                     continue
@@ -258,7 +261,14 @@ class FaultRegistry:
                 if rule.p < 1.0 and self._rng.random() >= rule.p:
                     continue
                 rule.fired += 1
-                return rule
+                fired = rule
+                break
+        if fired is not None:
+            # the flight ring sees every injected fault BEFORE it executes
+            # — for kill/kill_rank kinds the ring (exported over the gang
+            # wire) is the only witness the process leaves behind
+            _flight_record("fault", site=site, fault_kind=fired.kind)
+            return fired
         return None
 
     def raise_point(self, site: str, **ctx) -> None:
@@ -354,6 +364,7 @@ class FaultRegistry:
         seconds = max(0.0, float(seconds))
         with self._lock:
             self.sleep_log.append((site, seconds))
+        _flight_record("backoff", site=site, seconds=seconds)
         if seconds > 0 and not self.no_sleep:
             time.sleep(seconds)
 

@@ -67,6 +67,7 @@ import numpy as np
 from ..core.dataset import Dataset
 from ..core.params import Params, StringParam
 from ..telemetry import get_registry, write_json
+from ..telemetry.flight import record as _flight
 from .faults import PreemptionError, get_faults
 
 __all__ = [
@@ -629,6 +630,8 @@ class _RowGuard:
             error_class=error_class, error_message=message, verb=self.verb))
         self.bad_rows.append(row)
         self._m_rows.inc(1, stage=self.stage.uid, outcome=self.mode)
+        _flight("rowguard", stage=self.stage.uid, verdict=self.mode,
+                rows=1, row=int(row.source_index[0]), error=error_class)
 
     def _record_mask(self, ds: Dataset, bad: np.ndarray,
                      error_class: str, reasons: Dict[int, str]) -> None:
@@ -646,6 +649,8 @@ class _RowGuard:
         self.bad_rows.append(ds._mask_rows(bad))
         self._m_rows.inc(int(bad.sum()), stage=self.stage.uid,
                          outcome=self.mode)
+        _flight("rowguard", stage=self.stage.uid, verdict=self.mode,
+                rows=int(bad.sum()), error=error_class)
 
     # -- stage-boundary contract + NaN/Inf screen --------------------------
     def _screen(self, ds: Dataset) -> Dataset:

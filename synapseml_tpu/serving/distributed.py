@@ -38,6 +38,7 @@ import numpy as np
 from ..resilience import breaker_for
 from ..resilience.faults import get_faults
 from ..telemetry import get_registry
+from ..telemetry.flight import record as flight_record
 from .server import ServingServer
 
 #: replica probe states
@@ -171,10 +172,22 @@ class ReplicaRouter:
             "serving_replicas_healthy",
             "replicas currently probed healthy with a non-open breaker",
             ("router",))
+        # per-replica probe verdicts join the gang-level metric surface
+        # (the coordinator's /metrics shows every replica's health beside
+        # the rank-labeled worker metrics the gang plane mirrors)
+        self._g_probe = get_registry().gauge(
+            "serving_replica_probe_status",
+            "last probe verdict per replica: 1 healthy, 0.5 draining, "
+            "0 dead", ("router", "rank"))
         self._apply_table(table)
 
     def _apply_table(self, table: List[Tuple[str, int]]) -> None:
+        prev = len(getattr(self, "table", ()))
         self.table = [(h, int(p)) for h, p in table]
+        # a shrunk table must not leave departed replicas' last verdicts
+        # on /metrics as phantom healthy rows
+        for r in range(len(self.table), prev):
+            self._g_probe.remove(router=self.name, rank=str(r))
         # optimistic until probed: a fresh table names live listeners
         self._status = {r: HEALTHY for r in range(len(self.table))}
         self._breakers = {
@@ -214,8 +227,13 @@ class ReplicaRouter:
                 elif status == DEAD:
                     b.record_failure()
                 # draining is deliberate, not a fault: no breaker signal
+                self._g_probe.set(
+                    {HEALTHY: 1.0, DRAINING: 0.5}.get(status, 0.0),
+                    router=self.name, rank=str(rank))
                 self._update_gauge()
         get_faults().note("serving.replica_probe", rank=rank, status=status)
+        flight_record("replica_probe", router=self.name, rank=rank,
+                      status=status)
         return status
 
     def probe_all(self) -> Dict[int, str]:

@@ -14,6 +14,16 @@ telemetry plus ``LightGBMPerformance.scala`` phase measures:
 - :mod:`.artifact` — atomic, round-trip-verified JSON artifact writes
   (``write_json``), used by ``bench.py`` so a truncated ``BENCH_*.json``
   cannot recur.
+- :mod:`.flight` — the crash flight recorder: a bounded,
+  allocation-stable ring of structured events (collectives, checkpoint
+  publishes, backoffs, fault firings, heartbeats, rowguard verdicts),
+  dumped SIGKILL-atomically for post-mortem bundles.
+- :mod:`.gangplane` — the gang-wide observability plane: cross-rank
+  metric/span export over the ``SMLMP_TM:`` wire, ``worker_*{rank=}``
+  mirroring into the coordinator's ``/metrics``, multi-lane Chrome-trace
+  stitching, schema-checked ``postmortem.json`` bundles, and the
+  :class:`~synapseml_tpu.telemetry.gangplane.StepProfiler` train-step
+  decomposition (data/compute/collective).
 
 Everything here is stdlib-only and safe to import before jax.
 
@@ -52,6 +62,9 @@ from .artifact import (SchemaError, check_schema, dumps_checked, read_json,
                        write_json)
 from .exposition import (PROMETHEUS_CONTENT_TYPE, render_json,
                          render_prometheus)
+from .flight import FlightRecorder, get_flight
+from .gangplane import (GangPlane, StepProfiler, TM_MARKER,
+                        check_postmortem, parse_telemetry, write_postmortem)
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, get_registry)
 from .tracing import Span, Tracer, get_tracer, span
@@ -63,4 +76,7 @@ __all__ = [
     "render_prometheus", "render_json", "PROMETHEUS_CONTENT_TYPE",
     "SchemaError", "check_schema", "dumps_checked", "write_json",
     "read_json",
+    "FlightRecorder", "get_flight",
+    "GangPlane", "StepProfiler", "TM_MARKER", "check_postmortem",
+    "parse_telemetry", "write_postmortem",
 ]

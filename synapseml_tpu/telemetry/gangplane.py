@@ -1,0 +1,690 @@
+"""Gang-wide observability plane: cross-rank metric/span export, the
+post-mortem bundle writer, and the step-level training profiler.
+
+PR 1's telemetry is strictly per-process; a gang run therefore used to
+end with every worker rank's counters, spans and step timings dying with
+its process.  This module is the cross-rank layer (the Horovod-timeline
+analogue — Sergeev & Del Balso, arXiv:1802.05799 — single-process traces
+cannot explain collective stalls):
+
+- **wire export** — each worker periodically serializes a compact metric
+  snapshot, its completed spans and the flight-record increment into one
+  ``SMLMP_TM:{...}`` line on the result pipe (the ``SMLMP_HB:`` sibling).
+  The driver's per-rank readers feed :class:`GangPlane`, which mirrors
+  worker metrics into the coordinator's registry under a ``worker_``
+  prefix with a ``rank`` label (so the coordinator's ``/metrics`` serves
+  the whole gang) and stitches per-rank spans into one multi-lane
+  Chrome trace (``pid`` = rank).
+- **post-mortem bundles** — :func:`write_postmortem` gathers the failure
+  verdict, each rank's flight-record tail (wire tail, or the richer
+  on-disk dump a SIGTERMed rank leaves), last durable step and final
+  metric snapshot into a schema-checked ``postmortem.json`` via the
+  atomic artifact writer.
+- **:class:`StepProfiler`** — decomposes each train step's wall time
+  into data/compute/collective segments (host-timed; the collective leg
+  fed by the dispatch hooks in ``parallel.collectives``), exports
+  ``train_step_seconds{model,segment}`` histograms, and optionally
+  captures XLA cost analysis (flops, bytes accessed) once per compiled
+  fn for a roofline-ready summary — per-rank timing decomposition of
+  compute vs. communication, not aggregate throughput alone (Awan et
+  al., arXiv:1810.11112).
+
+Stdlib-only; importable before (and without) jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .artifact import SchemaError, write_json
+from .flight import get_flight, sanitize_floats as _sanitize
+from .registry import MetricsRegistry, get_registry
+from .tracing import get_tracer
+
+__all__ = ["TM_MARKER", "TM_INTERVAL_ENV", "OBS_DIR_ENV",
+           "TelemetryEmitter", "start_emitter", "parse_telemetry",
+           "telemetry_batch", "GangPlane", "mirror_snapshot",
+           "StepProfiler", "current_profiler", "observe_collective",
+           "check_postmortem", "write_postmortem", "GANG_METRICS"]
+
+#: marker in front of the telemetry-batch JSON line (``SMLMP_HB`` sibling)
+TM_MARKER = "SMLMP_TM:"
+#: env var the launcher sets to enable wire export (seconds; 0/unset = off)
+TM_INTERVAL_ENV = "SMLTPU_TM_INTERVAL_S"
+#: env var naming the observability directory (flight dumps, post-mortems)
+OBS_DIR_ENV = "SMLTPU_OBS_DIR"
+
+#: newest flight events per wire batch (one batch is one pipe line)
+MAX_FLIGHT_PER_BATCH = 200
+#: newest spans per wire batch
+MAX_SPANS_PER_BATCH = 1000
+
+#: gang-level metric names this plane exports — the hygiene sweep asserts
+#: every one of these is documented (worker metrics additionally surface
+#: under the ``worker_`` prefix + ``rank`` label, documented as a rule)
+GANG_METRICS = frozenset({
+    "gangplane_batches_total", "gangplane_spans_total",
+    "postmortem_bundles_total", "train_step_seconds", "train_steps_total",
+    "serving_replica_probe_status",
+})
+
+
+# ---------------------------------------------------------------------------
+# worker side: the wire
+# ---------------------------------------------------------------------------
+
+def _compact_snapshot(registry: Optional[MetricsRegistry] = None
+                      ) -> Dict[str, Any]:
+    """Registry snapshot minus help strings (the wire carries values,
+    not documentation — help text is re-attached at mirror time)."""
+    snap = (registry or get_registry()).snapshot()
+    return {name: {"kind": m["kind"], "labelnames": m["labelnames"],
+                   "series": m["series"]}
+            for name, m in snap.items()}
+
+
+def _chrome_event(span) -> Dict[str, Any]:
+    """One finished Span → a pid-less Chrome complete event (the driver
+    assigns ``pid`` = rank when stitching)."""
+    return {"name": span.name, "ph": "X", "cat": "host",
+            "ts": span.start_wall_s * 1e6,
+            "dur": (span.end_s - span.start_s) * 1e6,
+            "tid": span.thread_id,
+            "args": {**span.attrs, "span_id": span.span_id,
+                     "parent_id": span.parent_id}}
+
+
+def telemetry_batch(rank: int, *, span_cursor: int = 0,
+                    flight_seq: int = 0, seq: int = 0,
+                    final: bool = False) -> Tuple[Dict[str, Any], int, int]:
+    """Build one wire batch → ``(payload, new_span_cursor,
+    new_flight_seq)``.  The payload's metric snapshot is cumulative
+    (mirrors are SET, not added, so re-sends are idempotent); spans and
+    flight events are incremental since the given cursors."""
+    tracer = get_tracer()
+    spans = tracer.spans()
+    if span_cursor > len(spans):        # tracer was reset mid-run
+        span_cursor = 0
+    new_spans = [s for s in spans[span_cursor:] if s.end_s is not None]
+    if len(new_spans) > MAX_SPANS_PER_BATCH:
+        new_spans = new_spans[-MAX_SPANS_PER_BATCH:]
+    flight = get_flight()
+    events = flight.events_since(flight_seq, limit=MAX_FLIGHT_PER_BATCH)
+    payload = {
+        "rank": int(rank), "seq": int(seq), "ts": time.time(),
+        "final": bool(final),
+        "metrics": _compact_snapshot(),
+        "spans": [_chrome_event(s) for s in new_spans],
+        "flight": events,
+    }
+    new_flight_seq = events[-1]["seq"] if events else flight_seq
+    return payload, len(spans), new_flight_seq
+
+
+def parse_telemetry(line: str) -> Optional[dict]:
+    """``SMLMP_TM:{...}`` line → dict (None for other lines or garbage —
+    a chatty task must never crash the driver's reader)."""
+    if not line.startswith(TM_MARKER):
+        return None
+    try:
+        d = json.loads(line[len(TM_MARKER):])
+        return d if isinstance(d, dict) else None
+    except ValueError:
+        return None
+
+
+class TelemetryEmitter(threading.Thread):
+    """Daemon thread printing one ``SMLMP_TM:`` batch every
+    ``interval_s`` — and, via :meth:`emit_now`, a final batch flushed
+    synchronously BEFORE the worker's result marker, so a clean exit
+    drops no spans or metrics (crashes are covered by the periodic
+    batches and the driver-held flight tail)."""
+
+    def __init__(self, rank: int, interval_s: float, stream=None):
+        super().__init__(name=f"tm-emitter-r{rank}", daemon=True)
+        self.rank = int(rank)
+        self.interval_s = float(interval_s)
+        self._stream = stream
+        self._halt = threading.Event()
+        self._emit_lock = threading.Lock()
+        self._span_cursor = 0
+        self._flight_seq = 0
+        self._seq = 0
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def emit_now(self, final: bool = False) -> None:
+        """Serialize + write one batch on the caller's thread (the
+        emitter lock keeps cursors consistent with the periodic loop)."""
+        with self._emit_lock:
+            payload, self._span_cursor, self._flight_seq = telemetry_batch(
+                self.rank, span_cursor=self._span_cursor,
+                flight_seq=self._flight_seq, seq=self._seq, final=final)
+            self._seq += 1
+            from .artifact import _jsonify
+            line = TM_MARKER + json.dumps(payload, default=_jsonify)
+            # ONE write call: interleaving with the heartbeat thread's
+            # (or the result marker's) writes on shared stdout would
+            # corrupt both lines
+            stream = self._stream if self._stream is not None else sys.stdout
+            stream.write(line + "\n")
+            stream.flush()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                self.emit_now()
+            except Exception:
+                # a closed pipe at teardown silences this rank's export;
+                # the driver already holds everything sent so far
+                return
+            self._halt.wait(self.interval_s)
+
+
+def start_emitter(rank: int, interval_s: Optional[float] = None,
+                  stream=None) -> Optional[TelemetryEmitter]:
+    """Start the wire emitter when export is enabled (``interval_s`` or
+    the ``SMLTPU_TM_INTERVAL_S`` env var > 0); returns it, or None."""
+    if interval_s is None:
+        try:
+            interval_s = float(os.environ.get(TM_INTERVAL_ENV, "0") or 0)
+        except ValueError:
+            interval_s = 0.0
+    if interval_s <= 0:
+        return None
+    emitter = TelemetryEmitter(rank, interval_s, stream=stream)
+    emitter.start()
+    return emitter
+
+
+# ---------------------------------------------------------------------------
+# driver side: merge + stitch
+# ---------------------------------------------------------------------------
+
+def mirror_snapshot(snapshot: Dict[str, Any], *, prefix: str = "worker_",
+                    extra_labels: Optional[Dict[str, str]] = None,
+                    registry: Optional[MetricsRegistry] = None,
+                    help_note: str = "mirrored from a worker rank") -> int:
+    """SET a compact snapshot's series into ``registry`` under
+    ``prefix<name>`` with ``extra_labels`` appended (labels the source
+    already carries are NOT duplicated).  Values are assigned, not
+    accumulated, so re-mirroring a cumulative snapshot is idempotent.
+    Returns the number of series written; a malformed metric is skipped,
+    never raised (exposition must survive a garbled wire line)."""
+    reg = registry or get_registry()
+    extra = dict(extra_labels or {})
+    written = 0
+    for name, m in snapshot.items():
+        try:
+            kind = m.get("kind")
+            orig_lns = tuple(m.get("labelnames") or ())
+            add = {k: str(v) for k, v in extra.items() if k not in orig_lns}
+            lns = orig_lns + tuple(add)
+            series = m.get("series") or []
+            mname = prefix + name
+            if kind == "counter":
+                metric = reg.counter(mname, help_note, lns)
+            elif kind == "gauge":
+                metric = reg.gauge(mname, help_note, lns)
+            elif kind == "histogram":
+                if not series:
+                    continue
+                bounds = sorted(float(b) for b in series[0]["buckets"])
+                metric = reg.histogram(mname, help_note, lns, buckets=bounds)
+            else:
+                continue
+            for s in series:
+                labels = {**(s.get("labels") or {}), **add}
+                key = tuple(str(labels.get(ln, "")) for ln in lns)
+                if kind == "histogram":
+                    by_bound = {float(b): int(n)
+                                for b, n in s["buckets"].items()}
+                    st = {"buckets": [by_bound.get(b, 0)
+                                      for b in metric.buckets],
+                          "sum": float(s["sum"]), "count": int(s["count"])}
+                    with metric._lock:
+                        metric._series[key] = st
+                else:
+                    with metric._lock:
+                        metric._series[key] = float(s["value"])
+                written += 1
+        except Exception:
+            continue
+    return written
+
+
+class _RankState:
+    """Driver-held view of one rank's exported telemetry."""
+
+    def __init__(self, span_limit: int, flight_tail: int):
+        self.metrics: Optional[Dict[str, Any]] = None
+        self.spans: "collections.deque[dict]" = collections.deque(
+            maxlen=span_limit)
+        self.flight: "collections.deque[dict]" = collections.deque(
+            maxlen=flight_tail)
+        self.batches = 0
+        self.final = False
+        self.last_ts: Optional[float] = None
+
+
+class GangPlane:
+    """The coordinator's merged view of every rank's exported telemetry.
+
+    Fed by the launcher's per-rank reader threads (:meth:`ingest`);
+    mirrors worker metrics into ``registry`` (default: the process
+    registry behind ``/metrics``) as ``worker_<name>{...,rank=<r>}``,
+    retains a bounded span store per rank for Chrome-trace stitching,
+    and a bounded flight tail per rank for the post-mortem bundle."""
+
+    def __init__(self, n_ranks: int,
+                 registry: Optional[MetricsRegistry] = None,
+                 span_limit: int = 20_000, flight_tail: int = 256):
+        self.n_ranks = int(n_ranks)
+        self._registry = registry or get_registry()
+        self._lock = threading.Lock()
+        self._ranks: Dict[int, _RankState] = {
+            r: _RankState(span_limit, flight_tail)
+            for r in range(self.n_ranks)}
+        self._c_batches = self._registry.counter(
+            "gangplane_batches_total",
+            "telemetry wire batches ingested from workers", ("rank",))
+        self._c_spans = self._registry.counter(
+            "gangplane_spans_total",
+            "worker spans stitched into the gang trace", ("rank",))
+
+    # -- feeding -----------------------------------------------------------
+    def ingest(self, rank: int, payload: Dict[str, Any]) -> None:
+        """One parsed ``SMLMP_TM:`` batch.  Thread-safe; never raises
+        (a garbled line must not kill the reader thread)."""
+        try:
+            st = self._ranks.get(int(rank))
+            if st is None:
+                return
+            spans = payload.get("spans") or []
+            with self._lock:
+                if payload.get("metrics") is not None:
+                    st.metrics = payload["metrics"]
+                for ev in spans:
+                    st.spans.append(dict(ev, pid=int(rank)))
+                for ev in payload.get("flight") or []:
+                    st.flight.append(ev)
+                st.batches += 1
+                st.final = st.final or bool(payload.get("final"))
+                st.last_ts = payload.get("ts")
+            if payload.get("metrics") is not None:
+                mirror_snapshot(payload["metrics"],
+                                extra_labels={"rank": str(rank)},
+                                registry=self._registry)
+            self._c_batches.inc(1, rank=str(rank))
+            if spans:
+                self._c_spans.inc(len(spans), rank=str(rank))
+        except Exception:
+            pass
+
+    # -- reading -----------------------------------------------------------
+    def batches(self, rank: int) -> int:
+        with self._lock:
+            return self._ranks[rank].batches
+
+    def saw_final(self, rank: int) -> bool:
+        with self._lock:
+            return self._ranks[rank].final
+
+    def metrics_for(self, rank: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            m = self._ranks[rank].metrics
+        return dict(m) if m is not None else None
+
+    def spans_for(self, rank: int) -> List[dict]:
+        with self._lock:
+            return list(self._ranks[rank].spans)
+
+    def flight_tail(self, rank: int,
+                    n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            tail = list(self._ranks[rank].flight)
+        return tail if n is None else tail[-n:]
+
+    # -- stitching ---------------------------------------------------------
+    def chrome_trace(self) -> Dict[str, Any]:
+        """All ranks' spans as one Chrome trace: ``pid`` = rank, one
+        named lane per rank (process_name metadata events)."""
+        events: List[dict] = []
+        for r in range(self.n_ranks):
+            events.append({"name": "process_name", "ph": "M", "pid": r,
+                           "args": {"name": f"rank {r}"}})
+            events.extend(self.spans_for(r))
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str) -> Dict[str, Any]:
+        """Atomically write the stitched multi-lane trace (non-finite
+        span attrs stringified — one NaN must not abort the file)."""
+        return write_json(path, _sanitize(self.chrome_trace()),
+                          schema=("traceEvents",))
+
+
+# ---------------------------------------------------------------------------
+# post-mortem bundles
+# ---------------------------------------------------------------------------
+
+def check_postmortem(obj: Any) -> None:
+    """Schema validator for ``postmortem.json`` (artifact-writer
+    callable form): top-level task/verdict/causes/ranks, every rank
+    entry carrying cause, last_step, flight_tail (list) and metrics."""
+    if not isinstance(obj, dict):
+        raise SchemaError("postmortem bundle must be a JSON object")
+    for k in ("task", "verdict", "causes", "ranks", "attempt", "n_ranks",
+              "created_unix"):
+        if k not in obj:
+            raise SchemaError(f"postmortem bundle missing key {k!r}")
+    if not isinstance(obj["causes"], dict):
+        raise SchemaError("causes must be a rank → verdict map")
+    if not isinstance(obj["ranks"], dict) or not obj["ranks"]:
+        raise SchemaError("ranks must be a nonempty rank → state map")
+    for r, st in obj["ranks"].items():
+        if not isinstance(st, dict):
+            raise SchemaError(f"rank {r} entry must be an object")
+        for k in ("cause", "last_step", "flight_tail", "metrics"):
+            if k not in st:
+                raise SchemaError(f"rank {r} entry missing key {k!r}")
+        if not isinstance(st["flight_tail"], list):
+            raise SchemaError(f"rank {r} flight_tail must be a list")
+
+
+def _ondisk_flight(obs_dir: str, rank: int) -> Optional[Dict[str, Any]]:
+    path = os.path.join(obs_dir, f"flight-rank{rank}.json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def write_postmortem(path: str, *, task: str, causes: Dict[int, str],
+                     attempt: int, n_ranks: int,
+                     plane: Optional[GangPlane] = None,
+                     last_steps: Optional[Dict[int, Optional[int]]] = None,
+                     obs_dir: Optional[str] = None,
+                     tail_events: int = 64,
+                     verdict: Optional[str] = None) -> Dict[str, Any]:
+    """Gather one dead gang attempt into a schema-checked bundle.
+
+    Per rank, the flight tail prefers the on-disk dump a SIGTERMed rank
+    left (richer: the whole ring) over the wire tail the driver held —
+    unless the wire tail is fresher (higher ``seq``), which is the
+    SIGKILL case where the dump never happened."""
+    last_steps = dict(last_steps or {})
+    ranks: Dict[str, Any] = {}
+    for r in range(int(n_ranks)):
+        wire = plane.flight_tail(r) if plane is not None else []
+        wire_seq = max((e.get("seq", 0) for e in wire), default=0)
+        tail = wire
+        if obs_dir:
+            dumped = _ondisk_flight(obs_dir, r)
+            if dumped is not None and dumped.get("last_seq", 0) >= wire_seq:
+                tail = [e for e in dumped.get("events", [])
+                        if isinstance(e, dict)]
+        ranks[str(r)] = {
+            "cause": causes.get(r),
+            "last_step": last_steps.get(r),
+            "flight_tail": tail[-max(1, tail_events):],
+            "metrics": (plane.metrics_for(r) if plane is not None
+                        else None),
+            "final_batch_seen": (plane.saw_final(r)
+                                 if plane is not None else False),
+        }
+    known_steps = [s for s in last_steps.values() if s is not None]
+    bundle = {
+        "task": task,
+        "verdict": verdict or "; ".join(
+            f"rank {r}: {c}" for r, c in sorted(causes.items())) or
+        "gang attempt failed (no per-rank verdict)",
+        "causes": {str(r): c for r, c in causes.items()},
+        "attempt": int(attempt),
+        "n_ranks": int(n_ranks),
+        "last_durable_step": max(known_steps) if known_steps else None,
+        "created_unix": time.time(),
+        "ranks": ranks,
+    }
+    out = write_json(path, _sanitize(bundle), schema=check_postmortem)
+    get_registry().counter(
+        "postmortem_bundles_total",
+        "post-mortem bundles written for dead gang attempts",
+        ("task",)).inc(1, task=task)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# step profiler
+# ---------------------------------------------------------------------------
+
+_active = threading.local()
+
+#: train-step buckets: sub-ms dispatches through multi-second steps
+_STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def current_profiler() -> Optional["StepProfiler"]:
+    """The profiler whose step is open on THIS thread (None outside)."""
+    return getattr(_active, "profiler", None)
+
+
+def observe_collective(seconds: float, nbytes: int = 0) -> None:
+    """Collective-dispatch hook: attributes host-observed collective
+    time to the open step's ``collective`` segment.  Called by
+    ``parallel.collectives``; free when no step is open."""
+    prof = getattr(_active, "profiler", None)
+    if prof is not None:
+        prof._note_collective(seconds, nbytes)
+
+
+class StepProfiler:
+    """Wall-time decomposition of train steps into data / compute /
+    collective / other segments.
+
+    Two APIs over the same accounting:
+
+    - context managers (new loops)::
+
+          prof = StepProfiler("dl_text")
+          with prof.step(i):
+              with prof.segment("data"):    batch = shard(...)
+              with prof.segment("compute"): state, m = step_fn(...)
+
+    - begin/mark (retrofits into large existing loops, no re-indent)::
+
+          prof.step_begin(i)
+          ...prep...; prof.mark("data")
+          ...dispatch...; prof.mark("compute")
+          ...eval/checkpoint...; prof.step_end()   # remainder → "other"
+
+    The ``collective`` segment is fed by the dispatch hooks in
+    ``parallel.collectives`` (host-dispatched collectives only; in-jit
+    collectives execute inside whichever segment dispatched them — the
+    hook-fed number is reported alongside, not subtracted).  Per-segment
+    wall time lands in ``train_step_seconds{model,segment}`` plus a
+    ``total`` series per step; :meth:`summary` returns a roofline-ready
+    block, optionally with XLA cost analysis from :meth:`capture_cost`.
+    """
+
+    SEGMENTS = ("data", "compute", "collective", "other")
+
+    def __init__(self, model: str,
+                 registry: Optional[MetricsRegistry] = None,
+                 max_step_records: int = 1024,
+                 capture_xla: bool = False):
+        reg = registry or get_registry()
+        self.model = str(model)
+        self.capture_xla = bool(capture_xla)
+        self._hist = reg.histogram(
+            "train_step_seconds",
+            "wall-clock decomposition of train steps, by model and "
+            "segment (data/compute/collective/other/total)",
+            ("model", "segment"), buckets=_STEP_BUCKETS)
+        self._c_steps = reg.counter(
+            "train_steps_total", "profiled train steps", ("model",))
+        self._lock = threading.Lock()
+        self.steps = 0
+        self.totals: Dict[str, float] = {s: 0.0 for s in
+                                         (*self.SEGMENTS, "total")}
+        self.collective_bytes = 0
+        self.costs: Dict[str, Optional[Dict[str, float]]] = {}
+        self._tail: "collections.deque[dict]" = collections.deque(
+            maxlen=max(1, max_step_records))
+        # open-step state (thread-local via _active while a step is open)
+        self._open: Optional[dict] = None
+
+    # -- begin/mark API ----------------------------------------------------
+    def step_begin(self, index: Optional[int] = None) -> None:
+        if self._open is not None:      # a break skipped step_end: close it
+            self.step_end()
+        now = time.perf_counter()
+        self._open = {"index": index, "t0": now, "t_last": now,
+                      "segs": {}, "collective": 0.0, "prev": (
+                          getattr(_active, "profiler", None))}
+        _active.profiler = self
+
+    def mark(self, segment: str) -> None:
+        """Attribute the wall time since the previous mark (or step
+        begin) to ``segment``."""
+        st = self._open
+        if st is None:
+            return
+        now = time.perf_counter()
+        st["segs"][segment] = st["segs"].get(segment, 0.0) \
+            + (now - st["t_last"])
+        st["t_last"] = now
+
+    def step_end(self) -> None:
+        st = self._open
+        if st is None:
+            return
+        self._open = None
+        _active.profiler = st["prev"]
+        now = time.perf_counter()
+        total = now - st["t0"]
+        segs = st["segs"]
+        other = max(0.0, total - sum(segs.values()))
+        segs["other"] = segs.get("other", 0.0) + other
+        segs["collective"] = segs.get("collective", 0.0) + st["collective"]
+        rec = {"step": st["index"], "total": total,
+               **{s: segs.get(s, 0.0) for s in self.SEGMENTS}}
+        with self._lock:
+            self.steps += 1
+            self.totals["total"] += total
+            for s in self.SEGMENTS:
+                self.totals[s] += segs.get(s, 0.0)
+            self._tail.append(rec)
+        try:
+            for s in self.SEGMENTS:
+                if segs.get(s, 0.0) > 0.0:
+                    self._hist.observe(segs[s], model=self.model, segment=s)
+            self._hist.observe(total, model=self.model, segment="total")
+            self._c_steps.inc(1, model=self.model)
+        except Exception:       # telemetry must never break training
+            pass
+
+    def finish(self) -> None:
+        """Close any dangling step (early-stopping ``break`` paths)."""
+        if self._open is not None:
+            self.step_end()
+
+    # -- context API -------------------------------------------------------
+    @contextlib.contextmanager
+    def step(self, index: Optional[int] = None) -> Iterator[None]:
+        self.step_begin(index)
+        try:
+            yield
+        finally:
+            self.step_end()
+
+    @contextlib.contextmanager
+    def segment(self, name: str) -> Iterator[None]:
+        st = self._open
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if st is not None and st is self._open:
+                st["segs"][name] = st["segs"].get(name, 0.0) \
+                    + (time.perf_counter() - t0)
+                st["t_last"] = time.perf_counter()
+
+    # -- collective hook ---------------------------------------------------
+    def _note_collective(self, seconds: float, nbytes: int = 0) -> None:
+        st = self._open
+        if st is not None:
+            st["collective"] += float(seconds)
+        with self._lock:
+            self.collective_bytes += int(nbytes)
+
+    # -- XLA cost analysis -------------------------------------------------
+    def capture_cost(self, key: str, fn, *args,
+                     **kw) -> Optional[Dict[str, float]]:
+        """Once per ``key``: lower + compile ``fn`` on ``args`` and
+        record XLA's cost analysis (flops, bytes accessed).  Triggers an
+        AOT compile, so call it at most once per compiled fn and only
+        when roofline numbers are wanted (``capture_xla=True`` callers);
+        any failure records None and never propagates."""
+        if key in self.costs:
+            return self.costs[key]
+        entry: Optional[Dict[str, float]] = None
+        try:
+            ca = fn.lower(*args, **kw).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            entry = {"flops": float(ca.get("flops", 0.0)),
+                     "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+        except Exception:
+            entry = None
+        self.costs[key] = entry
+        return entry
+
+    # -- export ------------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        """Roofline-ready block: totals, per-step averages, hook-fed
+        collective bytes, and achieved flops/s / bytes/s per captured
+        compiled fn (against the average compute-segment second)."""
+        with self._lock:
+            steps = self.steps
+            totals = dict(self.totals)
+            cbytes = self.collective_bytes
+            tail = list(self._tail)
+        avg = {s: (totals[s] / steps if steps else 0.0) for s in totals}
+        roofline = {}
+        for key, cost in self.costs.items():
+            if not cost:
+                roofline[key] = None
+                continue
+            compute_s = avg.get("compute") or avg.get("total") or 0.0
+            roofline[key] = {
+                **cost,
+                "arithmetic_intensity": (
+                    cost["flops"] / cost["bytes_accessed"]
+                    if cost["bytes_accessed"] else None),
+                "achieved_flops_per_sec": (
+                    cost["flops"] / compute_s if compute_s else None),
+                "achieved_bytes_per_sec": (
+                    cost["bytes_accessed"] / compute_s
+                    if compute_s else None),
+            }
+        return {"model": self.model, "steps": steps, "seconds": totals,
+                "per_step_avg_seconds": avg,
+                "collective_bytes": cbytes,
+                "roofline": roofline, "last_steps": tail[-16:]}
+
+    def export(self, path: str) -> Dict[str, Any]:
+        """Atomically write :meth:`summary` (the reusable form of
+        bench.py's hand-rolled round-5 step decomposition)."""
+        return write_json(path, _sanitize(self.summary()),
+                          schema=("model", "steps", "seconds"))

@@ -74,6 +74,13 @@ class _Metric:
         with self._lock:
             self._series.clear()
 
+    def remove(self, **labels) -> None:
+        """Drop one label-set's series (no-op if absent) — for surfaces
+        whose membership shrinks, e.g. a refreshed routing table."""
+        key = self._key(labels)
+        with self._lock:
+            self._series.pop(key, None)
+
 
 class Counter(_Metric):
     """Monotonically increasing value per label set."""

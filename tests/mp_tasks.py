@@ -184,6 +184,44 @@ def gbdt_elastic_digest(args):
     }
 
 
+def obs_probe(args):
+    """Observability-plane scaffolding: registers worker-side metrics,
+    opens spans, checkpoints each step and beats — everything the
+    ``SMLMP_TM:`` wire should deliver to the driver, plus flight events
+    (checkpoint/heartbeat/fault) for the post-mortem gather.  Passes the
+    ``mp.step`` kill point so ``kill_rank`` schedules work unchanged."""
+    import os
+    import time
+
+    import jax
+
+    from synapseml_tpu.core.checkpoint import CheckpointManager
+    from synapseml_tpu.parallel.heartbeat import beat
+    from synapseml_tpu.resilience import get_faults
+    from synapseml_tpu.telemetry import get_registry, span
+
+    args = args or {}
+    steps = int(args.get("steps", 6))
+    step_sleep_s = float(args.get("step_sleep_s", 0.1))
+    rank = jax.process_index()
+    ckpt_dir = os.environ.get("SMLTPU_CKPT_DIR")
+    if ckpt_dir:
+        ckpt_dir = os.path.join(ckpt_dir, f"rank{rank}")
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=2) if ckpt_dir else None
+    steps_c = get_registry().counter(
+        "obs_probe_steps_total", "steps the obs-probe task ran", ("phase",))
+    for step in range(steps):
+        with span("obs_probe.step", step=step):
+            steps_c.inc(1, phase="train")
+            if mgr is not None:
+                mgr.save(step, {"state": np.asarray(step)})
+            beat(step=step)
+            get_faults().kill_point("mp.step", step=step, rank=rank)
+            if step_sleep_s > 0:
+                time.sleep(step_sleep_s)
+    return {"rank": rank, "steps": steps}
+
+
 def gbdt_fit_digest(args):
     """Fit a GBDT over ALL global devices; return a bit-exact model digest.
 

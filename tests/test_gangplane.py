@@ -1,0 +1,743 @@
+"""Gang-wide observability plane tests: the crash flight recorder, the
+``SMLMP_TM:`` cross-rank wire, metric mirroring, Chrome-trace stitching,
+post-mortem bundles, the step profiler, and the metric-hygiene sweep.
+
+The headline scenarios make the failure happen for real: a subprocess is
+SIGKILLed at the ``flight.dump`` fault site to prove the dump is atomic,
+and a 2-process gang loses rank 1 to ``kill_rank`` mid-train to prove the
+driver still assembles a schema-checked ``postmortem.json`` naming the
+dead rank with its flight tail and last durable step.
+"""
+
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from synapseml_tpu.telemetry import MetricsRegistry, get_registry
+from synapseml_tpu.telemetry.exposition import render_prometheus
+from synapseml_tpu.telemetry.flight import (FlightRecorder, get_flight,
+                                            sanitize_floats)
+from synapseml_tpu.telemetry.gangplane import (GANG_METRICS, TM_MARKER,
+                                               GangPlane, StepProfiler,
+                                               TelemetryEmitter,
+                                               check_postmortem,
+                                               mirror_snapshot,
+                                               observe_collective,
+                                               parse_telemetry,
+                                               telemetry_batch,
+                                               write_postmortem)
+from synapseml_tpu.telemetry.artifact import SchemaError
+
+pytestmark = pytest.mark.obs
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (unit)
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self):
+        r = FlightRecorder(capacity=4)
+        for i in range(10):
+            r.record("step", i=i)
+        evs = r.events()
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]      # oldest dropped
+        assert [e["seq"] for e in evs] == [7, 8, 9, 10]   # seq never resets
+        assert r.last_seq == 10
+        assert [e["i"] for e in r.tail(2)] == [8, 9]
+
+    def test_allocation_stable_slots(self):
+        r = FlightRecorder(capacity=8)
+        slots = r._slots
+        for i in range(100):
+            r.record("k", i=i)
+        assert r._slots is slots and len(r._slots) == 8
+
+    def test_events_since_watermark_and_limit(self):
+        r = FlightRecorder(capacity=16)
+        for i in range(6):
+            r.record("k", i=i)
+        since = r.events_since(3)
+        assert [e["seq"] for e in since] == [4, 5, 6]
+        assert [e["seq"] for e in r.events_since(0, limit=2)] == [5, 6]
+        assert r.events_since(99) == []
+
+    def test_disabled_recorder_is_a_no_op(self):
+        r = FlightRecorder(capacity=4)
+        r.enabled = False
+        r.record("k")
+        assert r.events() == [] and r.last_seq == 0
+
+    def test_clear(self):
+        r = FlightRecorder(capacity=4)
+        r.record("k")
+        r.clear()
+        assert r.events() == [] and r.last_seq == 0
+
+    def test_dump_roundtrip_and_overwrite(self, tmp_path):
+        r = FlightRecorder(capacity=8)
+        r.record("collective.begin", op="psum", nbytes=128)
+        r.record("checkpoint", step=3)
+        path = str(tmp_path / "flight.json")
+        r.dump(path, rank=1, extra={"note": "first"})
+        with open(path) as f:
+            d = json.load(f)
+        assert d["rank"] == 1 and d["last_seq"] == 2 and d["note"] == "first"
+        assert [e["kind"] for e in d["events"]] == ["collective.begin",
+                                                    "checkpoint"]
+        r.record("fault", site="x", fault_kind="kill")
+        r.dump(path, rank=1)
+        with open(path) as f:
+            assert json.load(f)["last_seq"] == 3
+
+    def test_dump_survives_nonfinite_fields(self, tmp_path):
+        r = FlightRecorder(capacity=4)
+        r.record("gauge", value=float("nan"), hi=float("inf"))
+        d = r.dump(str(tmp_path / "f.json"), rank=0)
+        with open(tmp_path / "f.json") as f:
+            parsed = json.load(f)          # strict JSON: no NaN literals
+        assert parsed["events"][0]["value"] == "nan"
+        assert d["last_seq"] == 1
+
+    def test_sanitize_floats(self):
+        out = sanitize_floats({"a": float("nan"), "b": [float("-inf"), 1.5],
+                               "c": {"d": 2.0}})
+        assert out == {"a": "nan", "b": ["-inf", 1.5], "c": {"d": 2.0}}
+
+    def test_record_never_raises(self):
+        r = FlightRecorder(capacity=2)
+        r._slots = None                       # sabotage the ring
+        r.record("k")                         # swallowed, not raised
+
+    def test_dump_reentrant_under_held_lock(self, tmp_path):
+        """The worker's SIGTERM handler dumps from the main thread, which
+        may have been interrupted INSIDE record()'s critical section —
+        the ring lock must be reentrant or the handler deadlocks and the
+        rank loses its dump to the follow-up SIGKILL."""
+        r = FlightRecorder(capacity=4)
+        r.record("k", i=1)
+        with r._lock:                         # simulate the interrupt point
+            d = r.dump(str(tmp_path / "f.json"), rank=0)
+        assert d["last_seq"] == 1
+
+    def test_default_recorder_capacity_env(self, monkeypatch):
+        import synapseml_tpu.telemetry.flight as fl
+        monkeypatch.setattr(fl, "_default", None)
+        monkeypatch.setenv(fl.CAPACITY_ENV, "7")
+        assert get_flight().capacity == 7
+        monkeypatch.setattr(fl, "_default", None)
+
+
+# ---------------------------------------------------------------------------
+# the SIGKILL-atomicity pin: kill at the flight.dump fault site
+# ---------------------------------------------------------------------------
+
+_ATOMIC_SCRIPT = """
+import sys
+from synapseml_tpu.telemetry.flight import FlightRecorder
+r = FlightRecorder(capacity=8)
+r.record("alpha", step=1)
+r.dump(sys.argv[1], rank=0)         # survives: fault armed with after=1
+r.record("beta", step=2)
+print("FIRST_DUMP_OK", flush=True)
+r.dump(sys.argv[1], rank=0)         # SIGKILL fires here, rename pending
+print("SECOND_DUMP_OK", flush=True)
+"""
+
+
+class TestFlightDumpAtomicity:
+    def test_sigkill_at_dump_leaves_no_partial_bundle(self, tmp_path):
+        """Kill the process at the ``flight.dump`` fault site — after the
+        temp file is written+fsynced but BEFORE the rename: the published
+        path must still hold the previous complete dump, bit for bit."""
+        path = str(tmp_path / "flight-rank0.json")
+        env = dict(os.environ,
+                   SML_FAULTS="flight.dump=kill:after=1",
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", _ATOMIC_SCRIPT, path],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == -signal.SIGKILL, proc.stderr
+        assert "FIRST_DUMP_OK" in proc.stdout
+        assert "SECOND_DUMP_OK" not in proc.stdout
+        with open(path) as f:
+            d = json.load(f)               # parses: never a torn file
+        assert d["last_seq"] == 1          # the FIRST dump, untouched
+        assert [e["kind"] for e in d["events"]] == ["alpha"]
+        # the unpublished temp file is the only other residue allowed
+        leftovers = [p for p in os.listdir(tmp_path)
+                     if not p.endswith(".json")]
+        assert all(".tmp." in p for p in leftovers)
+
+
+# ---------------------------------------------------------------------------
+# the wire: batches out, parse in
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def test_batch_roundtrip_and_incremental_cursors(self):
+        from synapseml_tpu.telemetry import span
+        from synapseml_tpu.telemetry.tracing import get_tracer
+        get_tracer().reset()
+        get_flight().clear()
+        get_registry().counter("wire_probe_steps_total",
+                               "wire-test scaffolding").inc()
+        get_flight().record("checkpoint", step=1)
+        with span("wire.work", step=1):
+            pass
+        payload, span_cur, flight_seq = telemetry_batch(3)
+        line = TM_MARKER + json.dumps(payload)
+        parsed = parse_telemetry(line)
+        assert parsed["rank"] == 3 and parsed["final"] is False
+        assert any(e["kind"] == "checkpoint" for e in parsed["flight"])
+        assert any(ev["name"] == "wire.work" for ev in parsed["spans"])
+        assert "pid" not in parsed["spans"][0]      # driver assigns pid=rank
+        # second batch from the advanced cursors is empty of increments
+        payload2, _, _ = telemetry_batch(3, span_cursor=span_cur,
+                                         flight_seq=flight_seq, seq=1)
+        assert payload2["spans"] == [] and payload2["flight"] == []
+        assert payload2["metrics"]                  # snapshot is cumulative
+
+    def test_parse_rejects_garbage(self):
+        assert parse_telemetry("ordinary log line") is None
+        assert parse_telemetry(TM_MARKER + "{broken") is None
+        assert parse_telemetry(TM_MARKER + "[1,2]") is None
+
+    def test_emitter_final_batch_flushes_synchronously(self):
+        import io
+        buf = io.StringIO()
+        em = TelemetryEmitter(rank=2, interval_s=3600.0, stream=buf)
+        em.emit_now()
+        em.emit_now(final=True)
+        lines = [l for l in buf.getvalue().splitlines() if l]
+        batches = [parse_telemetry(l) for l in lines]
+        assert [b["seq"] for b in batches] == [0, 1]
+        assert [b["final"] for b in batches] == [False, True]
+        assert all(b["rank"] == 2 for b in batches)
+
+
+# ---------------------------------------------------------------------------
+# driver side: mirroring + stitching
+# ---------------------------------------------------------------------------
+
+def _worker_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("steps_total", "", ("phase",)).inc(5, phase="train")
+    reg.gauge("queue_depth", "").set(2.0)
+    h = reg.histogram("lat_seconds", "", ("op",), buckets=(0.1, 1.0))
+    h.observe(0.05, op="x")
+    h.observe(0.5, op="x")
+    from synapseml_tpu.telemetry.gangplane import _compact_snapshot
+    return _compact_snapshot(reg)
+
+
+class TestMirror:
+    def test_mirror_sets_rank_labeled_series(self):
+        reg = MetricsRegistry()
+        n = mirror_snapshot(_worker_snapshot(),
+                            extra_labels={"rank": "1"}, registry=reg)
+        assert n == 3
+        assert reg.get("worker_steps_total").value(
+            phase="train", rank="1") == 5.0
+        assert reg.get("worker_queue_depth").value(rank="1") == 2.0
+        st = reg.get("worker_lat_seconds").stats(op="x", rank="1")
+        assert st["count"] == 2 and st["buckets"] == [1, 2]
+
+    def test_remirror_is_idempotent_and_multirank(self):
+        reg = MetricsRegistry()
+        snap = _worker_snapshot()
+        for _ in range(3):
+            mirror_snapshot(snap, extra_labels={"rank": "0"}, registry=reg)
+        mirror_snapshot(snap, extra_labels={"rank": "1"}, registry=reg)
+        c = reg.get("worker_steps_total")
+        assert c.value(phase="train", rank="0") == 5.0     # SET, not added
+        assert c.value(phase="train", rank="1") == 5.0
+
+    def test_malformed_metric_is_skipped_not_raised(self):
+        reg = MetricsRegistry()
+        snap = {"bad": {"kind": "histogram", "labelnames": [], "series":
+                        [{"buckets": "garbage"}]},
+                "ok": {"kind": "gauge", "labelnames": [],
+                       "series": [{"labels": {}, "value": 1.0}]}}
+        assert mirror_snapshot(snap, extra_labels={"rank": "0"},
+                               registry=reg) == 1
+        assert reg.get("worker_ok").value(rank="0") == 1.0
+
+
+class TestGangPlane:
+    def _batch(self, rank, *, spans=(), flight=(), metrics=None, final=False):
+        return {"rank": rank, "seq": 0, "ts": 1.0, "final": final,
+                "metrics": metrics, "spans": list(spans),
+                "flight": list(flight)}
+
+    def test_ingest_counts_and_tails(self):
+        reg = MetricsRegistry()
+        plane = GangPlane(2, registry=reg, flight_tail=2)
+        plane.ingest(1, self._batch(
+            1, spans=[{"name": "s", "ph": "X", "ts": 0, "dur": 1, "tid": 1}],
+            flight=[{"seq": i, "kind": "k"} for i in range(5)],
+            metrics=_worker_snapshot()))
+        assert plane.batches(1) == 1 and plane.batches(0) == 0
+        assert [e["seq"] for e in plane.flight_tail(1)] == [3, 4]  # bounded
+        assert plane.spans_for(1)[0]["pid"] == 1
+        assert reg.get("worker_steps_total").value(
+            phase="train", rank="1") == 5.0
+        assert reg.get("gangplane_batches_total").value(rank="1") == 1.0
+        assert reg.get("gangplane_spans_total").value(rank="1") == 1.0
+
+    def test_ingest_survives_garbage_and_unknown_rank(self):
+        plane = GangPlane(1, registry=MetricsRegistry())
+        plane.ingest(7, self._batch(7))            # unknown rank: dropped
+        plane.ingest(0, {"spans": "not-a-list"})   # garbled: swallowed
+        assert plane.batches(0) == 0
+
+    def test_chrome_trace_one_lane_per_rank(self, tmp_path):
+        plane = GangPlane(2, registry=MetricsRegistry())
+        for r in range(2):
+            plane.ingest(r, self._batch(r, spans=[
+                {"name": f"work{r}", "ph": "X", "ts": 0.0, "dur": 5.0,
+                 "tid": 1, "args": {}}]))
+        trace = plane.chrome_trace()
+        lanes = {e["pid"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert lanes == {0, 1}
+        assert {e["pid"] for e in trace["traceEvents"]
+                if e.get("ph") == "X"} == {0, 1}
+        out = plane.export_chrome(str(tmp_path / "trace.json"))
+        with open(tmp_path / "trace.json") as f:
+            assert json.load(f) == out
+
+    def test_export_chrome_survives_nonfinite_span_attr(self, tmp_path):
+        plane = GangPlane(1, registry=MetricsRegistry())
+        plane.ingest(0, self._batch(0, spans=[
+            {"name": "w", "ph": "X", "ts": 0.0, "dur": 1.0, "tid": 1,
+             "args": {"loss": float("nan")}}]))
+        out = plane.export_chrome(str(tmp_path / "trace.json"))
+        ev = [e for e in out["traceEvents"] if e.get("ph") == "X"][0]
+        assert ev["args"]["loss"] == "nan"     # stringified, not aborted
+
+    def test_final_flag_latches(self):
+        plane = GangPlane(1, registry=MetricsRegistry())
+        assert not plane.saw_final(0)
+        plane.ingest(0, self._batch(0, final=True))
+        plane.ingest(0, self._batch(0))
+        assert plane.saw_final(0)
+
+
+# ---------------------------------------------------------------------------
+# post-mortem bundles (unit)
+# ---------------------------------------------------------------------------
+
+class TestPostmortem:
+    def _plane(self):
+        plane = GangPlane(2, registry=MetricsRegistry())
+        plane.ingest(1, {"rank": 1, "metrics": _worker_snapshot(),
+                         "spans": [],
+                         "flight": [{"seq": 9, "kind": "checkpoint",
+                                     "step": 4}]})
+        return plane
+
+    def test_bundle_schema_and_contents(self, tmp_path):
+        path = str(tmp_path / "postmortem.json")
+        out = write_postmortem(
+            path, task="mp_tasks:job", causes={1: "killed by signal 9"},
+            attempt=0, n_ranks=2, plane=self._plane(),
+            last_steps={0: 6, 1: 4})
+        check_postmortem(out)                     # validates, no raise
+        with open(path) as f:
+            d = json.load(f)
+        assert d["causes"] == {"1": "killed by signal 9"}
+        assert d["last_durable_step"] == 6
+        assert d["ranks"]["1"]["last_step"] == 4
+        assert d["ranks"]["1"]["flight_tail"][-1]["kind"] == "checkpoint"
+        assert d["ranks"]["1"]["metrics"]["steps_total"]
+        assert "rank 1: killed by signal 9" in d["verdict"]
+
+    def test_schema_rejects_torn_bundles(self):
+        with pytest.raises(SchemaError):
+            check_postmortem([])
+        with pytest.raises(SchemaError):
+            check_postmortem({"task": "t", "verdict": "v", "causes": {},
+                              "ranks": {}, "attempt": 0, "n_ranks": 1,
+                              "created_unix": 0})       # empty ranks
+        with pytest.raises(SchemaError):
+            check_postmortem({"task": "t", "verdict": "v", "causes": {},
+                              "ranks": {"0": {"cause": None,
+                                              "last_step": None,
+                                              "flight_tail": "nope",
+                                              "metrics": None}},
+                              "attempt": 0, "n_ranks": 1,
+                              "created_unix": 0})       # tail not a list
+
+    def test_ondisk_dump_preferred_when_fresher(self, tmp_path):
+        """A SIGTERMed rank leaves its full on-disk ring; the bundle must
+        prefer it over the (staler) wire tail — and the wire tail when
+        the rank died by SIGKILL before dumping."""
+        plane = self._plane()                  # wire tail for rank 1: seq 9
+        obs = tmp_path
+        with open(obs / "flight-rank1.json", "w") as f:
+            json.dump({"last_seq": 12, "events": [
+                {"seq": 12, "kind": "fault", "site": "mp.step"}]}, f)
+        with open(obs / "flight-rank0.json", "w") as f:
+            json.dump({"last_seq": 1, "events": [
+                {"seq": 1, "kind": "heartbeat"}]}, f)
+        out = write_postmortem(
+            str(tmp_path / "pm.json"), task="t", causes={1: "x"},
+            attempt=0, n_ranks=2, plane=plane, obs_dir=str(obs))
+        assert out["ranks"]["1"]["flight_tail"][-1]["seq"] == 12  # disk wins
+        assert out["ranks"]["0"]["flight_tail"][-1]["seq"] == 1
+        # now a FRESHER wire tail (SIGKILL case: dump never happened)
+        plane.ingest(1, {"rank": 1, "metrics": None, "spans": [],
+                         "flight": [{"seq": 30, "kind": "late"}]})
+        out = write_postmortem(
+            str(tmp_path / "pm.json"), task="t", causes={1: "x"},
+            attempt=0, n_ranks=2, plane=plane, obs_dir=str(obs))
+        assert out["ranks"]["1"]["flight_tail"][-1]["seq"] == 30  # wire wins
+
+    def test_supervisor_clears_stale_flight_dumps(self, tmp_path):
+        """Flight ``seq`` counters restart per process: a previous
+        attempt's on-disk ring (high last_seq) must be cleared before a
+        new attempt launches, or it would outrank the live attempt's
+        wire tail in the gather."""
+        from synapseml_tpu.parallel import GangSupervisor
+        obs = tmp_path / "obs"
+        obs.mkdir()
+        for r in range(2):
+            with open(obs / f"flight-rank{r}.json", "w") as f:
+                json.dump({"last_seq": 999, "events": []}, f)
+        sup = GangSupervisor("mp_tasks:unused", n_processes=2,
+                             observability_dir=str(obs))
+        sup._clear_flight_dumps()
+        assert not any(obs.glob("flight-rank*.json"))
+
+    def test_nonfinite_metric_cannot_abort_bundle(self, tmp_path):
+        plane = GangPlane(1, registry=MetricsRegistry())
+        plane.ingest(0, {"rank": 0, "spans": [], "flight": [],
+                         "metrics": {"g": {"kind": "gauge", "labelnames": [],
+                                           "series": [{"labels": {},
+                                                       "value": float("nan")}
+                                                      ]}}})
+        out = write_postmortem(str(tmp_path / "pm.json"), task="t",
+                               causes={0: "x"}, attempt=0, n_ranks=1,
+                               plane=plane)
+        assert out["ranks"]["0"]["metrics"]["g"]["series"][0]["value"] == "nan"
+
+
+# ---------------------------------------------------------------------------
+# step profiler
+# ---------------------------------------------------------------------------
+
+class TestStepProfiler:
+    def _prof(self, **kw):
+        return StepProfiler("test_model", registry=MetricsRegistry(), **kw)
+
+    def test_begin_mark_end_accounting(self):
+        prof = self._prof()
+        prof.step_begin(0)
+        prof.mark("data")
+        prof.mark("compute")
+        prof.step_end()
+        assert prof.steps == 1
+        t = prof.totals
+        assert t["total"] >= t["data"] + t["compute"]
+        assert t["total"] == pytest.approx(
+            t["data"] + t["compute"] + t["other"], rel=1e-6)
+
+    def test_context_api_and_histogram_series(self):
+        prof = self._prof()
+        with prof.step(0):
+            with prof.segment("data"):
+                pass
+            with prof.segment("compute"):
+                pass
+        hist = prof._hist
+        assert hist.stats(model="test_model", segment="total")["count"] == 1
+        assert prof._c_steps.value(model="test_model") == 1
+
+    def test_collective_hook_feeds_open_step(self):
+        prof = self._prof()
+        prof.step_begin(0)
+        observe_collective(0.25, 1024)       # routed via the active profiler
+        prof.step_end()
+        assert prof.totals["collective"] == pytest.approx(0.25)
+        assert prof.collective_bytes == 1024
+        observe_collective(0.5, 1)           # no open step: bytes-only page
+        assert prof.totals["collective"] == pytest.approx(0.25)
+
+    def test_nested_loops_restore_outer_profiler(self):
+        from synapseml_tpu.telemetry.gangplane import current_profiler
+        outer, inner = self._prof(), self._prof()
+        outer.step_begin(0)
+        inner.step_begin(0)
+        assert current_profiler() is inner
+        inner.step_end()
+        assert current_profiler() is outer
+        outer.step_end()
+        assert current_profiler() is None
+
+    def test_dangling_step_closed_by_finish_and_next_begin(self):
+        prof = self._prof()
+        prof.step_begin(0)
+        prof.step_begin(1)                   # implicit close of step 0
+        prof.finish()                        # close step 1 (break path)
+        assert prof.steps == 2
+        prof.finish()                        # idempotent
+        assert prof.steps == 2
+
+    def test_capture_cost_once_and_summary_roofline(self):
+        class _Compiled:
+            def cost_analysis(self):
+                return {"flops": 100.0, "bytes accessed": 50.0}
+
+        class _Lowered:
+            def compile(self):
+                return _Compiled()
+
+        class _Fn:
+            calls = 0
+
+            def lower(self, *a, **kw):
+                _Fn.calls += 1
+                return _Lowered()
+
+        prof = self._prof(capture_xla=True)
+        fn = _Fn()
+        assert prof.capture_cost("step_fn", fn) == {
+            "flops": 100.0, "bytes_accessed": 50.0}
+        prof.capture_cost("step_fn", fn)
+        assert _Fn.calls == 1                # once per key
+        with prof.step(0):
+            with prof.segment("compute"):
+                pass
+        s = prof.summary()
+        roof = s["roofline"]["step_fn"]
+        assert roof["arithmetic_intensity"] == pytest.approx(2.0)
+        assert roof["achieved_flops_per_sec"] > 0
+        assert s["steps"] == 1 and s["model"] == "test_model"
+
+    def test_capture_cost_failure_records_none(self):
+        prof = self._prof(capture_xla=True)
+        assert prof.capture_cost("bad", object()) is None
+        assert prof.summary()["roofline"]["bad"] is None
+
+    def test_export_writes_summary_artifact(self, tmp_path):
+        prof = self._prof()
+        with prof.step(0):
+            pass
+        out = prof.export(str(tmp_path / "profile.json"))
+        assert out["steps"] == 1
+        with open(tmp_path / "profile.json") as f:
+            assert json.load(f)["model"] == "test_model"
+
+    def test_escaping_exception_restores_thread_local(self, fault_registry,
+                                                      tmp_path):
+        """An injected mid-train preemption unwinds out of the profiled
+        GBDT loop; the guard must close the open step and restore the
+        thread-local active profiler, or later collectives on this
+        thread would accumulate into a dead profiler's abandoned step."""
+        import numpy as np
+        from synapseml_tpu.models.gbdt.booster import BoostingConfig, train
+        from synapseml_tpu.resilience.faults import PreemptionError
+        from synapseml_tpu.telemetry.gangplane import current_profiler
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(300, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        fault_registry.configure("gbdt.checkpoint=preempt:times=1")
+        prof = self._prof()
+        with pytest.raises(PreemptionError):
+            train(X, y,
+                  BoostingConfig(objective="binary", num_iterations=4,
+                                 num_leaves=7, min_data_in_leaf=5,
+                                 max_bin=31),
+                  checkpoint_dir=str(tmp_path), checkpoint_interval=1,
+                  step_profiler=prof)
+        assert current_profiler() is None
+        assert prof._open is None and prof.steps >= 1
+
+    def test_gbdt_train_accepts_profiler(self):
+        """The GBDT loop profiled end to end: every iteration decomposed,
+        compute dominating, and the profile exportable."""
+        import numpy as np
+        from synapseml_tpu.models.gbdt.booster import BoostingConfig, train
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(400, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        prof = self._prof()
+        cfg = BoostingConfig(objective="binary", num_iterations=4,
+                             num_leaves=7, min_data_in_leaf=5, max_bin=31)
+        train(X, y, cfg, step_profiler=prof)
+        assert prof.steps == 4
+        assert prof.totals["compute"] > 0
+        rec = prof.summary()["last_steps"][-1]
+        assert set(rec) == {"step", "total", "data", "compute",
+                            "collective", "other"}
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition escaping (the corrupting-label pin)
+# ---------------------------------------------------------------------------
+
+_SERIES_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{([a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(\\.|[^"\\\n])*",?)*\})? [^ \n]+$')
+
+
+class TestExpositionEscaping:
+    def test_hostile_label_values_stay_parseable(self):
+        """Rank verdict strings and fault kinds carry quotes, newlines
+        and backslashes; every exposition line must still be one
+        well-formed ``name{label="escaped"} value`` line."""
+        reg = MetricsRegistry()
+        hostile = 'hang at step 3 ("no heartbeat")\nkilled\\now'
+        reg.counter("gang_failures_total", "why\nmultiline \\help",
+                    ("cause",)).inc(1, cause=hostile)
+        reg.gauge("verdict_info", "", ("rank", "verdict")).set(
+            1, rank="1", verdict='exit "code" -9')
+        text = render_prometheus(reg)
+        for line in text.splitlines():
+            if line.startswith("#"):
+                assert "\n" not in line            # HELP newline escaped
+                continue
+            assert _SERIES_RE.match(line), f"corrupt exposition: {line!r}"
+        assert '\\n' in text and '\\"' in text
+        # the escaping round-trips: unescape reproduces the raw verdict
+        m = re.search(r'cause="((?:\\.|[^"\\])*)"', text)
+        unescaped = (m.group(1).replace("\\\\", "\0").replace('\\"', '"')
+                     .replace("\\n", "\n").replace("\0", "\\"))
+        assert unescaped == hostile
+
+
+# ---------------------------------------------------------------------------
+# metric hygiene sweep (tier-1 CI: naming, duplicates, docs coverage)
+# ---------------------------------------------------------------------------
+
+_REG_CALL = re.compile(
+    r'\.(counter|gauge|histogram)\(\s*\n?\s*"([A-Za-z_0-9]+)"', re.S)
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+#: unit suffixes histogram/gauge observations may carry (Prometheus
+#: conventions: base units, pluralized)
+_HIST_UNITS = ("_seconds", "_bytes", "_size", "_rows", "_records")
+
+
+def _registrations():
+    """Every source-level metric registration: name → [(kind, file)]."""
+    regs = {}
+    for p in (REPO / "synapseml_tpu").rglob("*.py"):
+        for m in _REG_CALL.finditer(p.read_text(encoding="utf-8")):
+            regs.setdefault(m.group(2), []).append(
+                (m.group(1), str(p.relative_to(REPO))))
+    return regs
+
+
+class TestMetricHygiene:
+    def test_names_are_snake_case_with_unit_suffix(self):
+        bad = []
+        for name, sites in _registrations().items():
+            kinds = {k for k, _ in sites}
+            if not _SNAKE.match(name):
+                bad.append(f"{name}: not snake_case ({sites})")
+            if "counter" in kinds and not name.endswith("_total"):
+                bad.append(f"{name}: counter without _total suffix ({sites})")
+            if "histogram" in kinds and not name.endswith(_HIST_UNITS):
+                bad.append(f"{name}: histogram without unit suffix ({sites})")
+            if "gauge" in kinds and name.endswith("_total"):
+                bad.append(f"{name}: gauge with counter-reserved _total "
+                           f"suffix ({sites})")
+        assert not bad, "\n".join(bad)
+
+    def test_no_conflicting_registrations_across_modules(self):
+        """One name, one kind — a shared metric registered from several
+        modules (get-or-create) is fine, the same name as two different
+        kinds is a split-brain registry."""
+        conflicts = {n: s for n, s in _registrations().items()
+                     if len({k for k, _ in s}) > 1}
+        assert not conflicts, conflicts
+
+    def test_every_gang_metric_is_documented(self):
+        docs = "\n".join(p.read_text(encoding="utf-8")
+                         for p in (REPO / "docs" / "api").glob("*.md"))
+        missing = sorted(n for n in GANG_METRICS if n not in docs)
+        assert not missing, f"gang-level metrics absent from docs: {missing}"
+        # the worker-mirroring rule itself is documented
+        assert "worker_" in docs and "SMLMP_TM" in docs
+
+    def test_registry_sees_no_duplicate_kind_at_runtime(self):
+        """Importing the wired modules must not blow up on registration
+        conflicts (the registry raises on kind/label mismatches)."""
+        import synapseml_tpu.parallel.supervisor          # noqa: F401
+        import synapseml_tpu.resilience.rowguard          # noqa: F401
+        import synapseml_tpu.serving.distributed          # noqa: F401
+        import synapseml_tpu.telemetry.gangplane          # noqa: F401
+        names = [m.name for m in get_registry().metrics()]
+        assert len(names) == len(set(names))
+
+
+# ---------------------------------------------------------------------------
+# real gangs: live /metrics mirroring + the post-mortem acceptance pin
+# ---------------------------------------------------------------------------
+
+class TestGangObservabilitySubprocess:
+    @pytest.mark.gang
+    def test_sigkill_rank1_leaves_schema_checked_postmortem(
+            self, fault_registry, tmp_path):
+        """The acceptance pin: rank 1 of a live 2-process gang dies by
+        SIGKILL mid-train; the driver's bundle names the dead rank,
+        carries its last durable step and a nonempty flight tail, the
+        stitched Chrome trace has one lane per rank, and the coordinator
+        registry serves rank-labeled worker metrics."""
+        from synapseml_tpu.parallel import GangSupervisor, WorkerFailure
+        obs = tmp_path / "obs"
+        sup = GangSupervisor(
+            "mp_tasks:obs_probe", n_processes=2, devices_per_process=1,
+            task_args={"steps": 40, "step_sleep_s": 0.25},
+            timeout_s=120.0, heartbeat_interval_s=0.2,
+            observability_dir=str(obs),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            env_extra={"SML_FAULTS": "mp.step=kill_rank:rank=1:after=4"})
+        with pytest.raises(WorkerFailure):
+            sup.run()
+        assert sup.last_postmortem == str(obs / "postmortem.json")
+        # the attempt-numbered bundle survives later retries; the
+        # unsuffixed path aliases the latest attempt
+        with open(obs / "postmortem-attempt0.json") as f:
+            assert json.load(f)["attempt"] == 0
+        with open(obs / "postmortem.json") as f:
+            bundle = json.load(f)
+        check_postmortem(bundle)
+        # the dead rank is named with a kill verdict; rank 0 is collateral
+        assert "1" in bundle["causes"]
+        dead = bundle["ranks"]["1"]
+        assert dead["cause"]
+        assert dead["last_step"] is not None and dead["last_step"] >= 1
+        assert bundle["last_durable_step"] is not None
+        assert dead["flight_tail"], "SIGKILLed rank must leave a wire tail"
+        kinds = {e.get("kind") for e in dead["flight_tail"]}
+        assert kinds & {"checkpoint", "heartbeat", "fault"}
+        # rank 1's final metric snapshot reached the driver over the wire
+        assert dead["metrics"] and "obs_probe_steps_total" in dead["metrics"]
+        # rank 0 was SIGTERMed at teardown: its full on-disk ring exists
+        assert (obs / "flight-rank0.json").exists()
+        # stitched trace: one named lane per rank
+        with open(obs / "gang_trace.json") as f:
+            trace = json.load(f)
+        lanes = {e["pid"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert lanes == {0, 1}
+        # live mirroring reached the coordinator registry (the /metrics
+        # source): rank-labeled worker metrics + ingestion counters
+        reg = get_registry()
+        assert reg.get("worker_obs_probe_steps_total").value(
+            phase="train", rank="1") > 0
+        assert reg.get("gangplane_batches_total").value(rank="1") > 0
+        text = render_prometheus(reg)
+        assert 'worker_obs_probe_steps_total{phase="train",rank="1"}' in text
+        assert reg.get("postmortem_bundles_total").value(
+            task="mp_tasks:obs_probe") >= 1

@@ -13,7 +13,8 @@ All tests spawn subprocesses that cold-start JAX → marked slow.
 
 import pytest
 
-from synapseml_tpu.parallel import WorkerFailure, run_on_local_cluster
+from synapseml_tpu.parallel import (GangSupervisor, WorkerFailure,
+                                    run_on_local_cluster)
 
 pytestmark = pytest.mark.slow
 
@@ -77,6 +78,43 @@ def test_distributed_serving_two_processes():
     assert [r["rank"] for r in r0["results"]] == [0, 1]
     assert [r["echo"] for r in r0["results"]] == [0, 10]
     assert r1["results"] == []
+
+
+def test_clean_exit_flushes_final_telemetry_batch(tmp_path):
+    """``shutdown_cluster`` must drop nothing a crash wouldn't: every
+    rank of a CLEAN 2-process gang flushes a final ``SMLMP_TM:`` batch
+    (``final=true``, emitted before the result marker) carrying its last
+    cumulative metric snapshot and its remaining completed spans."""
+    obs = tmp_path / "obs"
+    sup = GangSupervisor(
+        "mp_tasks:obs_probe", n_processes=2, devices_per_process=1,
+        task_args={"steps": 3, "step_sleep_s": 0.05},
+        timeout_s=300.0, heartbeat_interval_s=0.5,
+        observability_dir=str(obs))
+    results = sup.run()
+    assert [r["rank"] for r in results] == [0, 1]
+    for rank in (0, 1):
+        # the final batch reached the driver (clean exits don't drop it)
+        assert sup.plane.saw_final(rank)
+        # ...and it carried the COMPLETE metric story: all 3 steps, even
+        # though the 0.5s cadence never sampled the 0.15s-long train loop
+        snap = sup.plane.metrics_for(rank)
+        series = snap["obs_probe_steps_total"]["series"]
+        assert [s["value"] for s in series] == [3.0]
+        # spans flushed through shutdown too: one per step
+        names = [e["name"] for e in sup.plane.spans_for(rank)]
+        assert names.count("obs_probe.step") == 3
+    # the clean path also leaves each rank's full on-disk flight ring
+    # and the stitched multi-lane trace
+    assert (obs / "flight-rank0.json").exists()
+    assert (obs / "flight-rank1.json").exists()
+    import json
+    with open(obs / "gang_trace.json") as f:
+        events = json.load(f)["traceEvents"]
+    # real span slices in each lane — the "M" process_name metadata rows
+    # are emitted per rank unconditionally, so they can't carry this
+    lanes = {e["pid"] for e in events if e["ph"] == "X"}
+    assert lanes == {0, 1}
 
 
 def test_worker_failure_surfaces_logs():
