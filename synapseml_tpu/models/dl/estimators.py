@@ -136,10 +136,23 @@ class _DLParamsBase(Params):
             "(train_step_seconds{model,segment}); with capture_xla=True "
             "it also records the compiled step's XLA cost analysis for "
             "the roofline summary")
+    collectiveCompression = PyObjectParam(
+        doc="wire codec + sharding for the gradient sync: 'none' "
+            "(default, the unchanged pjit path) | 'bf16' | 'int8' "
+            "(both with error feedback) | a parallel.compression."
+            "CollectiveConfig (compression / sharded_update / "
+            "error_feedback / min_size knobs) — runs the step as manual "
+            "data-parallel shard_map with a quantized allreduce and/or "
+            "reduce-scatter sharded weight update; requires a pure "
+            "data mesh (modelParallelism/expertParallelism == 1)")
 
-    def _checkpoint_loop(self, trainer: "DLTrainer",
-                         state: "TrainState") -> "_CheckpointLoop":
-        return _CheckpointLoop(self, trainer, state)
+    def _collective_config(self):
+        from ...parallel.compression import resolve_collective_config
+        return resolve_collective_config(self.get("collectiveCompression"))
+
+    def _checkpoint_loop(self, trainer: "DLTrainer", state: "TrainState",
+                         step=None) -> "_CheckpointLoop":
+        return _CheckpointLoop(self, trainer, state, step)
 
     def _opt_config(self, total_steps: int) -> OptimizerConfig:
         return OptimizerConfig(
@@ -165,16 +178,40 @@ class _CheckpointLoop:
     # maxEpochs is deliberately absent (resuming with MORE epochs is the
     # normal continue-training pattern)
     _CONFIG_KEYS = ("batchSize", "seed", "validationFraction")
+    #: collectiveCompression codec → config-guard float (the guard
+    #: compares floats; a codec switch mid-run would silently change
+    #: both the numerics and the checkpoint structure)
+    _CODEC_CODE = {"none": 0.0, "bf16": 1.0, "int8": 2.0}
 
-    def __init__(self, est: "_DLParamsBase", trainer, state):
+    def __init__(self, est: "_DLParamsBase", trainer, state, step=None):
         from ...core.checkpoint import CheckpointManager
         self.manager = None
         self.start_step = 0
         self.interval = int(est.checkpointInterval)
         self.state = state
+        self._step = step
         self._config = {k: float(est.get_or_default(k))
                         for k in self._CONFIG_KEYS}
         self._config["shards"] = float(trainer.mesh.shape["data"])
+        # ALWAYS written (0.0 = off), so toggling any knob that changes
+        # the step's numerics against an existing checkpoint mismatches
+        # instead of slipping through the saved∩current key intersection
+        # below: codec, sharding, EF, the big/small partition
+        # (min_size), the int8 chunk, and whether the manual shard_map
+        # step (per-rank dropout stream ≠ pjit's) is in use at all
+        cc = getattr(trainer, "collective", None)
+        self._config["compression"] = self._CODEC_CODE[
+            cc.compression if cc is not None else "none"]
+        self._config["sharded_update"] = float(
+            cc.sharded_update if cc is not None else False)
+        self._config["error_feedback"] = float(
+            cc.error_feedback if cc is not None else False)
+        self._config["manual_step"] = float(cc is not None)
+        self._config["codec_min_size"] = float(
+            cc.min_size if cc is not None else 0.0)
+        self._config["codec_chunk"] = float(
+            cc.chunk if cc is not None and cc.compression == "int8"
+            else 0.0)
         manager = est.get("checkpointManager")
         ckpt_dir = est.get("checkpointDir")
         if manager is None and not ckpt_dir:
@@ -187,6 +224,14 @@ class _CheckpointLoop:
             return
         saved_cfg = {k: v for k, v in self.manager.metrics(latest).items()
                      if k in self._config}
+        # checkpoints that predate the compression keys never wrote them:
+        # absence means the pjit step at compression-off wrote it, so the
+        # missing keys compare as 0.0 — enabling any codec/manual/sharding
+        # knob against such a checkpoint mismatches instead of slipping
+        # the saved∩current intersection
+        for k in ("compression", "sharded_update", "error_feedback",
+                  "manual_step", "codec_min_size", "codec_chunk"):
+            saved_cfg.setdefault(k, 0.0)
         mismatch = {k: (saved_cfg[k], self._config[k]) for k in saved_cfg
                     if saved_cfg[k] != self._config[k]}
         if mismatch:
@@ -195,11 +240,26 @@ class _CheckpointLoop:
                 f"different data-order config {mismatch}; resuming would "
                 f"silently train on wrong batches — use a fresh "
                 f"checkpointDir or restore manually")
-        restored = self.manager.restore_state_dict(state)
+        residuals = self._residuals()
+        if residuals is not None:
+            # error-feedback residuals are live training state: they
+            # ride the same checkpoint pytree so kill→resume replays the
+            # exact compressed gradient stream (bit-exactness pinned in
+            # tests/test_collectives_compression.py)
+            restored, res = self.manager.restore_state_dict(
+                (state, residuals))
+            res = jax.device_put(res, jax.tree_util.tree_map(
+                lambda _: trainer.residual_sharding(), res))
+            self._step.set_residuals(res)
+        else:
+            restored = self.manager.restore_state_dict(state)
         if trainer.state_shardings is not None:
             restored = jax.device_put(restored, trainer.state_shardings)
         self.state = restored
         self.start_step = int(np.asarray(restored.step))
+
+    def _residuals(self):
+        return getattr(self._step, "residuals", None)
 
     def skips(self, gstep: int) -> bool:
         """True while replaying already-trained steps (data order is
@@ -208,7 +268,10 @@ class _CheckpointLoop:
 
     def after_step(self, gstep: int, state) -> None:
         if self.manager and self.interval and gstep % self.interval == 0:
-            self.manager.save(gstep, jax.device_get(state),
+            residuals = self._residuals()
+            payload = ((state, residuals) if residuals is not None
+                       else state)
+            self.manager.save(gstep, jax.device_get(payload),
                               metrics=self._config)
             # preemption point: a kill/preempt fault lands exactly where
             # a real TPU eviction would — after a durable step, before
@@ -313,7 +376,8 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
                                   remat=bool(self.gradientCheckpointing))
         model = TextEncoder(cfg)
         trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
-                            zero1=bool(self.zero1))
+                            zero1=bool(self.zero1),
+                            collective=self._collective_config())
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, ids[:sample_n], mask[:sample_n])
         if ckpt_path:
@@ -325,7 +389,7 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         rng = np.random.default_rng(self.seed)
         key = jax.random.PRNGKey(self.seed)
 
-        ckpt = self._checkpoint_loop(trainer, state)
+        ckpt = self._checkpoint_loop(trainer, state, step)
         state = ckpt.state
         gstep = 0
         history = []
@@ -463,7 +527,8 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
         model = make_backbone(self.backbone, num_classes=len(classes))
         trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
                             has_batch_stats=True, train_kwarg="train",
-                            zero1=bool(self.zero1))
+                            zero1=bool(self.zero1),
+                            collective=self._collective_config())
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, imgs[:sample_n])
         if self.get("checkpoint"):
@@ -483,7 +548,7 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
         rng = np.random.default_rng(self.seed)
         key = jax.random.PRNGKey(self.seed)
 
-        ckpt = self._checkpoint_loop(trainer, state)
+        ckpt = self._checkpoint_loop(trainer, state, step)
         state = ckpt.state
         gstep = 0
         history = []

@@ -25,10 +25,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 from flax import core as flax_core
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...parallel.compression import (CollectiveConfig, bf16_decode,
+                                     bf16_encode, compressed_tree_sync,
+                                     flatten_with_residuals, int8_decode,
+                                     int8_encode, int8_reduce_scatter,
+                                     unpack_residuals)
 from ...parallel.mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding,
                               data_parallel_mesh, dp_tp_mesh)
 from ...telemetry import get_registry
@@ -85,6 +91,46 @@ class _InstrumentedStep:
         return getattr(self._fn, name)
 
 
+class _CompressedStep:
+    """Host-side wrapper for the manual data-parallel (compressed /
+    sharded-update) train step: presents the SAME ``step(state, inputs,
+    labels, key) -> (state, metrics)`` surface as the pjit step while
+    carrying the per-rank error-feedback residuals across calls.
+
+    ``residuals`` (a pytree matching params, each leaf stacked
+    ``(n_ranks, *shape)`` and sharded over ``data``) is live training
+    state: the checkpoint loop saves/restores it alongside the
+    TrainState so kill→resume stays bit-exact with compression on
+    (``None`` when error feedback is off)."""
+
+    def __init__(self, fn, residuals):
+        self._fn = fn
+        self.residuals = residuals
+
+    def __call__(self, state, inputs, labels, dropout_key):
+        if self.residuals is not None:
+            state, metrics, self.residuals = self._fn(
+                state, inputs, labels, dropout_key, self.residuals)
+        else:
+            state, metrics = self._fn(state, inputs, labels, dropout_key)
+        return state, metrics
+
+    def set_residuals(self, residuals) -> None:
+        """Checkpoint-restore hook (``__setattr__`` through the outer
+        ``_InstrumentedStep`` would land on the wrapper, not here)."""
+        self.residuals = residuals
+
+    def lower(self, state, inputs, labels, dropout_key):
+        """AOT-lowering surface for ``StepProfiler.capture_cost``."""
+        if self.residuals is not None:
+            return self._fn.lower(state, inputs, labels, dropout_key,
+                                  self.residuals)
+        return self._fn.lower(state, inputs, labels, dropout_key)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
 def _rbg_key(key):
     """Re-wrap a PRNG key as an rbg key for dropout-mask generation.
 
@@ -123,7 +169,11 @@ class OptimizerConfig:
     total_steps: int = 10_000
     grad_clip_norm: float = 0.0
 
-    def build(self) -> optax.GradientTransformation:
+    def build(self, with_clip: bool = True) -> optax.GradientTransformation:
+        """``with_clip=False`` builds the same optimizer WITHOUT the
+        global-norm clip stage — the sharded-update path computes the
+        TRUE global norm across shards itself (optax's clip inside the
+        shard would see 1/N of the tree and clip per-shard)."""
         if self.schedule == "cosine":
             lr = optax.warmup_cosine_decay_schedule(
                 0.0, self.learning_rate, max(self.warmup_steps, 1),
@@ -141,7 +191,7 @@ class OptimizerConfig:
             tx = optax.sgd(lr, momentum=self.momentum)
         else:
             raise ValueError(f"unknown optimizer {self.name!r}")
-        if self.grad_clip_norm > 0:
+        if with_clip and self.grad_clip_norm > 0:
             tx = optax.chain(optax.clip_by_global_norm(self.grad_clip_norm), tx)
         return tx
 
@@ -211,10 +261,30 @@ class DLTrainer:
                  mesh: Mesh, loss_fn: Optional[Callable] = None,
                  has_batch_stats: bool = False,
                  train_kwarg: str = "deterministic",
-                 zero1: bool = False):
+                 zero1: bool = False,
+                 collective: Optional[CollectiveConfig] = None):
         self.model = model
         self.mesh = mesh
         self.zero1 = zero1
+        self.collective = (collective
+                           if collective is not None and collective.enabled
+                           else None)
+        if self.collective is not None:
+            if zero1:
+                raise ValueError(
+                    "zero1 (GSPMD weight-update sharding) and a "
+                    "CollectiveConfig are mutually exclusive — "
+                    "sharded_update=True IS the explicit form of zero1 "
+                    "and composes with compression")
+            bad = {a: s for a, s in mesh.shape.items()
+                   if a != DATA_AXIS and s > 1}
+            if bad:
+                raise ValueError(
+                    f"collective compression/sharded update runs the step "
+                    f"as manual data-parallel shard_map and supports pure "
+                    f"data meshes only; this mesh also has {bad} — drop "
+                    "tensor/expert parallelism or collectiveCompression")
+        self._opt_cfg = optimizer
         self.tx = optimizer.build()
         self.has_batch_stats = has_batch_stats
         self.train_kwarg = train_kwarg
@@ -224,6 +294,7 @@ class DLTrainer:
         self._step_fn = None
         self._eval_fn = None
         self.state_shardings = None
+        self._shard_info = None
         self._rules = usable_rules(mesh)
 
     # -- init --------------------------------------------------------------
@@ -248,7 +319,14 @@ class DLTrainer:
                                                     abs_state, self.mesh)
         init = jax.jit(self._make_state,
                        out_shardings=self.state_shardings)
-        return init(rng, *sample_inputs)
+        state = init(rng, *sample_inputs)
+        if self.collective is not None:
+            self._shard_info = self._compute_shard_info(state.params)
+            if self.collective.sharded_update:
+                state = state.replace(
+                    opt_state=self._init_sharded_opt(state.params))
+            self._residuals0 = self.init_residuals(state)
+        return state
 
     def batch_sharding(self, ndim: int) -> NamedSharding:
         return batch_sharding(self.mesh, ndim)
@@ -298,8 +376,317 @@ class DLTrainer:
         updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
         return optax.apply_updates(state.params, updates), new_opt
 
+    # -- compressed / sharded-update manual data-parallel path -------------
+    #
+    # The pjit step's gradient allreduce is inserted by GSPMD — there is
+    # no hook to compress it.  With a CollectiveConfig the step instead
+    # runs as an EXPLICIT shard_map over the data axis: each rank grads
+    # its local batch shard, the sync is ours (quantized allreduce with
+    # error feedback per EQuARX/1-bit-SGD, or reduce-scatter + sharded
+    # optimizer update + param all-gather per Xu et al. 2004.13336),
+    # and the updated state leaves replicated exactly like the pjit
+    # step's.  compression='none' never enters this path — the default
+    # is byte-identical to the original program.
+
+    def _compute_shard_info(self, params):
+        """Static flat-buffer layout of the gradient/param stream:
+        which leaves ride the compressed/sharded buffer (``big``) vs
+        the plain small-tensor psum, plus padded/shard sizes."""
+        cfg = self.collective
+        n = self.mesh.shape[DATA_AXIS]
+        leaves = jax.tree_util.tree_leaves(params)
+        big = tuple(i for i, lf in enumerate(leaves)
+                    if jnp.issubdtype(lf.dtype, jnp.floating)
+                    and lf.size >= cfg.min_size)
+        total = sum(int(leaves[i].size) for i in big)
+        unit = n * (cfg.chunk if cfg.compression == "int8" else 1)
+        padded = -(-max(total, 1) // unit) * unit
+        return dict(big=big, total=total, padded=padded,
+                    shard=padded // n, n=n)
+
+    def _map_opt_branches(self, flat_fn, small_fn, opt):
+        """Apply per-branch transforms to the ``{'flat','small'}`` opt
+        dict.  The sharded moment buffer is identified by its BRANCH
+        plus shape (within ``flat``, only the ``(padded,)`` moment
+        vectors shard; optax scalars like adam's count stay replicated)
+        — never by shape alone across the whole tree, so a ``small``
+        leaf whose first dim happens to equal the padded stream length
+        cannot be misclassified.  One implementation for all three
+        consumers (device placement, restore-time shardings, shard_map
+        specs) so they cannot drift."""
+        info = self._shard_info
+
+        def on_flat(leaf):
+            sharded = (getattr(leaf, "ndim", 0) >= 1
+                       and leaf.shape[0] == info["padded"])
+            return flat_fn(leaf) if sharded else small_fn(leaf)
+
+        return {"flat": jax.tree_util.tree_map(on_flat, opt["flat"]),
+                "small": jax.tree_util.tree_map(small_fn, opt["small"])}
+
+    def _init_sharded_opt(self, params):
+        """Sharded-update optimizer state: ONE flat f32 moment buffer of
+        the padded big-leaf stream, sharded 1/N per rank over ``data``
+        (the Xu et al. layout — the redundant N-way moment copies and
+        their update FLOPs disappear), plus a replicated state for the
+        small leaves.  Built WITHOUT optax's global-norm clip — the step
+        computes the true global norm across shards itself."""
+        info = self._shard_info
+        self._tx_flat = self._opt_cfg.build(with_clip=False)
+        leaves = jax.tree_util.tree_leaves(params)
+        small = [leaves[i] for i in range(len(leaves))
+                 if i not in info["big"]]
+        opt = {"flat": self._tx_flat.init(
+                   jnp.zeros(info["padded"], jnp.float32)),
+               "small": self._tx_flat.init(small)}
+        self._opt_abs = jax.tree_util.tree_map(
+            lambda lf: jax.ShapeDtypeStruct(lf.shape, lf.dtype), opt)
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        repl = NamedSharding(self.mesh, P())
+
+        opt = self._map_opt_branches(
+            lambda lf: jax.device_put(lf, shard),
+            lambda lf: jax.device_put(lf, repl), opt)
+        # keep restore-time re-sharding working: the checkpoint loop
+        # device_puts restored arrays onto trainer.state_shardings
+        if self.state_shardings is not None:
+            self.state_shardings = self.state_shardings.replace(
+                opt_state=self._map_opt_branches(
+                    lambda _: shard, lambda _: repl, opt))
+        return opt
+
+    def init_residuals(self, state: TrainState):
+        """Per-rank error-feedback residuals: a pytree matching params,
+        each leaf stacked ``(n_ranks, *shape)`` f32 and sharded over
+        ``data`` (rank r owns row r).  ``None`` when the config carries
+        no error feedback."""
+        cfg = self.collective
+        if cfg is None or not (cfg.compresses and cfg.error_feedback):
+            return None
+        n = self.mesh.shape[DATA_AXIS]
+        sh = self.residual_sharding()
+        return jax.tree_util.tree_map(
+            lambda lf: jax.device_put(
+                jnp.zeros((n,) + tuple(lf.shape), jnp.float32), sh),
+            state.params)
+
+    def residual_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def _build_manual_dp_step(self):
+        cfg = self.collective
+        info = self._shard_info
+        if info is None:
+            raise RuntimeError(
+                "a CollectiveConfig requires init_state() before "
+                "train_step(): the step is pinned to the flat "
+                "gradient-stream layout computed at init")
+        axis = DATA_AXIS
+        n = info["n"]
+        ef = cfg.compresses and cfg.error_feedback
+        sharded = cfg.sharded_update
+        clip = self._opt_cfg.grad_clip_norm
+        train_flag = {self.train_kwarg: (True if self.train_kwarg == "train"
+                                         else False)}
+        from ...parallel.collectives import _record, tree_psum_bucketed
+
+        def local_grads(state, inputs, labels, dropout_key):
+            def loss_of(params):
+                variables = {"params": params, **state.extra_vars}
+                kwargs = dict(train_flag)
+                # per-rank dropout stream: fold the rank in on top of the
+                # step (the pjit path's masks are position-dependent the
+                # same way — only the stream values differ)
+                rngs = {"dropout": _rbg_key(jax.random.fold_in(
+                    jax.random.fold_in(dropout_key, state.step),
+                    lax.axis_index(axis)))}
+                # deliberately NOT wrapped in `with self.mesh,
+                # nn.logical_axis_rules(...)` like the pjit loss body:
+                # GSPMD sharding hints (nn.with_logical_constraint) do
+                # not compose inside a manual shard_map body, and this
+                # path requires a pure data mesh where model-axis hints
+                # have nothing to bind to anyway
+                logits, updates = state.apply_fn(
+                    variables, *inputs, **kwargs,
+                    mutable=["batch_stats", "losses"], rngs=rngs)
+                updates = dict(updates)
+                aux = sum((jnp.sum(leaf) for leaf in
+                           jax.tree_util.tree_leaves(
+                               updates.pop("losses", {}))),
+                          jnp.zeros((), jnp.float32))
+                if not self.has_batch_stats:
+                    updates.pop("batch_stats", None)
+                loss = self.loss_fn(logits, labels) + aux
+                return loss, (logits, updates)
+
+            return jax.value_and_grad(loss_of, has_aux=True)(state.params)
+
+        def finish(state, loss, logits, labels, updates, new_params,
+                   new_opt):
+            # extra_vars (batch_stats) update per-rank locally, then
+            # sync — cross-replica batch-norm semantics, matching the
+            # pjit path's global-batch statistics up to reassociation
+            extra = dict(state.extra_vars)
+            extra.update(jax.tree_util.tree_map(
+                lambda v: lax.pmean(v, axis) if jnp.issubdtype(
+                    v.dtype, jnp.floating) else v, updates))
+            new_state = state.replace(step=state.step + 1,
+                                      params=new_params, extra_vars=extra,
+                                      opt_state=new_opt)
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels)
+                           .astype(jnp.float32))
+            metrics = {"loss": lax.pmean(loss, axis),
+                       "accuracy": lax.pmean(acc, axis)}
+            return new_state, metrics
+
+        def replicated_update(state, inputs, labels, dropout_key,
+                              residuals=None):
+            (loss, (logits, updates)), grads = local_grads(
+                state, inputs, labels, dropout_key)
+            grads, new_res = compressed_tree_sync(
+                grads, axis, cfg, residuals=residuals, mean=True)
+            new_params, new_opt = self._apply_updates(state, grads)
+            out = finish(state, loss, logits, labels, updates, new_params,
+                         new_opt)
+            return out + ((new_res,) if ef else ())
+
+        def sharded_update(state, inputs, labels, dropout_key,
+                           residuals=None):
+            (loss, (logits, updates)), grads = local_grads(
+                state, inputs, labels, dropout_key)
+            p_leaves, p_def = jax.tree_util.tree_flatten(state.params)
+            g_leaves = jax.tree_util.tree_leaves(grads)
+            res_leaves = (jax.tree_util.tree_leaves(residuals)
+                          if ef else None)
+            big = info["big"]
+            small = [i for i in range(len(p_leaves)) if i not in big]
+            _record("grad_reduce_scatter", axis,
+                    [g_leaves[i] for i in big], config=cfg)
+
+            flat = flatten_with_residuals(g_leaves, big, res_leaves,
+                                          info["padded"])
+            if cfg.compression == "int8":
+                shard_sum = int8_reduce_scatter(flat, axis, cfg.chunk)
+                sent = int8_decode(*int8_encode(flat, cfg.chunk))
+            elif cfg.compression == "bf16":
+                shard_sum = bf16_decode(lax.psum_scatter(
+                    bf16_encode(flat), axis_name=axis,
+                    scatter_dimension=0, tiled=True))
+                sent = bf16_decode(bf16_encode(flat))
+            else:
+                shard_sum = lax.psum_scatter(flat, axis_name=axis,
+                                             scatter_dimension=0,
+                                             tiled=True)
+                sent = flat
+            g_shard = shard_sum / n
+
+            # small leaves: plain fused psum, mean
+            small_g = [g_leaves[i] for i in small]
+            if small_g:
+                small_g = [g / n for g in
+                           tree_psum_bucketed(small_g, axis=axis)]
+
+            if clip > 0:
+                # true GLOBAL grad norm: the shards partition the big
+                # stream exactly (pad rows are zero), small leaves are
+                # replicated — optax's in-tree clip would see 1/N
+                sq = lax.psum(jnp.sum(g_shard * g_shard), axis_name=axis)
+                for g in small_g:
+                    sq = sq + jnp.sum(
+                        g.astype(jnp.float32) * g.astype(jnp.float32))
+                gnorm = jnp.sqrt(sq)
+                scale = jnp.where(gnorm > clip, clip / gnorm, 1.0)
+                g_shard = g_shard * scale
+                small_g = [g * scale for g in small_g]
+
+            flat_p = jnp.pad(
+                jnp.concatenate([p_leaves[i].astype(jnp.float32)
+                                 .reshape(-1) for i in big])
+                if big else jnp.zeros((0,), jnp.float32),
+                (0, info["padded"] - info["total"]))
+            me = lax.axis_index(axis)
+            p_shard = lax.dynamic_slice(flat_p, (me * info["shard"],),
+                                        (info["shard"],))
+            opt = state.opt_state
+            upd_shard, new_flat_opt = self._tx_flat.update(
+                g_shard, opt["flat"], p_shard)
+            new_p_shard = optax.apply_updates(p_shard, upd_shard)
+            # record the per-shard INPUT (the series' documented
+            # semantics) — the gathered output would count n-fold
+            _record("param_all_gather", axis, new_p_shard)
+            gathered = lax.all_gather(new_p_shard, axis_name=axis,
+                                      tiled=True)             # (padded,)
+
+            small_p = [p_leaves[i] for i in small]
+            if small_p:
+                upd_small, new_small_opt = self._tx_flat.update(
+                    small_g, opt["small"], small_p)
+                new_small_p = optax.apply_updates(small_p, upd_small)
+            else:
+                new_small_p, new_small_opt = [], opt["small"]
+
+            new_leaves = list(p_leaves)
+            offset = 0
+            for i in big:
+                sz = p_leaves[i].size
+                new_leaves[i] = gathered[offset:offset + sz].reshape(
+                    p_leaves[i].shape).astype(p_leaves[i].dtype)
+                offset += sz
+            for j, i in enumerate(small):
+                new_leaves[i] = new_small_p[j]
+            new_params = jax.tree_util.tree_unflatten(p_def, new_leaves)
+            new_opt = {"flat": new_flat_opt, "small": new_small_opt}
+
+            new_res = None
+            if ef:
+                new_res = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(residuals),
+                    unpack_residuals(flat - sent, big, p_leaves,
+                                     res_leaves))
+            out = finish(state, loss, logits, labels, updates, new_params,
+                         new_opt)
+            return out + ((new_res,) if ef else ())
+
+        body = sharded_update if sharded else replicated_update
+
+        # spec trees: everything replicated except the flat sharded
+        # moment buffer (rows of the padded stream) and the stacked
+        # per-rank residuals
+        repl = P()
+        opt_spec = repl
+        if sharded:
+            opt_spec = self._map_opt_branches(
+                lambda _: P(DATA_AXIS), lambda _: P(), self._opt_abs)
+        state_spec = TrainState(step=repl, params=repl, extra_vars=repl,
+                                opt_state=opt_spec, tx=self.tx,
+                                apply_fn=self.model.apply)
+        in_specs = [state_spec, P(DATA_AXIS), P(DATA_AXIS), repl]
+        out_specs = [state_spec, repl]
+        donate = (0,)
+        if ef:
+            in_specs.append(P(DATA_AXIS))
+            out_specs.append(P(DATA_AXIS))
+            donate = (0, 4)
+        if jax.default_backend() == "cpu":
+            # jaxlib's CPU client corrupts the heap when a donated input
+            # is a freshly device_put restored array (the pre-existing
+            # native crash test_resilience's DL preempt-resume test
+            # isolates); donation only saves memory, so the CPU backend
+            # forgoes it and checkpoint-resume stays crash-free
+            donate = ()
+        mapped = jax.shard_map(body, mesh=self.mesh,
+                               in_specs=tuple(in_specs),
+                               out_specs=tuple(out_specs),
+                               check_vma=False)
+        return jax.jit(mapped, donate_argnums=donate)
+
     def train_step(self):
         if self._step_fn is None:
+            if self.collective is not None:
+                self._step_fn = _InstrumentedStep(_CompressedStep(
+                    self._build_manual_dp_step(),
+                    getattr(self, "_residuals0", None)))
+                return self._step_fn
             out_shardings = None
             if self.zero1:
                 if self.state_shardings is None:
@@ -310,8 +697,14 @@ class DLTrainer:
                 # pin the output state to the ZeRO-1 layout so the updated
                 # params all_gather and the moments stay sharded
                 out_shardings = (self.state_shardings, None)
+            # same CPU-backend donation guard as the manual step above:
+            # jaxlib's CPU client corrupts the heap when a donated input
+            # is a freshly device_put restored array — the native crash
+            # in the restore path test_resilience's DL preempt-resume
+            # test isolates
+            donate = (0,) if jax.default_backend() != "cpu" else ()
             self._step_fn = _InstrumentedStep(jax.jit(
-                self._build_step(), donate_argnums=(0,),
+                self._build_step(), donate_argnums=donate,
                 out_shardings=out_shardings))
         return self._step_fn
 

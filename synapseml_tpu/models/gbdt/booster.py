@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ... import telemetry as _telemetry
+from ...parallel.compression import resolve_collective_config
 from ...parallel.mesh import DATA_AXIS, batch_sharding, replicated
 from . import metrics as metrics_mod
 from .binning import BinMapper, FeatureBundler, fit_bin_mapper
@@ -132,6 +133,16 @@ class BoostingConfig:
     #: (monotonePenalty, BaseTrainParams.scala:128-130): 1 forbids them at
     #: the root, larger values reach deeper
     monotone_penalty: float = 0.0
+    #: wire codec for the data-parallel histogram allreduce (EQuARX,
+    #: arXiv:2506.17615): "none" (default, byte-identical to the f32
+    #: path) | "bf16" | "int8" | a full
+    #: :class:`~synapseml_tpu.parallel.compression.CollectiveConfig`.
+    #: Stateless per histogram (no error feedback — histograms are
+    #: re-derived per split, not an accumulating stream); every rank
+    #: decodes identical bytes so trees stay identical across ranks.
+    #: Ignored by voting/feature parallelism (their collectives are
+    #: already top-k-sparse or local) and by single-device fits.
+    collective_compression: Any = "none"
     pass_through: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def growth_params(self) -> GrowthParams:
@@ -497,13 +508,20 @@ def _step_factory_args(config: "BoostingConfig", K: int, mesh, featpar: bool,
             1.0 if is_rf else config.learning_rate, mesh,
             config.boosting_type == "goss",
             config.top_rate, config.other_rate)
+    # compressed histogram wire applies only where the histogram psum
+    # exists: data-parallel growth over a real mesh (voting aggregates
+    # top-k-sparse, feature_parallel keeps histograms local)
+    cconfig = resolve_collective_config(config.collective_compression)
+    if _hist_psum_nulled(config, mesh is not None):
+        cconfig = None
     kwargs = dict(ova=(config.objective == "multiclassova"),
                   use_pallas=use_pallas,
                   growth_policy=config.growth_policy,
                   feature_parallel=featpar,
                   bundled_featpar=bool(featpar and config.enable_bundle),
                   bagging_fraction=(config.bagging_fraction
-                                    if use_bagging else 1.0))
+                                    if use_bagging else 1.0),
+                  cconfig=cconfig)
     return args, kwargs
 
 
@@ -577,7 +595,8 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                use_pallas: bool = False, bagging_fraction: float = 1.0,
                growth_policy: str = "depthwise",
                feature_parallel: bool = False,
-               bundled_featpar: bool = False):
+               bundled_featpar: bool = False,
+               cconfig=None):
     """Build the jitted one-iteration step.
 
     step(binned, scores, labels, weights, (base_bag, bag_key),
@@ -608,9 +627,12 @@ def _make_step(p: GrowthParams, objective_fn, num_class: int,
                                    n_slots=fp_slots)
     elif growth_policy == "depthwise" and p.voting_k == 0:
         grower = functools.partial(grow_tree_depthwise,
-                                   n_slots=default_n_slots(p.num_leaves))
+                                   n_slots=default_n_slots(p.num_leaves),
+                                   cconfig=cconfig)
     else:
-        grower = grow_tree            # lossguide / voting-parallel
+        # lossguide / voting-parallel (the grower itself skips the
+        # compressed wire on its voting collectives)
+        grower = functools.partial(grow_tree, cconfig=cconfig)
 
     def goss_weights(g_abs, bag, key):
         """Gradient one-side sampling: keep top_rate by |grad|, sample
@@ -765,6 +787,31 @@ class InstrumentationMeasures:
         d = dataclasses.asdict(self)
         d["iterations_per_sec"] = self.iterations_per_sec()
         return d
+
+
+def _hist_psum_nulled(config: "BoostingConfig", mesh_present: bool) -> bool:
+    """True where the data-parallel histogram psum does not exist (no
+    mesh, feature/voting parallelism) — THE predicate for 'is the codec
+    live', consumed by both ``_step_factory_args`` (which nulls the
+    cconfig the growers trace) and ``_effective_wire_key`` (the resume
+    guard), so the two can never drift apart."""
+    return (not mesh_present
+            or config.parallelism in ("feature_parallel",
+                                      "voting_parallel"))
+
+
+def _effective_wire_key(config: "BoostingConfig", mesh_present: bool):
+    """The histogram-psum wire a fit ACTUALLY uses, as a comparable key:
+    ``None`` for the f32 wire (no codec, or :func:`_hist_psum_nulled`),
+    else ``(compression, min_size, chunk)`` with chunk zeroed for
+    non-int8 codecs (bf16 never chunks).  DL-only fields
+    (error_feedback/sharded_update/manual) never enter the key."""
+    cc = resolve_collective_config(config.collective_compression)
+    if (cc is None or not cc.compresses
+            or _hist_psum_nulled(config, mesh_present)):
+        return None
+    return (cc.compression, cc.min_size,
+            cc.chunk if cc.compression == "int8" else 0)
 
 
 def _latest_checkpoint(directory: str) -> Optional[Booster]:
@@ -954,12 +1001,51 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         # stated-approximate behavior (LightGBMBase.scala:38-59).
         resumed = _latest_checkpoint(checkpoint_dir)
         if resumed is not None:
+            # codec guard (the DL _CheckpointLoop's counterpart): the
+            # remaining trees would grow on a different histogram wire
+            # than the carried ones — bit-exact with neither clean run —
+            # so a collective_compression toggle against an existing
+            # checkpoint fails loudly instead of silently changing the
+            # numerics mid-model.  The key is the EFFECTIVE wire, not
+            # the declared config: only the fields the histogram psum
+            # reads (codec, min_size, int8 chunk — error_feedback/
+            # sharded_update/manual are DL-only, bf16 never chunks),
+            # nulled where the psum itself is nulled (_step_factory_args:
+            # no mesh, feature/voting parallelism) — so a topology change
+            # like gang-fit → single-device-resume flips the key even
+            # under an unchanged config, and a single-device fit that
+            # declared a (documented-ignored) codec resumes freely.
+            # Checkpoints carry the writer's key (stamped below) because
+            # mesh-ness is a train() arg the config alone cannot encode.
+            saved_pt = resumed.config.pass_through or {}
+            if "_codec_wire_key" in saved_pt:
+                saved_cc = saved_pt["_codec_wire_key"]
+                saved_cc = tuple(saved_cc) if saved_cc is not None else None
+            else:
+                # unstamped checkpoint: the codec fields did not exist
+                # when it was written, so it trained on the f32 wire
+                saved_cc = None
+            cur_cc = _effective_wire_key(config, mesh is not None)
+            if saved_cc != cur_cc:
+                raise ValueError(
+                    f"checkpoint at {checkpoint_dir} was trained with "
+                    f"collective_compression wire {saved_cc!r} but this "
+                    f"fit requests {cur_cc!r}; resuming would grow the "
+                    "remaining trees under different histogram numerics "
+                    "— use a fresh checkpoint_dir or keep the codec")
             done = resumed.num_trees // max(resumed.num_class, 1)
             if done >= config.num_iterations:
                 return resumed, []
             config = dataclasses.replace(
                 config, num_iterations=config.num_iterations - done)
             init_model = resumed
+        # stamp THIS fit's effective wire into the config the written
+        # checkpoints carry (the guard above reads it back; JSON
+        # round-trips the tuple as a list)
+        key = _effective_wire_key(config, mesh is not None)
+        config = dataclasses.replace(config, pass_through={
+            **config.pass_through,
+            "_codec_wire_key": list(key) if key is not None else None})
     source = X if hasattr(X, "iter_chunks") else None
     if source is not None:
         n, F = source.num_rows, source.num_features
@@ -978,6 +1064,8 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         raise ValueError(
             f"two_level_hist={config.two_level_hist!r}: must be 'auto', "
             "'on', or 'off'")
+    # fail fast on a bad codec string, before binning/compiles start
+    resolve_collective_config(config.collective_compression)
 
     if config.monotone_constraints and any(config.monotone_constraints):
         if config.monotone_constraints_method not in ("basic",

@@ -125,6 +125,13 @@ class GBDTParams(Params):
                                     "pass-through analogue)")
     predictDisableShapeCheck = BoolParam(doc="skip feature-count check at "
                                              "predict", default=False)
+    collectiveCompression = PyObjectParam(
+        doc="wire codec for the data-parallel histogram allreduce: "
+            "'none' (default) | 'bf16' | 'int8' | a "
+            "parallel.compression.CollectiveConfig — int8 ships ~1/4 "
+            "the bytes per histogram psum at a bounded split-quality "
+            "cost (holdout parity pinned in tier-1); ignored for "
+            "voting/feature parallelism and single-device fits")
 
     def _build_config(self, objective: str, num_class: int = 1) -> BoostingConfig:
         extra = self.passThroughArgs or {}
@@ -166,6 +173,8 @@ class GBDTParams(Params):
             if self.get("monotoneConstraints") else None,
             monotone_constraints_method=self.monotoneConstraintsMethod,
             monotone_penalty=self.monotonePenalty,
+            collective_compression=(self.get("collectiveCompression")
+                                    or "none"),
         )
         for k, v in extra.items():
             if hasattr(cfg, k):

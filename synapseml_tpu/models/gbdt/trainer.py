@@ -39,6 +39,7 @@ from jax import lax
 
 from ... import telemetry as _telemetry
 from ...parallel.collectives import psum as _c_psum
+from ...parallel.compression import compressed_psum as _c_compressed_psum
 
 
 def _tl_gauge(grower: str, active: bool) -> None:
@@ -690,7 +691,8 @@ def _best_split_voting(local_hist, sum_g, sum_h, sum_c, num_bins,
         gl[bi, bb], hl[bi, bb], cl[bi, bb]
 
 
-@functools.partial(jax.jit, static_argnames=("p", "axis_name", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("p", "axis_name", "use_pallas",
+                                             "cconfig"))
 def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
               grad: jnp.ndarray,            # (N,) f32 (0 for pad rows)
               hess: jnp.ndarray,            # (N,) f32 (0 for pad rows)
@@ -703,6 +705,7 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
               axis_name: Optional[str] = None,
               use_pallas: bool = False,
               bundle_map: Optional[dict] = None,
+              cconfig=None,
               ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one tree; returns (tree, per-row leaf node ids).
 
@@ -714,6 +717,12 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     search, routing and the emitted tree all live in ORIGINAL feature
     space — histograms unbundle before each pick, splits route through
     :func:`_slot_route_params`.
+
+    ``cconfig`` (a :class:`~synapseml_tpu.parallel.compression.
+    CollectiveConfig`, static): puts the per-split histogram allreduce —
+    THE data-parallel bandwidth hog — on a quantized wire.  Stateless
+    per histogram; every rank still decodes identical bytes, so the
+    identical-tree invariant holds.
     """
     F, N = bins_t.shape
     B = p.total_bins
@@ -747,8 +756,15 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     def ar(x):
         # routed through the instrumented wrapper so the histogram
         # allreduce — THE data-parallel hot collective — shows up in
-        # collective_{calls,bytes}_total (recorded per traced program)
-        return _c_psum(x, axis_name) if (axis_name and not voting) else x
+        # collective_{calls,bytes}_total (recorded per traced program);
+        # with a compression config the wire rides the quantized
+        # reduce-scatter + all-gather instead of the f32 psum
+        if not axis_name or voting:
+            return x
+        if cconfig is not None and cconfig.compresses:
+            return _c_compressed_psum(x, axis_name, cconfig,
+                                      op="gbdt_hist_psum")
+        return _c_psum(x, axis_name)
 
     def unb(hist3, g, h, c):
         if bundle_map is None:
@@ -1110,7 +1126,7 @@ def default_n_slots(num_leaves: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("p", "axis_name", "use_pallas",
-                                             "n_slots"))
+                                             "n_slots", "cconfig"))
 def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                         grad: jnp.ndarray,       # (N,) f32
                         hess: jnp.ndarray,       # (N,) f32
@@ -1124,6 +1140,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                         use_pallas: bool = False,
                         n_slots: int = 16,
                         bundle_map: Optional[dict] = None,
+                        cconfig=None,
                         ) -> Tuple[Tree, jnp.ndarray]:
     """Grow one tree wave-by-wave; returns (tree, per-row leaf node ids).
 
@@ -1131,6 +1148,9 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     in: within a wave all selected leaves split simultaneously, so when the
     leaf budget runs out mid-wave the marginal leaves may differ from strict
     best-first order.  Split decisions per node are identical.
+
+    ``cconfig``: quantized wire for the per-wave histogram psum — see
+    :func:`grow_tree`.
     """
     from .pallas_hist import prep_hist_vals
 
@@ -1144,7 +1164,12 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     rows = jnp.arange(N)
 
     def ar(x):
-        return _c_psum(x, axis_name) if axis_name else x
+        if not axis_name:
+            return x
+        if cconfig is not None and cconfig.compresses:
+            return _c_compressed_psum(x, axis_name, cconfig,
+                                      op="gbdt_hist_psum")
+        return _c_psum(x, axis_name)
 
     vals8, scales = (prep_hist_vals(grad, hess, row_valid) if use_pallas
                      else (None, None))

@@ -3,6 +3,8 @@ from .collectives import (CollectiveTimeout, all_gather, allreduce_fn,
                           hierarchical_psum, pmax, pmean, pmin, ppermute,
                           psum, reduce_scatter, ring_allreduce, ring_shift,
                           shard_map_over, tree_psum_bucketed)
+from .compression import (CollectiveConfig, compressed_psum,
+                          compressed_tree_sync, resolve_collective_config)
 from .distributed import ClusterConfig, initialize_cluster, shutdown_cluster
 from .launcher import (ReservedPort, WorkerFailure, find_free_port,
                        run_on_local_cluster)

@@ -56,19 +56,36 @@ class CollectiveTimeout(RuntimeError):
         self.payload_bytes = payload_bytes
 
 
-def _payload_bytes(x) -> int:
-    nbytes = 0
-    for leaf in jax.tree_util.tree_leaves(x):
-        size, dtype = getattr(leaf, "size", None), getattr(leaf, "dtype",
-                                                           None)
-        if size is not None and dtype is not None:
-            nbytes += int(size) * np.dtype(dtype).itemsize
-    return nbytes
+class _ShapeOnly:
+    """A shape/dtype stand-in leaf for byte accounting — lets the host
+    wrapper account S copies of the per-shard LOCAL layout without
+    materializing them (``wire_nbytes``/``logical_nbytes`` read only
+    ``shape``/``size``/``dtype``)."""
+    __slots__ = ("shape", "size", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.size = int(np.prod(self.shape)) if self.shape else 1
+        self.dtype = dtype
+
+
+def _payload_bytes(x, config=None, channel_major: bool = False) -> int:
+    """Per-shard bytes the op actually moves: WIRE bytes when a
+    compression config is in play, logical dtype bytes otherwise (the
+    pre-codec behavior assumed logical size for every op, which
+    double-counted compressed payloads and mis-ranked codecs in
+    /metrics and flight events)."""
+    from .compression import logical_nbytes, wire_nbytes
+    if config is not None and config.compresses:
+        return wire_nbytes(x, config, channel_major=channel_major)
+    return logical_nbytes(x)
 
 
 def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
                       deadline=None, timeout_s: Optional[float] = None,
-                      payload_bytes: Optional[int] = None, **kw):
+                      payload_bytes: Optional[int] = None,
+                      codec: str = "none",
+                      logical_bytes: Optional[int] = None, **kw):
     """Run a blocking dispatch under a host-side watchdog timer.
 
     ``deadline`` (a :class:`~synapseml_tpu.resilience.Deadline`) and/or
@@ -83,18 +100,24 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
     thread, so an armed ``hang`` rule wedges the dispatch exactly where
     a lost peer would.
     """
+    # compressed ops tag their flight events with the codec and BOTH
+    # byte counts (``nbytes`` is what moved on the wire, ``logical_nbytes``
+    # what it represents); the "none" path emits the identical event
+    # payload it always did
+    extra = ({"codec": codec, "logical_nbytes": logical_bytes}
+             if codec != "none" else {})
     if deadline is not None:
         timeout_s = deadline.limit(timeout_s)
     if timeout_s is None:
         flight_record("collective.begin", op=op, axis=str(axis),
-                      nbytes=payload_bytes)
+                      nbytes=payload_bytes, **extra)
         get_faults().raise_point("collective.dispatch", op=op,
                                  axis=str(axis))
         t0 = time.perf_counter()
         out = fn(*args, **kw)
         dt = time.perf_counter() - t0
         flight_record("collective.end", op=op, axis=str(axis),
-                      nbytes=payload_bytes, seconds=round(dt, 6))
+                      nbytes=payload_bytes, seconds=round(dt, 6), **extra)
         observe_collective(dt, payload_bytes or 0)
         return out
     box: dict = {}
@@ -111,7 +134,7 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
             done.set()
 
     flight_record("collective.begin", op=op, axis=str(axis),
-                  nbytes=payload_bytes, timeout_s=float(timeout_s))
+                  nbytes=payload_bytes, timeout_s=float(timeout_s), **extra)
     t0 = time.perf_counter()
     t = threading.Thread(target=_run, daemon=True,
                          name=f"collective-{op}")
@@ -122,7 +145,8 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
             "host-dispatched collectives that blocked past their "
             "deadline", ("op", "axis")).inc(1, op=op, axis=str(axis))
         flight_record("collective.timeout", op=op, axis=str(axis),
-                      nbytes=payload_bytes, timeout_s=float(timeout_s))
+                      nbytes=payload_bytes, timeout_s=float(timeout_s),
+                      **extra)
         raise CollectiveTimeout(op, axis, float(timeout_s),
                                 payload_bytes=payload_bytes)
     dt = time.perf_counter() - t0
@@ -131,14 +155,18 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
         # inline leg — a paired `end` means the op completed
         raise box["error"]
     flight_record("collective.end", op=op, axis=str(axis),
-                  nbytes=payload_bytes, seconds=round(dt, 6))
+                  nbytes=payload_bytes, seconds=round(dt, 6), **extra)
     observe_collective(dt, payload_bytes or 0)
     return box["value"]
 
 
-def _record(op: str, axis, x) -> None:
+def _record(op: str, axis, x, config=None, channel_major: bool = False) -> None:
     """EQuARX-style per-collective accounting (arXiv:2506.17615): count +
     payload bytes per (op, axis) into the process metrics registry.
+    ``collective_bytes_total`` stays LOGICAL bytes (the signal the op
+    reduces); compressed ops additionally land their WIRE bytes +
+    compression ratio via :func:`~synapseml_tpu.parallel.compression.
+    record_compressed` so codecs rank correctly in /metrics.
 
     These wrappers run under jit TRACING, so for compiled code each
     series counts collectives per traced program, weighted by the
@@ -146,21 +174,20 @@ def _record(op: str, axis, x) -> None:
     bytes does this step's program hand to the ICI" — not per execution.
     Telemetry must never break a trace, hence the blanket except."""
     try:
-        nbytes = 0
-        for leaf in jax.tree_util.tree_leaves(x):
-            size, dtype = getattr(leaf, "size", None), getattr(leaf, "dtype",
-                                                               None)
-            if size is not None and dtype is not None:
-                nbytes += int(size) * np.dtype(dtype).itemsize
+        from .compression import logical_nbytes, record_compressed
+        nbytes = logical_nbytes(x)
         reg = get_registry()
         labels = dict(op=op, axis=str(axis))
         reg.counter("collective_calls_total",
                     "collective ops traced, by op and mesh axis",
                     ("op", "axis")).inc(1, **labels)
         reg.counter("collective_bytes_total",
-                    "per-shard payload bytes handed to collectives, "
-                    "by op and mesh axis", ("op", "axis")).inc(
+                    "per-shard LOGICAL payload bytes handed to "
+                    "collectives, by op and mesh axis", ("op", "axis")).inc(
                         nbytes, **labels)
+        if config is not None and config.compresses:
+            record_compressed(op, axis, x, config,
+                              channel_major=channel_major)
     except Exception:
         pass
 
@@ -337,10 +364,18 @@ def tree_psum_bucketed(tree, axis: str = DATA_AXIS,
     return jax.tree.unflatten(treedef, out)
 
 
-def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
+def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS,
+                 config=None) -> Callable:
     """jitted allreduce over the data axis: input is per-rank values stacked
     on dim 0 (shape (num_ranks, *H)), output is their sum (shape (*H)).
     The LightGBM histogram-allreduce replacement.
+
+    ``config`` (a :class:`~synapseml_tpu.parallel.compression.
+    CollectiveConfig`) selects the wire codec: the reduce runs as the
+    compressed :func:`~synapseml_tpu.parallel.compression.
+    compressed_psum`, and every metric/flight event reports WIRE bytes
+    with the codec attached (``None``/"none" keeps today's f32 path and
+    event payloads byte-identical).
 
     The returned callable is host-dispatched (unlike the in-jit wrappers
     above), so each call ALSO lands one sample in the
@@ -351,12 +386,23 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
     resilience.Deadline`) or ``timeout_s=`` per call and an
     indefinitely-blocked dispatch raises :class:`CollectiveTimeout`
     instead of freezing the rank (see :func:`dispatch_watchdog`)."""
+    from .compression import codec_eligible, compressed_psum
+    compresses = config is not None and config.compresses
+    codec = config.compression if compresses else "none"
+
     @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P())
     def _allreduce(x):
         # x.sum(0) handles both one and several stacked values per shard
-        return lax.psum(x.sum(0), axis_name=axis)
+        local = x.sum(0)
+        if compresses:
+            # record=False: the host wrapper below accounts this op once
+            # (per call, on the full stacked payload) — recording the
+            # traced inner reduce too would double-count the series
+            return compressed_psum(local, axis, config, op="allreduce_fn",
+                                   record=False)
+        return lax.psum(local, axis_name=axis)
 
     latency = get_registry().histogram(
         "collective_latency_seconds",
@@ -365,7 +411,30 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
 
     @functools.wraps(_allreduce)
     def timed(x, *, deadline=None, timeout_s=None):
-        _record("allreduce_fn", axis, x)
+        # codec accounting shares the traced compressed_psum's
+        # eligibility predicate: the codec applies to the locally summed
+        # (*H,) payload, so a stacked input whose inner size is below
+        # min_size (or non-float) really reduces in f32 and must be
+        # reported that way — not as int8 wire that never existed
+        inner = getattr(x, "shape", ())[1:]
+        dtype = getattr(x, "dtype", jnp.float32)
+        active = codec_eligible(inner, dtype, config)
+        # the traced compressed_psum lays the ndim>=2 LOCAL (*H) out
+        # channel-major (per-channel chunk padding), so the stacked
+        # account is S x the padded local — padding the stacked array
+        # itself would miscount the pad bytes the wire really ships
+        cm = len(inner) >= 2
+        if active:
+            S = int(getattr(x, "shape", (1,))[0])
+            payload = [_ShapeOnly(inner, dtype)] * S
+        else:
+            payload = x
+        _record("allreduce_fn", axis, payload,
+                config=config if active else None, channel_major=cm)
+        wire = _payload_bytes(payload, config if active else None,
+                              channel_major=cm)
+        extra = ({"codec": codec, "logical_nbytes": _payload_bytes(x)}
+                 if active else {})
         t0 = time.perf_counter()
         if deadline is None and timeout_s is None:
             out = _allreduce(x)
@@ -373,10 +442,10 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
             # collective segment + the flight ring (the watched leg below
             # goes through dispatch_watchdog, which does both itself)
             dt = time.perf_counter() - t0
-            observe_collective(dt, _payload_bytes(x))
+            observe_collective(dt, wire)
             flight_record("collective.end", op="allreduce_fn",
-                          axis=str(axis), nbytes=_payload_bytes(x),
-                          seconds=round(dt, 6))
+                          axis=str(axis), nbytes=wire,
+                          seconds=round(dt, 6), **extra)
         else:
             # the watched leg must SYNCHRONIZE: under async dispatch the
             # bare call returns before the ring moves a byte, and a hung
@@ -385,7 +454,8 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS) -> Callable:
                 lambda v: jax.block_until_ready(_allreduce(v)), x,
                 op="allreduce_fn", axis=axis,
                 deadline=deadline, timeout_s=timeout_s,
-                payload_bytes=_payload_bytes(x))
+                payload_bytes=wire, codec=codec if active else "none",
+                logical_bytes=_payload_bytes(x))
         latency.observe(time.perf_counter() - t0, op="allreduce_fn",
                         axis=str(axis))
         return out

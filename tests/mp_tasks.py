@@ -171,7 +171,9 @@ def gbdt_elastic_digest(args):
     mesh = data_parallel_mesh(len(jax.devices()))
     cfg = BoostingConfig(objective="binary",
                          num_iterations=int(args.get("iters", 4)),
-                         num_leaves=7, min_data_in_leaf=5, max_bin=31)
+                         num_leaves=7, min_data_in_leaf=5, max_bin=31,
+                         collective_compression=args.get("compression",
+                                                         "none"))
     ckpt_dir = os.environ.get("SMLTPU_CKPT_DIR") or args.get("ckpt_dir")
     booster, _ = train(X, y, cfg, mesh=mesh,
                        checkpoint_dir=ckpt_dir, checkpoint_interval=1)

@@ -504,21 +504,29 @@ class TestGangSubprocess:
         assert "exit" in ei.value.causes[0]
 
     @pytest.mark.slow
-    def test_gbdt_elastic_resume_bit_exact(self, fault_registry, tmp_path):
+    @pytest.mark.parametrize("compression", ["none", "int8"])
+    def test_gbdt_elastic_resume_bit_exact(self, fault_registry, tmp_path,
+                                           compression):
         """SIGKILL one rank of a 2-process GBDT gang after its second
         published checkpoint; the relaunched gang resumes from the last
         complete iteration and the final model digest is bit-exact with
         the fault-free run (the warm-start margin replay keeps resumed
-        boosting identical)."""
+        boosting identical).
+
+        The ``int8`` leg repeats the pin with the compressed histogram
+        wire on: the codec is stateless and every rank decodes identical
+        bytes, so kill→resume with quantized collectives must stay
+        bit-exact too."""
+        task_args = {"compression": compression}
         clean = run_on_local_cluster(
             "mp_tasks:gbdt_elastic_digest", n_processes=2,
             devices_per_process=1, timeout_s=300.0,
-            heartbeat_interval_s=0.5,
+            heartbeat_interval_s=0.5, task_args=task_args,
             checkpoint_dir=str(tmp_path / "gbdt-clean"))
         sup = GangSupervisor(
             "mp_tasks:gbdt_elastic_digest", n_processes=2,
             devices_per_process=1, timeout_s=300.0,
-            heartbeat_interval_s=0.5,
+            heartbeat_interval_s=0.5, task_args=task_args,
             retry_policy=RetryPolicy(max_retries=2, base_s=0.01, seed=5),
             checkpoint_dir=str(tmp_path / "gbdt-elastic"),
             env_extra={"SML_FAULTS":
