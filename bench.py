@@ -603,6 +603,89 @@ def bench_gang_recovery():
     return recovery_s, delta_s / base_s * 100.0, base_s
 
 
+def bench_elastic_resize():
+    """Elastic gang-resize cost, measured by making the resize happen.
+
+    Shrink leg: a 2-rank elastic counter job whose rank 1 dies at the
+    same step of EVERY attempt (permanent loss) — the supervisor shrinks
+    to 1 rank and resumes; the clock is failure-detection → the degraded
+    gang re-reaching the dead attempt's best step
+    (``GangSupervisor.last_recovery_s``).  Grow leg: a degraded 1-rank
+    job gets a mid-run ``resize(2)``; same clock across the deliberate
+    teardown + 2-rank resume.  ``degraded_throughput_pct`` contrasts the
+    per-rank step rate of clean 1-rank vs 2-rank runs of the same
+    workload (the counter's steps are rank-local, so ~100% here; a
+    collective-bound trainer shows the real degradation).
+
+    → (shrink_recovery_s, grow_recovery_s, degraded_pct)."""
+    import tempfile
+    import threading
+
+    from synapseml_tpu.parallel import GangSupervisor, run_on_local_cluster
+    from synapseml_tpu.resilience import RetryPolicy
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+
+    task_args = {"steps": 8, "step_sleep_s": 0.15}
+
+    # shrink-to-survive: permanent rank-1 loss → 2 → 1
+    with tempfile.TemporaryDirectory() as ckpt:
+        sup = GangSupervisor(
+            "mp_tasks:elastic_counter", n_processes=2,
+            devices_per_process=1, task_args=task_args, timeout_s=120.0,
+            heartbeat_interval_s=0.25, min_ranks=1, shrink_after=2,
+            retry_policy=RetryPolicy(max_retries=4, base_s=0.01, seed=2),
+            checkpoint_dir=ckpt,
+            env_extra={"SML_FAULTS": "mp.step=kill_rank:rank=1:after=2"})
+        sup.run()
+    assert sup.world_size == 1 and sup.resize_history
+    shrink_recovery_s = sup.last_recovery_s
+
+    # grow-on-capacity: degraded 1-rank start, mid-run resize(2)
+    grow_args = {"steps": 14, "step_sleep_s": 0.25}
+    with tempfile.TemporaryDirectory() as ckpt:
+        sup2 = GangSupervisor(
+            "mp_tasks:elastic_counter", n_processes=2,
+            devices_per_process=1, task_args=grow_args, timeout_s=180.0,
+            heartbeat_interval_s=0.25, min_ranks=1,
+            retry_policy=RetryPolicy(max_retries=2, base_s=0.01, seed=3),
+            checkpoint_dir=ckpt)
+        sup2.resize(1)
+
+        def grower():
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                m = sup2.monitor
+                if (m is not None and sup2.world_size == 1
+                        and (m.max_step() or -1) >= 2):
+                    sup2.resize(2)
+                    return
+                time.sleep(0.05)
+
+        t = threading.Thread(target=grower, daemon=True)
+        t.start()
+        sup2.run()
+        t.join(timeout=5.0)
+    grow_recovery_s = sup2.last_recovery_s if sup2.world_size == 2 else None
+
+    # degraded throughput: clean per-rank step rate at each size
+    def steps_per_sec(n):
+        out = run_on_local_cluster(
+            "mp_tasks:elastic_counter", n_processes=n,
+            devices_per_process=1, task_args=task_args, timeout_s=120.0,
+            heartbeat_interval_s=0.25)
+        r = out[0]
+        return r["steps_run"] / r["loop_s"] if r["loop_s"] else None
+
+    full_sps, deg_sps = steps_per_sec(2), steps_per_sec(1)
+    degraded_pct = (deg_sps / full_sps * 100.0
+                    if full_sps and deg_sps else None)
+    return shrink_recovery_s, grow_recovery_s, degraded_pct
+
+
 def bench_obs_overhead():
     """Gang-observability overhead on the CLEAN training path: the same
     short GBDT train, bare (flight recorder disabled, no profiler — a
@@ -1345,6 +1428,21 @@ def main():
         print(f"[secondary] gang-recovery bench failed: {e}",
               file=sys.stderr)
 
+    resize_shrink_s = resize_grow_s = resize_degraded_pct = None
+    try:
+        resize_shrink_s, resize_grow_s, resize_degraded_pct = \
+            bench_elastic_resize()
+        print(f"[secondary] elastic resize: shrink 2→1 recovery "
+              f"{resize_shrink_s:.2f} s, grow 1→2 recovery "
+              + (f"{resize_grow_s:.2f} s" if resize_grow_s is not None
+                 else "n/a")
+              + (f", degraded throughput {resize_degraded_pct:.1f}%"
+                 if resize_degraded_pct is not None else ""),
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] elastic-resize bench failed: {e}",
+              file=sys.stderr)
+
     guard_pct = guard_base_ms = guard_guarded_ms = None
     try:
         guard_pct, guard_base_ms, guard_guarded_ms = bench_guard_overhead()
@@ -1497,6 +1595,14 @@ def main():
             round(gang_hb_pct, 3) if gang_hb_pct is not None else None),
         "gang_clean_launch_seconds": (
             round(gang_launch_s, 3) if gang_launch_s is not None else None),
+        "resize_recovery_seconds": (
+            round(resize_shrink_s, 3) if resize_shrink_s is not None
+            else None),
+        "resize_recovery_seconds_grow": (
+            round(resize_grow_s, 3) if resize_grow_s is not None else None),
+        "degraded_throughput_pct": (
+            round(resize_degraded_pct, 2) if resize_degraded_pct is not None
+            else None),
         "rowguard_clean_overhead_pct": (
             round(guard_pct, 3) if guard_pct is not None else None),
         "rowguard_unguarded_transform_ms": (

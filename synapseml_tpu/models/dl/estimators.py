@@ -232,27 +232,54 @@ class _CheckpointLoop:
         for k in ("compression", "sharded_update", "error_feedback",
                   "manual_step", "codec_min_size", "codec_chunk"):
             saved_cfg.setdefault(k, 0.0)
+        # "shards" is the one WORLD-SIZE key: a mismatch there is an
+        # elastic gang resize, not a config error — the checkpoint is
+        # world-size-independent by contract (gather-to-canonical-then-
+        # reshard below), so it re-shards instead of refusing.  Every
+        # other key still refuses: those change the numerics/data order
+        # in ways no re-shard can reconcile.
         mismatch = {k: (saved_cfg[k], self._config[k]) for k in saved_cfg
-                    if saved_cfg[k] != self._config[k]}
+                    if saved_cfg[k] != self._config[k] and k != "shards"}
         if mismatch:
             raise ValueError(
                 f"checkpoint at {ckpt_dir} step {latest} was written with a "
                 f"different data-order config {mismatch}; resuming would "
                 f"silently train on wrong batches — use a fresh "
                 f"checkpointDir or restore manually")
+        saved_shards = int(saved_cfg.get("shards",
+                                         self._config["shards"]))
+        cur_shards = int(self._config["shards"])
+        resized = saved_shards != cur_shards
         residuals = self._residuals()
         if residuals is not None:
             # error-feedback residuals are live training state: they
             # ride the same checkpoint pytree so kill→resume replays the
             # exact compressed gradient stream (bit-exactness pinned in
-            # tests/test_collectives_compression.py)
+            # tests/test_collectives_compression.py).  Restoring across
+            # a resize, the saved (N, *shape) stacking lands in the
+            # M-shaped template positionally and reshard_restored
+            # re-lays it before anything touches a device.
             restored, res = self.manager.restore_state_dict(
                 (state, residuals))
+            if resized:
+                restored, res = trainer.reshard_restored(
+                    restored, res, saved_shards)
             res = jax.device_put(res, jax.tree_util.tree_map(
                 lambda _: trainer.residual_sharding(), res))
             self._step.set_residuals(res)
         else:
             restored = self.manager.restore_state_dict(state)
+            if resized:
+                restored, _ = trainer.reshard_restored(
+                    restored, None, saved_shards)
+        if resized:
+            from ...resilience.faults import get_faults
+            from ...telemetry.flight import record as flight_record
+            get_faults().note("dl.resize_resume", saved=saved_shards,
+                              current=cur_shards)
+            flight_record("resize_resume", trainer="dl",
+                          saved_shards=saved_shards,
+                          current_shards=cur_shards)
         if trainer.state_shardings is not None:
             restored = jax.device_put(restored, trainer.state_shardings)
         self.state = restored

@@ -31,9 +31,11 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...parallel.compression import (CollectiveConfig, bf16_decode,
-                                     bf16_encode, compressed_tree_sync,
+                                     bf16_encode, canonical_residuals,
+                                     compressed_tree_sync,
                                      flatten_with_residuals, int8_decode,
                                      int8_encode, int8_reduce_scatter,
+                                     reshard_flat_stream, reshard_residuals,
                                      unpack_residuals)
 from ...parallel.mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding,
                               data_parallel_mesh, dp_tp_mesh)
@@ -472,6 +474,56 @@ class DLTrainer:
 
     def residual_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def reshard_restored(self, state: TrainState, residuals,
+                         saved_shards: int):
+        """Re-lay an N-rank checkpoint's world-size-dependent state for
+        THIS trainer's M-way data mesh (elastic gang resize restore).
+
+        Gather-to-canonical-then-reshard: the stacked per-rank EF
+        residuals collapse to their canonical total-error form and
+        re-stack at M (rank 0 carries the total — exact, sum-preserving),
+        and the sharded-update flat moment stream trims its old padding
+        and re-pads for the new ``n * unit`` multiple.  Everything else
+        (params, step, small-leaf moments, optax scalars) is already
+        world-size-free.  Deterministic: restoring the same checkpoint
+        at the same M always yields bit-identical state, whatever N
+        wrote it.  No-op when ``saved_shards`` equals this mesh's data
+        size, so same-size resume stays bit-exact with the
+        uninterrupted run."""
+        n_old = int(saved_shards)
+        n_new = int(self.mesh.shape[DATA_AXIS])
+        cfg = self.collective
+        if n_old == n_new or cfg is None:
+            return state, residuals
+        if residuals is not None:
+            def restack(lf):
+                lf = np.asarray(lf)
+                if lf.ndim < 1 or lf.shape[0] != n_old:
+                    raise ValueError(
+                        f"residual leaf {lf.shape} does not carry the "
+                        f"saved {n_old}-rank stacking")
+                return reshard_residuals(canonical_residuals(lf), n_new)
+
+            residuals = jax.tree_util.tree_map(restack, residuals)
+        if cfg.sharded_update and self._shard_info is not None:
+            info = self._shard_info
+            unit = int(n_old) * (cfg.chunk if cfg.compression == "int8"
+                                 else 1)
+            padded_old = -(-max(info["total"], 1) // unit) * unit
+            padded_new = info["padded"]
+
+            def relay(lf):
+                if (getattr(lf, "ndim", 0) >= 1
+                        and lf.shape[0] == padded_old):
+                    return reshard_flat_stream(lf, info["total"],
+                                               padded_new)
+                return lf
+
+            opt = dict(state.opt_state)
+            opt["flat"] = jax.tree_util.tree_map(relay, opt["flat"])
+            state = state.replace(opt_state=opt)
+        return state, residuals
 
     def _build_manual_dp_step(self):
         cfg = self.collective

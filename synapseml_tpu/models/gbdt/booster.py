@@ -800,6 +800,16 @@ def _hist_psum_nulled(config: "BoostingConfig", mesh_present: bool) -> bool:
                                       "voting_parallel"))
 
 
+def _mesh_world_size(mesh: Optional[Mesh]) -> int:
+    """Device count of a fit's mesh (1 with no mesh) — the ONE
+    world-size derivation for both the resume-time comparison and the
+    checkpoint stamp, so the two can never read differently-computed
+    values."""
+    if mesh is None:
+        return 1
+    return int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+
 def _effective_wire_key(config: "BoostingConfig", mesh_present: bool):
     """The histogram-psum wire a fit ACTUALLY uses, as a comparable key:
     ``None`` for the f32 wire (no codec, or :func:`_hist_psum_nulled`),
@@ -1033,6 +1043,23 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                     f"fit requests {cur_cc!r}; resuming would grow the "
                     "remaining trees under different histogram numerics "
                     "— use a fresh checkpoint_dir or keep the codec")
+            # world size is deliberately NOT part of the refusal key: an
+            # elastic gang resize resumes an N-rank checkpoint on M ranks
+            # (rows re-pad and re-shard over the new mesh below; the
+            # histogram psum is a sum over ALL rows, so the partition is
+            # not model state).  The stamped writer size is
+            # informational — a resized resume is recorded, never
+            # refused, as long as the effective wire matches.
+            cur_ws = _mesh_world_size(mesh)
+            saved_ws = saved_pt.get("_fit_world_size")
+            if saved_ws is not None and int(saved_ws) != cur_ws:
+                from ...resilience.faults import get_faults
+                from ...telemetry.flight import record as _flight_rec
+                get_faults().note("gbdt.resize_resume",
+                                  saved=int(saved_ws), current=cur_ws)
+                _flight_rec("resize_resume", trainer="gbdt",
+                            saved_shards=int(saved_ws),
+                            current_shards=cur_ws)
             done = resumed.num_trees // max(resumed.num_class, 1)
             if done >= config.num_iterations:
                 return resumed, []
@@ -1041,11 +1068,13 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
             init_model = resumed
         # stamp THIS fit's effective wire into the config the written
         # checkpoints carry (the guard above reads it back; JSON
-        # round-trips the tuple as a list)
+        # round-trips the tuple as a list), plus the writer's device
+        # count for resize observability
         key = _effective_wire_key(config, mesh is not None)
         config = dataclasses.replace(config, pass_through={
             **config.pass_through,
-            "_codec_wire_key": list(key) if key is not None else None})
+            "_codec_wire_key": list(key) if key is not None else None,
+            "_fit_world_size": _mesh_world_size(mesh)})
     source = X if hasattr(X, "iter_chunks") else None
     if source is not None:
         n, F = source.num_rows, source.num_features

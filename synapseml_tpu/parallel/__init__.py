@@ -6,8 +6,8 @@ from .collectives import (CollectiveTimeout, all_gather, allreduce_fn,
 from .compression import (CollectiveConfig, compressed_psum,
                           compressed_tree_sync, resolve_collective_config)
 from .distributed import ClusterConfig, initialize_cluster, shutdown_cluster
-from .launcher import (ReservedPort, WorkerFailure, find_free_port,
-                       run_on_local_cluster)
+from .launcher import (GangInterrupted, ReservedPort, WorkerFailure,
+                       find_free_port, run_on_local_cluster)
 from .selfcheck import cluster_report
 from .supervisor import GangSupervisor, HeartbeatMonitor
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,

@@ -422,6 +422,58 @@ def unpack_residuals(err, big, leaves, res_leaves):
     return new_res
 
 
+# -- world-size-independent re-sharding (elastic gang resize) ---------------
+#
+# Two pieces of training state are laid out by WORLD SIZE: the per-rank
+# error-feedback residuals (stacked ``(n, *leaf.shape)``) and the
+# sharded-update flat moment stream (padded to an ``n * unit`` multiple).
+# An N-rank checkpoint restoring on M ranks goes through a CANONICAL
+# (world-size-free) form first — gather-to-canonical-then-reshard — so
+# the restored state is a pure function of the checkpoint, identical
+# whichever size reads it.
+
+def canonical_residuals(stacked):
+    """Stacked per-rank EF residuals ``(n, *shape)`` → the canonical
+    ``(*shape,)`` TOTAL carried error.
+
+    The EF recursion is additive in SUM units: each rank transmits
+    ``Q(g_r + e_r)`` and keeps ``e_r' = (g_r + e_r) - Q(g_r + e_r)``, so
+    the quantity the compressed stream owes the true gradient trajectory
+    is ``sum_r e_r`` — the per-rank decomposition is an artifact of who
+    computed what, not training state.  Summation order is the stacked
+    rank order (0..n-1), deterministic on every reader."""
+    return np.asarray(stacked, dtype=np.float32).sum(axis=0)
+
+
+def reshard_residuals(canonical, n: int):
+    """Canonical total error ``(*shape,)`` → ``(n, *shape)`` stacked
+    per-rank residuals: rank 0 carries the whole total, ranks 1.. carry
+    zeros.  Exact (no divide — splitting ``e / n`` would round) and
+    preserves the EF invariant ``sum_r e_r == canonical``; the
+    decomposition re-balances itself within one step (each rank's next
+    error is its own quantization error)."""
+    canonical = np.asarray(canonical, dtype=np.float32)
+    out = np.zeros((int(n),) + canonical.shape, dtype=np.float32)
+    out[0] = canonical
+    return out
+
+
+def reshard_flat_stream(buf, total: int, new_padded: int):
+    """A flat padded per-stream vector (sharded-update moments) laid out
+    for one world size → the same stream re-padded for another: trim to
+    the ``total`` real values (pad positions hold zeros — pad gradients
+    are structurally zero, so their moments never grow), re-pad to
+    ``new_padded``."""
+    buf = np.asarray(buf)
+    if total > buf.shape[0] or new_padded < total:
+        raise ValueError(
+            f"cannot re-lay stream of {buf.shape[0]} values to "
+            f"{new_padded} keeping {total} real values")
+    out = np.zeros((int(new_padded),), dtype=buf.dtype)
+    out[:total] = buf[:total]
+    return out
+
+
 def compressed_tree_sync(tree, axis: Optional[str],
                          config: CollectiveConfig,
                          residuals=None, mean: bool = True,

@@ -153,6 +153,14 @@ class WorkerFailure(RuntimeError):
         self.logs = logs
 
 
+class GangInterrupted(RuntimeError):
+    """The DRIVER tore a healthy gang down on purpose (an elastic
+    ``GangSupervisor.resize()`` request between checkpoints) — not a
+    worker failure: it burns no retry, writes no post-mortem, and the
+    relaunch resumes from the last durable checkpoint exactly like a
+    recovered crash."""
+
+
 class _RankReader(threading.Thread):
     """Per-rank pipe drain: parses heartbeat/result markers on the fly
     and retains only a bounded tail of raw lines.
@@ -245,9 +253,14 @@ def _launch_once(task: str, n_processes: int, devices_per_process: int,
                  term_grace_s: float = 2.0,
                  tail_lines: int = DEFAULT_TAIL_LINES,
                  plane=None, tm_interval_s: float = 0.0,
-                 obs_dir: Optional[str] = None) -> List[Any]:
+                 obs_dir: Optional[str] = None,
+                 interrupt: Optional[threading.Event] = None) -> List[Any]:
     """One rendezvous attempt: spawn, watch (heartbeats + exits + global
-    deadline), collect (or tear down and raise WorkerFailure)."""
+    deadline), collect (or tear down and raise WorkerFailure).
+
+    ``interrupt`` (set by another thread) tears the healthy gang down at
+    the next watch poll and raises :class:`GangInterrupted` — the
+    supervisor's elastic-resize boundary."""
     # fault site: an armed rule here stands in for a failed rendezvous
     # without burning real subprocess spawns in tests
     if get_faults().check("launcher.attempt") is not None:
@@ -308,7 +321,14 @@ def _launch_once(task: str, n_processes: int, devices_per_process: int,
                   if heartbeat_interval_s > 0 else 0.05)
         timed_out: List[int] = []
         hb_causes: Dict[int, str] = {}
+        interrupted = False
         while True:
+            if interrupt is not None and interrupt.is_set():
+                # driver-requested teardown (elastic resize): not a
+                # failure — tear down NOW so the relaunch at the new
+                # size starts from the last durable checkpoint
+                interrupted = True
+                break
             running = []
             failed_exit = False
             for rank, p in enumerate(procs):
@@ -340,12 +360,16 @@ def _launch_once(task: str, n_processes: int, devices_per_process: int,
         # snapshot exits BEFORE tearing down: a rank WE kill must not be
         # blamed with its teardown signal in the cause map
         returncodes = {rank: p.poll() for rank, p in enumerate(procs)}
-        if timed_out or hb_causes or any(
+        if interrupted or timed_out or hb_causes or any(
                 rc not in (0, None) for rc in returncodes.values()):
             _teardown_gang(procs, term_grace_s=term_grace_s)
         for r in readers:
             r.join(timeout=10.0)
         logs = {r.rank: r.text() for r in readers}
+
+        if interrupted:
+            raise GangInterrupted(
+                "gang torn down by driver request (elastic resize)")
 
         stragglers = monitor.stragglers() if monitor is not None else {}
 
@@ -397,8 +421,12 @@ def _launch_once(task: str, n_processes: int, devices_per_process: int,
         reserved.release()
         _teardown_gang(procs, term_grace_s=0.0)
         if monitor is not None:
+            # REMOVE the per-rank series rather than zeroing them: after
+            # an elastic shrink the departed ranks must not linger on
+            # /metrics as phantom age-0 rows (the replica-probe
+            # _Metric.remove() fix, applied to the heartbeat gauge)
             for rank in range(n_processes):
-                g_hb_age.set(0.0, rank=str(rank))
+                g_hb_age.remove(rank=str(rank))
 
 
 def run_on_local_cluster(task: str,
@@ -417,6 +445,11 @@ def run_on_local_cluster(task: str,
                          tail_lines: int = DEFAULT_TAIL_LINES,
                          observability_dir: Optional[str] = None,
                          tm_interval_s: Optional[float] = None,
+                         min_ranks: Optional[int] = None,
+                         shrink_after: int = 2,
+                         resize_cooldown_s: float = 0.0,
+                         max_resizes: int = 8,
+                         capacity_fn=None,
                          ) -> List[Any]:
     """Run ``module:function`` on a real N-process JAX cluster; return the
     per-rank results (rank order).
@@ -446,6 +479,16 @@ def run_on_local_cluster(task: str,
     schema-checked ``postmortem.json`` bundle plus a stitched multi-lane
     ``gang_trace.json``.  ``tm_interval_s`` overrides the export cadence
     (defaults to the heartbeat interval).
+
+    Elastic resize (see :class:`~synapseml_tpu.parallel.supervisor.
+    GangSupervisor`): ``min_ranks < n_processes`` lets the job SHRINK to
+    the largest healthy size ≥ ``min_ranks`` when the same rank keeps
+    failing ``shrink_after`` consecutive attempts (degraded mode, under
+    ``resize_cooldown_s`` + ``max_resizes``), and ``capacity_fn``
+    (→ placeable rank count) grows a degraded gang back toward
+    ``n_processes`` at the next relaunch boundary.  Keep a reference to
+    a :class:`GangSupervisor` instead if you need mid-run
+    ``resize(n)`` requests.
     """
     from .supervisor import GangSupervisor
     return GangSupervisor(
@@ -457,4 +500,6 @@ def run_on_local_cluster(task: str,
         straggler_lag_steps=straggler_lag_steps,
         checkpoint_dir=checkpoint_dir, term_grace_s=term_grace_s,
         tail_lines=tail_lines, observability_dir=observability_dir,
-        tm_interval_s=tm_interval_s).run()
+        tm_interval_s=tm_interval_s, min_ranks=min_ranks,
+        shrink_after=shrink_after, resize_cooldown_s=resize_cooldown_s,
+        max_resizes=max_resizes, capacity_fn=capacity_fn).run()

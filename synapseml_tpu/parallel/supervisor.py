@@ -29,12 +29,23 @@ Horovod-elastic / TPU-pod style (preemption is the common case):
   job.  ``last_recovery_s`` clocks kill-to-resumed-step wall time (the
   ``bench_gang_recovery`` probe's number).
 
+- **Elastic resize** (this PR): a permanently lost rank no longer kills
+  the job.  With ``min_ranks`` set, repeated failure of the same rank
+  shrinks the next relaunch to the largest healthy size ≥ ``min_ranks``
+  (degraded mode, resumed from the last durable checkpoint);
+  :meth:`GangSupervisor.resize` / ``capacity_fn`` grow it back when
+  capacity returns.  Checkpoints are world-size-independent by contract
+  (DL state re-shards on restore; the booster is its own state), so an
+  N-rank checkpoint resumes on M ranks.
+
 Telemetry: ``gang_restarts_total{task}``, ``gang_failures_total{task,
-cause}``, ``rank_heartbeat_age_seconds{rank}`` (updated live by the
-launcher's watch loop).  The fault registry's call log records observed
-beats (``gang.heartbeat``), teardown signals (``gang.teardown``) and
-restarts (``gang.restart``) when ``record_calls`` is set, so chaos tests
-assert the supervision schedule itself.
+cause}``, ``gang_resizes_total{task,direction}``,
+``rank_heartbeat_age_seconds{rank}`` (updated live by the launcher's
+watch loop; departed ranks' series are removed).  The fault registry's
+call log records observed beats (``gang.heartbeat``), teardown signals
+(``gang.teardown``), restarts (``gang.restart``) and resizes
+(``gang.resize``) when ``record_calls`` is set, so chaos tests assert
+the supervision schedule itself.
 """
 
 from __future__ import annotations
@@ -43,7 +54,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from ..resilience import RetryPolicy
 from ..resilience.faults import get_faults
@@ -88,7 +99,8 @@ class HeartbeatMonitor:
                  straggler_lag_steps: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
                  on_observe: Optional[Callable[[int, Optional[int]], None]]
-                 = None):
+                 = None,
+                 ranks: Optional[Iterable[int]] = None):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
         self.interval_s = float(interval_s)
@@ -99,8 +111,16 @@ class HeartbeatMonitor:
         self._on_observe = on_observe
         self._lock = threading.Lock()
         now = clock()
+        # the watched rank set comes from the LIVE attempt: the
+        # supervisor rebuilds the monitor per attempt at its (post-
+        # resize) world size, so verdicts/ages/stragglers never
+        # reference a departed rank.  ``ranks`` additionally lets a
+        # caller watch a sparse/explicit id set (gang ranks always
+        # renumber 0..n-1, so the supervisor itself never needs it).
+        rank_ids = (list(ranks) if ranks is not None
+                    else list(range(n_ranks)))
         self.ranks: Dict[int, RankHealth] = {
-            r: RankHealth(rank=r, started=now) for r in range(n_ranks)}
+            r: RankHealth(rank=r, started=now) for r in rank_ids}
 
     # -- feeding -----------------------------------------------------------
     def observe(self, rank: int, step: Optional[int] = None,
@@ -220,7 +240,8 @@ class HeartbeatMonitor:
 
 class GangSupervisor:
     """Elastic whole-gang launcher: detect fast, tear down, relaunch,
-    resume from the last complete checkpoint.
+    resume from the last complete checkpoint — and, with a resize
+    policy, RESIZE the gang instead of dying with it.
 
     One instance supervises one logical job; :meth:`run` returns the
     per-rank results of the first attempt that completes.  State left on
@@ -231,7 +252,30 @@ class GangSupervisor:
     the elastic-resume cost), ``monitor`` (the live attempt's detector),
     ``plane`` (the attempt's merged cross-rank telemetry when the
     observability plane is on), ``last_postmortem`` (path of the bundle
-    the last dead attempt left in ``observability_dir``).
+    the last dead attempt left in ``observability_dir``),
+    ``world_size`` (the live attempt's rank count — ``n_processes``
+    until a resize), ``resize_history`` (every applied resize).
+
+    Elastic resize (Horovod-elastic shrink-to-survive semantics,
+    arXiv:1802.05799): ``min_ranks < n_processes`` arms the shrink
+    policy — when the SAME rank is blamed for ``shrink_after``
+    consecutive failed attempts (a really-lost TPU host keeps failing
+    however often the gang relaunches at the same size), the next
+    relaunch drops to the largest healthy size ≥ ``min_ranks`` and
+    resumes from the last durable checkpoint in DEGRADED mode.  Growth:
+    :meth:`resize` requests a new size (a running healthy attempt is
+    torn down at the next watch poll and relaunched — resume from the
+    last durable checkpoint makes that a between-checkpoints boundary),
+    and ``capacity_fn`` (→ currently placeable rank count) lets a
+    degraded gang grow back toward ``n_processes`` automatically at the
+    next relaunch boundary.  Resizes ride the caller's
+    :class:`~synapseml_tpu.resilience.RetryPolicy` (failure-driven
+    shrinks consume a retry + its backoff exactly like a same-size
+    relaunch) plus their own brake: ``resize_cooldown_s`` between
+    automatic shrinks and a ``max_resizes`` budget.  Checkpoints must be
+    world-size-independent for this to be sound — GBDT boosters are
+    (the model is the state), DL TrainStates re-shard on restore (see
+    ``docs/api/gang.md`` "Elastic resize").
     """
 
     def __init__(self, task: str, n_processes: int = 2,
@@ -247,7 +291,12 @@ class GangSupervisor:
                  term_grace_s: float = 2.0,
                  tail_lines: int = 400,
                  observability_dir: Optional[str] = None,
-                 tm_interval_s: Optional[float] = None):
+                 tm_interval_s: Optional[float] = None,
+                 min_ranks: Optional[int] = None,
+                 shrink_after: int = 2,
+                 resize_cooldown_s: float = 0.0,
+                 max_resizes: int = 8,
+                 capacity_fn: Optional[Callable[[], int]] = None):
         self.task = task
         self.n_processes = int(n_processes)
         self.devices_per_process = int(devices_per_process)
@@ -276,6 +325,19 @@ class GangSupervisor:
                              if observability_dir else 0.0)
         self.tm_interval_s = float(tm_interval_s)
 
+        # -- elastic resize policy ----------------------------------------
+        if min_ranks is not None:
+            min_ranks = int(min_ranks)
+            if not 1 <= min_ranks <= self.n_processes:
+                raise ValueError(
+                    f"min_ranks={min_ranks}: must be in "
+                    f"[1, n_processes={self.n_processes}]")
+        self.min_ranks = min_ranks
+        self.shrink_after = max(1, int(shrink_after))
+        self.resize_cooldown_s = float(resize_cooldown_s)
+        self.max_resizes = int(max_resizes)
+        self.capacity_fn = capacity_fn
+
         self.restarts = 0
         self.last_failure: Optional[BaseException] = None
         self.last_recovery_s: Optional[float] = None
@@ -284,6 +346,18 @@ class GangSupervisor:
         self.plane: Optional[GangPlane] = None
         #: path of the last written post-mortem bundle, if any
         self.last_postmortem: Optional[str] = None
+        #: rank count of the live (or next) attempt
+        self.world_size = self.n_processes
+        #: applied resizes: [{"attempt", "from", "to", "direction",
+        #: "cause"}] — also lands in post-mortem bundles
+        self.resize_history: List[Dict[str, Any]] = []
+        self._max_world = self.n_processes
+        self._fail_streak: Dict[int, int] = {}
+        self._resizes_done = 0
+        self._last_shrink_at: Optional[float] = None
+        self._resize_lock = threading.Lock()
+        self._requested_size: Optional[int] = None
+        self._interrupt = threading.Event()
 
         reg = get_registry()
         self._c_restarts = reg.counter(
@@ -293,6 +367,10 @@ class GangSupervisor:
             "gang_failures_total",
             "gang attempts that failed, by first-listed cause kind",
             ("task", "cause"))
+        self._c_resizes = reg.counter(
+            "gang_resizes_total",
+            "applied elastic gang resizes, by direction",
+            ("task", "direction"))
 
     def _new_monitor(self, watermark: Optional[int],
                      failed_at: Optional[float]) -> Optional[HeartbeatMonitor]:
@@ -300,6 +378,11 @@ class GangSupervisor:
             return None
 
         recovered = {"done": watermark is None or failed_at is None}
+        # surfaced so run() can close the clock at gang COMPLETION when
+        # no beat ever re-reached the watermark (the dead attempt's best
+        # step was the last step — the relaunch restores it and has
+        # nothing left to replay)
+        self._recovery_pending = recovered
 
         def on_observe(rank: int, step: Optional[int]) -> None:
             # kill-to-resumed-step clock: first beat of the relaunched
@@ -309,8 +392,10 @@ class GangSupervisor:
             recovered["done"] = True
             self.last_recovery_s = time.monotonic() - failed_at
 
+        # rank set from the LIVE attempt (post-resize size), never the
+        # fixed construction-time n_processes
         return HeartbeatMonitor(
-            self.n_processes, self.heartbeat_interval_s,
+            self.world_size, self.heartbeat_interval_s,
             hang_intervals=self.hang_intervals,
             startup_grace_s=self.startup_grace_s,
             straggler_lag_steps=self.straggler_lag_steps,
@@ -341,7 +426,7 @@ class GangSupervisor:
         obs = self.observability_dir
         if not obs or not os.path.isdir(obs):
             return
-        for r in range(self.n_processes):
+        for r in range(self._max_world):
             try:
                 os.unlink(os.path.join(obs, f"flight-rank{r}.json"))
             except OSError:
@@ -366,8 +451,9 @@ class GangSupervisor:
             bundle = write_postmortem(
                 os.path.join(obs, f"postmortem-attempt{attempt}.json"),
                 task=self.task, causes=dict(failure.causes),
-                attempt=attempt, n_ranks=self.n_processes,
-                plane=self.plane, last_steps=last_steps, obs_dir=obs)
+                attempt=attempt, n_ranks=self.world_size,
+                plane=self.plane, last_steps=last_steps, obs_dir=obs,
+                resize_history=list(self.resize_history))
             from ..telemetry.artifact import write_json
             from ..telemetry.gangplane import check_postmortem
             latest = os.path.join(obs, "postmortem.json")
@@ -391,26 +477,162 @@ class GangSupervisor:
             except Exception:
                 pass
 
+    # -- elastic resize ----------------------------------------------------
+    def resize(self, n: int) -> None:
+        """Request the gang run at ``n`` ranks from the next attempt on.
+
+        Thread-safe and callable mid-run: a running healthy attempt is
+        torn down at the next watch poll (SIGTERM → grace → SIGKILL, the
+        normal teardown) and the relaunch at the new size resumes from
+        the last durable checkpoint — so the request lands *between
+        checkpoints*, never inside one.  An explicit request is an
+        operator action: it bypasses the automatic ``max_resizes``
+        budget and the shrink cooldown (but still clamps to ≥ 1)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"resize({n}): need at least one rank")
+        with self._resize_lock:
+            if n == self.world_size:
+                # already there: a no-op request must not tear down a
+                # healthy running gang — it only CANCELS any pending
+                # request for a different size (and its wakeup; the
+                # event is set nowhere else)
+                self._requested_size = None
+                self._interrupt.clear()
+                return
+            # set the wakeup under the SAME lock that consumes the
+            # request: setting it after release races
+            # _plan_before_launch (request consumed, event cleared, THEN
+            # set) into tearing down the next healthy, correctly-sized
+            # attempt for nothing
+            self._requested_size = n
+            self._interrupt.set()
+
+    def _apply_resize(self, attempt: int, new_size: int, cause: str,
+                      automatic: bool) -> None:
+        # the world_size write happens under the SAME lock resize()'s
+        # no-op comparison reads it under — otherwise a request racing
+        # the application of a capacity/failure resize compares against
+        # a stale size and needlessly tears down the next attempt
+        with self._resize_lock:
+            old = self.world_size
+            if new_size == old:
+                return
+            direction = "shrink" if new_size < old else "grow"
+            self.world_size = new_size
+        self._max_world = max(self._max_world, new_size)
+        if automatic:
+            self._resizes_done += 1
+            if direction == "shrink":
+                self._last_shrink_at = time.monotonic()
+        # rank indices renumber 0..new-1 on relaunch: stale streaks
+        # would blame the wrong process
+        self._fail_streak.clear()
+        event = {"attempt": int(attempt), "from": old, "to": new_size,
+                 "direction": direction, "cause": cause}
+        self.resize_history.append(event)
+        self._c_resizes.inc(1, task=self.task, direction=direction)
+        get_faults().note("gang.resize", **event)
+        try:
+            from ..telemetry.flight import record as flight_record
+            flight_record("gang_resize", task=self.task, **event)
+        except Exception:
+            pass
+
+    def _resize_budget_ok(self) -> bool:
+        return self._resizes_done < self.max_resizes
+
+    def _shrink_cooled_down(self) -> bool:
+        """THE cooldown gate for every AUTOMATIC shrink — failure-driven
+        and capacity-driven alike, so a flapping capacity probe cannot
+        sidestep the brake the operator configured."""
+        return (self._last_shrink_at is None
+                or time.monotonic() - self._last_shrink_at
+                >= self.resize_cooldown_s)
+
+    def _plan_after_failure(self, causes: Dict[int, str]) -> Optional[int]:
+        """Shrink-to-survive decision for one failed attempt → target
+        size, or None.  A rank is *persistently* failing once it is
+        blamed (non-advisory cause) in ``shrink_after`` consecutive
+        failed attempts — the permanent-loss signature (a transient
+        crash resumes fine at the same size; a cordoned host fails
+        every relaunch).  Target: largest healthy size ≥ ``min_ranks``.
+        """
+        blamed = {r for r, c in causes.items()
+                  if not str(c).startswith("straggler")}
+        for r in list(self._fail_streak):
+            if r not in blamed:
+                del self._fail_streak[r]
+        for r in blamed:
+            self._fail_streak[r] = self._fail_streak.get(r, 0) + 1
+        if self.min_ranks is None:
+            return None
+        persistent = [r for r in blamed
+                      if self._fail_streak[r] >= self.shrink_after]
+        if not persistent:
+            return None
+        target = max(self.min_ranks, self.world_size - len(persistent))
+        if target >= self.world_size or not self._resize_budget_ok() \
+                or not self._shrink_cooled_down():
+            return None
+        return target
+
+    def _plan_before_launch(self, attempt: int) -> None:
+        """Attempt-boundary resize decisions: consume an explicit
+        :meth:`resize` request, then let ``capacity_fn`` shrink a gang
+        whose capacity left or grow a degraded gang back toward
+        ``n_processes`` when capacity returned."""
+        with self._resize_lock:
+            req = self._requested_size
+            self._requested_size = None
+            # a request set while no attempt ran left the event set;
+            # consuming the request consumes the wakeup too
+            self._interrupt.clear()
+        if req is not None:
+            self._apply_resize(attempt, req, cause="requested",
+                               automatic=False)
+            return
+        if self.capacity_fn is None:
+            return
+        try:
+            cap = int(self.capacity_fn())
+        except Exception:
+            return                      # a flaky probe must not kill the job
+        floor = self.min_ranks if self.min_ranks is not None else 1
+        if cap < self.world_size:
+            target = max(floor, cap)
+            if (target < self.world_size and self._resize_budget_ok()
+                    and self._shrink_cooled_down()):
+                self._apply_resize(attempt, target,
+                                   cause=f"capacity {cap}", automatic=True)
+        elif self.world_size < self.n_processes and cap > self.world_size:
+            target = min(self.n_processes, cap)
+            if self._resize_budget_ok():
+                self._apply_resize(attempt, target,
+                                   cause=f"capacity {cap}", automatic=True)
+
     def run(self) -> List[Any]:
-        """Launch (and relaunch) until a gang completes; per-rank results
-        in rank order, or the LAST attempt's failure when retries
+        """Launch (and relaunch/resize) until a gang completes; per-rank
+        results in rank order (length = the completing attempt's
+        ``world_size``), or the LAST attempt's failure when retries
         exhaust."""
-        from .launcher import WorkerFailure, _launch_once
+        from .launcher import GangInterrupted, WorkerFailure, _launch_once
 
         policy = self.retry_policy
-        attempts = 1 + (policy.max_retries if policy else 0)
+        retries_left = policy.max_retries if policy else 0
         watermark: Optional[int] = None
         failed_at: Optional[float] = None
-        last: Optional[WorkerFailure] = None
-        for attempt in range(attempts):
+        attempt = 0
+        while True:
+            self._plan_before_launch(attempt)
             self.monitor = self._new_monitor(watermark, failed_at)
-            self.plane = (GangPlane(self.n_processes)
+            self.plane = (GangPlane(self.world_size)
                           if (self.tm_interval_s > 0
                               or self.observability_dir) else None)
             self._clear_flight_dumps()
             try:
                 results = _launch_once(
-                    self.task, self.n_processes, self.devices_per_process,
+                    self.task, self.world_size, self.devices_per_process,
                     self.task_args, self.timeout_s, self.env_extra,
                     monitor=self.monitor,
                     heartbeat_interval_s=self.heartbeat_interval_s,
@@ -418,11 +640,38 @@ class GangSupervisor:
                     term_grace_s=self.term_grace_s,
                     tail_lines=self.tail_lines,
                     plane=self.plane, tm_interval_s=self.tm_interval_s,
-                    obs_dir=self.observability_dir)
+                    obs_dir=self.observability_dir,
+                    interrupt=self._interrupt)
+                if (failed_at is not None
+                        and not getattr(self, "_recovery_pending",
+                                        {"done": True})["done"]):
+                    # the relaunched gang completed without ever beating
+                    # a step ≥ watermark (everything durable was already
+                    # done): completion IS the recovery
+                    self.last_recovery_s = time.monotonic() - failed_at
                 self._export_trace()
                 return results
+            except GangInterrupted:
+                # a deliberate resize teardown: no retry burned, no
+                # post-mortem — but the recovery clock starts, so
+                # resize_recovery_seconds covers requested grows too
+                failed_at = time.monotonic()
+                if self.monitor is not None:
+                    step = self.monitor.max_step()
+                    if step is not None and (watermark is None
+                                             or step > watermark):
+                        watermark = step
+                self.restarts += 1
+                self._c_restarts.inc(1, task=self.task)
+                # ``attempt`` is the FAILURE index (postmortem naming)
+                # and does not advance here; ``restart`` is the
+                # monotonic launch counter both restart paths share, so
+                # fault-log consumers can order the timeline
+                get_faults().note("gang.restart", attempt=attempt,
+                                  restart=self.restarts, causes={},
+                                  watermark=watermark, resize=True)
+                continue
             except WorkerFailure as e:
-                last = e
                 self.last_failure = e
                 failed_at = time.monotonic()
                 if self.monitor is not None:
@@ -433,14 +682,21 @@ class GangSupervisor:
                 self._c_failures.inc(1, task=self.task,
                                      cause=self._cause_kind(e.causes))
                 self._write_postmortem(attempt, e)
-                if policy is None or attempt >= attempts - 1 \
+                target = self._plan_after_failure(e.causes)
+                if policy is None or retries_left <= 0 \
                         or not policy.acquire_retry():
                     raise
+                retries_left -= 1
+                if target is not None:
+                    self._apply_resize(attempt, target,
+                                       cause=self._cause_kind(e.causes),
+                                       automatic=True)
                 self.restarts += 1
                 self._c_restarts.inc(1, task=self.task)
                 get_faults().note("gang.restart", attempt=attempt + 1,
+                                  restart=self.restarts,
                                   causes=dict(e.causes),
                                   watermark=watermark)
                 policy.sleep(policy.backoff_s(attempt),
                              site="launcher.backoff")
-        raise last  # pragma: no cover — loop always returns or raises
+                attempt += 1

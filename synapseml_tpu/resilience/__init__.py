@@ -34,7 +34,8 @@ deadline), ``services.base.RemoteServiceTransformer`` (policy, breaker),
 (preemption kill points, resume).
 """
 
-from .breaker import CircuitBreaker, CircuitOpenError, breaker_for
+from .breaker import (CircuitBreaker, CircuitOpenError, breaker_for,
+                      drop_breaker)
 from .faults import (FAULTS_ENV, FAULTS_SEED_ENV, FaultRegistry, FaultRule,
                      PoisonRowError, PreemptionError,
                      ResourceExhaustedError, get_faults)
@@ -54,7 +55,7 @@ _ROWGUARD_NAMES = (
 __all__ = [
     "RetryPolicy", "RetryBudget", "Deadline", "RETRY_STATUSES",
     "parse_retry_after",
-    "CircuitBreaker", "CircuitOpenError", "breaker_for",
+    "CircuitBreaker", "CircuitOpenError", "breaker_for", "drop_breaker",
     "FaultRegistry", "FaultRule", "PreemptionError",
     "ResourceExhaustedError", "PoisonRowError", "get_faults",
     "FAULTS_ENV", "FAULTS_SEED_ENV",

@@ -23,7 +23,8 @@ from typing import Callable, Dict, Optional
 
 from ..telemetry import get_registry
 
-__all__ = ["CircuitBreaker", "CircuitOpenError", "breaker_for"]
+__all__ = ["CircuitBreaker", "CircuitOpenError", "breaker_for",
+           "drop_breaker"]
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 _STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
@@ -61,6 +62,11 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probes = 0
+        #: set by drop_breaker: a caller still holding this object keeps
+        #: a working state machine but stops writing /metrics, so a late
+        #: transition cannot resurrect the removed gauge row (or fight a
+        #: successor breaker re-registered under the same name)
+        self._dropped = False
         reg = get_registry()
         self._g_state = reg.gauge(
             "resilience_breaker_state",
@@ -76,8 +82,9 @@ class CircuitBreaker:
     # -- state machine (all transitions under the lock) --------------------
     def _transition(self, to: str) -> None:
         self._state = to
-        self._g_state.set(_STATE_CODE[to], breaker=self.name)
-        self._c_trans.inc(1, breaker=self.name, to=to)
+        if not self._dropped:
+            self._g_state.set(_STATE_CODE[to], breaker=self.name)
+            self._c_trans.inc(1, breaker=self.name, to=to)
 
     @property
     def state(self) -> str:
@@ -156,3 +163,22 @@ def breaker_for(endpoint: str, failure_threshold: int = 5,
                                half_open_max_probes)
             _breakers[endpoint] = b
         return b
+
+
+def drop_breaker(endpoint: str) -> None:
+    """Forget the process-wide breaker for ``endpoint`` and remove its
+    live state series from /metrics (transition/rejection counters stay —
+    they are history).  For surfaces whose membership shrinks: an
+    elastic routing-table refresh must not leak one breaker (plus a
+    phantom gauge row) per departed replica forever.  No-op when the
+    endpoint has no breaker."""
+    with _breakers_lock:
+        b = _breakers.pop(endpoint, None)
+    if b is not None:
+        # under the breaker's own lock: an in-flight _transition that
+        # already read _dropped == False must finish its gauge write
+        # BEFORE the row is removed, or the removal loses the race and
+        # the phantom row resurrects permanently
+        with b._lock:
+            b._dropped = True
+            b._g_state.remove(breaker=endpoint)

@@ -18,9 +18,14 @@ PR-2 health contract on top: per-replica ``/healthz``+``/readyz`` probes,
 per-replica circuit breakers (``breaker_for``), and a :meth:`~
 ReplicaRouter.route` that round-robins over replicas while skipping
 dead/draining ones and NEVER returning a replica whose breaker is open.
-After an elastic gang restart, :meth:`DistributedServingServer.
+After an elastic gang restart OR RESIZE, :meth:`DistributedServingServer.
 refresh_routing_table` re-gathers the table over the re-formed mesh and
-rebuilds the router.  Health is exported as
+rebuilds the router — a shrink/grow is just a shorter/longer table: the
+round-robin cursor clamps, departed endpoints release their process-wide
+breakers (``drop_breaker``) and probe-gauge rows, and a departing
+replica flushes its in-flight exchanges through :meth:`
+DistributedServingServer.leave` (the PR-2 zero-drop ``drain()`` path),
+so a resize drops nothing.  Health is exported as
 ``serving_replicas_healthy{router}``.
 """
 
@@ -35,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..resilience import breaker_for
+from ..resilience import breaker_for, drop_breaker
 from ..resilience.faults import get_faults
 from ..telemetry import get_registry
 from ..telemetry.flight import record as flight_record
@@ -181,20 +186,40 @@ class ReplicaRouter:
             "0 dead", ("router", "rank"))
         self._apply_table(table)
 
+    def _breaker_key(self, host: str, port: int) -> str:
+        return f"replica:{self.name}:{host}:{port}"
+
     def _apply_table(self, table: List[Tuple[str, int]]) -> None:
-        prev = len(getattr(self, "table", ()))
+        prev_table = list(getattr(self, "table", ()))
+        prev = len(prev_table)
         self.table = [(h, int(p)) for h, p in table]
         # a shrunk table must not leave departed replicas' last verdicts
         # on /metrics as phantom healthy rows
         for r in range(len(self.table), prev):
             self._g_probe.remove(router=self.name, rank=str(r))
+        # a shrunk table must also not leave the round-robin cursor
+        # pointing past the end: route()'s modulo would still be safe,
+        # but the cursor is a ROTATION POSITION and a stale one biases
+        # the first post-resize pick — reset on shrink, keep on grow
+        if self._rr >= len(self.table):
+            self._rr = 0
         # optimistic until probed: a fresh table names live listeners
         self._status = {r: HEALTHY for r in range(len(self.table))}
         self._breakers = {
-            r: breaker_for(f"replica:{self.name}:{h}:{p}",
+            r: breaker_for(self._breaker_key(h, p),
                            failure_threshold=self.failure_threshold,
                            cooldown_s=self.cooldown_s)
             for r, (h, p) in enumerate(self.table)}
+        # departed ENDPOINTS release their process-wide breaker registry
+        # entry (and its state gauge row) — an elastic gang resizing
+        # every few minutes must not accumulate one breaker per address
+        # it ever routed to.  Endpoints still in the table keep their
+        # breaker (and its failure history) across the refresh.
+        live = {self._breaker_key(h, p) for h, p in self.table}
+        for h, p in prev_table:
+            key = self._breaker_key(h, p)
+            if key not in live:
+                drop_breaker(key)
         self._update_gauge()
 
     def _update_gauge(self) -> None:
@@ -261,6 +286,16 @@ class ReplicaRouter:
         breaker refuses the call (open, or half-open past its probe
         budget).  Raises :class:`NoHealthyReplicaError` with the full
         per-rank status map when nothing is routable."""
+        rank, addr, url = self.route_addr(path)
+        return rank, url
+
+    def route_addr(self, path: str = "/") -> Tuple[int, Tuple[str, int],
+                                                   str]:
+        """:meth:`route` plus the routed ``(host, port)`` captured under
+        the same lock — hand that address back to :meth:`report` and the
+        report survives a concurrent :meth:`refresh` renumbering the
+        table (no lossy re-parse of the url, no racy
+        ``router.table[rank]`` read)."""
         with self._lock:
             n = len(self.table)
             start = self._rr
@@ -271,18 +306,35 @@ class ReplicaRouter:
                 if not self._breakers[r].allow():
                     continue
                 self._rr = (r + 1) % n
-                return r, self.url_for(r, path)
+                return r, self.table[r], self.url_for(r, path)
             statuses = {
                 r: (self._status[r] if self._status[r] != HEALTHY
                     else f"breaker {self._breakers[r].state}")
                 for r in range(n)}
         raise NoHealthyReplicaError(statuses)
 
-    def report(self, rank: int, ok: bool) -> None:
+    def report(self, rank: int, ok: bool,
+               addr: Optional[Tuple[str, int]] = None) -> None:
         """Outcome of a routed request — feeds the replica's breaker (a
         breaker fed only by probes would take a whole probe cycle to
-        notice a flapping replica)."""
-        b = self._breakers[rank]
+        notice a flapping replica).
+
+        A report for a rank a concurrent :meth:`refresh` dropped from
+        the table is ignored (never a crash).  Pass ``addr`` — the
+        ``(host, port)`` the request actually went to, recoverable from
+        :meth:`route`'s url — and a report whose rank was RENUMBERED by
+        the refresh (its index now names a different endpoint) is
+        ignored too, instead of poisoning the new occupant's breaker;
+        without ``addr`` an index-only report cannot detect renumbering
+        and is applied to whatever endpoint now holds the index."""
+        with self._lock:
+            if addr is not None and (rank >= len(self.table)
+                                     or self.table[rank] !=
+                                     (addr[0], int(addr[1]))):
+                return
+            b = self._breakers.get(rank)
+        if b is None:
+            return
         if ok:
             b.record_success()
         else:
@@ -291,10 +343,15 @@ class ReplicaRouter:
             self._update_gauge()
 
     def refresh(self, table: List[Tuple[str, int]]) -> None:
-        """Adopt a re-gathered table (after an elastic restart): statuses
-        reset optimistic; breakers persist per endpoint, so a replica
-        that came back on the same address keeps its history until its
-        cooldown admits a probe."""
+        """Adopt a re-gathered table (after an elastic restart or
+        resize): statuses reset optimistic; breakers persist per
+        endpoint still IN the table (a replica that came back on the
+        same address keeps its history until its cooldown admits a
+        probe), departed endpoints release theirs; the round-robin
+        cursor clamps so rotation never starts past the shrunk end.
+        ``route()`` calls racing the refresh either route on the old
+        table (their replica drains, it does not vanish) or the new —
+        never a mix."""
         with self._lock:
             self._apply_table(table)
 
@@ -346,22 +403,44 @@ class DistributedServingServer:
         :meth:`ReplicaRouter.route`)."""
         return self.router.route(path)
 
+    def route_addr(self, path: str = "/") -> Tuple[int, Tuple[str, int],
+                                                   str]:
+        """:meth:`route` plus the routed ``(host, port)`` — pass it back
+        through :meth:`report_result`'s ``addr=`` so the report survives
+        a concurrent table refresh renumbering the ranks (see
+        :meth:`ReplicaRouter.route_addr`)."""
+        return self.router.route_addr(path)
+
     def probe_replicas(self) -> Dict[int, str]:
         return self.router.probe_all()
 
-    def report_result(self, rank: int, ok: bool) -> None:
-        self.router.report(rank, ok)
+    def report_result(self, rank: int, ok: bool,
+                      addr: Optional[Tuple[str, int]] = None) -> None:
+        self.router.report(rank, ok, addr=addr)
 
     def refresh_routing_table(
             self, timeout_s: Optional[float] = None) -> List[Tuple[str, int]]:
         """Re-gather the table over the (re-formed) mesh — call on every
-        process after an elastic restart, collectively — and rebuild the
-        router's view from it."""
+        process after an elastic restart OR resize, collectively — and
+        rebuild the router's view from it.  A resize is absorbed, not
+        special-cased: the gathered table simply has a different length,
+        the router clamps its rotation, departed endpoints release
+        their breakers, and in-flight exchanges against a departing
+        replica finish through its :meth:`leave` drain."""
         lh, lp = self.local.address
         self.routing_table = exchange_routing_table(
             lh, lp, timeout_s=timeout_s or self._gather_timeout_s)
         self.router.refresh(self.routing_table)
         return self.routing_table
+
+    def leave(self, timeout_s: float = 30.0) -> bool:
+        """This replica is departing (elastic shrink): stop admitting —
+        probes flip to ``draining``, so every peer's router skips this
+        rank before the table even refreshes — then flush EVERY accepted
+        in-flight exchange through the PR-2 zero-drop ``drain()`` path
+        and close.  Returns drain()'s verdict (True = nothing was
+        dropped)."""
+        return self.local.drain(timeout_s=timeout_s)
 
     # local-API passthroughs
     def register_api(self, *a, **kw):

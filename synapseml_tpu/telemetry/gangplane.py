@@ -382,11 +382,21 @@ def check_postmortem(obj: Any) -> None:
     if not isinstance(obj, dict):
         raise SchemaError("postmortem bundle must be a JSON object")
     for k in ("task", "verdict", "causes", "ranks", "attempt", "n_ranks",
-              "created_unix"):
+              "world_size", "created_unix"):
         if k not in obj:
             raise SchemaError(f"postmortem bundle missing key {k!r}")
     if not isinstance(obj["causes"], dict):
         raise SchemaError("causes must be a rank → verdict map")
+    if not isinstance(obj["world_size"], int) or obj["world_size"] < 1:
+        raise SchemaError("world_size must be a positive rank count")
+    rh = obj.get("resize_history", [])
+    if not isinstance(rh, list):
+        raise SchemaError("resize_history must be a list of resize events")
+    for ev in rh:
+        if not isinstance(ev, dict) or not {"from", "to",
+                                            "direction"} <= set(ev):
+            raise SchemaError(
+                "resize_history events need from/to/direction keys")
     if not isinstance(obj["ranks"], dict) or not obj["ranks"]:
         raise SchemaError("ranks must be a nonempty rank → state map")
     for r, st in obj["ranks"].items():
@@ -415,13 +425,20 @@ def write_postmortem(path: str, *, task: str, causes: Dict[int, str],
                      last_steps: Optional[Dict[int, Optional[int]]] = None,
                      obs_dir: Optional[str] = None,
                      tail_events: int = 64,
-                     verdict: Optional[str] = None) -> Dict[str, Any]:
+                     verdict: Optional[str] = None,
+                     resize_history: Optional[List[Dict[str, Any]]] = None
+                     ) -> Dict[str, Any]:
     """Gather one dead gang attempt into a schema-checked bundle.
 
     Per rank, the flight tail prefers the on-disk dump a SIGTERMed rank
     left (richer: the whole ring) over the wire tail the driver held —
     unless the wire tail is fresher (higher ``seq``), which is the
-    SIGKILL case where the dump never happened."""
+    SIGKILL case where the dump never happened.
+
+    ``n_ranks`` is the ATTEMPT's world size (post-resize, not the job's
+    launch size) — recorded twice: the legacy ``n_ranks`` key and the
+    explicit ``world_size``; ``resize_history`` carries every elastic
+    resize the supervisor applied before this attempt died."""
     last_steps = dict(last_steps or {})
     ranks: Dict[str, Any] = {}
     for r in range(int(n_ranks)):
@@ -451,6 +468,8 @@ def write_postmortem(path: str, *, task: str, causes: Dict[int, str],
         "causes": {str(r): c for r, c in causes.items()},
         "attempt": int(attempt),
         "n_ranks": int(n_ranks),
+        "world_size": int(n_ranks),
+        "resize_history": list(resize_history or []),
         "last_durable_step": max(known_steps) if known_steps else None,
         "created_unix": time.time(),
         "ranks": ranks,

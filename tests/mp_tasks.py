@@ -139,6 +139,11 @@ def elastic_counter(args):
         if latest is not None:
             state = np.int64(np.asarray(mgr.restore(latest)["state"]))
             start = latest + 1
+            # announce the restored durable position: the supervisor's
+            # recovery clock closes on the first beat re-reaching the
+            # dead attempt's best step, which on resume we already HOLD
+            beat(step=latest)
+    t_loop = time.perf_counter()
     for step in range(start, steps):
         state = np.int64((int(state) * 6364136223846793005 + 1442695040888963407)
                          % (1 << 63))
@@ -149,8 +154,17 @@ def elastic_counter(args):
                                 rank=jax.process_index())
         if step_sleep_s > 0:
             time.sleep(step_sleep_s)
+    loop_s = time.perf_counter() - t_loop
+    # world_size makes the task RESIZE-capable scaffolding: the state
+    # recurrence is world-size-free (f^steps(seed) whatever the gang
+    # shape), so a shrunken/grown relaunch must still produce the
+    # bit-exact fault-free state — and the result reports what size
+    # actually ran (plus loop timing for the degraded-throughput
+    # bench), so resize pins assert the topology too
     return {"rank": jax.process_index(), "state": int(state),
-            "resumed_from": start}
+            "resumed_from": start, "steps_run": steps - start,
+            "loop_s": round(loop_s, 4),
+            "world_size": jax.process_count()}
 
 
 def gbdt_elastic_digest(args):
@@ -179,10 +193,18 @@ def gbdt_elastic_digest(args):
                        checkpoint_dir=ckpt_dir, checkpoint_interval=1)
     text = booster.to_string()
     margins = booster.predict_margin(X[:8])
+    # holdout AUC on a fixed fresh draw: the RESIZE acceptance metric —
+    # a shrunken resume is documented tolerance-close (row repartition
+    # reassociates the histogram psum), where same-size resume pins md5
+    from synapseml_tpu.models.gbdt.metrics import auc as _auc
+    Xh, yh = _binary_data(n=300, f=int(args.get("f", 8)), seed=99)
+    ph = np.asarray(booster.predict_margin(Xh)).ravel()
     return {
         "rank": jax.process_index(),
+        "world_size": jax.process_count(),
         "model_md5": hashlib.md5(text.encode()).hexdigest(),
         "margins": [round(float(m), 6) for m in np.asarray(margins).ravel()],
+        "holdout_auc": round(float(_auc(yh, ph)), 6),
     }
 
 

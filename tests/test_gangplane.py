@@ -360,6 +360,39 @@ class TestPostmortem:
         assert d["ranks"]["1"]["flight_tail"][-1]["kind"] == "checkpoint"
         assert d["ranks"]["1"]["metrics"]["steps_total"]
         assert "rank 1: killed by signal 9" in d["verdict"]
+        # world_size is the attempt's (post-resize) rank count; no
+        # resizes → empty history, both schema-required shapes
+        assert d["world_size"] == 2
+        assert d["resize_history"] == []
+
+    def test_bundle_carries_resize_history(self, tmp_path):
+        path = str(tmp_path / "pm-resize.json")
+        hist = [{"attempt": 1, "from": 4, "to": 3, "direction": "shrink",
+                 "cause": "exit"}]
+        out = write_postmortem(
+            path, task="t", causes={2: "exit -9"}, attempt=2, n_ranks=3,
+            last_steps={}, resize_history=hist)
+        check_postmortem(out)
+        assert out["world_size"] == 3
+        assert out["resize_history"] == hist
+
+    def test_schema_requires_world_size_and_valid_history(self):
+        base = {"task": "t", "verdict": "v", "causes": {}, "attempt": 0,
+                "n_ranks": 1, "created_unix": 0,
+                "ranks": {"0": {"cause": None, "last_step": None,
+                                "flight_tail": [], "metrics": None}}}
+        with pytest.raises(SchemaError, match="world_size"):
+            check_postmortem(dict(base))
+        with pytest.raises(SchemaError, match="world_size"):
+            check_postmortem({**base, "world_size": 0})
+        with pytest.raises(SchemaError, match="resize_history"):
+            check_postmortem({**base, "world_size": 1,
+                              "resize_history": "nope"})
+        with pytest.raises(SchemaError, match="from/to/direction"):
+            check_postmortem({**base, "world_size": 1,
+                              "resize_history": [{"from": 2}]})
+        check_postmortem({**base, "world_size": 1, "resize_history": [
+            {"from": 2, "to": 1, "direction": "shrink"}]})
 
     def test_schema_rejects_torn_bundles(self):
         with pytest.raises(SchemaError):
