@@ -11,11 +11,14 @@ from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
                     causal_lm_loss, init_cache, llama_from_pretrained,
                     rope_frequencies)
+from .slots import AdmitResult, SlotEngine, StepEvent
 from .stage import LLMTransformer
 
 __all__ = [
-    "LLM_LOGICAL_RULES", "CausalAttention", "DecoderBlock", "LLMTransformer",
-    "LlamaConfig", "LlamaModel", "RMSNorm", "apply_rope", "causal_lm_loss",
+    "LLM_LOGICAL_RULES", "AdmitResult", "CausalAttention", "DecoderBlock",
+    "LLMTransformer",
+    "LlamaConfig", "LlamaModel", "RMSNorm", "SlotEngine", "StepEvent",
+    "apply_rope", "causal_lm_loss",
     "cast_params", "finetune_lm", "generate", "generate_speculative",
     "init_cache", "llama_from_pretrained", "make_lm_train_step",
     "quantize_int8",

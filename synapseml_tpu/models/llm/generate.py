@@ -289,7 +289,9 @@ def _generate_spec_jit(model: LlamaModel, variables: Any,
 def spec_unpack(packed, max_new_tokens: int, draft_len: int):
     """Host-side unpack of a ``block=False`` speculative result →
     (tokens (B, max_new_tokens), stats dict) — same stats as the
-    blocking path."""
+    blocking path.  Publishes the acceptance telemetry (see
+    :func:`_record_spec_stats`), so pipelined serving drains report the
+    same metrics as blocking calls."""
     packed = np.asarray(packed)
     out = packed[:, :max_new_tokens]
     acc = packed[:, max_new_tokens].astype(np.float64)
@@ -300,7 +302,28 @@ def spec_unpack(packed, max_new_tokens: int, draft_len: int):
              "accepted": int(acc.sum()),
              "tokens_per_step": tps,
              "acceptance_rate": max(tps - 1.0, 0.0) / max(int(draft_len), 1)}
+    _record_spec_stats(stats)
     return out, stats
+
+
+def _record_spec_stats(stats: dict) -> None:
+    """Export speculative-decode acceptance as process metrics — the
+    number ROADMAP item 3 tracks lived only inside bench.py before;
+    with it on /metrics a serving fleet can watch draft quality decay
+    live (e.g. after a model or tokenizer swap)."""
+    from ...telemetry import get_registry
+    reg = get_registry()
+    reg.counter("llm_spec_accepted_tokens_total",
+                "draft tokens accepted by speculative verification").inc(
+        stats["accepted"])
+    reg.counter("llm_spec_verify_steps_total",
+                "speculative verify forwards executed").inc(stats["steps"])
+    reg.gauge("llm_spec_tokens_per_step",
+              "accepted tokens per verify step (last call)").set(
+        stats["tokens_per_step"])
+    reg.gauge("llm_spec_acceptance_rate",
+              "fraction of drafted tokens accepted (last call)").set(
+        stats["acceptance_rate"])
 
 
 def generate_speculative(model: LlamaModel, variables: Any, prompt_ids,

@@ -179,7 +179,13 @@ def _dense(features, axes, name, dtype, quant: str = "none"):
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> List[Dict]:
-    """Per-layer KV cache pytree."""
+    """Per-layer KV cache pytree.
+
+    ``batch`` doubles as the SLOT axis for continuous-batching serving
+    (:mod:`synapseml_tpu.models.llm.slots`): each row is one independent
+    sequence slot, written at its own per-slot offset via the vector
+    ``cache_index`` path and protected by ``slot_mask`` so retired slots
+    keep their K/V intact as prefix-cache source material."""
     shape = (batch, max_len, cfg.num_kv_heads, cfg.d_head)
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
@@ -191,7 +197,8 @@ class CausalAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Dict],
-                 cache_index: Optional[jnp.ndarray]):
+                 cache_index: Optional[jnp.ndarray],
+                 slot_mask: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         B, S, _ = x.shape
         H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.d_head
@@ -215,20 +222,31 @@ class CausalAttention(nn.Module):
                     cache["v"], v, (0, cache_index, 0, 0))
             else:
                 # PER-SEQUENCE write offsets (B,) — speculative decoding
-                # accepts a different number of tokens per sequence, so
-                # each row writes its S-token block at its own position.
-                # One-hot matmul scatter: exact (single nonzero per sum)
-                # and a few MFLOPs at decode shapes
-                T = cache["k"].shape[1]
+                # accepts a different number of tokens per sequence and
+                # the slotted serving cache advances every slot at its
+                # own position, so each row writes its S-token block at
+                # its own offset.  Batched ``.at[].set`` scatter: exact
+                # (one writer per position) and updatable IN PLACE when
+                # the caller donates the cache — the earlier one-hot
+                # matmul formulation materialized the ENTIRE cache every
+                # step, which made decode cost scale with slots x
+                # max_len instead of with the tokens actually written
                 wpos = cache_index[:, None] + jnp.arange(S)[None, :]
-                oh = (wpos[:, :, None]
-                      == jnp.arange(T)[None, None, :])          # (B, S, T)
-                keep = (~jnp.any(oh, axis=1)).astype(cfg.dtype)  # (B, T)
-                ohd = oh.astype(cfg.dtype)
-                k_all = (cache["k"] * keep[..., None, None]
-                         + jnp.einsum("bst,bskd->btkd", ohd, k))
-                v_all = (cache["v"] * keep[..., None, None]
-                         + jnp.einsum("bst,bskd->btkd", ohd, v))
+                bidx = jnp.arange(B)[:, None]
+                k_w, v_w = k, v
+                if slot_mask is not None:
+                    # ACTIVE-SLOT gate (continuous-batching serving): a
+                    # row whose slot is inactive must not write — a
+                    # retired slot's K/V is live prefix-cache material,
+                    # and one junk write per step would silently corrupt
+                    # it.  Masking the PAYLOAD (write back the old
+                    # values, gathered (B, S) rows only) keeps the
+                    # scatter shape — and its in-place update — intact.
+                    m = slot_mask.reshape(B, 1, 1, 1)
+                    k_w = jnp.where(m, k, cache["k"][bidx, wpos])
+                    v_w = jnp.where(m, v, cache["v"][bidx, wpos])
+                k_all = cache["k"].at[bidx, wpos].set(k_w)
+                v_all = cache["v"].at[bidx, wpos].set(v_w)
             new_cache = {"k": k_all, "v": v_all}
             k_att, v_att = k_all, v_all
             T = k_all.shape[1]
@@ -259,11 +277,11 @@ class DecoderBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache, cache_index):
+    def __call__(self, x, positions, cache, cache_index, slot_mask=None):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_attn")(x)
         a, new_cache = CausalAttention(cfg, name="attn")(
-            h, positions, cache, cache_index)
+            h, positions, cache, cache_index, slot_mask)
         x = x + a
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_mlp")(x)
         gate = _dense(cfg.d_ff, ("embed", "mlp"), "gate_proj", cfg.dtype,
@@ -283,7 +301,8 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None,
-                 cache_index=None, deterministic: bool = True):
+                 cache_index=None, deterministic: bool = True,
+                 slot_mask: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         B, S = input_ids.shape
         if positions is None:
@@ -302,7 +321,7 @@ class LlamaModel(nn.Module):
         for i in range(cfg.num_layers):
             layer_cache = cache[i] if cache is not None else None
             x, nc = DecoderBlock(cfg, name=f"layer_{i}")(
-                x, positions, layer_cache, cache_index)
+                x, positions, layer_cache, cache_index, slot_mask)
             new_caches.append(nc)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_final")(x)
         if cfg.tie_embeddings:

@@ -1,0 +1,478 @@
+"""Slotted KV cache + continuous-batching decode engine.
+
+The Orca-style in-flight batching / vLLM-style paged-KV pattern (Yu et
+al., OSDI'22; Kwon et al., SOSP'23) adapted to XLA's static-shape world:
+instead of dynamically-sized pages, the cache is a FIXED tensor of
+``n_slots`` independent rows — ``(n_slots, max_len, kv_heads, d_head)``
+per layer — and one jitted decode step advances every ACTIVE slot by one
+token.  Admission and eviction happen between steps on the host, so the
+scheduler serves heterogeneous sequence lengths with exactly three
+compiled programs: one decode step, one prefill per prompt-length
+bucket, and one prefix copy.
+
+Mechanics:
+
+- **decode step** — the per-sequence vector ``cache_index`` path of
+  :class:`~synapseml_tpu.models.llm.model.CausalAttention` writes each
+  slot's K/V at its own offset, the causal mask (``key_pos <= qpos``)
+  confines each slot to its own prefix, and ``slot_mask`` gates writes
+  so inactive slots' rows stay untouched (they are live prefix-cache
+  material).
+- **prefill-into-slot** — the prompt is padded to a power-of-two bucket
+  (bounded compile count), its K/V lands in ONE slot row (sliced out,
+  filled batch-1, written back), and the true-last-token logits come
+  back for the first sampled token.  ``start > 0`` resumes a prefill
+  after a prefix copy.
+- **prefix reuse** — prompts are indexed by a hash of their first
+  ``min_prefix`` tokens; on admit the engine finds the slot (retired or
+  active) with the longest common prefix, verifies it token-by-token
+  (hash collisions can't corrupt output), copies that K/V span into the
+  new slot, and prefills only the tail.  Reuse is capped at
+  ``len(prompt) - 1`` so the prefill always produces next-token logits.
+- **retirement** — EOS or the per-request token budget frees the slot;
+  its K/V and token buffer persist as prefix-cache until the slot is
+  reclaimed (least-recently-retired first).
+
+Junk-write safety: padded prefill rows and pre-copy leftovers only ever
+land at positions strictly beyond a slot's current length; decode writes
+position ``q`` BEFORE attending ``<= q``, so every attendable key was
+written by the slot's current occupant.
+
+Greedy decode through this engine is token-exact with the dense-cache
+:func:`~synapseml_tpu.models.llm.generate.generate` path (pinned in
+tier-1), so continuous batching is a pure scheduling win, not an
+approximation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...telemetry import get_registry
+from .generate import sample_logits
+from .model import LlamaModel, init_cache
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnums=(2,))
+def _prefill_slot_jit(model: LlamaModel, variables: Any, cache: Any,
+                      tokens: jnp.ndarray, plen: jnp.ndarray,
+                      slot: jnp.ndarray, start: jnp.ndarray):
+    """Prefill ``plen`` real tokens (``tokens`` is padded to a static
+    bucket length) into row ``slot`` starting at position ``start``.
+    Returns ``(new_cache, last_logits (V,) f32)`` where ``last_logits``
+    is the row for the prompt's true last token."""
+    pb = tokens.shape[0]
+    row = jax.tree.map(
+        lambda c: lax.dynamic_slice_in_dim(c, slot, 1, axis=0), cache)
+    positions = (start + jnp.arange(pb))[None, :]
+    logits, row = model.apply(variables, tokens[None, :],
+                              positions=positions, cache=row,
+                              cache_index=start)
+    new_cache = jax.tree.map(
+        lambda c, r: lax.dynamic_update_slice_in_dim(c, r, slot, axis=0),
+        cache, row)
+    # one-hot extraction: plen is traced, a dynamic gather would be the
+    # TPU pathology (see generate._ngram_draft)
+    last = jnp.sum(jnp.where((jnp.arange(pb) == plen - 1)[:, None],
+                             logits[0], 0.0), axis=0)
+    return new_cache, last
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "model", "temperature", "top_k", "top_p"), donate_argnums=(2,))
+def _decode_step_jit(model: LlamaModel, variables: Any, cache: Any,
+                     tokens: jnp.ndarray, lengths: jnp.ndarray,
+                     active: jnp.ndarray, key: jnp.ndarray,
+                     temperature: float, top_k: int, top_p: float):
+    """One decode step for every slot: feed each slot's pending token at
+    its own position (vector ``cache_index``), sample the next.  Inactive
+    slots compute a throwaway row and write nothing (``slot_mask``)."""
+    positions = (lengths - 1)[:, None]
+    logits, cache = model.apply(variables, tokens[:, None],
+                                positions=positions, cache=cache,
+                                cache_index=lengths - 1, slot_mask=active)
+    key, sub = jax.random.split(key)
+    nxt = sample_logits(logits[:, 0], sub, temperature, top_k, top_p)
+    return cache, nxt, key
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_prefix_jit(cache: Any, src: jnp.ndarray, dst: jnp.ndarray,
+                     length: jnp.ndarray):
+    """Copy K/V positions ``[0, length)`` of slot ``src`` into slot
+    ``dst`` (the longest-common-prefix reuse transfer)."""
+    def cp(c):
+        row = lax.dynamic_slice_in_dim(c, src, 1, axis=0)
+        old = lax.dynamic_slice_in_dim(c, dst, 1, axis=0)
+        m = (jnp.arange(c.shape[1]) < length)[None, :, None, None]
+        return lax.dynamic_update_slice_in_dim(
+            c, jnp.where(m, row, old), dst, axis=0)
+    return jax.tree.map(cp, cache)
+
+
+@dataclasses.dataclass
+class AdmitResult:
+    """What :meth:`SlotEngine.admit` hands back: the slot, the FIRST
+    generated token (prefill produces it immediately — this is the
+    time-to-first-token moment), whether the sequence already finished
+    (eos on token one / budget of one), how many prompt tokens were
+    served from a reused prefix, and the prefill's last-token logits
+    (f32 host copy — the prefix-reuse exactness surface)."""
+    slot: int
+    token: int
+    finished: bool
+    reused_tokens: int
+    logits: np.ndarray
+
+
+@dataclasses.dataclass
+class StepEvent:
+    """One slot's outcome of a decode step."""
+    slot: int
+    token: int
+    finished: bool
+    reason: Optional[str] = None      # "eos" | "length" when finished
+
+
+class SlotEngine:
+    """Continuous-batching decode engine over a slotted KV cache.
+
+    Single-threaded by contract: one serving loop (or bench driver) owns
+    the engine and interleaves :meth:`admit` / :meth:`step` freely — a
+    sequence admitted mid-flight decodes next to longer-running
+    neighbors in the same jitted step.  Greedy output is token-exact
+    with the dense-cache ``generate`` path.
+    """
+
+    def __init__(self, model: LlamaModel, variables: Any,
+                 n_slots: int = 16, max_len: Optional[int] = None, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_id: Optional[int] = None,
+                 pad_id: int = 0, min_prefix: int = 8,
+                 min_bucket: int = 8, seed: int = 0, name: str = "llm"):
+        self.model = model
+        self.variables = variables
+        self.cfg = model.cfg
+        self.n_slots = int(n_slots)
+        self.max_len = int(max_len or self.cfg.max_len)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_id = eos_id
+        self.pad_id = int(pad_id)
+        self.min_prefix = max(1, int(min_prefix))
+        self.name = name
+        self._key = jax.random.PRNGKey(seed)
+        self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
+        # prompt-length buckets: powers of two, so the prefill compiles
+        # O(log max_len) programs however ragged the traffic
+        buckets = []
+        b = max(1, int(min_bucket))
+        while b < self.max_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_len)
+        self._buckets = tuple(buckets)
+        # host-side slot state (one serving loop owns these, no locks)
+        n = self.n_slots
+        self.ctx = np.zeros((n, self.max_len), np.int32)   # incl. pending tok
+        self.lengths = np.zeros(n, np.int64)               # tokens in ctx
+        self.active = np.zeros(n, bool)
+        self.kv_len = np.zeros(n, np.int64)                # valid K/V rows
+        self._retired_at = np.full(n, -np.inf)             # reclaim recency
+        self._max_new = np.zeros(n, np.int64)
+        self._generated = np.zeros(n, np.int64)
+        # hashed prefix index: first-min_prefix-tokens hash -> slots
+        self._prefix_index: Dict[int, Set[int]] = {}
+        self._slot_hash: List[Optional[int]] = [None] * n
+        reg = get_registry()
+        self._m_admit = reg.counter(
+            "llm_admissions_total", "sequences admitted into a slot",
+            ("engine",))
+        self._m_evict = reg.counter(
+            "llm_evictions_total", "sequences retired from a slot",
+            ("engine", "reason"))
+        self._m_tokens = reg.counter(
+            "llm_engine_tokens_total", "tokens generated by the engine",
+            ("engine",))
+        self._m_reuse = reg.counter(
+            "llm_prefix_reuse_total", "admissions served a reused prefix",
+            ("engine",))
+        self._m_reuse_tok = reg.counter(
+            "llm_prefix_tokens_reused_total",
+            "prompt tokens copied from a cached prefix instead of "
+            "prefilled", ("engine",))
+        self._m_occ = reg.gauge(
+            "llm_slot_occupancy", "active slots / total slots", ("engine",))
+        self.admissions = 0
+        self.evictions = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
+        self.tokens_generated = 0
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def active_count(self) -> int:
+        return int(self.active.sum())
+
+    @property
+    def free_slot_count(self) -> int:
+        return self.n_slots - self.active_count
+
+    def min_remaining_tokens(self) -> Optional[int]:
+        """Smallest remaining token budget across active slots — the
+        soonest a slot can free up (the SLO-projection numerator).  None
+        when no slot is active."""
+        if not self.active.any():
+            return None
+        rem = (self._max_new - self._generated)[self.active]
+        return int(rem.min())
+
+    # -- prefix reuse ------------------------------------------------------
+    def _prefix_key(self, ids: np.ndarray) -> Optional[int]:
+        if len(ids) < self.min_prefix:
+            return None
+        return hash(ids[:self.min_prefix].tobytes())
+
+    def _register_prefix(self, slot: int, ids: np.ndarray) -> None:
+        self._unregister_prefix(slot)
+        key = self._prefix_key(ids)
+        if key is not None:
+            self._prefix_index.setdefault(key, set()).add(slot)
+            self._slot_hash[slot] = key
+
+    def _unregister_prefix(self, slot: int) -> None:
+        key = self._slot_hash[slot]
+        if key is not None:
+            slots = self._prefix_index.get(key)
+            if slots is not None:
+                slots.discard(slot)
+                if not slots:
+                    self._prefix_index.pop(key, None)
+            self._slot_hash[slot] = None
+
+    def _best_prefix(self, prompt: np.ndarray,
+                     dst: int) -> Tuple[Optional[int], int]:
+        """Longest common prefix between ``prompt`` and any indexed
+        slot's context (hash-filtered candidates, then exact token
+        comparison — a collision can never smuggle wrong K/V).  Reuse is
+        capped at ``len(prompt) - 1``: the prefill must always run at
+        least one token to produce next-token logits.
+
+        ``dst`` itself is a valid source — the multi-turn sweet spot
+        where the reclaimed slot already holds the conversation's
+        earlier turns: the K/V is already in place, so the admit skips
+        the copy and just prefills the tail (``dst`` wins ties for
+        that reason).  The returned lcp is additionally clamped so the
+        tail's PADDED prefill bucket fits inside ``max_len`` — without
+        the clamp a long reuse pushes ``start + bucket`` past the cache
+        end and ``dynamic_update_slice`` silently CLAMPS the write
+        start, corrupting the reused prefix K/V."""
+        key = self._prefix_key(prompt)
+        if key is None:
+            return None, 0
+        best_slot, best_lcp = None, 0
+        for s in self._prefix_index.get(key, ()):
+            m = int(min(self.kv_len[s], len(prompt) - 1))
+            if m < self.min_prefix:
+                continue
+            neq = self.ctx[s, :m] != prompt[:m]
+            lcp = m if not neq.any() else int(np.argmax(neq))
+            if lcp >= self.min_prefix and (
+                    lcp > best_lcp or (lcp == best_lcp and s == dst)):
+                best_slot, best_lcp = s, lcp
+        lcp = best_lcp
+        while lcp >= self.min_prefix \
+                and lcp + self._bucket(len(prompt) - lcp) > self.max_len:
+            # shrink until the padded tail fits; terminates — lcp
+            # strictly decreases (the violated bound implies
+            # lcp > max_len - bucket)
+            lcp = self.max_len - self._bucket(len(prompt) - lcp)
+        if lcp < self.min_prefix:
+            return None, 0
+        return best_slot, lcp
+
+    # -- admission ---------------------------------------------------------
+    def _pick_slot(self) -> Optional[int]:
+        free = np.flatnonzero(~self.active)
+        if len(free) == 0:
+            return None
+        # least-recently-retired first: the freshest retired caches stay
+        # resident longest, which is what multi-turn prefix reuse wants
+        return int(free[np.argmin(self._retired_at[free])])
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _sample_host(self, logits: np.ndarray) -> int:
+        if self.temperature <= 0.0:
+            return int(np.argmax(logits))
+        self._key, sub = jax.random.split(self._key)
+        return int(sample_logits(jnp.asarray(logits)[None, :], sub,
+                                 self.temperature, self.top_k, self.top_p)[0])
+
+    def admit(self, prompt_ids, max_new_tokens: int) -> Optional[AdmitResult]:
+        """Admit one sequence into a free slot (prefill + first token).
+        Returns None when every slot is busy — the caller queues or
+        sheds.  Raises ``ValueError`` for a prompt that cannot fit."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # room for prompt + every generated token incl. the final
+        # sampled-but-never-fed one
+        if len(prompt) + max_new + 1 > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)} tokens) + max_new_tokens "
+                f"({max_new}) exceeds the engine's max_len "
+                f"({self.max_len})")
+        slot = self._pick_slot()
+        if slot is None:
+            return None
+        src, lcp = self._best_prefix(prompt, slot)
+        if src is not None and lcp > 0:
+            if src != slot:
+                self.cache = _copy_prefix_jit(self.cache, src, slot, lcp)
+            # src == slot: in-place resume — the reclaimed slot already
+            # holds this conversation's prefix K/V, no copy needed
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += lcp
+            self._m_reuse.inc(1, engine=self.name)
+            self._m_reuse_tok.inc(lcp, engine=self.name)
+        else:
+            lcp = 0
+        tail = prompt[lcp:]
+        pb = self._bucket(len(tail))
+        padded = np.full(pb, self.pad_id, np.int32)
+        padded[:len(tail)] = tail
+        self.cache, last = _prefill_slot_jit(
+            self.model, self.variables, self.cache, jnp.asarray(padded),
+            len(tail), slot, lcp)
+        logits = np.asarray(last, np.float32)
+        tok = self._sample_host(logits)
+        plen = len(prompt)
+        self.ctx[slot, :plen] = prompt
+        self.ctx[slot, plen] = tok
+        self.lengths[slot] = plen + 1
+        self.kv_len[slot] = plen
+        self.active[slot] = True
+        self._max_new[slot] = max_new
+        self._generated[slot] = 1
+        self._register_prefix(slot, prompt)
+        self.admissions += 1
+        self._m_admit.inc(1, engine=self.name)
+        self.tokens_generated += 1
+        self._m_tokens.inc(1, engine=self.name)
+        finished, reason = self._finish_reason(slot, tok)
+        if finished:
+            self._retire(slot, reason)
+        self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
+        return AdmitResult(slot, tok, finished, lcp, logits)
+
+    # -- stepping ----------------------------------------------------------
+    def _finish_reason(self, slot: int,
+                       tok: int) -> Tuple[bool, Optional[str]]:
+        if self.eos_id is not None and tok == self.eos_id:
+            return True, "eos"
+        if self._generated[slot] >= self._max_new[slot]:
+            return True, "length"
+        return False, None
+
+    def _retire(self, slot: int, reason: str) -> None:
+        self.active[slot] = False
+        self._retired_at[slot] = time.monotonic()
+        self.evictions += 1
+        self._m_evict.inc(1, engine=self.name, reason=reason)
+
+    def cancel(self, slot: int) -> None:
+        """Retire ``slot`` early (client gone / reply window expired) —
+        frees the slot next step; its K/V stays as prefix material."""
+        if self.active[slot]:
+            self._retire(slot, "cancelled")
+            self._m_occ.set(self.active_count / self.n_slots,
+                            engine=self.name)
+
+    def reset(self) -> None:
+        """Recover from a failed jitted call.  The decode/prefill
+        programs DONATE the cache buffers, so an exception raised
+        mid-call can leave ``self.cache`` pointing at deleted arrays —
+        every later admit/step would fail forever.  Rebuild the cache
+        and clear every slot (active sequences are lost — the serving
+        loop answers their 500s and calls this)."""
+        for slot in np.flatnonzero(self.active):
+            self._retire(int(slot), "reset")
+        self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
+        # all cached K/V died with the old buffers: nothing is a valid
+        # prefix source anymore
+        self.kv_len[:] = 0
+        self.lengths[:] = 0
+        self._prefix_index.clear()
+        self._slot_hash = [None] * self.n_slots
+        self._m_occ.set(0.0, engine=self.name)
+
+    def step(self) -> List[StepEvent]:
+        """One decode step across every active slot.  Returns the
+        per-slot events (token + retirement verdicts); empty when no
+        slot is active."""
+        if not self.active.any():
+            return []
+        idx = np.arange(self.n_slots)
+        lengths = np.where(self.active, self.lengths, 1)
+        tokens = np.where(self.active,
+                          self.ctx[idx, np.maximum(self.lengths - 1, 0)],
+                          self.pad_id).astype(np.int32)
+        self.cache, nxt, self._key = _decode_step_jit(
+            self.model, self.variables, self.cache, jnp.asarray(tokens),
+            jnp.asarray(lengths.astype(np.int32)), jnp.asarray(self.active),
+            self._key, self.temperature, self.top_k, self.top_p)
+        nxt = np.asarray(nxt)
+        events: List[StepEvent] = []
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            tok = int(nxt[slot])
+            ln = int(self.lengths[slot])
+            self.ctx[slot, ln] = tok
+            self.lengths[slot] = ln + 1
+            self.kv_len[slot] = ln        # the fed token's K/V just landed
+            self._generated[slot] += 1
+            self.tokens_generated += 1
+            finished, reason = self._finish_reason(slot, tok)
+            if finished:
+                self._retire(slot, reason)
+            events.append(StepEvent(slot, tok, finished, reason))
+        self._m_tokens.inc(len(events), engine=self.name)
+        self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
+        return events
+
+    # -- output ------------------------------------------------------------
+    def generated_ids(self, slot: int) -> np.ndarray:
+        """The tokens generated so far in ``slot`` (prompt excluded)."""
+        start = int(self.lengths[slot] - self._generated[slot])
+        return self.ctx[slot, start:int(self.lengths[slot])].copy()
+
+    def run_to_completion(self, max_steps: Optional[int] = None
+                          ) -> Dict[int, np.ndarray]:
+        """Drive :meth:`step` until every slot retires (static-batch
+        semantics / test harness).  Returns {slot: generated ids}."""
+        slots = [int(s) for s in np.flatnonzero(self.active)]
+        steps = 0
+        while self.active.any():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return {s: self.generated_ids(s) for s in slots}

@@ -4,10 +4,12 @@ from .continuous import ContinuousClient
 from .distributed import (DistributedServingServer, NoHealthyReplicaError,
                           ReplicaRouter, exchange_routing_table,
                           probe_replica)
+from .llm import LLMServer
 from .server import (ApiHandle, MultiPipelineServer, PipelineServer,
                      ServingReply, ServingRequest, ServingServer)
 
 __all__ = ["ApiHandle", "ContinuousClient", "DistributedServingServer",
+           "LLMServer",
            "MultiPipelineServer", "NoHealthyReplicaError", "PipelineServer",
            "ReplicaRouter", "ServingReply", "ServingRequest",
            "ServingServer", "exchange_routing_table", "probe_replica"]

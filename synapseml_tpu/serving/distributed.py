@@ -36,6 +36,7 @@ import struct
 import threading
 import urllib.error
 import urllib.request
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -166,13 +167,19 @@ class ReplicaRouter:
 
     def __init__(self, table: List[Tuple[str, int]], name: str = "serving",
                  failure_threshold: int = 3, cooldown_s: float = 5.0,
-                 probe_timeout_s: float = 1.0):
+                 probe_timeout_s: float = 1.0,
+                 session_cache_size: int = 4096):
         self.name = name
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self._lock = threading.Lock()
         self._rr = 0
+        #: session key -> (host, port) — keyed by ADDRESS, not rank, so
+        #: an elastic resize renumbering the table cannot silently remap
+        #: a session onto a stranger's prefix cache.  Bounded LRU.
+        self._session_cap = int(session_cache_size)
+        self._sessions: "OrderedDict[str, Tuple[str, int]]" = OrderedDict()
         self._g_healthy = get_registry().gauge(
             "serving_replicas_healthy",
             "replicas currently probed healthy with a non-open breaker",
@@ -220,6 +227,14 @@ class ReplicaRouter:
             key = self._breaker_key(h, p)
             if key not in live:
                 drop_breaker(key)
+        # address -> rank for session-affinity lookups; sessions pinned
+        # to a DEPARTED address fall back cleanly to round-robin (and
+        # re-pin) on their next route — a resize loses the prefix cache
+        # either way, never the request
+        self._addr_rank = {addr: r for r, addr in enumerate(self.table)}
+        for key in [s for s, addr in self._sessions.items()
+                    if addr not in self._addr_rank]:
+            del self._sessions[key]
         self._update_gauge()
 
     def _update_gauge(self) -> None:
@@ -279,18 +294,30 @@ class ReplicaRouter:
         path = path.rstrip("/") or "/"
         return f"http://{h}:{p}{'' if path == '/' else path}"
 
-    def route(self, path: str = "/") -> Tuple[int, str]:
+    def route(self, path: str = "/",
+              session: Optional[str] = None) -> Tuple[int, str]:
         """Next routable replica (round-robin) → ``(rank, url)``.
 
         Skips replicas probed dead or draining and replicas whose
         breaker refuses the call (open, or half-open past its probe
         budget).  Raises :class:`NoHealthyReplicaError` with the full
-        per-rank status map when nothing is routable."""
-        rank, addr, url = self.route_addr(path)
+        per-rank status map when nothing is routable.
+
+        ``session`` pins SESSION AFFINITY: repeated routes for the same
+        key land on the same replica while it stays routable — a
+        multi-turn conversation keeps hitting the replica whose slotted
+        KV cache still holds its prefix, so the follow-up turn's prompt
+        prefills only its new tail.  When the pinned replica becomes
+        unroutable (dead, draining, breaker-open, or dropped by an
+        elastic resize), the session falls back to round-robin and
+        RE-PINS to the replica it gets — a cold prefill, never a
+        failure."""
+        rank, addr, url = self.route_addr(path, session=session)
         return rank, url
 
-    def route_addr(self, path: str = "/") -> Tuple[int, Tuple[str, int],
-                                                   str]:
+    def route_addr(self, path: str = "/",
+                   session: Optional[str] = None
+                   ) -> Tuple[int, Tuple[str, int], str]:
         """:meth:`route` plus the routed ``(host, port)`` captured under
         the same lock — hand that address back to :meth:`report` and the
         report survives a concurrent :meth:`refresh` renumbering the
@@ -298,6 +325,17 @@ class ReplicaRouter:
         ``router.table[rank]`` read)."""
         with self._lock:
             n = len(self.table)
+            if session is not None:
+                addr = self._sessions.get(session)
+                if addr is not None:
+                    r = self._addr_rank.get(addr)
+                    if (r is not None and self._status[r] == HEALTHY
+                            and self._breakers[r].allow()):
+                        # affinity hit: round-robin cursor untouched —
+                        # pinned traffic must not skew the rotation the
+                        # unpinned traffic balances on
+                        self._sessions.move_to_end(session)
+                        return r, addr, self.url_for(r, path)
             start = self._rr
             for i in range(n):
                 r = (start + i) % n
@@ -306,6 +344,11 @@ class ReplicaRouter:
                 if not self._breakers[r].allow():
                     continue
                 self._rr = (r + 1) % n
+                if session is not None:
+                    self._sessions[session] = self.table[r]
+                    self._sessions.move_to_end(session)
+                    while len(self._sessions) > self._session_cap:
+                        self._sessions.popitem(last=False)
                 return r, self.table[r], self.url_for(r, path)
             statuses = {
                 r: (self._status[r] if self._status[r] != HEALTHY
@@ -398,18 +441,21 @@ class DistributedServingServer:
         return f"http://{h}:{p}{'' if path == '/' else path}"
 
     # -- failover ----------------------------------------------------------
-    def route(self, path: str = "/") -> Tuple[int, str]:
-        """Next healthy replica for a request (see
-        :meth:`ReplicaRouter.route`)."""
-        return self.router.route(path)
+    def route(self, path: str = "/",
+              session: Optional[str] = None) -> Tuple[int, str]:
+        """Next healthy replica for a request; ``session`` pins
+        multi-turn requests to the replica holding their prefix cache
+        (see :meth:`ReplicaRouter.route`)."""
+        return self.router.route(path, session=session)
 
-    def route_addr(self, path: str = "/") -> Tuple[int, Tuple[str, int],
-                                                   str]:
+    def route_addr(self, path: str = "/",
+                   session: Optional[str] = None
+                   ) -> Tuple[int, Tuple[str, int], str]:
         """:meth:`route` plus the routed ``(host, port)`` — pass it back
         through :meth:`report_result`'s ``addr=`` so the report survives
         a concurrent table refresh renumbering the ranks (see
         :meth:`ReplicaRouter.route_addr`)."""
-        return self.router.route_addr(path)
+        return self.router.route_addr(path, session=session)
 
     def probe_replicas(self) -> Dict[int, str]:
         return self.router.probe_all()

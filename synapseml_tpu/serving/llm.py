@@ -1,0 +1,116 @@
+"""Continuous-batching LLM serving: one listener + slotted decode loop.
+
+:class:`LLMServer` is the LLM-shaped sibling of
+:class:`~synapseml_tpu.serving.server.PipelineServer`: it wires a
+:class:`~synapseml_tpu.serving.server.ServingServer` to a
+:class:`~synapseml_tpu.models.llm.SlotEngine` through the
+:class:`~synapseml_tpu.serving.server._DecodeLoop` scheduler, so
+requests are admitted into KV-cache slots *every decode step* instead
+of waiting for a full batch.
+
+Request body (JSON, POST to the api path)::
+
+    {"ids": [1, 2, 3], "max_new_tokens": 32}          # raw token ids
+    {"prompt": "text", "stream": true}                 # with a tokenizer
+
+Replies carry ``{"ids": [...]}`` (plus ``"completion"`` when a
+tokenizer is configured); ``stream: true`` switches to a chunked body
+with one ``{"token": id}`` JSON line per generated token and a final
+``{"done": true, ...}`` line.  Load shedding, ``Retry-After``, drain
+semantics, and ``/metrics``/``/healthz``/``/readyz`` are the standard
+serving contract (see docs/api/serving.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .server import ServingRequest, ServingServer, _DecodeLoop
+
+
+class LLMServer:
+    """Serve an LLM with continuous batching over a slotted KV cache.
+
+    ``model``/``variables`` build a
+    :class:`~synapseml_tpu.models.llm.SlotEngine` (or pass a prebuilt
+    ``engine=``); ``tokenizer`` (optional, ``encode``/``decode``) lets
+    requests carry ``"prompt"`` text instead of raw ``"ids"``.
+    ``ttft_slo_s`` arms SLO-aware admission control: queued requests
+    whose projected time-to-first-token exceeds it answer 503 +
+    ``Retry-After``."""
+
+    def __init__(self, model: Any = None, variables: Any = None, *,
+                 engine: Any = None, tokenizer: Any = None,
+                 n_slots: int = 16, max_len: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/generate",
+                 max_new_tokens_default: int = 32,
+                 ttft_slo_s: Optional[float] = None,
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, min_prefix: int = 8,
+                 max_queue: int = 1024, reply_timeout_s: float = 30.0,
+                 engine_kwargs: Optional[Dict[str, Any]] = None):
+        if engine is None:
+            from ..models.llm import SlotEngine
+            engine = SlotEngine(model, variables, n_slots=n_slots,
+                                max_len=max_len, temperature=temperature,
+                                top_k=top_k, top_p=top_p, eos_id=eos_id,
+                                pad_id=pad_id, min_prefix=min_prefix,
+                                **(engine_kwargs or {}))
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.server = ServingServer(host, port, api_path,
+                                    reply_timeout_s=reply_timeout_s,
+                                    max_queue=max_queue)
+        self._loop = _DecodeLoop(
+            self.server, self.server._default, engine,
+            input_parser=self._parse,
+            output_formatter=self._format,
+            max_new_tokens_default=max_new_tokens_default,
+            ttft_slo_s=ttft_slo_s)
+
+    # -- request/reply shaping --------------------------------------------
+    def _parse(self, req: ServingRequest) -> Dict[str, Any]:
+        body = req.json()
+        if "ids" in body:
+            spec = dict(body)
+        elif "prompt" in body and self.tokenizer is not None:
+            # budget prompt tokens against the engine window, leaving
+            # room for the continuation (LLMTransformer's contract)
+            budget = self.engine.max_len - int(
+                body.get("max_new_tokens",
+                         self._loop.max_new_tokens_default)) - 1
+            rows = self.tokenizer.encode([str(body["prompt"])],
+                                         max(budget, 1))[0]
+            ids = [int(t) for t in rows[0] if t]
+            spec = dict(body, ids=ids or [0])
+        else:
+            raise ValueError('request needs "ids" (or "prompt" with a '
+                             "tokenizer configured)")
+        return spec
+
+    def _format(self, ids: List[int]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"ids": [int(t) for t in ids]}
+        if self.tokenizer is not None:
+            out["completion"] = self.tokenizer.decode([ids])[0]
+        return out
+
+    # -- server surface ----------------------------------------------------
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown with the serving zero-drop contract:
+        the listener sheds NEW work immediately, the decode loop keeps
+        running so every in-flight sequence decodes to completion (or
+        answers a clean 503 + ``Retry-After`` when its projected TTFT is
+        already past the SLO), and only then does the loop stop."""
+        drained = self.server.drain(timeout_s)
+        self._loop.stop()
+        return drained
+
+    def close(self) -> None:
+        self._loop.stop()
+        self.server.close()

@@ -129,12 +129,31 @@ class ApiHandle:
         """Block up to ``timeout_s`` for the first request, then drain only
         what is already queued — continuous-mode semantics: a lone request
         is served immediately instead of waiting out the batch window,
-        while a burst still rides one batched transform."""
+        while a burst still rides one batched transform.
+
+        ``timeout_s <= 0`` is the non-blocking fast path (``poll``): a
+        decode loop with sequences in flight must never stall a running
+        batch waiting on new arrivals."""
+        if timeout_s <= 0:
+            return self.poll(max_rows)
         out: List[_Exchange] = []
         try:
             out.append(self._queue.get(timeout=timeout_s))
         except Empty:
             return []
+        while len(out) < max_rows:
+            try:
+                out.append(self._queue.get_nowait())
+            except Empty:
+                break
+        return [e.request for e in out]
+
+    def poll(self, max_rows: int = 64) -> List[ServingRequest]:
+        """Non-blocking :meth:`get_batch`: return whatever is already
+        queued (possibly nothing) without waiting — the admission path
+        of a continuous-batching loop, which checks for new arrivals
+        EVERY decode step and must not park the in-flight batch."""
+        out: List[_Exchange] = []
         while len(out) < max_rows:
             try:
                 out.append(self._queue.get_nowait())
@@ -314,22 +333,32 @@ class ServingServer:
                         # pull chunks on a worker thread: a generator that
                         # blocks between yields (live token streams) must
                         # not stall the event loop for every other
-                        # connection
+                        # connection.  A write failure (client gone
+                        # mid-stream) tells an abandonable body before
+                        # propagating, so a live token stream's producer
+                        # can stop decoding for the dead connection
                         it = iter(rbody)
                         _end = object()
-                        while True:
-                            chunk = await self._loop.run_in_executor(
-                                None, next, it, _end)
-                            if chunk is _end:
-                                break
-                            chunk = bytes(chunk)
-                            if not chunk:
-                                continue
-                            writer.write(f"{len(chunk):x}\r\n".encode("latin1")
-                                         + chunk + b"\r\n")
+                        try:
+                            while True:
+                                chunk = await self._loop.run_in_executor(
+                                    None, next, it, _end)
+                                if chunk is _end:
+                                    break
+                                chunk = bytes(chunk)
+                                if not chunk:
+                                    continue
+                                writer.write(
+                                    f"{len(chunk):x}\r\n".encode("latin1")
+                                    + chunk + b"\r\n")
+                                await writer.drain()
+                            writer.write(b"0\r\n\r\n")
                             await writer.drain()
-                        writer.write(b"0\r\n\r\n")
-                        await writer.drain()
+                        except BaseException:
+                            abandon = getattr(rbody, "abandon", None)
+                            if abandon is not None:
+                                abandon()
+                            raise
                 finally:
                     self._inflight -= 1
                 if not keep:
@@ -762,6 +791,18 @@ class ServingServer:
         self._thread.join(timeout=5)
 
 
+def _reply_never_raises(api: ApiHandle, request_id: str,
+                        rep: ServingReply) -> bool:
+    """``api.reply`` that cannot kill a serving worker thread: after
+    drain/close the asyncio loop is gone and call_soon_threadsafe
+    raises — the exchange is already lost either way, the loop must
+    live.  Shared by ``_ApiLoop`` and ``_DecodeLoop``."""
+    try:
+        return api.reply(request_id, rep)
+    except Exception:  # noqa: BLE001 — serving must not die
+        return False
+
+
 class _BatchAlignmentError(RuntimeError):
     """Model output rows cannot be mapped back onto requests (row count
     changed with no provenance) — a deployment bug, not poison data, so
@@ -875,13 +916,7 @@ class _ApiLoop:
                     self._m_rps.set(served / dt, api=self.api.path)
 
     def _safe_reply(self, request_id: str, rep: ServingReply) -> bool:
-        """api.reply that cannot kill the worker thread: after drain/
-        close the asyncio loop is gone and call_soon_threadsafe raises —
-        the exchange is already lost either way, the loop must live."""
-        try:
-            return self.api.reply(request_id, rep)
-        except Exception:  # noqa: BLE001 — serving must not die
-            return False
+        return _reply_never_raises(self.api, request_id, rep)
 
     def _reply_all(self, reqs: List[ServingRequest], status: int,
                    e: Exception, kind: str) -> None:
@@ -1006,6 +1041,379 @@ class _ApiLoop:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+
+
+class _TokenStream:
+    """Blocking token-chunk iterator bridging the decode loop and the
+    chunked-transfer reply writer: the loop pushes encoded chunks as
+    tokens are sampled, the listener's executor thread pulls them.  The
+    exchange stays in-flight until ``finish()``'s sentinel drains, so
+    ``drain()``'s zero-drop guarantee covers live token streams.
+
+    ``abandon()`` is the listener's back-signal for a client that
+    disconnected mid-stream: the decode loop checks the flag every
+    tick and cancels the slot instead of decoding the full budget for
+    nobody (the streaming counterpart of the non-stream reply-window
+    expiry).  An abandoned stream drops further pushes so the queue
+    cannot grow behind a dead connection."""
+
+    _DONE = object()
+
+    def __init__(self):
+        self._q: "Queue" = Queue()
+        self.abandoned = False
+
+    def push(self, chunk: bytes) -> None:
+        if not self.abandoned:
+            self._q.put(chunk)
+
+    def finish(self) -> None:
+        self._q.put(self._DONE)
+
+    def abandon(self) -> None:
+        self.abandoned = True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            raise StopIteration
+        return item
+
+
+@dataclass
+class _DecodeSeq:
+    """One request's decode lifecycle (queued → slotted → retired)."""
+    req: ServingRequest
+    ids: List[int]
+    max_new: int
+    stream: bool
+    slot: Optional[int] = None
+    tokens: List[int] = field(default_factory=list)
+    stream_obj: Optional[_TokenStream] = None
+    first_token_at: Optional[float] = None
+
+
+class _DecodeLoop:
+    """Continuous-batching serving loop for an LLM decode engine —
+    the token-streaming sibling of :class:`_ApiLoop`.
+
+    Instead of batch → transform → reply, the loop runs one SLOTTED
+    decode step at a time and re-schedules between steps:
+
+    - **admission every step** — queued requests are pulled with the
+      non-blocking :meth:`ApiHandle.poll` and admitted into free cache
+      slots the moment one exists; a request never waits for a "full
+      batch" and an in-flight batch never stalls waiting on arrivals;
+    - **SLO-aware shedding** — with ``ttft_slo_s`` set, a queued request
+      whose PROJECTED time-to-first-token (time already waited + the
+      soonest slot release, from the engine's remaining-token floor ×
+      the observed step time) exceeds the SLO answers 503 with the
+      PR-2 queue-depth ``Retry-After`` hint instead of serving a stale
+      reply — including while the server drains;
+    - **eviction per step** — EOS / token-budget retirement frees the
+      slot immediately for the next admission; a reply window that
+      expired mid-decode cancels the slot;
+    - **streaming** — ``stream`` requests are answered immediately with
+      a chunked body fed token-by-token through the existing
+      exchange/reply machinery (one JSON line per token, a final
+      ``done`` line with the full ids).
+
+    The engine is duck-typed (``admit``/``step``/``cancel``/
+    ``n_slots``/``active_count``/``free_slot_count``/
+    ``min_remaining_tokens``) so this module never imports jax; pass a
+    :class:`synapseml_tpu.models.llm.SlotEngine`.
+    """
+
+    def __init__(self, server: ServingServer, api: ApiHandle, engine: Any,
+                 input_parser: Callable[[ServingRequest], Dict[str, Any]],
+                 output_formatter: Optional[
+                     Callable[[List[int]], Dict[str, Any]]] = None,
+                 max_new_tokens_default: int = 32,
+                 ttft_slo_s: Optional[float] = None,
+                 idle_timeout_s: float = 0.02):
+        self.server = server
+        self.api = api
+        self.engine = engine
+        self.input_parser = input_parser
+        self.output_formatter = output_formatter or (
+            lambda ids: {"ids": [int(t) for t in ids]})
+        self.max_new_tokens_default = int(max_new_tokens_default)
+        self.ttft_slo_s = ttft_slo_s
+        self.idle_timeout_s = idle_timeout_s
+        self._waiting: List[_DecodeSeq] = []
+        self._by_slot: Dict[int, _DecodeSeq] = {}
+        self._step_ewma: Optional[float] = None
+        self._retired_window: List[float] = []
+        reg = get_registry()
+        self._m_ttft = reg.histogram(
+            "llm_ttft_seconds", "request arrival to first generated token",
+            ("api",), buckets=(.005, .01, .025, .05, .1, .25, .5, 1, 2.5,
+                               5, 10, 30))
+        self._m_tok_lat = reg.histogram(
+            "llm_token_latency_seconds",
+            "per-token decode latency (one observation per emitted token)",
+            ("api",), buckets=(.0005, .001, .0025, .005, .01, .025, .05,
+                               .1, .25, 1))
+        self._m_tokens = reg.counter(
+            "llm_tokens_total", "tokens streamed/replied by the decode "
+            "loop", ("api",))
+        self._m_sheds = reg.counter(
+            "llm_sheds_total", "requests shed by the decode loop",
+            ("api", "reason"))
+        self._m_errors = reg.counter(
+            "serving_errors_total", "batches failed (500) or shed (503)",
+            ("api", "kind"))
+        self._m_records = reg.counter(
+            "serving_records_total", "records replied 200", ("api",))
+        self._m_rps = reg.gauge(
+            "serving_records_per_sec",
+            "last-batch records/sec through transform+reply", ("api",))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- shared with _ApiLoop ---------------------------------------------
+    def _safe_reply(self, request_id: str, rep: ServingReply) -> bool:
+        return _reply_never_raises(self.api, request_id, rep)
+
+    # -- admission ---------------------------------------------------------
+    def _pump_queue(self) -> None:
+        """Move newly-arrived requests into the waiting list.  Blocks
+        only when the loop is otherwise idle; the pull is capped so the
+        bounded api queue keeps providing saturation backpressure."""
+        room = max(0, 2 * self.engine.n_slots - len(self._waiting))
+        if room == 0:
+            return
+        if self.engine.active_count or self._waiting:
+            batch = self.api.poll(room)
+        else:
+            batch = self.api.get_batch(room, self.idle_timeout_s)
+        for req in batch:
+            try:
+                spec = self.input_parser(req)
+                ids = [int(t) for t in spec["ids"]]
+                if not ids:
+                    raise ValueError("empty prompt")
+                max_new = int(spec.get("max_new_tokens",
+                                       self.max_new_tokens_default))
+            except Exception as e:  # noqa: BLE001 — isolated to record
+                self._m_errors.inc(1, api=self.api.path, kind="parse")
+                self._safe_reply(req.id, ServingReply(400, json.dumps(
+                    {"error": f"unparseable record: {e}"}).encode()))
+                continue
+            self._waiting.append(_DecodeSeq(
+                req, ids, max_new, bool(spec.get("stream", False))))
+
+    def _projected_ttft(self, seq: _DecodeSeq, position: int) -> float:
+        """Projection of this request's TTFT if admitted as soon as
+        capacity allows: time already queued plus the soonest slot
+        release, scaled by how many queued requests are ahead of it.
+
+        The release estimate is the SMALLER of the engine's
+        remaining-token floor × observed step time (exact when
+        sequences run their full budget) and the observed
+        inter-retirement interval from the recent window (the honest
+        estimate when EOS retires sequences far under budget —
+        budget-based projection alone would shed requests that real
+        retirement traffic was about to serve)."""
+        waited = time.monotonic() - seq.req.enqueued_at
+        if self.engine.free_slot_count > 0:
+            return waited
+        rem = self.engine.min_remaining_tokens()
+        if rem is None or self._step_ewma is None:
+            return waited
+        next_free = rem * self._step_ewma
+        now = time.monotonic()
+        recent = [t for t in self._retired_window if now - t < 5.0]
+        if recent:
+            next_free = min(next_free, 5.0 / len(recent))
+        waves = 1 + position // max(1, self.engine.n_slots)
+        return waited + next_free * waves
+
+    def _shed_headers(self) -> Dict[str, str]:
+        from ..resilience.health import retry_after_from_depth
+        depth = len(self._waiting) + self.engine.active_count
+        now = time.monotonic()
+        self._retired_window = [t for t in self._retired_window
+                                if now - t < 5.0]
+        rps = len(self._retired_window) / 5.0
+        return {"Retry-After": str(retry_after_from_depth(depth, rps))}
+
+    def _shed(self, seq: _DecodeSeq, reason: str) -> None:
+        self._m_sheds.inc(1, api=self.api.path, reason=reason)
+        self._m_errors.inc(1, api=self.api.path, kind="shed")
+        self._safe_reply(seq.req.id, ServingReply(
+            503, json.dumps({"error": "projected time-to-first-token "
+                             "exceeds the serving SLO"}).encode(),
+            self._shed_headers()))
+
+    def _admit_waiting(self) -> None:
+        keep: List[_DecodeSeq] = []
+        for pos, seq in enumerate(self._waiting):
+            if (self.ttft_slo_s is not None
+                    and self._projected_ttft(seq, pos) > self.ttft_slo_s):
+                self._shed(seq, "slo")
+                continue
+            if self.engine.free_slot_count == 0:
+                keep.append(seq)
+                continue
+            try:
+                res = self.engine.admit(seq.ids, seq.max_new)
+            except ValueError as e:             # prompt cannot fit
+                self._m_errors.inc(1, api=self.api.path, kind="parse")
+                self._safe_reply(seq.req.id, ServingReply(
+                    400, json.dumps({"error": str(e)}).encode()))
+                continue
+            if res is None:                     # raced full — requeue
+                keep.append(seq)
+                continue
+            seq.slot = res.slot
+            seq.first_token_at = time.monotonic()
+            self._m_ttft.observe(
+                seq.first_token_at - seq.req.enqueued_at,
+                api=self.api.path)
+            if seq.stream:
+                seq.stream_obj = _TokenStream()
+                if not self._safe_reply(seq.req.id, ServingReply(
+                        200, seq.stream_obj,
+                        {"Content-Type": "application/json"})):
+                    self.engine.cancel(res.slot)
+                    continue
+            self._by_slot[res.slot] = seq
+            self._on_token(seq, res.token, res.finished)
+        self._waiting = keep
+
+    # -- token/retirement handling ----------------------------------------
+    def _on_token(self, seq: _DecodeSeq, token: int,
+                  finished: bool) -> None:
+        seq.tokens.append(int(token))
+        self._m_tokens.inc(1, api=self.api.path)
+        if seq.stream_obj is not None:
+            seq.stream_obj.push(
+                json.dumps({"token": int(token)}).encode() + b"\n")
+        if finished:
+            self._finish(seq)
+
+    def _finish(self, seq: _DecodeSeq) -> None:
+        self._by_slot.pop(seq.slot, None)
+        now = time.monotonic()
+        # prune at the append site: the window must stay ~5s of
+        # timestamps, not one float per request served since startup
+        self._retired_window = [t for t in self._retired_window
+                                if now - t < 5.0]
+        self._retired_window.append(now)
+        payload = self.output_formatter(seq.tokens)
+        if seq.stream_obj is not None:
+            payload["done"] = True
+            seq.stream_obj.push(json.dumps(payload).encode() + b"\n")
+            seq.stream_obj.finish()
+            self._m_records.inc(1, api=self.api.path)
+        else:
+            ok = self._safe_reply(seq.req.id, ServingReply(
+                200, json.dumps(payload).encode(),
+                {"Content-Type": "application/json"}))
+            if ok:
+                self._m_records.inc(1, api=self.api.path)
+
+    def _cancel_expired(self) -> None:
+        """A sequence nobody is waiting on must not hold a slot (and
+        SLO-shed queued requests on its behalf): a NON-STREAM request
+        whose reply window expired (the listener answered 504 and
+        forgot the exchange), or a STREAM whose client disconnected
+        mid-decode (the chunk writer flagged the stream abandoned).
+        Streams replied at admission, so the window applies only to
+        non-stream sequences."""
+        now = time.monotonic()
+        for slot, seq in list(self._by_slot.items()):
+            if seq.stream_obj is not None:
+                dead = seq.stream_obj.abandoned
+                kind = "disconnect"
+            else:
+                dead = (now - seq.req.enqueued_at
+                        > self.api.reply_timeout_s)
+                kind = "expired"
+            if dead:
+                self.engine.cancel(slot)
+                self._by_slot.pop(slot, None)
+                self._m_errors.inc(1, api=self.api.path, kind=kind)
+
+    # -- the loop ----------------------------------------------------------
+    def _loop(self) -> None:
+        # the _ApiLoop invariant — serving must not die — holds here
+        # too: any engine failure (XLA resource errors, a duck-typed
+        # engine bug) fails the IN-FLIGHT sequences with 500s, frees
+        # their slots, and keeps the thread serving
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — serving must not die
+                self._fail_inflight(e)
+                time.sleep(0.05)    # a persistently-broken engine must
+                #                     not spin the loop hot
+
+    def _tick(self) -> None:
+        self._pump_queue()
+        self._admit_waiting()
+        self._cancel_expired()
+        if not self.engine.active_count:
+            return
+        t0 = time.perf_counter()
+        events = self.engine.step()
+        dt = time.perf_counter() - t0
+        self._step_ewma = (dt if self._step_ewma is None
+                           else 0.8 * self._step_ewma + 0.2 * dt)
+        for ev in events:
+            seq = self._by_slot.get(ev.slot)
+            if seq is None:         # cancelled under us
+                continue
+            self._m_tok_lat.observe(dt, api=self.api.path)
+            self._on_token(seq, ev.token, ev.finished)
+        if events and dt > 0:
+            self._m_rps.set(len(events) / dt, api=self.api.path)
+
+    def _fail_inflight(self, e: Exception) -> None:
+        """Answer every in-flight sequence 500 (streams get a final
+        error line) and free its slot after an engine failure."""
+        body = json.dumps({"error": str(e)}).encode()
+        for slot, seq in list(self._by_slot.items()):
+            try:
+                self.engine.cancel(slot)
+            except Exception:  # noqa: BLE001 — engine may be broken
+                pass
+            if seq.stream_obj is not None:
+                seq.stream_obj.push(json.dumps(
+                    {"error": str(e)}).encode() + b"\n")
+                seq.stream_obj.finish()
+            else:
+                self._safe_reply(seq.req.id, ServingReply(500, body))
+            self._by_slot.pop(slot, None)
+        self._m_errors.inc(1, api=self.api.path, kind="transform")
+        # the engine's jitted programs donate their cache buffers: an
+        # exception mid-call can leave the cache pointing at DELETED
+        # arrays, so without a rebuild every later admit/step fails
+        # forever ("Array has been deleted") — recovery, not cleanup
+        reset = getattr(self.engine, "reset", None)
+        if reset is not None:
+            try:
+                reset()
+            except Exception:  # noqa: BLE001 — stay alive regardless
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        # release every still-open stream: the listener's executor
+        # thread is parked in Queue.get() on it, and an unfinished
+        # stream would leak that (non-daemon) thread past close —
+        # observed as a process that never exits.  After the join the
+        # loop thread is gone, so this cannot race a push.
+        for seq in self._by_slot.values():
+            if seq.stream_obj is not None:
+                seq.stream_obj.finish()
+        self._by_slot.clear()
 
 
 def _default_format(value: Any) -> bytes:

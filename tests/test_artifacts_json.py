@@ -24,6 +24,24 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: grows — new truncation is a bug this test must fail on
 KNOWN_TRUNCATED = {"BENCH_r05.json"}
 
+#: the continuous-batching serving block: when a bench record carries
+#: ANY ``llmserve_`` key it must carry the full acceptance-criteria set
+#: (throughput pair + ratio, TTFT percentiles, per-token latency ratio,
+#: slot occupancy, admission/eviction counters) so a partially-failed
+#: serving leg can't masquerade as a complete measurement
+LLMSERVE_REQUIRED = (
+    "llmserve_continuous_tokens_per_sec",
+    "llmserve_static8_tokens_per_sec",
+    "llmserve_throughput_ratio",
+    "llmserve_continuous_ttft_p50_ms",
+    "llmserve_continuous_ttft_p95_ms",
+    "llmserve_continuous_ttft_p99_ms",
+    "llmserve_token_latency_ratio_p95",
+    "llmserve_slot_occupancy",
+    "llmserve_admissions_total",
+    "llmserve_evictions_total",
+)
+
 
 def _artifact_paths():
     paths = []
@@ -53,3 +71,36 @@ def test_artifact_parses(path):
                  if ln.lstrip().startswith("{")]
         if lines:
             json.loads(lines[-1])     # the bench record itself must parse
+
+
+def _bench_records():
+    """Every parseable bench record (inner ``parsed`` dict, or the
+    top-level object when there is no driver wrapper)."""
+    records = []
+    for path in _artifact_paths():
+        if os.path.basename(path) in KNOWN_TRUNCATED:
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            try:
+                obj = json.load(f)
+            except ValueError:
+                continue              # test_artifact_parses owns this
+        if isinstance(obj, dict):
+            rec = obj.get("parsed") if isinstance(obj.get("parsed"),
+                                                  dict) else obj
+            records.append((os.path.basename(path), rec))
+    return records
+
+
+def test_llmserve_fields_complete():
+    """A record carrying any continuous-batching serving field carries
+    the whole set, each numeric or null."""
+    for name, rec in _bench_records():
+        if not any(k.startswith("llmserve_") for k in rec):
+            continue
+        missing = [k for k in LLMSERVE_REQUIRED if k not in rec]
+        assert not missing, f"{name}: incomplete llmserve block: {missing}"
+        bad = [k for k in rec if k.startswith("llmserve_")
+               and rec[k] is not None
+               and not isinstance(rec[k], (int, float))]
+        assert not bad, f"{name}: non-numeric llmserve fields: {bad}"
