@@ -52,40 +52,21 @@ GBDT_MAX_BIN = 63         # the TPU fast path (LightGBM's own GPU default);
                           # (vs_baseline = 63-bin TPU / 64-bin anchor)
 ANCHOR_ITERS = 10         # anchor runs fewer iters; rate is per-iteration
 
-#: peak dense bf16 FLOPs/s by device kind (public spec sheets)
-CHIP_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5": 459e12,        # v5p
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-}
+# chip spec tables live in telemetry.roofline (ONE source for the
+# auditor, the StepProfiler gauges and this bench); the bench keeps its
+# historical defaults for MFU so unknown-kind devices still get a number
+from synapseml_tpu.telemetry import roofline as _roofline
 
-#: HBM bandwidth bytes/s by device kind (public spec sheets) — used to
-#: emit the vision bench's bandwidth roofline into the JSON
-CHIP_HBM_BW = {
-    "TPU v4": 1228e9,
-    "TPU v5 lite": 819e9,    # v5e
-    "TPU v5": 2765e9,        # v5p
-    "TPU v6 lite": 1640e9,   # v6e / Trillium
-}
-
-
-def _chip_lookup(device, table, default):
-    """Longest-prefix device-kind match into a spec table."""
-    kind = getattr(device, "device_kind", "")
-    best = None
-    for name, val in table.items():
-        if kind.startswith(name) and (best is None or len(name) > best[0]):
-            best = (len(name), val)
-    return best[1] if best else default
+CHIP_PEAK_FLOPS = _roofline.CHIP_PEAK_FLOPS
+CHIP_HBM_BW = _roofline.CHIP_HBM_BW
 
 
 def _chip_bw(device) -> float:
-    return _chip_lookup(device, CHIP_HBM_BW, 819e9)
+    return _roofline.chip_hbm_bw(device, 819e9)
 
 
 def _chip_peak(device) -> float:
-    return _chip_lookup(device, CHIP_PEAK_FLOPS, 197e12)
+    return _roofline.chip_peak_flops(device, 197e12)
 
 
 def _median_window(run_steps, n_windows=3):
@@ -120,8 +101,13 @@ def _median_rate(run_once, n=3):
     return sorted(rates)[n // 2]
 
 
-def bench_bert():
+def _bert_leg(precision, ids, mask, labels):
+    """One BERT fine-tune configuration: compile via AOT (so ONE compile
+    both executes the windows and reports cost_analysis), run the timed
+    windows.  → dict(sps_chip, mfu, n_params, bytes/flops per sample,
+    measured ms, roofline block)."""
     import jax
+    from synapseml_tpu.models.dl.precision import resolve_precision
     from synapseml_tpu.models.dl.training import DLTrainer, OptimizerConfig
     from synapseml_tpu.models.dl.transformer import TextEncoder, TransformerConfig
     from synapseml_tpu.parallel.mesh import make_mesh
@@ -130,14 +116,9 @@ def bench_bert():
     mesh = make_mesh({"data": len(devs)}, devs)
     cfg = TransformerConfig.bert_base(num_classes=2, max_len=BERT_SEQ)
     model = TextEncoder(cfg)
-    trainer = DLTrainer(model, OptimizerConfig(learning_rate=2e-5), mesh)
-
-    rng = np.random.default_rng(0)
-    bs = BERT_BATCH * len(devs)
-    ids = rng.integers(0, cfg.vocab_size, (bs, BERT_SEQ))
-    mask = np.ones((bs, BERT_SEQ), bool)
-    labels = rng.integers(0, 2, bs)
-
+    trainer = DLTrainer(model, OptimizerConfig(learning_rate=2e-5), mesh,
+                        precision=resolve_precision(precision))
+    bs = len(ids)
     state = trainer.init_state(0, ids, mask)
     n_params = sum(int(np.prod(x.shape))
                    for x in jax.tree.leaves(state.params))
@@ -145,53 +126,94 @@ def bench_bert():
     bi, bm, bl = trainer.shard_batch((ids, mask, labels))
     key = jax.random.PRNGKey(0)
 
-    state, m = step(state, (bi, bm), bl, key)        # compile
+    compiled = step.lower(state, (bi, bm), bl, key).compile()
+    xla_bytes = xla_flops = None
+    cost = _roofline.capture_compiled(compiled)
+    if cost:
+        per_dev = bs / len(devs)
+        if cost["bytes_accessed"]:
+            xla_bytes = cost["bytes_accessed"] / per_dev
+        if cost["flops"]:
+            xla_flops = cost["flops"] / per_dev
+
+    state, m = compiled(state, (bi, bm), bl, key)    # warm the executable
     float(np.asarray(m["loss"]))
 
     def window():
         nonlocal state
         m = None
         for _ in range(BERT_STEPS):
-            state, m = step(state, (bi, bm), bl, key)
+            state, m = compiled(state, (bi, bm), bl, key)
         return BERT_STEPS * bs, lambda: float(np.asarray(m["loss"]))
 
     sps_chip = _median_window(window) / len(devs)
     # standard training-FLOPs accounting: 6 · params · tokens (fwd 2PT, bwd 4PT)
     flops_per_sample = 6.0 * n_params * BERT_SEQ
-    mfu = sps_chip * flops_per_sample / _chip_peak(jax.devices()[0])
-    return sps_chip, mfu, n_params
+    mfu = sps_chip * flops_per_sample / _chip_peak(devs[0])
+    measured_ms = bs / len(devs) / sps_chip * 1e3
+    return {"sps_chip": sps_chip, "mfu": mfu, "n_params": n_params,
+            "bytes_per_sample": xla_bytes, "flops_per_sample": xla_flops,
+            "measured_step_ms": measured_ms,
+            "block": _roofline.roofline_block(
+                xla_bytes, xla_flops or flops_per_sample, measured_ms,
+                device=devs[0], samples=bs / len(devs))}
+
+
+def bench_bert():
+    """Primary metric (unchanged config: precision='bf16') plus the
+    byte-diet pair: the AFTER leg rounds gradient leaves to bf16
+    ('bf16_grad') — BERT sits at MFU 0.65 (compute-leaning), so remat is
+    deliberately NOT in this leg's after config (it trades flops for
+    bytes, the wrong direction here); the paired roofline blocks record
+    what the gradient-path diet buys on this backend."""
+    import jax
+    from synapseml_tpu.models.dl.transformer import TransformerConfig
+    rng = np.random.default_rng(0)
+    bs = BERT_BATCH * len(jax.devices())
+    vocab = TransformerConfig.bert_base(num_classes=2,
+                                        max_len=BERT_SEQ).vocab_size
+    ids = rng.integers(0, vocab, (bs, BERT_SEQ))
+    mask = np.ones((bs, BERT_SEQ), bool)
+    labels = rng.integers(0, 2, bs)
+
+    before = _bert_leg("bf16", ids, mask, labels)
+    after = _bert_leg("bf16_grad", ids, mask, labels)
+    extras = {
+        **_roofline.paired_roofline("bert_finetune", before["block"],
+                                    after["block"]),
+        "bert_finetune_bf16_grad_samples_per_sec": after["sps_chip"],
+        "bert_finetune_bytes_reduction": (
+            1.0 - after["bytes_per_sample"] / before["bytes_per_sample"]
+            if after["bytes_per_sample"] and before["bytes_per_sample"]
+            else None),
+    }
+    return before["sps_chip"], before["mfu"], before["n_params"], extras
 
 
 VISION_BATCH = 256    # per-chip; +6% over 128, fits v5e HBM with headroom
 VISION_STEPS = 30     # ~3 s windows so the readback RTT is <3% of a window
 
 
-def bench_vision():
-    """DeepVisionClassifier ResNet-50 fine-tune step (BASELINE config #3;
-    reference path: DeepVisionClassifier.py:215 over Horovod DDP) —
-    samples/sec/chip + MFU at 224x224, bf16 convs, batch-norm training
-    mode, adamw.  Median of three windows; the loss readback is the
-    barrier.  MFU counts the XLA-compiled program's own FLOPs
-    (cost_analysis), not a transformer-style 6PT approximation — conv
-    nets' FLOPs live in the convolutions, and XLA's count includes the
-    batch-norm/elementwise tail that dilutes conv MFU."""
+def _vision_leg(remat, precision, imgs, labels, *, steps=None,
+                windows=True, probe_steps=3):
+    """One ResNet-50 fine-tune configuration: AOT-compile, capture XLA
+    cost, optionally run the timed windows.  → dict with sps_chip / mfu /
+    bytes+flops per sample / measured ms / the canonical roofline block /
+    the first ``probe_steps`` losses (the bit-exactness probe)."""
     import jax
 
+    from synapseml_tpu.models.dl.precision import resolve_precision
     from synapseml_tpu.models.dl.resnet import make_backbone
     from synapseml_tpu.models.dl.training import DLTrainer, OptimizerConfig
     from synapseml_tpu.parallel.mesh import make_mesh
 
     devs = jax.devices()
     mesh = make_mesh({"data": len(devs)}, devs)
-    model = make_backbone("resnet50", num_classes=1000)
+    model = make_backbone("resnet50", num_classes=1000, remat=remat)
     trainer = DLTrainer(model, OptimizerConfig(learning_rate=1e-4), mesh,
-                        has_batch_stats=True, train_kwarg="train")
-
-    rng = np.random.default_rng(0)
-    bs = VISION_BATCH * len(devs)
-    imgs = rng.normal(size=(bs, 224, 224, 3)).astype(np.float32)
-    labels = rng.integers(0, 1000, bs)
-
+                        has_batch_stats=True, train_kwarg="train",
+                        precision=resolve_precision(precision))
+    bs = len(imgs)
     state = trainer.init_state(0, imgs[:8])
     step = trainer.train_step()
     bi, bl = trainer.shard_batch((imgs, labels))
@@ -202,61 +224,117 @@ def bench_vision():
     # executable cache, so calling the jitted step too would compile the
     # whole graph a second time over the tunnel)
     compiled = step.lower(state, (bi,), bl, key).compile()
-    flops_per_sample = None
-    bytes_per_sample = None
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0]
+    flops_per_sample = bytes_per_sample = None
+    cost = _roofline.capture_compiled(compiled)
+    if cost:
         # the SPMD-partitioned per-DEVICE program processes bs/len(devs)
         # samples per step
-        per_dev_flops = float(cost.get("flops", 0.0))
-        if per_dev_flops:
-            flops_per_sample = per_dev_flops / (bs / len(devs))
-        per_dev_bytes = float(cost.get("bytes accessed", 0.0))
-        if per_dev_bytes:
-            bytes_per_sample = per_dev_bytes / (bs / len(devs))
-    except Exception:
-        pass
+        per_dev = bs / len(devs)
+        if cost["flops"]:
+            flops_per_sample = cost["flops"] / per_dev
+        if cost["bytes_accessed"]:
+            bytes_per_sample = cost["bytes_accessed"] / per_dev
     if not flops_per_sample:
         # fallback: published ResNet-50@224 forward cost is ~4.1 GMACs =
         # ~8.2 GFLOP with multiply and add counted separately (XLA's and
         # the chip-peak convention), 3x for fwd+bwd
         flops_per_sample = 3 * 8.2e9
 
-    state, m = compiled(state, (bi,), bl, key)       # warm the executable
-    float(np.asarray(m["loss"]))
+    # loss trajectory of the FIRST probe_steps optimizer steps from the
+    # deterministic init — the remat bit-exactness probe compares these
+    # bitwise across configurations that must not change numerics
+    probe = []
+    for _ in range(max(probe_steps, 1)):
+        state, m = compiled(state, (bi,), bl, key)
+        probe.append(float(np.asarray(m["loss"])))
 
-    def window():
-        nonlocal state
-        m = None
-        for _ in range(VISION_STEPS):
-            state, m = compiled(state, (bi,), bl, key)
-        return VISION_STEPS * bs, lambda: float(np.asarray(m["loss"]))
+    out = {"remat": remat, "precision": precision,
+           "flops_per_sample": flops_per_sample,
+           "bytes_per_sample": bytes_per_sample,
+           "probe_losses": probe, "sps_chip": None, "mfu": None,
+           "measured_step_ms": None}
+    if windows:
+        n_steps = steps if steps else VISION_STEPS
 
-    sps_chip = _median_window(window) / len(devs)
-    mfu = (sps_chip * flops_per_sample) / _chip_peak(devs[0])
-    # the roofline decomposition behind the MFU number, emitted so the
-    # "this graph is bandwidth-bound" claim audits from the JSON alone:
-    # XLA's own bytes-accessed sets the memory roofline, the chip peak
-    # sets the compute roofline, and the measured step lands against them
+        def window():
+            # thread state through (the step donates its input buffers
+            # on TPU — re-running a window from a donated state crashes)
+            nonlocal state
+            m = None
+            for _ in range(n_steps):
+                state, m = compiled(state, (bi,), bl, key)
+            return n_steps * bs, lambda: float(np.asarray(m["loss"]))
+
+        sps_chip = _median_window(window) / len(devs)
+        out["sps_chip"] = sps_chip
+        out["mfu"] = (sps_chip * flops_per_sample) / _chip_peak(devs[0])
+        out["measured_step_ms"] = bs / len(devs) / sps_chip * 1e3
+    out["block"] = _roofline.roofline_block(
+        bytes_per_sample, flops_per_sample, out["measured_step_ms"],
+        device=devs[0], samples=bs / len(devs))
+    return out
+
+
+def bench_vision():
+    """DeepVisionClassifier ResNet-50 fine-tune step (BASELINE config #3;
+    reference path: DeepVisionClassifier.py:215 over Horovod DDP) —
+    samples/sec/chip + MFU at 224x224, batch-norm training mode, adamw.
+    Median of three windows; the loss readback is the barrier.  MFU
+    counts the XLA-compiled program's own FLOPs (cost_analysis).
+
+    BENCH_r05 pinned this leg at 93% of its BANDWIDTH roofline (305
+    MB/sample for 23.9 GFLOP/sample, MFU ceiling 0.33) — the fix is
+    moving fewer bytes.  The leg therefore runs PAIRED configurations:
+
+    - before: the historical step (rematPolicy='none', precision='bf16')
+    - after:  the byte-diet step (rematPolicy='full' — per-block
+      rematerialization — plus precision='bf16_grad')
+
+    plus a cheap remat-only probe whose first-steps loss trajectory must
+    be BIT-IDENTICAL to the before leg (remat re-runs the same ops on
+    the same values; 'bf16_grad' is the part that changes numerics and
+    is holdout-parity-pinned in tier-1, not bitwise).  The headline
+    ``resnet50_finetune_*`` keys report the AFTER step — the
+    configuration this build recommends for the bandwidth-bound regime —
+    with the paired roofline blocks making the before/after comparison
+    auditable from the JSON alone."""
+    rng = np.random.default_rng(0)
+    import jax
+    bs = VISION_BATCH * len(jax.devices())
+    imgs = rng.normal(size=(bs, 224, 224, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, bs)
+
+    before = _vision_leg("none", "bf16", imgs, labels)
+    remat_probe = _vision_leg("full", "bf16", imgs, labels, windows=False)
+    after = _vision_leg("full", "bf16_grad", imgs, labels)
+
+    bitexact = remat_probe["probe_losses"] == before["probe_losses"]
     roof = None
-    if bytes_per_sample:
-        per_dev_bs = bs / len(devs)
-        peak, bw = _chip_peak(devs[0]), _chip_bw(devs[0])
-        measured_ms = per_dev_bs / sps_chip * 1e3
-        comp_ms = per_dev_bs * flops_per_sample / peak * 1e3
-        bw_ms = per_dev_bs * bytes_per_sample / bw * 1e3
+    if after["bytes_per_sample"]:
+        blk = after["block"]
         roof = {
-            "xla_bytes_per_sample_mb": bytes_per_sample / 1e6,
-            "xla_flops_per_sample_g": flops_per_sample / 1e9,
-            "roofline_compute_ms": comp_ms,
-            "roofline_bandwidth_ms": bw_ms,
-            "measured_step_ms": measured_ms,
-            "frac_of_bandwidth_roofline": bw_ms / measured_ms,
-            "mfu_ceiling_bandwidth_bound": comp_ms / bw_ms,
+            "xla_bytes_per_sample_mb": after["bytes_per_sample"] / 1e6,
+            "xla_flops_per_sample_g": after["flops_per_sample"] / 1e9,
+            "roofline_compute_ms": blk["compute_ms"],
+            "roofline_bandwidth_ms": blk["bandwidth_ms"],
+            "measured_step_ms": blk["measured_ms"],
+            "frac_of_bandwidth_roofline": blk["frac_of_bandwidth_roofline"],
+            "mfu_ceiling_bandwidth_bound": (
+                blk["compute_ms"] / blk["bandwidth_ms"]
+                if blk["compute_ms"] and blk["bandwidth_ms"] else None),
         }
-    return sps_chip, mfu, roof
+    extras = {
+        **_roofline.paired_roofline("resnet50_finetune", before["block"],
+                                    after["block"]),
+        "resnet50_finetune_remat_bitexact": bool(bitexact),
+        "resnet50_finetune_bytes_reduction": (
+            1.0 - after["bytes_per_sample"] / before["bytes_per_sample"]
+            if after["bytes_per_sample"] and before["bytes_per_sample"]
+            else None),
+        "resnet50_finetune_before_samples_per_sec": before["sps_chip"],
+        "resnet50_finetune_before_mfu": before["mfu"],
+    }
+    return after["sps_chip"], after["mfu"], roof, extras
 
 
 def _gbdt_labels(rng, X):
@@ -304,6 +382,68 @@ def bench_gbdt(X, y, max_bin=GBDT_MAX_BIN, two_level=None):
     Xh = rng.normal(size=(100_000, GBDT_FEATURES)).astype(np.float32)
     auc_h = float(auc(_gbdt_labels(rng, Xh), booster.predict_margin(Xh)))
     return full, steady, warm, auc_h
+
+
+def bench_gbdt_hist_pair(X, y, iters=4):
+    """Fused-vs-unfused histogram ingest, measured as a paired capture.
+
+    Both legs run the SAME protocol: a profiled (eager-host-path) train
+    of ``iters`` iterations at max_bin=255 with ``capture_xla=True``, so
+    ``StepProfiler.capture_cost`` records the one-iteration step
+    program's XLA cost analysis and the per-step compute time.  Emitted:
+
+    - ``gbdt_step_roofline_before/after`` — the canonical paired blocks
+      (bytes/flops per ROW of the captured step program);
+    - ``gbdt_step_bytes_reduction`` — what the compiler actually saved
+      end-to-end (scatter/route internals included, so this is the
+      conservative number);
+    - ``gbdt_hist_ingest_bytes_per_row_before/after`` — the ingest
+      arrays themselves (the ISSUE's "(n_rows,) f32 g/h" stream): the
+      unfused step materializes grad+hess as f32 (8 B/row), the fused
+      step as bf16 (4 B/row) and every per-wave histogram build re-reads
+      them at that width.  50% by construction of the dtypes — verified
+      against the captured programs, not just asserted.
+    """
+    import jax
+    from synapseml_tpu.models.gbdt import BoostingConfig, train
+    from synapseml_tpu.telemetry.gangplane import StepProfiler
+
+    # per-row division by the FULL N is correct here because these legs
+    # train WITHOUT a mesh: the captured program is single-device and
+    # processes all N rows per step (booster's own capture_cost passes
+    # items=N//row_shards for the sharded case — same invariant)
+    N = len(X)
+    legs = {}
+    for fused, tag in ((False, "before"), (True, "after")):
+        prof = StepProfiler(f"gbdt_hist_{tag}", capture_xla=True)
+        cfg = BoostingConfig(objective="binary", num_iterations=iters,
+                             num_leaves=31, max_bin=255,
+                             fused_ingest=fused)
+        train(X, y, cfg, step_profiler=prof)
+        s = prof.summary()
+        cost = (s["roofline"] or {}).get("gbdt_step") or {}
+        step_ms = (s["per_step_avg_seconds"].get("compute") or 0.0) * 1e3
+        bpr = (cost.get("bytes_accessed") or 0.0) / N or None
+        fpr = (cost.get("flops") or 0.0) / N or None
+        legs[tag] = {
+            "bytes_per_row": bpr, "flops_per_row": fpr,
+            "step_ms": step_ms or None,
+            "block": _roofline.roofline_block(
+                bpr, fpr, step_ms or None, device=jax.devices()[0],
+                samples=N),
+            "top_hlos": cost.get("top_hlos", []),
+        }
+    b, a = legs["before"], legs["after"]
+    out = _roofline.paired_roofline("gbdt_step", b["block"], a["block"])
+    out["gbdt_step_bytes_reduction"] = (
+        1.0 - a["bytes_per_row"] / b["bytes_per_row"]
+        if a["bytes_per_row"] and b["bytes_per_row"] else None)
+    # the ingest arrays (g/h materialized between objective and the
+    # histogram builds): f32 pair vs bf16 pair — dtype-determined
+    out["gbdt_hist_ingest_bytes_per_row_before"] = 8.0
+    out["gbdt_hist_ingest_bytes_per_row_after"] = 4.0
+    out["gbdt_hist_ingest_bytes_reduction"] = 0.5
+    return out
 
 
 def bench_gbdt_anchor(X, y):
@@ -413,12 +553,19 @@ def bench_gbdt_streamed(X, y):
     repo = os.path.dirname(os.path.dirname(synapseml_tpu.__file__))
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "bench_stream.smlc")
-        write_matrix(path, np.concatenate(
-            [X, np.asarray(y, np.float32)[:, None]], axis=1))
+        mat = np.concatenate(
+            [X, np.asarray(y, np.float32)[:, None]], axis=1)
+        write_matrix(path, mat)
+        # the bf16 colstore (v2): same matrix at half the bytes — the
+        # storage half of the histogram-ingest byte diet, measured with
+        # the identical scan/stream protocol on the halved file
+        path16 = os.path.join(td, "bench_stream_bf16.smlc")
+        write_matrix(path16, mat, dtype="bf16")
+        size_ratio = os.path.getsize(path16) / os.path.getsize(path)
 
-        def run(mode):
+        def run(mode, p=path):
             r = subprocess.run(
-                [sys.executable, "-c", _STREAM_CHILD, mode, path,
+                [sys.executable, "-c", _STREAM_CHILD, mode, p,
                  str(STREAM_ITERS), repo, str(X.shape[1])],
                 capture_output=True, text=True, timeout=900)
             if r.returncode != 0:
@@ -426,14 +573,19 @@ def bench_gbdt_streamed(X, y):
             return json.loads(r.stdout.strip().splitlines()[-1])
 
         scan = run("scan")
+        scan16 = run("scan", path16)
         streamed = run("stream")
+        streamed16 = run("stream", path16)
         mem = run("mem")
     return {"ingest_rows_per_sec": scan["rows_per_sec"],
             "iters_per_sec": streamed["full_wall_its"],
             "steady_iters_per_sec": streamed["steady_its"],
             "peak_rss_mb": streamed["peak_rss_mb"],
             "inmem_peak_rss_mb": mem["peak_rss_mb"],
-            "inmem_steady_iters_per_sec": mem["steady_its"]}
+            "inmem_steady_iters_per_sec": mem["steady_its"],
+            "bf16_ingest_rows_per_sec": scan16["rows_per_sec"],
+            "bf16_steady_iters_per_sec": streamed16["steady_its"],
+            "colstore_bf16_bytes_ratio": size_ratio}
 
 
 def bench_serving():
@@ -1480,12 +1632,37 @@ def _nullify_nonfinite(obj):
     return obj
 
 
-def main():
-    bert_sps, mfu, n_params = bench_bert()
+class _SkippedLeg(Exception):
+    """Raised inside a leg's try-block when ``--only`` deselects it —
+    rides the section's existing except so skipped legs cost nothing."""
+
+    def __str__(self):
+        return "skipped (--only)"
+
+
+#: bench legs selectable via ``--only`` (comma-separated) — each name
+#: gates one section of main(); everything else is skipped and, when a
+#: prior BENCH_latest.json exists, its values for the skipped legs are
+#: preserved by the merge in main().  The point: re-measure ONE roofline
+#: pair without the full 870s-class sweep.
+BENCH_LEGS = ("bert", "llm", "spec", "llm8b", "resnet_onnx", "vision",
+              "gbdt", "gbdt_pair", "anchor", "streamed", "serving",
+              "gang", "resize", "guard", "comms", "llmserve", "obs")
+
+
+def main(only=None):
+    want = (lambda leg: True) if not only else \
+        (lambda leg: leg in only)
+    bert_sps = mfu = n_params = None
+    bert_extras = None
+    if want("bert"):
+        bert_sps, mfu, n_params, bert_extras = bench_bert()
     llm_tps = llm_tps32 = llm_spec_tps = llm_spec_stats = None
     llm_int8_tps = llm_int8_pipe_tps = None
     llm_int8_slope_ms = llm_int8_fixed_ms = None
     try:
+        if not want("llm"):
+            raise _SkippedLeg()
         (llm_tps, llm_tps32, llm_spec_tps, llm_spec_stats,
          llm_int8_tps, llm_int8_pipe_tps, llm_int8_slope_ms,
          llm_int8_fixed_ms) = bench_llm()
@@ -1509,6 +1686,8 @@ def main():
 
     spec_target = None
     try:
+        if not want("spec"):
+            raise _SkippedLeg()
         spec_target = bench_llm_spec_target()
         sp = spec_target
         print(f"[secondary] speculative decode TARGET regime (in-bench "
@@ -1527,6 +1706,8 @@ def main():
 
     llm8b_tps = llm8b_gb = None
     try:
+        if not want("llm8b"):
+            raise _SkippedLeg()
         llm8b_tps, llm8b_gb = bench_llm_8b_int8()
         print(f"[secondary] Llama-3-8B int8 single-chip decode: "
               f"{llm8b_tps:.0f} tokens/s/chip (batch 4, {llm8b_gb:.1f} GB "
@@ -1536,6 +1717,8 @@ def main():
 
     resnet_ips = resnet_bf16_ips = None
     try:
+        if not want("resnet_onnx"):
+            raise _SkippedLeg()
         resnet_ips, resnet_bf16_ips = bench_resnet50()
         print(f"[secondary] ResNet-50 ONNX batch inference: "
               f"{resnet_ips:.1f} img/s/chip f32, "
@@ -1543,17 +1726,30 @@ def main():
     except Exception as e:
         print(f"[secondary] ResNet-50 bench failed: {e}", file=sys.stderr)
 
-    vision_sps = vision_mfu = vision_roof = None
+    vision_sps = vision_mfu = vision_roof = vision_extras = None
     try:
-        vision_sps, vision_mfu, vision_roof = bench_vision()
-        print(f"[secondary] DeepVisionClassifier ResNet-50 fine-tune: "
+        if not want("vision"):
+            raise _SkippedLeg()
+        vision_sps, vision_mfu, vision_roof, vision_extras = bench_vision()
+        print(f"[secondary] DeepVisionClassifier ResNet-50 fine-tune "
+              f"(remat=full + bf16_grad): "
               f"{vision_sps:.1f} samples/s/chip, MFU {vision_mfu:.3f}",
               file=sys.stderr)
         if vision_roof:
             print(f"[secondary]   roofline: {vision_roof['measured_step_ms']:.1f} ms/step measured, "
-                  f"bandwidth bound {vision_roof['roofline_bandwidth_ms']:.1f} ms "
-                  f"({vision_roof['xla_bytes_per_sample_mb']:.0f} MB/sample), "
-                  f"compute bound {vision_roof['roofline_compute_ms']:.1f} ms",
+                  f"bandwidth bound "
+                  + (f"{vision_roof['roofline_bandwidth_ms']:.1f} ms "
+                     if vision_roof['roofline_bandwidth_ms'] else "n/a ")
+                  + f"({vision_roof['xla_bytes_per_sample_mb']:.0f} MB/sample)",
+                  file=sys.stderr)
+        if vision_extras:
+            red = vision_extras.get("resnet50_finetune_bytes_reduction")
+            print(f"[secondary]   byte diet: "
+                  + (f"{100 * red:.1f}% fewer bytes/sample vs the "
+                     "remat-off f32-grad step" if red is not None
+                     else "capture unavailable")
+                  + f"; remat loss trajectory bit-exact: "
+                  f"{vision_extras['resnet50_finetune_remat_bitexact']}",
                   file=sys.stderr)
     except Exception as e:
         print(f"[secondary] vision bench failed: {e}", file=sys.stderr)
@@ -1562,8 +1758,20 @@ def main():
     gbdt_ips255 = gbdt_steady255 = gbdt_auc255 = None
     anchor_ips = anchor_ips64 = anchor_cores = None
     gbdt_auc = None
+    X = y = None
     try:
-        X, y = _gbdt_data()
+        # inside a guard: a MemoryError allocating the 1M-row matrix
+        # must skip the GBDT legs, not abort the whole bench after the
+        # expensive BERT/LLM/vision legs already finished
+        if any(want(leg) for leg in ("gbdt", "gbdt_pair", "anchor",
+                                     "streamed")):
+            X, y = _gbdt_data()
+    except Exception as e:
+        print(f"[secondary] GBDT data generation failed: {e}",
+              file=sys.stderr)
+    try:
+        if not want("gbdt"):
+            raise _SkippedLeg()
         gbdt_ips, gbdt_steady, gbdt_warm, gbdt_auc = bench_gbdt(X, y)
         print(f"[secondary] GBDT @1Mx{GBDT_FEATURES} max_bin={GBDT_MAX_BIN}: "
               f"{gbdt_ips:.2f} iters/sec "
@@ -1598,8 +1806,28 @@ def main():
     except Exception as e:
         print(f"[secondary] two-level-off contrast failed: {e}",
               file=sys.stderr)
+    gbdt_pair = None
     try:
-        if gbdt_ips is not None:
+        if not want("gbdt_pair"):
+            raise _SkippedLeg()
+        gbdt_pair = bench_gbdt_hist_pair(X, y)
+        red = gbdt_pair.get("gbdt_step_bytes_reduction")
+        print(f"[secondary] GBDT fused bf16 ingest pair (max_bin=255): "
+              f"step bytes/row "
+              f"{(gbdt_pair['gbdt_step_roofline_before']['bytes_per_sample'] or 0):.0f}"
+              f" → "
+              f"{(gbdt_pair['gbdt_step_roofline_after']['bytes_per_sample'] or 0):.0f}"
+              + (f" ({100 * red:.1f}% captured reduction)"
+                 if red is not None else "")
+              + "; ingest arrays 8 → 4 B/row (f32 → bf16 g/h)",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] GBDT fused-pair bench failed: {e}",
+              file=sys.stderr)
+    try:
+        if not want("anchor"):
+            raise _SkippedLeg()
+        if X is not None:
             anchors, anchor_cores = bench_gbdt_anchor(X, y)
             anchor_ips, anchor_ips64 = anchors[255], anchors[64]
             print(f"[anchor] sklearn HistGradientBoosting same host "
@@ -1611,7 +1839,9 @@ def main():
 
     gbdt_streamed = None
     try:
-        if gbdt_ips is not None:
+        if not want("streamed"):
+            raise _SkippedLeg()
+        if X is not None:
             gbdt_streamed = bench_gbdt_streamed(X, y)
             print(f"[secondary] GBDT streamed @1Mx{GBDT_FEATURES} "
                   f"max_bin=63: ingest "
@@ -1629,6 +1859,8 @@ def main():
 
     serving_marg_ms = serving_solo_ms = None
     try:
+        if not want("serving"):
+            raise _SkippedLeg()
         serving_marg_ms, serving_solo_ms = bench_serving()
         print(f"[secondary] continuous serving: {serving_marg_ms:.3f} "
               f"ms/record marginal (window 128), solo RTT "
@@ -1638,6 +1870,8 @@ def main():
 
     gang_recovery_s = gang_hb_pct = gang_launch_s = None
     try:
+        if not want("gang"):
+            raise _SkippedLeg()
         gang_recovery_s, gang_hb_pct, gang_launch_s = bench_gang_recovery()
         print(f"[secondary] gang recovery (SIGKILL → resumed step): "
               f"{gang_recovery_s:.2f} s; heartbeat clean-path overhead "
@@ -1649,6 +1883,8 @@ def main():
 
     resize_shrink_s = resize_grow_s = resize_degraded_pct = None
     try:
+        if not want("resize"):
+            raise _SkippedLeg()
         resize_shrink_s, resize_grow_s, resize_degraded_pct = \
             bench_elastic_resize()
         print(f"[secondary] elastic resize: shrink 2→1 recovery "
@@ -1664,6 +1900,8 @@ def main():
 
     guard_pct = guard_base_ms = guard_guarded_ms = None
     try:
+        if not want("guard"):
+            raise _SkippedLeg()
         guard_pct, guard_base_ms, guard_guarded_ms = bench_guard_overhead()
         print(f"[secondary] row-guard clean-path overhead @100k rows: "
               f"{guard_pct:.2f}% ({guard_base_ms:.2f} ms unguarded → "
@@ -1675,6 +1913,8 @@ def main():
 
     comms = None
     try:
+        if not want("comms"):
+            raise _SkippedLeg()
         comms = bench_comms_compression()
         if "allreduce_error" not in comms:
             wr = (comms["allreduce_logical_bytes"]
@@ -1711,6 +1951,8 @@ def main():
 
     llmserve = None
     try:
+        if not want("llmserve"):
+            raise _SkippedLeg()
         llmserve = bench_llm_serving()
         print(f"[secondary] LLM continuous batching (Poisson open loop, "
               f"{llmserve['offered_rps']:.1f} req/s offered): "
@@ -1742,6 +1984,8 @@ def main():
     obs_pct = obs_bare_ms = obs_observed_ms = None
     obs_step_decomp = None
     try:
+        if not want("obs"):
+            raise _SkippedLeg()
         (obs_pct, obs_bare_ms, obs_observed_ms,
          obs_step_decomp) = bench_obs_overhead()
         print(f"[secondary] gang-observability clean-path overhead: "
@@ -1754,11 +1998,11 @@ def main():
 
     out = {
         "metric": "DeepTextClassifier BERT-base fine-tune throughput per chip",
-        "value": round(bert_sps, 2),
+        "value": round(bert_sps, 2) if bert_sps is not None else None,
         "unit": "samples/sec/chip",
         "vs_baseline": (round(gbdt_ips / anchor_ips64, 3)
                         if gbdt_ips and anchor_ips64 else None),
-        "mfu": round(mfu, 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
         "bert_params": n_params,
         "gbdt_iters_per_sec": round(gbdt_ips, 3) if gbdt_ips else None,
         "gbdt_steady_iters_per_sec": (round(gbdt_steady, 3)
@@ -1779,8 +2023,14 @@ def main():
                                               if vision_sps else None),
         "resnet50_finetune_mfu": (round(vision_mfu, 4)
                                   if vision_mfu else None),
-        **({f"resnet50_finetune_{k}": round(v, 4)
+        **({f"resnet50_finetune_{k}": (round(v, 4) if v is not None
+                                       else None)
             for k, v in vision_roof.items()} if vision_roof else {}),
+        # paired before/after roofline blocks + remat bit-exactness +
+        # byte-diet reduction (ROADMAP item 4's standing requirement)
+        **(vision_extras or {}),
+        **(bert_extras or {}),
+        **(gbdt_pair or {}),
         "resnet50_onnx_imgs_per_sec": (round(resnet_ips, 1)
                                        if resnet_ips else None),
         "resnet50_onnx_bf16_imgs_per_sec": (round(resnet_bf16_ips, 1)
@@ -1832,6 +2082,15 @@ def main():
             if gbdt_streamed else None),
         "gbdt_streamed_inmem_steady_iters_per_sec": (
             round(gbdt_streamed["inmem_steady_iters_per_sec"], 3)
+            if gbdt_streamed else None),
+        "gbdt_streamed_bf16_ingest_rows_per_sec": (
+            round(gbdt_streamed["bf16_ingest_rows_per_sec"], 0)
+            if gbdt_streamed else None),
+        "gbdt_streamed_bf16_steady_iters_per_sec": (
+            round(gbdt_streamed["bf16_steady_iters_per_sec"], 3)
+            if gbdt_streamed else None),
+        "gbdt_colstore_bf16_bytes_ratio": (
+            round(gbdt_streamed["colstore_bf16_bytes_ratio"], 4)
             if gbdt_streamed else None),
         # continuous-batching serving block: emitted all-or-nothing so
         # the tier-1 artifact schema check (llmserve_ completeness) can
@@ -1903,6 +2162,32 @@ def main():
     # zero-length window) become null FIRST: the writer rejects NaN, and
     # one bad secondary must not abort the emit of a finished run
     out = _nullify_nonfinite(out)
+    out_path = os.environ.get(
+        "SML_BENCH_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_latest.json"))
+    if only and out_path:
+        # --only re-measures selected legs WITHOUT discarding the rest
+        # of an existing record: fresh non-null values win, everything
+        # else (other legs, the primary metric when bert is deselected)
+        # is carried over.  A failed selected leg keeps the old value —
+        # its failure is on stderr, the record stays complete.
+        try:
+            with open(out_path, "r", encoding="utf-8") as f:
+                prior = json.load(f)
+            if isinstance(prior, dict):
+                out = {**prior,
+                       **{k: v for k, v in out.items() if v is not None}}
+        except (OSError, ValueError):
+            pass
+        if out.get("value") is None:
+            # no prior record and the bert leg deselected: label the
+            # record as the partial run it is (the metric string alone
+            # would otherwise claim a BERT measurement with value null)
+            out["metric"] = ("partial bench (--only "
+                             + ",".join(sorted(only)) + ")")
+        for k in ("value", "unit", "vs_baseline"):
+            out.setdefault(k, None)
     try:
         line = dumps_checked(out, schema=BENCH_SCHEMA)
     except ValueError as e:
@@ -1911,10 +2196,6 @@ def main():
         print(f"[secondary] bench record failed strict check: {e}",
               file=sys.stderr)
         line = json.dumps(out, default=str)
-    out_path = os.environ.get(
-        "SML_BENCH_OUT",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_latest.json"))
     if out_path:                      # SML_BENCH_OUT="" disables the file
         try:
             write_json(out_path, out, schema=BENCH_SCHEMA)
@@ -1925,4 +2206,23 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="synapseml_tpu benchmark sweep")
+    ap.add_argument(
+        "--only", default=None, metavar="LEG[,LEG...]",
+        help="run only the named legs ("
+             + ", ".join(BENCH_LEGS)
+             + ") and merge their fresh values into an existing "
+             "BENCH_latest.json — re-measure one roofline pair without "
+             "the full sweep")
+    args = ap.parse_args()
+    selected = None
+    if args.only:
+        selected = {leg.strip() for leg in args.only.split(",")
+                    if leg.strip()}
+        unknown = selected - set(BENCH_LEGS)
+        if unknown:
+            ap.error(f"unknown legs {sorted(unknown)}; expected a subset "
+                     f"of {BENCH_LEGS}")
+    main(only=selected)

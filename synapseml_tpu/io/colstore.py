@@ -23,6 +23,36 @@ import numpy as np
 
 _HEADER_BYTES = 4 + 4 + 8 + 8       # magic, version, rows, cols
 
+#: SMLC payload dtype by header version: v1 is the native loader's f32;
+#: v2 stores bf16 (uint16 bit pattern) — half the ingest traffic of the
+#: GBDT streaming path for one bf16 rounding of the feature values
+#: (binning is quantile-based, so split quality is AUC-pinned, not
+#: bit-pinned; see docs/api/perf.md "GBDT fused bf16 ingest")
+_VERSION_F32 = 1
+_VERSION_BF16 = 2
+
+
+def f32_to_bf16_bits(arr: np.ndarray) -> np.ndarray:
+    """float32 → bfloat16 bit patterns (uint16), round-to-nearest-even —
+    the same rounding jax's ``astype(bfloat16)`` applies, implemented on
+    the raw bits so the storage layer needs no ml_dtypes import."""
+    bits = np.ascontiguousarray(arr, np.float32).view(np.uint32)
+    # RNE: add 0x7FFF + lsb-of-kept-half, then truncate
+    rounded = bits + 0x7FFF + ((bits >> 16) & 1)
+    out = (rounded >> 16).astype(np.uint16)
+    # NaN must stay NaN (the rounding above can carry into the exponent
+    # and turn a NaN payload into inf): force the quiet-NaN pattern
+    nan = np.isnan(arr)
+    if nan.any():
+        out[nan] = np.uint16(0x7FC0)
+    return out
+
+
+def bf16_bits_to_f32(bits: np.ndarray) -> np.ndarray:
+    """bfloat16 bit patterns (uint16) → exact float32 values."""
+    return (np.asarray(bits, np.uint16).astype(np.uint32) << 16) \
+        .view(np.float32)
+
 
 def _balanced_range(lo: int, hi: int, index: int,
                     count: int) -> Tuple[int, int]:
@@ -37,16 +67,19 @@ def _balanced_range(lo: int, hi: int, index: int,
     return s, s + base + (1 if index < extra else 0)
 
 
-def _open_colstore(path: str) -> Tuple[np.memmap, int, int]:
+def _open_colstore(path: str) -> Tuple[np.memmap, int, int, bool]:
     with open(path, "rb") as f:
         if f.read(4) != b"SMLC":
             raise IOError(f"{path}: not an SMLC column store")
-        np.frombuffer(f.read(4), np.uint32)          # version
+        version = int(np.frombuffer(f.read(4), np.uint32)[0])
         rows = int(np.frombuffer(f.read(8), np.int64)[0])
         cols = int(np.frombuffer(f.read(8), np.int64)[0])
-    mm = np.memmap(path, np.float32, mode="r", offset=_HEADER_BYTES,
-                   shape=(cols, rows))
-    return mm, rows, cols
+    if version not in (_VERSION_F32, _VERSION_BF16):
+        raise IOError(f"{path}: unknown SMLC version {version}")
+    bf16 = version == _VERSION_BF16
+    mm = np.memmap(path, np.uint16 if bf16 else np.float32, mode="r",
+                   offset=_HEADER_BYTES, shape=(cols, rows))
+    return mm, rows, cols, bf16
 
 
 class ChunkedColumnSource:
@@ -66,7 +99,7 @@ class ChunkedColumnSource:
                  chunk_rows: int = 65_536,
                  row_range: Optional[Tuple[int, int]] = None):
         self.path = path
-        self._mm, total_rows, total_cols = _open_colstore(path)
+        self._mm, total_rows, total_cols, self._bf16 = _open_colstore(path)
         if feature_cols is None:
             excluded = {c for c in (label_col, weight_col) if c is not None}
             feature_cols = [c for c in range(total_cols) if c not in excluded]
@@ -100,18 +133,25 @@ class ChunkedColumnSource:
             self.chunk_rows, row_range=(lo, hi))
 
     # -- reads -------------------------------------------------------------
+    def _col_slice(self, c: int, lo: int, hi: int) -> np.ndarray:
+        """One column's [lo, hi) slice as f32 (exact bf16 upcast on v2
+        stores — NEVER ``astype`` the raw uint16 bit patterns)."""
+        raw = self._mm[c, lo:hi]
+        return bf16_bits_to_f32(raw) if self._bf16 \
+            else np.asarray(raw, np.float32)
+
     def _rows(self, lo: int, hi: int) -> np.ndarray:
         out = np.empty((hi - lo, len(self.feature_cols)), np.float32)
         for j, c in enumerate(self.feature_cols):
-            out[:, j] = self._mm[c, lo:hi]
+            out[:, j] = self._col_slice(c, lo, hi)
         return out
 
     def _read_chunk(self, lo: int, hi: int) -> Tuple[np.ndarray,
                                                      Optional[np.ndarray],
                                                      Optional[np.ndarray]]:
-        y = (np.asarray(self._mm[self.label_col, lo:hi], np.float32)
+        y = (self._col_slice(self.label_col, lo, hi)
              if self.label_col is not None else None)
-        w = (np.asarray(self._mm[self.weight_col, lo:hi], np.float32)
+        w = (self._col_slice(self.weight_col, lo, hi)
              if self.weight_col is not None else None)
         return self._rows(lo, hi), y, w
 
@@ -124,14 +164,12 @@ class ChunkedColumnSource:
     def read_labels(self) -> Optional[np.ndarray]:
         if self.label_col is None:
             return None
-        return np.asarray(self._mm[self.label_col, self._lo:self._hi],
-                          np.float32)
+        return self._col_slice(self.label_col, self._lo, self._hi)
 
     def read_weights(self) -> Optional[np.ndarray]:
         if self.weight_col is None:
             return None
-        return np.asarray(self._mm[self.weight_col, self._lo:self._hi],
-                          np.float32)
+        return self._col_slice(self.weight_col, self._lo, self._hi)
 
     def sample_rows(self, k: int, seed: int = 0) -> np.ndarray:
         """Uniform row sample (same draw as fit_bin_mapper's in-memory
@@ -143,7 +181,9 @@ class ChunkedColumnSource:
         idx = np.sort(rng.choice(n, k, replace=False)) + self._lo
         out = np.empty((k, len(self.feature_cols)), np.float32)
         for j, c in enumerate(self.feature_cols):
-            out[:, j] = self._mm[c][idx]
+            raw = self._mm[c][idx]
+            out[:, j] = bf16_bits_to_f32(raw) if self._bf16 \
+                else raw
         return out
 
     def iter_batches(self, batch_size: int,
@@ -183,11 +223,32 @@ class ChunkedColumnSource:
                          w[full:] if w is not None else None)
 
 
-def write_matrix(path: str, matrix: np.ndarray) -> None:
-    """Write a float32 matrix as an SMLC column store (native fast path
-    when the toolchain is available)."""
-    from ..native import write_colstore
-    write_colstore(path, np.asarray(matrix, np.float32))
+def write_matrix(path: str, matrix: np.ndarray,
+                 dtype: str = "f32") -> None:
+    """Write a matrix as an SMLC column store.
+
+    ``dtype="f32"`` is the native loader's v1 format; ``dtype="bf16"``
+    writes the v2 bf16 colstore — half the bytes on disk AND half the
+    ingest traffic of every later streamed read (the GBDT histogram
+    byte-diet's storage half: values round once to bf16, reads upcast
+    exactly to f32, bin boundaries move by at most one rounding ulp)."""
+    if dtype == "f32":
+        from ..native import write_colstore
+        write_colstore(path, np.asarray(matrix, np.float32))
+        return
+    if dtype != "bf16":
+        raise ValueError(f"dtype={dtype!r}: expected 'f32' or 'bf16'")
+    matrix = np.ascontiguousarray(matrix, np.float32)
+    rows, cols = matrix.shape
+    with open(path, "wb") as f:
+        f.write(b"SMLC")
+        f.write(np.uint32(_VERSION_BF16).tobytes())
+        f.write(np.int64(rows).tobytes())
+        f.write(np.int64(cols).tobytes())
+        # column-major like the native writer: one column = one
+        # contiguous run, which is what chunk reads slice
+        f.write(np.ascontiguousarray(
+            f32_to_bf16_bits(matrix).T).tobytes())
 
 
 def csv_to_colstore(csv_path: str, out_path: str,

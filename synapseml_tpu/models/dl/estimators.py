@@ -136,6 +136,24 @@ class _DLParamsBase(Params):
             "(train_step_seconds{model,segment}); with capture_xla=True "
             "it also records the compiled step's XLA cost analysis for "
             "the roofline summary")
+    rematPolicy = StringParam(
+        doc="rematerialize model blocks in the backward pass: 'none' | "
+            "'dots_saveable' (keep matmul outputs, recompute the cheap "
+            "chains) | 'full'/'blocks' (save only block inputs — O(1)-"
+            "block activation memory for ~1/3 more FLOPs).  Bit-exact vs "
+            "'none' by construction (the recompute re-runs the identical "
+            "ops); the byte-diet lever for bandwidth-bound fine-tunes "
+            "(BENCH roofline)", default="none",
+        allowed=("none", "dots_saveable", "full", "blocks"))
+    precision = StringParam(
+        doc="mixed-precision policy (models/dl/precision.py): 'bf16' "
+            "(default — bf16 activations, f32 grads/params, the "
+            "historical step byte-for-byte) | 'f32' (full-precision "
+            "compute) | 'bf16_grad' (bf16 activations AND gradient "
+            "leaves across the sync boundary; f32 master params/"
+            "optimizer/batch-stats — holdout-parity pinned, composes "
+            "with collectiveCompression, EF residuals stay f32)",
+        default="bf16", allowed=("bf16", "f32", "bf16_grad"))
     collectiveCompression = PyObjectParam(
         doc="wire codec + sharding for the gradient sync: 'none' "
             "(default, the unchanged pjit path) | 'bf16' | 'int8' "
@@ -149,6 +167,15 @@ class _DLParamsBase(Params):
     def _collective_config(self):
         from ...parallel.compression import resolve_collective_config
         return resolve_collective_config(self.get("collectiveCompression"))
+
+    def _precision_policy(self):
+        from .precision import resolve_precision
+        return resolve_precision(self.precision)
+
+    def _model_dtype(self):
+        """Model compute dtype under the precision policy (the models'
+        own default is bf16; 'f32' lifts the whole forward/backward)."""
+        return self._precision_policy().compute_dtype
 
     def _checkpoint_loop(self, trainer: "DLTrainer", state: "TrainState",
                          step=None) -> "_CheckpointLoop":
@@ -212,6 +239,13 @@ class _CheckpointLoop:
         self._config["codec_chunk"] = float(
             cc.chunk if cc is not None and cc.compression == "int8"
             else 0.0)
+        # precision changes the numerics the resumed batches train under
+        # ('bf16_grad' rounds the gradient stream); rematPolicy is
+        # deliberately ABSENT — remat is bit-exact by construction, so a
+        # remat toggle may resume any checkpoint
+        from .precision import PRECISION_CODE
+        self._config["precision"] = PRECISION_CODE[
+            str(est.get_or_default("precision"))]
         manager = est.get("checkpointManager")
         ckpt_dir = est.get("checkpointDir")
         if manager is None and not ckpt_dir:
@@ -230,7 +264,8 @@ class _CheckpointLoop:
         # knob against such a checkpoint mismatches instead of slipping
         # the saved∩current intersection
         for k in ("compression", "sharded_update", "error_feedback",
-                  "manual_step", "codec_min_size", "codec_chunk"):
+                  "manual_step", "codec_min_size", "codec_chunk",
+                  "precision"):       # pre-precision checkpoints = 'bf16'
             saved_cfg.setdefault(k, 0.0)
         # "shards" is the one WORLD-SIZE key: a mismatch there is an
         # elastic gang resize, not a config error — the checkpoint is
@@ -398,13 +433,17 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
         base_cfg = (ckpt_cfg if ckpt_cfg is not None
                     else self._model_config(num_classes))
         # estimator-level overrides applied once, whichever branch built
-        # the config (the checkpoint path carries the pretrained dims)
+        # the config (the checkpoint path carries the pretrained dims);
+        # rematPolicy supersedes the legacy gradientCheckpointing bool
+        remat = (self.rematPolicy if self.rematPolicy != "none"
+                 else bool(self.gradientCheckpointing))
         cfg = dataclasses.replace(base_cfg, num_classes=num_classes,
-                                  remat=bool(self.gradientCheckpointing))
+                                  remat=remat, dtype=self._model_dtype())
         model = TextEncoder(cfg)
         trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
                             zero1=bool(self.zero1),
-                            collective=self._collective_config())
+                            collective=self._collective_config(),
+                            precision=self._precision_policy())
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, ids[:sample_n], mask[:sample_n])
         if ckpt_path:
@@ -435,8 +474,11 @@ class DeepTextClassifier(_DLParamsBase, Estimator):
                     if prof is not None:
                         prof.mark("data")
                         if prof.capture_xla:
+                            # items = per-DEVICE samples: the captured
+                            # cost is the SPMD per-device program's
                             prof.capture_cost("dl_text_step", step,
-                                              state, (bi, bm), bl, key)
+                                              state, (bi, bm), bl, key,
+                                              items=len(idx) // shards)
                     state, metrics = step(state, (bi, bm), bl, key)
                     if prof is not None:
                         # async dispatch returns immediately; sync so
@@ -551,11 +593,14 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
         n = len(imgs)
         total_steps = num_minibatches(n, self.batchSize, shards) * self.maxEpochs
 
-        model = make_backbone(self.backbone, num_classes=len(classes))
+        model = make_backbone(self.backbone, num_classes=len(classes),
+                              remat=self.rematPolicy,
+                              dtype=self._model_dtype())
         trainer = DLTrainer(model, self._opt_config(total_steps), mesh,
                             has_batch_stats=True, train_kwarg="train",
                             zero1=bool(self.zero1),
-                            collective=self._collective_config())
+                            collective=self._collective_config(),
+                            precision=self._precision_policy())
         sample_n = max(self.batchSize, shards)
         state = trainer.init_state(self.seed, imgs[:sample_n])
         if self.get("checkpoint"):
@@ -593,8 +638,10 @@ class DeepVisionClassifier(_DLParamsBase, Estimator):
                     if prof is not None:
                         prof.mark("data")
                         if prof.capture_xla:
+                            # items = per-DEVICE samples (see text path)
                             prof.capture_cost("dl_vision_step", step,
-                                              state, (bi,), bl, key)
+                                              state, (bi,), bl, key,
+                                              items=len(idx) // shards)
                     state, metrics = step(state, (bi,), bl, key)
                     if prof is not None:
                         # async dispatch returns immediately; sync so

@@ -72,6 +72,14 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
+    #: rematerialize each residual block in the backward pass
+    #: ("none" | "dots_saveable" | "full"/"blocks", see
+    #: models/dl/precision.py:remat_policy): the fine-tune step is
+    #: bandwidth-bound (BENCH_r05 roofline), so trading HBM round trips
+    #: of saved activations for recompute FLOPs is the byte-diet lever.
+    #: Bit-exact vs "none" by construction — the recomputation re-runs
+    #: the identical ops (pinned in tests/test_perf_roofline.py).
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -83,12 +91,27 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        from .precision import remat_policy
+        use_remat, policy = remat_policy(self.remat)
+        block_cls = self.block_cls
+        if use_remat:
+            # (x) is the only traced arg; the train flag is baked into
+            # the bound norm partial, so no static_argnums needed
+            block_cls = nn.remat(self.block_cls, policy=policy)
+        # explicit names matching the unwrapped auto-naming
+        # ("<BlockCls>_<k>"): the remat wrapper must not change param
+        # paths, or checkpoints/pretrained imports written without remat
+        # would not load (and init would draw DIFFERENT weights — remat
+        # is pinned bit-exact vs 'none')
+        base_name = self.block_cls.__name__
+        k = 0
         for i, block_size in enumerate(self.stage_sizes):
             for j in range(block_size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(self.num_filters * 2 ** i,
-                                   conv=conv, norm=norm, act=self.act,
-                                   strides=strides)(x)
+                x = block_cls(self.num_filters * 2 ** i,
+                              conv=conv, norm=norm, act=self.act,
+                              strides=strides, name=f"{base_name}_{k}")(x)
+                k += 1
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x

@@ -40,6 +40,7 @@ from ...parallel.compression import (CollectiveConfig, bf16_decode,
 from ...parallel.mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding,
                               data_parallel_mesh, dp_tp_mesh)
 from ...telemetry import get_registry
+from .precision import PrecisionPolicy, cast_floating, resolve_precision, round_to
 from .transformer import LOGICAL_RULES
 
 
@@ -177,9 +178,13 @@ class OptimizerConfig:
         TRUE global norm across shards itself (optax's clip inside the
         shard would see 1/N of the tree and clip per-shard)."""
         if self.schedule == "cosine":
+            # decay_steps counts warmup + cosine; clamp against the
+            # CLAMPED warmup so a 1-step fit still gets >= 1 cosine step
+            # (optax rejects decay_steps == warmup_steps)
+            warm = max(self.warmup_steps, 1)
             lr = optax.warmup_cosine_decay_schedule(
-                0.0, self.learning_rate, max(self.warmup_steps, 1),
-                max(self.total_steps, self.warmup_steps + 1))
+                0.0, self.learning_rate, warm,
+                max(self.total_steps, warm + 1))
         elif self.schedule == "linear":
             lr = optax.linear_schedule(self.learning_rate, 0.0,
                                        max(self.total_steps, 1))
@@ -264,10 +269,16 @@ class DLTrainer:
                  has_batch_stats: bool = False,
                  train_kwarg: str = "deterministic",
                  zero1: bool = False,
-                 collective: Optional[CollectiveConfig] = None):
+                 collective: Optional[CollectiveConfig] = None,
+                 precision: Optional[PrecisionPolicy] = None):
         self.model = model
         self.mesh = mesh
         self.zero1 = zero1
+        # "bf16" (the default) is a no-op contract here: the models
+        # already compute in bf16 with f32 params; only "bf16_grad"
+        # changes the step (gradient leaves rounded to bf16 at the sync
+        # boundary — params/moments/batch stats stay f32 master state)
+        self.precision = resolve_precision(precision)
         self.collective = (collective
                            if collective is not None and collective.enabled
                            else None)
@@ -364,6 +375,12 @@ class DLTrainer:
 
             (loss, (logits, updates)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(state.params)
+            if self.precision.casts_grads:
+                # bf16 gradient leaves cross the (GSPMD) sync boundary
+                # and feed the optimizer read at half width; moments and
+                # params stay f32 (optax promotes per-op), so tiny
+                # updates cannot round to zero — the f32-master contract
+                grads = cast_floating(grads, self.precision.grad_dtype)
             new_params, new_opt = self._apply_updates(state, grads)
             extra = dict(state.extra_vars)
             extra.update(updates)
@@ -595,6 +612,11 @@ class DLTrainer:
                               residuals=None):
             (loss, (logits, updates)), grads = local_grads(
                 state, inputs, labels, dropout_key)
+            if self.precision.casts_grads:
+                # round THROUGH bf16, keep f32 containers: the wire
+                # codec owns the wire dtype and the EF residual math
+                # stays f32 — they just see bf16-rounded values
+                grads = round_to(grads, self.precision.grad_dtype)
             grads, new_res = compressed_tree_sync(
                 grads, axis, cfg, residuals=residuals, mean=True)
             new_params, new_opt = self._apply_updates(state, grads)
@@ -606,6 +628,9 @@ class DLTrainer:
                            residuals=None):
             (loss, (logits, updates)), grads = local_grads(
                 state, inputs, labels, dropout_key)
+            if self.precision.casts_grads:
+                # same rounding contract as replicated_update above
+                grads = round_to(grads, self.precision.grad_dtype)
             p_leaves, p_def = jax.tree_util.tree_flatten(state.params)
             g_leaves = jax.tree_util.tree_leaves(grads)
             res_leaves = (jax.tree_util.tree_leaves(residuals)

@@ -64,8 +64,12 @@ class TransformerConfig:
     #: rematerialize each encoder block's activations in the backward pass
     #: (jax.checkpoint): activation memory drops from O(layers) to O(1)
     #: blocks for ~1/3 extra FLOPs — the knob that fits longer sequences /
-    #: bigger per-chip batches in HBM
-    remat: bool = False
+    #: bigger per-chip batches in HBM.  Accepts the legacy bool (True =
+    #: "full") or a rematPolicy name ("none" | "dots_saveable" |
+    #: "full"/"blocks", see models/dl/precision.py:remat_policy);
+    #: "dots_saveable" keeps the attention/MLP matmul outputs and
+    #: recomputes only the cheap elementwise/norm chains
+    remat: Any = False
     seq_axis: str = "seq"
     num_experts: int = 0              # >0: MoE FFN on every moe_layer_freq-th block
     moe_top_k: int = 2
@@ -265,9 +269,11 @@ class TextEncoder(nn.Module):
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_embed")(tok + pos)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
 
+        from .precision import remat_policy
+        use_remat, policy = remat_policy(cfg.remat)
         block_cls = EncoderBlock
-        if cfg.remat:
-            block_cls = nn.remat(EncoderBlock,
+        if use_remat:
+            block_cls = nn.remat(EncoderBlock, policy=policy,
                                  static_argnums=(3,))   # deterministic flag
         for i in range(cfg.num_layers):
             moe = (cfg.num_experts > 0

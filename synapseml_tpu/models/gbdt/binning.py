@@ -52,7 +52,14 @@ class BinMapper:
         return bool(self.cat_features)
 
     def transform(self, features: np.ndarray) -> np.ndarray:
-        """Map raw (n, F) floats → (n, F) int32 bins ∈ [0, max_bin]."""
+        """Map raw (n, F) floats → (n, F) int32 bins ∈ [0, max_bin].
+
+        Accepts any float dtype (the bf16 colstore's streamed chunks
+        arrive as exact f32 upcasts of bf16-rounded values — see
+        ``io.colstore.write_matrix(dtype="bf16")``: boundaries are
+        quantiles, so bf16-rounding the values moves a row across a
+        boundary only when it was within one rounding ulp of it)."""
+        features = np.asarray(features, np.float32)
         n, f = features.shape
         out = np.empty((n, f), np.int32)
         cat = self.cat_features or {}

@@ -217,10 +217,19 @@ def read_colstore(path: str) -> np.ndarray:
         magic = f.read(4)
         if magic != b"SMLC":
             raise IOError(f"{path}: not an SMLC column store")
-        np.frombuffer(f.read(4), np.uint32)  # version
+        version = int(np.frombuffer(f.read(4), np.uint32)[0])
         rows = int(np.frombuffer(f.read(8), np.int64)[0])
         cols = int(np.frombuffer(f.read(8), np.int64)[0])
-        data = np.frombuffer(f.read(rows * cols * 4), np.float32)
+        if version == 1:
+            data = np.frombuffer(f.read(rows * cols * 4), np.float32)
+        elif version == 2:
+            # v2 stores bf16 bit patterns (io.colstore.write_matrix
+            # dtype="bf16"); upcast exactly like ChunkedColumnSource
+            from ..io.colstore import bf16_bits_to_f32
+            data = bf16_bits_to_f32(
+                np.frombuffer(f.read(rows * cols * 2), np.uint16))
+        else:
+            raise IOError(f"{path}: unknown SMLC version {version}")
     return data.reshape(cols, rows).T
 
 

@@ -99,6 +99,31 @@ def _topk_conditional(queries: jnp.ndarray, index: jnp.ndarray,
     return best_d, best_i
 
 
+def _refine_topk(queries: np.ndarray, points: np.ndarray,
+                 idx: np.ndarray):
+    """Exact re-computation of the k winners' squared distances.
+
+    The MXU kernel's ``|q|^2 - 2 q.x + |x|^2`` expansion cancels
+    catastrophically near zero distance — a self-match reports
+    ~sqrt(eps.|x|^2) (measured ~1.4e-3 on 128-dim unit-scale data, the
+    env failure carried since PR 3).  The kernel still finds the right
+    NEIGHBOURS (error is uniform across candidates); only the k returned
+    distances need the direct ``sum((q - x)^2)`` form, which is O(Q.k.D)
+    on the host — noise next to the O(Q.N.D) scan.  Winners re-sort on
+    the refined distances (stable, so expansion-order ties keep the
+    kernel's order); padded ``-1`` slots stay +inf/last."""
+    queries = np.atleast_2d(np.asarray(queries, np.float32))
+    idx = np.asarray(idx)
+    valid = idx >= 0
+    pts = np.asarray(points, np.float32)[np.maximum(idx, 0)]   # (Q, k, D)
+    diff = pts - queries[:, None, :]
+    d2r = np.einsum("qkd,qkd->qk", diff, diff, dtype=np.float64)
+    d2r[~valid] = np.inf
+    order = np.argsort(d2r, axis=1, kind="stable")
+    return (np.take_along_axis(d2r, order, axis=1),
+            np.take_along_axis(idx, order, axis=1))
+
+
 def _pad_rows(mat: np.ndarray, multiple: int):
     n = mat.shape[0]
     padded = -(-n // multiple) * multiple
@@ -137,10 +162,9 @@ class BallTree:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         k = min(k, len(self.points))
         padded, valid = _pad_rows(self.points, self.tile)
-        d2, idx = _topk_neighbors(jnp.asarray(queries), jnp.asarray(padded),
-                                  k, self.tile, jnp.asarray(valid))
-        d2 = np.maximum(np.asarray(d2), 0.0)
-        idx = np.asarray(idx)
+        _, idx = _topk_neighbors(jnp.asarray(queries), jnp.asarray(padded),
+                                 k, self.tile, jnp.asarray(valid))
+        d2, idx = _refine_topk(queries, self.points, np.asarray(idx))
         return np.sqrt(d2), idx
 
     def query_point(self, point: np.ndarray, k: int = 1):
@@ -192,10 +216,9 @@ class KNNModel(Model):
         k = min(int(self.k), len(index))
         tile = int(min(self.leafSize, max(8, len(index))))
         padded, valid = _pad_rows(index, tile)
-        d2, idx = _topk_neighbors(jnp.asarray(queries), jnp.asarray(padded),
-                                  k, tile, jnp.asarray(valid))
-        d2 = np.maximum(np.asarray(d2), 0.0)
-        idx = np.asarray(idx)
+        _, idx = _topk_neighbors(jnp.asarray(queries), jnp.asarray(padded),
+                                 k, tile, jnp.asarray(valid))
+        d2, idx = _refine_topk(queries, index, np.asarray(idx))
         out = np.empty(ds.num_rows, dtype=object)
         for i in range(ds.num_rows):
             out[i] = [{"value": values[j], "distance": float(np.sqrt(d))}
@@ -271,11 +294,10 @@ class ConditionalKNNModel(Model):
         padded, valid = _pad_rows(index, tile)
         lab_padded = np.zeros(len(padded), np.int32)
         lab_padded[:len(labels)] = labels
-        d2, idx = _topk_conditional(
+        _, idx = _topk_conditional(
             jnp.asarray(queries), jnp.asarray(padded), jnp.asarray(lab_padded),
             jnp.asarray(cond), k, tile, jnp.asarray(valid), n_labels)
-        d2 = np.maximum(np.asarray(d2), 0.0)
-        idx = np.asarray(idx)
+        d2, idx = _refine_topk(queries, index, np.asarray(idx))
         out = np.empty(ds.num_rows, dtype=object)
         for i in range(ds.num_rows):
             matches = []

@@ -18,6 +18,10 @@ telemetry plus ``LightGBMPerformance.scala`` phase measures:
   allocation-stable ring of structured events (collectives, checkpoint
   publishes, backoffs, fault firings, heartbeats, rowguard verdicts),
   dumped SIGKILL-atomically for post-mortem bundles.
+- :mod:`.roofline` — the roofline auditor: XLA-captured bytes/flops +
+  top byte-moving HLOs for any jitted step, and the canonical paired
+  before/after roofline block every perf change lands with in
+  ``BENCH_latest.json`` (ROADMAP item 4's standing requirement).
 - :mod:`.gangplane` — the gang-wide observability plane: cross-rank
   metric/span export over the ``SMLMP_TM:`` wire, ``worker_*{rank=}``
   mirroring into the coordinator's ``/metrics``, multi-lane Chrome-trace
@@ -67,6 +71,8 @@ from .gangplane import (GangPlane, StepProfiler, TM_MARKER,
                         check_postmortem, parse_telemetry, write_postmortem)
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, get_registry)
+from .roofline import (ROOFLINE_BLOCK_KEYS, check_roofline_block,
+                       paired_roofline, roofline_block)
 from .tracing import Span, Tracer, get_tracer, span
 
 __all__ = [
@@ -79,4 +85,6 @@ __all__ = [
     "FlightRecorder", "get_flight",
     "GangPlane", "StepProfiler", "TM_MARKER", "check_postmortem",
     "parse_telemetry", "write_postmortem",
+    "ROOFLINE_BLOCK_KEYS", "check_roofline_block", "paired_roofline",
+    "roofline_block",
 ]

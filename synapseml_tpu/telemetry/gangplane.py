@@ -72,7 +72,8 @@ MAX_SPANS_PER_BATCH = 1000
 GANG_METRICS = frozenset({
     "gangplane_batches_total", "gangplane_spans_total",
     "postmortem_bundles_total", "train_step_seconds", "train_steps_total",
-    "serving_replica_probe_status",
+    "serving_replica_probe_status", "train_step_bytes_per_sample",
+    "train_step_mfu",
 })
 
 
@@ -558,6 +559,18 @@ class StepProfiler:
                                          (*self.SEGMENTS, "total")}
         self.collective_bytes = 0
         self.costs: Dict[str, Optional[Dict[str, float]]] = {}
+        #: per-device items (samples/rows) one step processes, by capture
+        #: key — feeds the per-sample gauges in :meth:`summary`
+        self._cost_items: Dict[str, float] = {}
+        self._g_bytes = reg.gauge(
+            "train_step_bytes_per_sample",
+            "XLA-captured bytes accessed per sample of the compiled train "
+            "step (per device)", ("model", "key"))
+        self._g_mfu = reg.gauge(
+            "train_step_mfu",
+            "achieved model-flops utilization of the profiled train step "
+            "against the device's spec-sheet peak (absent table entry = "
+            "gauge not set)", ("model", "key"))
         self._tail: "collections.deque[dict]" = collections.deque(
             maxlen=max(1, max_step_records))
         # open-step state (thread-local via _active while a step is open)
@@ -648,25 +661,26 @@ class StepProfiler:
             self.collective_bytes += int(nbytes)
 
     # -- XLA cost analysis -------------------------------------------------
-    def capture_cost(self, key: str, fn, *args,
+    def capture_cost(self, key: str, fn, *args, items: Optional[float] = None,
                      **kw) -> Optional[Dict[str, float]]:
         """Once per ``key``: lower + compile ``fn`` on ``args`` and
-        record XLA's cost analysis (flops, bytes accessed).  Triggers an
-        AOT compile, so call it at most once per compiled fn and only
-        when roofline numbers are wanted (``capture_xla=True`` callers);
-        any failure records None and never propagates."""
+        record XLA's cost analysis (flops, bytes accessed) plus the top
+        byte-moving HLOs (via :mod:`telemetry.roofline`).  ``items`` is
+        the per-device sample (or row) count one step processes — when
+        given, :meth:`summary` also exports the
+        ``train_step_bytes_per_sample`` / ``train_step_mfu`` gauges so
+        byte regressions surface in live ``/metrics``, not just bench
+        runs.  Triggers an AOT compile, so call it at most once per
+        compiled fn and only when roofline numbers are wanted
+        (``capture_xla=True`` callers); any failure records None and
+        never propagates."""
         if key in self.costs:
             return self.costs[key]
-        entry: Optional[Dict[str, float]] = None
-        try:
-            ca = fn.lower(*args, **kw).compile().cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else {}
-            entry = {"flops": float(ca.get("flops", 0.0)),
-                     "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
-        except Exception:
-            entry = None
+        from . import roofline as _roofline
+        entry = _roofline.capture(fn, *args, **kw)
         self.costs[key] = entry
+        if items:
+            self._cost_items[key] = float(items)
         return entry
 
     # -- export ------------------------------------------------------------
@@ -686,6 +700,7 @@ class StepProfiler:
                 roofline[key] = None
                 continue
             compute_s = avg.get("compute") or avg.get("total") or 0.0
+            items = self._cost_items.get(key)
             roofline[key] = {
                 **cost,
                 "arithmetic_intensity": (
@@ -696,7 +711,25 @@ class StepProfiler:
                 "achieved_bytes_per_sec": (
                     cost["bytes_accessed"] / compute_s
                     if compute_s else None),
+                "bytes_per_sample": (cost["bytes_accessed"] / items
+                                     if items else None),
             }
+            # live-telemetry export (the bench-independent view of byte
+            # regressions); telemetry must never break the summary
+            try:
+                if items and cost["bytes_accessed"]:
+                    self._g_bytes.set(cost["bytes_accessed"] / items,
+                                      model=self.model, key=key)
+                if compute_s and cost["flops"]:
+                    from . import roofline as _roofline
+                    import jax as _jax
+                    peak = _roofline.chip_peak_flops(_jax.devices()[0])
+                    if peak:
+                        self._g_mfu.set(
+                            cost["flops"] / compute_s / peak,
+                            model=self.model, key=key)
+            except Exception:
+                pass
         return {"model": self.model, "steps": steps, "seconds": totals,
                 "per_step_avg_seconds": avg,
                 "collective_bytes": cbytes,

@@ -92,6 +92,38 @@ def _bench_records():
     return records
 
 
+def test_roofline_blocks_paired_and_complete():
+    """Same schema discipline as the llmserve sweep: a record carrying
+    ANY ``*_roofline_*`` key must carry the FULL paired block — both the
+    ``_before`` and ``_after`` side for that leg, each a dict with
+    exactly the canonical field set (bytes_per_sample / flops_per_sample
+    / compute_ms / bandwidth_ms / measured_ms /
+    frac_of_bandwidth_roofline), every field numeric or null — so a
+    half-captured pair can't masquerade as a before/after measurement."""
+    import re
+
+    from synapseml_tpu.telemetry.roofline import check_roofline_block
+
+    pat = re.compile(r"^(.+)_roofline_(before|after)$")
+    for name, rec in _bench_records():
+        for key in rec:
+            m = pat.match(key)
+            if not m:
+                assert "_roofline_" not in key, (
+                    f"{name}: {key} looks roofline-shaped but is neither "
+                    "_before nor _after")
+                continue
+            leg, side = m.group(1), m.group(2)
+            other = f"{leg}_roofline_" + ("after" if side == "before"
+                                          else "before")
+            assert other in rec, (
+                f"{name}: {key} present without its pair {other}")
+            try:
+                check_roofline_block(rec[key])
+            except ValueError as e:
+                raise AssertionError(f"{name}: {key}: {e}") from None
+
+
 def test_llmserve_fields_complete():
     """A record carrying any continuous-batching serving field carries
     the whole set, each numeric or null."""

@@ -636,7 +636,8 @@ class TestCheckpointCompat:
             return types.SimpleNamespace(
                 checkpointInterval=1,
                 get_or_default=lambda k: {"batchSize": 8.0, "seed": 0.0,
-                                          "validationFraction": 0.0}[k],
+                                          "validationFraction": 0.0,
+                                          "precision": "bf16"}[k],
                 get=lambda k: {"checkpointManager": mgr}.get(k))
 
         def trainer(collective):
@@ -675,7 +676,8 @@ class TestCheckpointCompat:
             return types.SimpleNamespace(
                 checkpointInterval=1,
                 get_or_default=lambda k: {"batchSize": 8.0, "seed": 0.0,
-                                          "validationFraction": 0.0}[k],
+                                          "validationFraction": 0.0,
+                                          "precision": "bf16"}[k],
                 get=lambda k: {"checkpointManager": mgr}.get(k))
 
         def trainer(collective):

@@ -543,8 +543,9 @@ class TestStepProfiler:
 
         prof = self._prof(capture_xla=True)
         fn = _Fn()
-        assert prof.capture_cost("step_fn", fn) == {
-            "flops": 100.0, "bytes_accessed": 50.0}
+        got = prof.capture_cost("step_fn", fn, items=4)
+        assert got["flops"] == 100.0 and got["bytes_accessed"] == 50.0
+        assert got["top_hlos"] == []         # mock exposes no HLO text
         prof.capture_cost("step_fn", fn)
         assert _Fn.calls == 1                # once per key
         with prof.step(0):
@@ -554,7 +555,12 @@ class TestStepProfiler:
         roof = s["roofline"]["step_fn"]
         assert roof["arithmetic_intensity"] == pytest.approx(2.0)
         assert roof["achieved_flops_per_sec"] > 0
+        assert roof["bytes_per_sample"] == pytest.approx(50.0 / 4)
         assert s["steps"] == 1 and s["model"] == "test_model"
+        # the live-telemetry export of the per-sample bytes (satellite:
+        # byte regressions must show in /metrics, not just bench runs)
+        assert any(v == pytest.approx(50.0 / 4)
+                   for v in prof._g_bytes.series().values())
 
     def test_capture_cost_failure_records_none(self):
         prof = self._prof(capture_xla=True)
