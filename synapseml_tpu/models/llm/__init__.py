@@ -11,17 +11,26 @@ from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
                     causal_lm_loss, init_cache, llama_from_pretrained,
                     rope_frequencies)
+from .pallas_attn import (ATTENTION_BACKENDS, PagedGeometry,
+                          dense_read_bytes, paged_decode_attention,
+                          paged_geometry, paged_read_bytes,
+                          resolve_attention_backend, span_bucket_tiles)
 from .slots import AdmitResult, SlotEngine, StepEvent
 from .stage import LLMTransformer
 
 __all__ = [
+    "ATTENTION_BACKENDS",
     "LLM_LOGICAL_RULES", "AdmitResult", "CausalAttention", "DecoderBlock",
     "LLMTransformer",
-    "LlamaConfig", "LlamaModel", "RMSNorm", "SlotEngine", "StepEvent",
+    "LlamaConfig", "LlamaModel", "PagedGeometry", "RMSNorm", "SlotEngine",
+    "StepEvent",
     "apply_rope", "causal_lm_loss",
-    "cast_params", "finetune_lm", "generate", "generate_speculative",
+    "cast_params", "dense_read_bytes", "finetune_lm", "generate",
+    "generate_speculative",
     "init_cache", "llama_from_pretrained", "make_lm_train_step",
+    "paged_decode_attention", "paged_geometry", "paged_read_bytes",
     "quantize_int8",
-    "rope_frequencies", "sample_logits", "spec_unpack",
+    "resolve_attention_backend", "rope_frequencies", "sample_logits",
+    "span_bucket_tiles", "spec_unpack",
     "templated_log_corpus",
 ]

@@ -198,7 +198,10 @@ class CausalAttention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Dict],
                  cache_index: Optional[jnp.ndarray],
-                 slot_mask: Optional[jnp.ndarray] = None):
+                 slot_mask: Optional[jnp.ndarray] = None,
+                 attention_backend: str = "dense",
+                 paged_num_tiles: Optional[int] = None,
+                 paged_tile: Optional[int] = None):
         cfg = self.cfg
         B, S, _ = x.shape
         H, KV, D = cfg.num_heads, cfg.num_kv_heads, cfg.d_head
@@ -258,16 +261,48 @@ class CausalAttention(nn.Module):
             T = S
             causal = jnp.tril(jnp.ones((S, S), bool))[None]     # (1, S, S)
 
-        group = H // KV
-        qg = q.reshape(B, S, KV, group, D)
-        logits = jnp.einsum("bskgd,btkd->bkgst", qg, k_att,
-                            preferred_element_type=jnp.float32)
-        logits = logits / np.sqrt(D)
-        mask = jnp.broadcast_to(causal[:, None, None, :, :], logits.shape)
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bkgst,btkd->bskgd", probs, v_att)
-        out = out.reshape(B, S, H * D)
+        if (attention_backend in ("paged", "interpret")
+                and cache is not None and jnp.ndim(cache_index) != 0
+                and S == 1):
+            # paged decode read: each slot attends ONLY its live K/V
+            # span [0, positions+1) through the Pallas online-softmax
+            # kernel — bytes scale with live tokens, not cache capacity
+            # (the vector-cache_index single-token step is the serving
+            # hot loop; prefill and training stay dense, where the
+            # full-row read is the work).  ``paged_tile`` is the
+            # engine-resolved geometry (the byte ledger prices the
+            # same tile by construction); absent it, re-derive — the
+            # direct-apply ergonomic path.
+            from .pallas_attn import paged_decode_attention, \
+                paged_geometry
+            tile = paged_tile
+            if tile is None:
+                geo = paged_geometry(T, H, KV, D, cfg.dtype)
+                if geo is None:
+                    raise ValueError(
+                        f"attention_backend={attention_backend!r}: no "
+                        f"paged geometry for max_len={T}, "
+                        f"kv_heads={KV}, d_head={D} — resolve the "
+                        "backend via resolve_attention_backend first")
+                tile = geo.tile
+            spans = positions[:, 0].astype(jnp.int32) + 1
+            out = paged_decode_attention(
+                q[:, 0], k_all, v_all, spans, tile=tile,
+                num_tiles=(paged_num_tiles or T // tile),
+                interpret=(attention_backend == "interpret")
+            ).reshape(B, S, H * D)
+        else:
+            group = H // KV
+            qg = q.reshape(B, S, KV, group, D)
+            logits = jnp.einsum("bskgd,btkd->bkgst", qg, k_att,
+                                preferred_element_type=jnp.float32)
+            logits = logits / np.sqrt(D)
+            mask = jnp.broadcast_to(causal[:, None, None, :, :],
+                                    logits.shape)
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bkgst,btkd->bskgd", probs, v_att)
+            out = out.reshape(B, S, H * D)
         out = _dense(cfg.d_model, ("heads", "embed"), "o_proj",
                      cfg.dtype, cfg.weight_quant)(out)
         return out, new_cache
@@ -277,11 +312,15 @@ class DecoderBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache, cache_index, slot_mask=None):
+    def __call__(self, x, positions, cache, cache_index, slot_mask=None,
+                 attention_backend: str = "dense",
+                 paged_num_tiles: Optional[int] = None,
+                 paged_tile: Optional[int] = None):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_attn")(x)
         a, new_cache = CausalAttention(cfg, name="attn")(
-            h, positions, cache, cache_index, slot_mask)
+            h, positions, cache, cache_index, slot_mask,
+            attention_backend, paged_num_tiles, paged_tile)
         x = x + a
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_mlp")(x)
         gate = _dense(cfg.d_ff, ("embed", "mlp"), "gate_proj", cfg.dtype,
@@ -302,7 +341,10 @@ class LlamaModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, positions=None, cache=None,
                  cache_index=None, deterministic: bool = True,
-                 slot_mask: Optional[jnp.ndarray] = None):
+                 slot_mask: Optional[jnp.ndarray] = None,
+                 attention_backend: str = "dense",
+                 paged_num_tiles: Optional[int] = None,
+                 paged_tile: Optional[int] = None):
         cfg = self.cfg
         B, S = input_ids.shape
         if positions is None:
@@ -321,7 +363,8 @@ class LlamaModel(nn.Module):
         for i in range(cfg.num_layers):
             layer_cache = cache[i] if cache is not None else None
             x, nc = DecoderBlock(cfg, name=f"layer_{i}")(
-                x, positions, layer_cache, cache_index, slot_mask)
+                x, positions, layer_cache, cache_index, slot_mask,
+                attention_backend, paged_num_tiles, paged_tile)
             new_caches.append(nc)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="ln_final")(x)
         if cfg.tie_embeddings:

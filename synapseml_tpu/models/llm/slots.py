@@ -17,7 +17,12 @@ Mechanics:
   slot's K/V at its own offset, the causal mask (``key_pos <= qpos``)
   confines each slot to its own prefix, and ``slot_mask`` gates writes
   so inactive slots' rows stay untouched (they are live prefix-cache
-  material).
+  material).  ``attention_backend`` selects the attention READ: dense
+  (full ``max_len`` rows, masked) or the Pallas paged kernel
+  (:mod:`~synapseml_tpu.models.llm.pallas_attn` — only each slot's
+  live span, span-bucketed so one compiled step exists per power-of-
+  two tile bucket; ``'auto'`` = paged on TPU when the geometry fits
+  VMEM).
 - **prefill-into-slot** — the prompt is padded to a power-of-two bucket
   (bounded compile count), its K/V lands in ONE slot row (sliced out,
   filled batch-1, written back), and the true-last-token logits come
@@ -59,6 +64,9 @@ from jax import lax
 from ...telemetry import get_registry
 from .generate import sample_logits
 from .model import LlamaModel, init_cache
+from .pallas_attn import (dense_read_bytes, paged_geometry,
+                          paged_read_bytes, resolve_attention_backend,
+                          span_bucket_tiles)
 
 
 @functools.partial(jax.jit, static_argnames=("model",),
@@ -88,18 +96,30 @@ def _prefill_slot_jit(model: LlamaModel, variables: Any, cache: Any,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "model", "temperature", "top_k", "top_p"), donate_argnums=(2,))
+    "model", "temperature", "top_k", "top_p", "attention_backend",
+    "paged_num_tiles", "paged_tile"), donate_argnums=(2,))
 def _decode_step_jit(model: LlamaModel, variables: Any, cache: Any,
                      tokens: jnp.ndarray, lengths: jnp.ndarray,
                      active: jnp.ndarray, key: jnp.ndarray,
-                     temperature: float, top_k: int, top_p: float):
+                     temperature: float, top_k: int, top_p: float,
+                     attention_backend: str = "dense",
+                     paged_num_tiles: Optional[int] = None,
+                     paged_tile: Optional[int] = None):
     """One decode step for every slot: feed each slot's pending token at
     its own position (vector ``cache_index``), sample the next.  Inactive
-    slots compute a throwaway row and write nothing (``slot_mask``)."""
+    slots compute a throwaway row and write nothing (``slot_mask``).
+
+    ``attention_backend``/``paged_num_tiles`` (static — one compiled
+    program per span bucket) select the Pallas paged-read attention:
+    each slot's K/V read covers only its live span instead of the full
+    ``max_len`` row (see :mod:`~synapseml_tpu.models.llm.pallas_attn`)."""
     positions = (lengths - 1)[:, None]
     logits, cache = model.apply(variables, tokens[:, None],
                                 positions=positions, cache=cache,
-                                cache_index=lengths - 1, slot_mask=active)
+                                cache_index=lengths - 1, slot_mask=active,
+                                attention_backend=attention_backend,
+                                paged_num_tiles=paged_num_tiles,
+                                paged_tile=paged_tile)
     key, sub = jax.random.split(key)
     nxt = sample_logits(logits[:, 0], sub, temperature, top_k, top_p)
     return cache, nxt, key
@@ -158,12 +178,31 @@ class SlotEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,
                  pad_id: int = 0, min_prefix: int = 8,
-                 min_bucket: int = 8, seed: int = 0, name: str = "llm"):
+                 min_bucket: int = 8, seed: int = 0, name: str = "llm",
+                 attention_backend: str = "auto", step_profiler=None):
         self.model = model
         self.variables = variables
         self.cfg = model.cfg
         self.n_slots = int(n_slots)
         self.max_len = int(max_len or self.cfg.max_len)
+        # decode-attention backend: 'auto' resolves to the Pallas paged
+        # kernel on TPU when the geometry fits VMEM, dense otherwise;
+        # 'paged'/'interpret' fail fast when they cannot run (the
+        # resolve_collective_config validation idiom)
+        self.attention_backend = resolve_attention_backend(
+            attention_backend, max_len=self.max_len,
+            num_heads=self.cfg.num_heads,
+            num_kv_heads=self.cfg.num_kv_heads,
+            d_head=self.cfg.d_head, dtype=self.cfg.dtype)
+        self._paged_geo = (None if self.attention_backend == "dense"
+                          else paged_geometry(
+                              self.max_len, self.cfg.num_heads,
+                              self.cfg.num_kv_heads, self.cfg.d_head,
+                              self.cfg.dtype))
+        #: optional telemetry.gangplane.StepProfiler — decode steps run
+        #: under step/mark and (capture_xla) the per-bucket step program
+        #: goes through capture_cost for the roofline gauges
+        self.step_profiler = step_profiler
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -213,11 +252,19 @@ class SlotEngine:
             "prefilled", ("engine",))
         self._m_occ = reg.gauge(
             "llm_slot_occupancy", "active slots / total slots", ("engine",))
+        self._m_decode_bytes = reg.gauge(
+            "llm_decode_bytes_per_token",
+            "decode-attention K/V bytes read per generated token this "
+            "step (exact DMA ledger for the paged kernel; the full-"
+            "capacity read model for dense)", ("engine", "backend"))
         self.admissions = 0
         self.evictions = 0
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
         self.tokens_generated = 0
+        #: cumulative decode-attention K/V bytes (the ledger feeding the
+        #: gauge above; bench reads it for the paired roofline block)
+        self.decode_attn_bytes = 0
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -424,6 +471,45 @@ class SlotEngine:
         self._slot_hash = [None] * self.n_slots
         self._m_occ.set(0.0, engine=self.name)
 
+    def _decode_step_args(self):
+        """(jit kwargs, spans) for THIS step: the span-bucketed grid
+        length for the paged backends (one compiled program per power-
+        of-two tile bucket, so short batches never iterate a long
+        cache's grid) and the per-slot live spans the byte ledger
+        prices."""
+        lengths = np.where(self.active, self.lengths, 1)
+        kw = {"attention_backend": self.attention_backend,
+              "paged_num_tiles": None, "paged_tile": None}
+        if self._paged_geo is not None:
+            # the engine's resolved tile rides the jit statics so the
+            # kernel and the byte ledger can never price different
+            # geometries
+            kw["paged_num_tiles"] = span_bucket_tiles(
+                int(lengths.max()), self._paged_geo)
+            kw["paged_tile"] = self._paged_geo.tile
+        return kw, lengths
+
+    def _account_decode_bytes(self, spans: np.ndarray, served: int) -> None:
+        """Per-step decode-attention K/V read accounting → the
+        ``llm_decode_bytes_per_token`` gauge (exact for the paged
+        kernel by construction of its clamped-index grid — ``spans``
+        covers ALL slots, inactive ones at span 1, because every grid
+        row DMAs at least its first tile; the full-capacity model for
+        dense)."""
+        itemsize = np.dtype(self.cfg.dtype).itemsize
+        if self._paged_geo is not None:
+            nbytes = paged_read_bytes(
+                spans, self._paged_geo.tile, self.cfg.num_kv_heads,
+                self.cfg.d_head, itemsize, self.cfg.num_layers)
+        else:
+            nbytes = dense_read_bytes(
+                self.n_slots, self.max_len, self.cfg.num_kv_heads,
+                self.cfg.d_head, itemsize, self.cfg.num_layers)
+        self.decode_attn_bytes += nbytes
+        self._m_decode_bytes.set(nbytes / max(1, served),
+                                 engine=self.name,
+                                 backend=self.attention_backend)
+
     def step(self) -> List[StepEvent]:
         """One decode step across every active slot.  Returns the
         per-slot events (token + retirement verdicts); empty when no
@@ -431,15 +517,33 @@ class SlotEngine:
         if not self.active.any():
             return []
         idx = np.arange(self.n_slots)
-        lengths = np.where(self.active, self.lengths, 1)
+        kw, lengths = self._decode_step_args()
         tokens = np.where(self.active,
                           self.ctx[idx, np.maximum(self.lengths - 1, 0)],
                           self.pad_id).astype(np.int32)
+        prof = self.step_profiler
+        if prof is not None:
+            if getattr(prof, "capture_xla", False):
+                nt = kw["paged_num_tiles"]
+                prof.capture_cost(
+                    f"llm_decode_step_{self.attention_backend}"
+                    + (f"_nt{nt}" if nt is not None else ""),
+                    _decode_step_jit, self.model, self.variables,
+                    self.cache, jnp.asarray(tokens),
+                    jnp.asarray(lengths.astype(np.int32)),
+                    jnp.asarray(self.active), self._key, self.temperature,
+                    self.top_k, self.top_p,
+                    items=float(self.active_count), **kw)
+            prof.step_begin()
         self.cache, nxt, self._key = _decode_step_jit(
             self.model, self.variables, self.cache, jnp.asarray(tokens),
             jnp.asarray(lengths.astype(np.int32)), jnp.asarray(self.active),
-            self._key, self.temperature, self.top_k, self.top_p)
+            self._key, self.temperature, self.top_k, self.top_p, **kw)
         nxt = np.asarray(nxt)
+        if prof is not None:
+            prof.mark("compute")      # np.asarray synchronized the step
+            prof.step_end()
+        self._account_decode_bytes(lengths, int(self.active.sum()))
         events: List[StepEvent] = []
         for slot in np.flatnonzero(self.active):
             slot = int(slot)

@@ -37,7 +37,10 @@ class LLMServer:
     requests carry ``"prompt"`` text instead of raw ``"ids"``.
     ``ttft_slo_s`` arms SLO-aware admission control: queued requests
     whose projected time-to-first-token exceeds it answer 503 +
-    ``Retry-After``."""
+    ``Retry-After``.  ``attention_backend`` selects the decode-step
+    attention read (``'auto'`` = the Pallas paged kernel on TPU when
+    the geometry fits VMEM, dense otherwise — see
+    docs/api/serving.md "Paged decode attention")."""
 
     def __init__(self, model: Any = None, variables: Any = None, *,
                  engine: Any = None, tokenizer: Any = None,
@@ -50,6 +53,7 @@ class LLMServer:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, min_prefix: int = 8,
                  max_queue: int = 1024, reply_timeout_s: float = 30.0,
+                 attention_backend: str = "auto",
                  engine_kwargs: Optional[Dict[str, Any]] = None):
         if engine is None:
             from ..models.llm import SlotEngine
@@ -57,6 +61,7 @@ class LLMServer:
                                 max_len=max_len, temperature=temperature,
                                 top_k=top_k, top_p=top_p, eos_id=eos_id,
                                 pad_id=pad_id, min_prefix=min_prefix,
+                                attention_backend=attention_backend,
                                 **(engine_kwargs or {}))
         self.engine = engine
         self.tokenizer = tokenizer

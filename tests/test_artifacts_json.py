@@ -126,13 +126,42 @@ def test_roofline_blocks_paired_and_complete():
 
 def test_llmserve_fields_complete():
     """A record carrying any continuous-batching serving field carries
-    the whole set, each numeric or null."""
+    the whole set, each numeric or null (roofline blocks are dicts by
+    design — their schema is owned by the paired-roofline sweep)."""
     for name, rec in _bench_records():
         if not any(k.startswith("llmserve_") for k in rec):
             continue
         missing = [k for k in LLMSERVE_REQUIRED if k not in rec]
         assert not missing, f"{name}: incomplete llmserve block: {missing}"
         bad = [k for k in rec if k.startswith("llmserve_")
+               and "_roofline_" not in k
                and rec[k] is not None
                and not isinstance(rec[k], (int, float))]
         assert not bad, f"{name}: non-numeric llmserve fields: {bad}"
+
+
+def test_llmserve_decode_requires_paired_roofline():
+    """ISSUE 11: ANY ``llmserve_decode_*`` key (the paged-vs-dense
+    decode measurement) requires the FULL paired roofline block —
+    ``llmserve_decode_roofline_before`` AND ``_after``, each holding
+    the canonical numeric-or-null field set — plus a numeric-or-null
+    ``llmserve_decode_bytes_reduction``, so a partially-failed paged
+    leg cannot ship a bytes claim without its dense anchor."""
+    from synapseml_tpu.telemetry.roofline import check_roofline_block
+
+    for name, rec in _bench_records():
+        if not any(k.startswith("llmserve_decode_") for k in rec):
+            continue
+        for side in ("before", "after"):
+            key = f"llmserve_decode_roofline_{side}"
+            assert key in rec, (
+                f"{name}: llmserve_decode_* present without {key}")
+            try:
+                check_roofline_block(rec[key])
+            except ValueError as e:
+                raise AssertionError(f"{name}: {key}: {e}") from None
+        assert "llmserve_decode_bytes_reduction" in rec, (
+            f"{name}: paged decode pair without its bytes_reduction")
+        red = rec["llmserve_decode_bytes_reduction"]
+        assert red is None or isinstance(red, (int, float)), (
+            f"{name}: non-numeric llmserve_decode_bytes_reduction: {red!r}")

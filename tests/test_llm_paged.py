@@ -1,0 +1,398 @@
+"""Pallas paged decode attention: interpret-mode correctness pins.
+
+The contract under test (ISSUE 11 acceptance criteria):
+
+- the kernel's online-softmax output is ulp-close to the dense masked-
+  softmax math across span buckets — including span 1, the full
+  ``max_len`` row, and the PR-8 repro shape (58 live tokens in a
+  64-row cache);
+- greedy decode through :class:`SlotEngine` with
+  ``attention_backend='interpret'`` is TOKEN-EXACT vs the dense path,
+  including mid-flight admission, prefix reuse, and spans that grow
+  across tile and bucket boundaries;
+- a retired slot's K/V survives a paged decode step BIT-identically
+  (the kernel only reads; the ``slot_mask`` write gate still owns the
+  scatter);
+- ``resolve_attention_backend`` fails fast off-TPU for ``'paged'`` with
+  an actionable message, and ``'auto'`` falls back to dense;
+- the byte ledger (:func:`paged_read_bytes` / :func:`dense_read_bytes`)
+  prices the paged read at ``sum(ceil(span/tile)*tile)`` tokens of K+V
+  instead of ``n_slots * max_len``.
+
+Everything here runs the kernel through the Pallas INTERPRETER on CPU
+(the ``pallas_hist`` honesty pattern — speed is measured where the
+hardware is); TPU-compiled coverage rides the same entry points when a
+chip is present.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from synapseml_tpu.models.llm import (LlamaConfig, LlamaModel, SlotEngine,
+                                      dense_read_bytes, generate,
+                                      paged_decode_attention,
+                                      paged_geometry, paged_read_bytes,
+                                      resolve_attention_backend,
+                                      span_bucket_tiles)
+
+pytestmark = pytest.mark.pallas
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny(num_layers=2, max_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _prompts(cfg, n, length, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, cfg.vocab_size, (n, length)).astype(np.int32)
+
+
+def _dense_reference(q, k, v, spans):
+    """The model.py dense decode math (S=1): full-row masked softmax."""
+    B, H, D = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    group = H // KV
+    qg = q.reshape(B, 1, KV, group, D)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                        preferred_element_type=jnp.float32) / np.sqrt(D)
+    causal = jnp.arange(T)[None, None, :] < spans[:, None, None]
+    mask = jnp.broadcast_to(causal[:, None, None, :, :], logits.shape)
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, H, D)
+
+
+class TestKernelParity:
+    """Direct kernel-vs-dense logits parity, every span bucket."""
+
+    B, T, KV, GROUP, D = 5, 96, 4, 2, 32
+
+    def _operands(self, seed=0, T=None):
+        rng = np.random.default_rng(seed)
+        T = T or self.T
+        H = self.KV * self.GROUP
+        q = jnp.asarray(rng.normal(size=(self.B, H, self.D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(self.B, T, self.KV, self.D)),
+                        jnp.float32)
+        v = jnp.asarray(rng.normal(size=(self.B, T, self.KV, self.D)),
+                        jnp.float32)
+        return q, k, v
+
+    @pytest.mark.parametrize("spans", [
+        [1, 1, 1, 1, 1],              # single-token spans
+        [96, 96, 96, 96, 96],         # the full max_len row
+        [1, 33, 96, 58, 7],           # ragged, tile-misaligned
+        [32, 64, 96, 31, 65],         # exact tile boundaries +/- 1
+    ])
+    @pytest.mark.parametrize("tile", [32, 96])
+    def test_matches_dense_softmax(self, spans, tile):
+        q, k, v = self._operands()
+        sp = jnp.asarray(spans, jnp.int32)
+        ref = _dense_reference(q, k, v, sp)
+        geo = paged_geometry(self.T, self.KV * self.GROUP, self.KV,
+                             self.D, jnp.float32)
+        assert geo is not None and self.T % tile == 0
+        nt = span_bucket_tiles(
+            max(spans), type(geo)(tile, self.T // tile, geo.vmem_bytes))
+        out = paged_decode_attention(q, k, v, sp, tile=tile, num_tiles=nt,
+                                     interpret=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_every_bucket_size_exact(self):
+        """One compiled program per power-of-two bucket: each bucket
+        that can cover its spans agrees with dense."""
+        q, k, v = self._operands(seed=1)
+        tile, total = 8, self.T // 8
+        spans_np = [5, 17, 40, 63, 96]
+        sp = jnp.asarray(spans_np, jnp.int32)
+        ref = _dense_reference(q, k, v, sp)
+        for nt in (12,):              # clamped: next pow2 of 12 is 16 > 12
+            out = paged_decode_attention(q, k, v, sp, tile=tile,
+                                         num_tiles=nt, interpret=True)
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # short batch in a small bucket: the grid never iterates the
+        # long cache's tiles
+        sp_short = jnp.asarray([5, 3, 8, 1, 7], jnp.int32)
+        ref_short = _dense_reference(q, k, v, sp_short)
+        out_short = paged_decode_attention(q, k, v, sp_short, tile=tile,
+                                           num_tiles=1, interpret=True)
+        np.testing.assert_allclose(out_short, ref_short, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_pr8_repro_shape_58_at_64(self):
+        """58 live tokens in a 64-row cache — the shape that exposed
+        the PR-8 prefix-clamp bug rides the paged read exactly."""
+        q, k, v = self._operands(seed=2, T=64)
+        sp = jnp.asarray([58, 64, 1, 58, 33], jnp.int32)
+        ref = _dense_reference(q, k, v, sp)
+        out = paged_decode_attention(q, k, v, sp, tile=32, num_tiles=2,
+                                     interpret=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestModelDispatch:
+    def test_decode_step_logits_match_dense(self, tiny_model):
+        """One vector-cache_index decode step through LlamaModel: the
+        paged backend's logits are ulp-close to the dense backend's on
+        the identical cache state."""
+        from synapseml_tpu.models.llm import init_cache
+        cfg, model, variables = tiny_model
+        rng = np.random.default_rng(3)
+        n, T = 3, cfg.max_len
+        # ONE batched prefill builds every slot's K/V; the ragged
+        # lengths then declare how much of each row is LIVE — both
+        # backends mask (dense) or skip (paged) everything beyond a
+        # slot's span, so the junk tail is never attended either way
+        lengths = np.asarray([1, 37, 90], np.int64)
+        ids = rng.integers(1, cfg.vocab_size, (n, 90))
+        cache = init_cache(cfg, n, T)
+        _, cache = model.apply(variables, jnp.asarray(ids, jnp.int32),
+                               positions=jnp.broadcast_to(
+                                   jnp.arange(90)[None, :], (n, 90)),
+                               cache=cache, cache_index=0)
+        toks = jnp.asarray(rng.integers(1, cfg.vocab_size, (n, 1)),
+                           jnp.int32)
+        positions = jnp.asarray(lengths, jnp.int32)[:, None]
+        out = {}
+        for backend in ("dense", "interpret"):
+            out[backend], _ = model.apply(
+                variables, toks, positions=positions,
+                cache=jax.tree.map(lambda x: x, cache),
+                cache_index=jnp.asarray(lengths, jnp.int32),
+                slot_mask=jnp.ones(n, bool), attention_backend=backend)
+        np.testing.assert_allclose(out["interpret"], out["dense"],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_prefill_path_stays_dense_bitwise(self, tiny_model):
+        """The backend switch governs ONLY the vector-index decode
+        step: a scalar-index prefill under 'interpret' is the dense
+        program, bit for bit."""
+        from synapseml_tpu.models.llm import init_cache
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 2, 9, seed=4)
+        outs = {}
+        for backend in ("dense", "interpret"):
+            cache = init_cache(cfg, 2, cfg.max_len)
+            logits, _ = model.apply(
+                variables, jnp.asarray(ids),
+                positions=jnp.arange(9)[None, :].repeat(2, 0),
+                cache=cache, cache_index=0, attention_backend=backend)
+            outs[backend] = np.asarray(logits)
+        np.testing.assert_array_equal(outs["interpret"], outs["dense"])
+
+
+class TestEngineExactness:
+    def test_greedy_token_exact_vs_dense(self, tiny_model):
+        """The headline pin: paged greedy decode through the SlotEngine
+        is token-identical to the dense fused-scan generate path."""
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 3, 7)
+        ref = generate(model, variables, ids, max_new_tokens=10)
+        eng = SlotEngine(model, variables, n_slots=4, max_len=64,
+                         attention_backend="interpret")
+        assert eng.attention_backend == "interpret"
+        slots = {i: eng.admit(ids[i], 10).slot for i in range(3)}
+        out = eng.run_to_completion()
+        for i in range(3):
+            np.testing.assert_array_equal(out[slots[i]], ref[i])
+
+    def test_mid_flight_admission_token_exact(self, tiny_model):
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 2, 9, seed=1)
+        ref_a = generate(model, variables, ids[0:1], max_new_tokens=14)[0]
+        ref_b = generate(model, variables, ids[1:2], max_new_tokens=6)[0]
+        eng = SlotEngine(model, variables, n_slots=4, max_len=64,
+                         attention_backend="interpret")
+        ra = eng.admit(ids[0], 14)
+        for _ in range(5):
+            eng.step()
+        rb = eng.admit(ids[1], 6)          # admitted mid-flight
+        while eng.active.any():
+            eng.step()
+        np.testing.assert_array_equal(eng.generated_ids(ra.slot), ref_a)
+        np.testing.assert_array_equal(eng.generated_ids(rb.slot), ref_b)
+
+    def test_prefix_reuse_token_exact(self, tiny_model):
+        """Prefix-cache reuse composes with the paged read: a warm
+        admit (LCP K/V copy + tail prefill) decodes the same tokens as
+        a cold DENSE engine."""
+        cfg, model, variables = tiny_model
+        rng = np.random.default_rng(2)
+        prefix = rng.integers(1, cfg.vocab_size, 16).astype(np.int32)
+        p1 = np.concatenate([prefix, rng.integers(1, cfg.vocab_size,
+                                                  6).astype(np.int32)])
+        p2 = np.concatenate([prefix, rng.integers(1, cfg.vocab_size,
+                                                  6).astype(np.int32)])
+        warm = SlotEngine(model, variables, n_slots=4, max_len=64,
+                          min_prefix=8, attention_backend="interpret")
+        warm.admit(p1, 4)
+        warm.run_to_completion()
+        r_warm = warm.admit(p2, 4)
+        assert r_warm.reused_tokens == 16
+        cold = SlotEngine(model, variables, n_slots=4, max_len=64,
+                          min_prefix=8, attention_backend="dense")
+        r_cold = cold.admit(p2, 4)
+        # prefill is the dense program under both backends
+        np.testing.assert_array_equal(r_warm.logits, r_cold.logits)
+        warm.run_to_completion()
+        cold.run_to_completion()
+        np.testing.assert_array_equal(warm.generated_ids(r_warm.slot),
+                                      cold.generated_ids(r_cold.slot))
+
+    def test_span_growth_across_tile_and_bucket_boundary(self, tiny_model):
+        """A sequence decoding from span 30 to span 70 crosses the
+        32-token tile boundary AND the 1-tile -> 2-tile bucket
+        boundary; every token stays exactly greedy."""
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 1, 30, seed=7)
+        ref = generate(model, variables, ids, max_new_tokens=40)[0]
+        eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                         attention_backend="interpret")
+        assert eng._paged_geo.tile == 32
+        r = eng.admit(ids[0], 40)
+        eng.run_to_completion()
+        np.testing.assert_array_equal(eng.generated_ids(r.slot), ref)
+
+    def test_full_max_len_span_token_exact(self, tiny_model):
+        """The span runs the cache to the last row: ceil rounds the
+        paged read up to the full cache and output stays exact."""
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 1, 43, seed=8)
+        ref = generate(model, variables, ids, max_new_tokens=20)[0]
+        eng = SlotEngine(model, variables, n_slots=2, max_len=64,
+                         attention_backend="interpret")
+        r = eng.admit(ids[0], 20)        # 43 + 20 + 1 == max_len
+        eng.run_to_completion()
+        np.testing.assert_array_equal(eng.generated_ids(r.slot), ref)
+
+    def test_retired_slot_kv_survives_paged_steps_bitwise(self, tiny_model):
+        """Neighbor-corruption pin: a retired slot's K/V rows are
+        BIT-identical after many paged decode steps of an active
+        neighbor — the kernel reads spans, the slot_mask write gate
+        still owns every store."""
+        cfg, model, variables = tiny_model
+        rng = np.random.default_rng(9)
+        p1 = rng.integers(1, cfg.vocab_size, 14).astype(np.int32)
+        eng = SlotEngine(model, variables, n_slots=3, max_len=64,
+                         min_prefix=8, attention_backend="interpret")
+        r1 = eng.admit(p1, 3)
+        eng.run_to_completion()                     # slot r1 retired
+        before = [(np.asarray(c["k"][r1.slot]).copy(),
+                   np.asarray(c["v"][r1.slot]).copy())
+                  for c in eng.cache]
+        eng.admit(_prompts(cfg, 1, 8, seed=10)[0], 20)
+        eng.run_to_completion()                     # 20 paged steps
+        for c, (k0, v0) in zip(eng.cache, before):
+            np.testing.assert_array_equal(np.asarray(c["k"][r1.slot]), k0)
+            np.testing.assert_array_equal(np.asarray(c["v"][r1.slot]), v0)
+
+
+class TestResolveAndGeometry:
+    def test_auto_falls_back_to_dense_off_tpu(self):
+        assert resolve_attention_backend(
+            "auto", max_len=256, num_heads=8, num_kv_heads=4,
+            d_head=32, dtype=jnp.float32) == "dense"
+
+    def test_paged_off_tpu_fails_fast_actionably(self):
+        with pytest.raises(ValueError) as ei:
+            resolve_attention_backend(
+                "paged", max_len=256, num_heads=8, num_kv_heads=4,
+                d_head=32, dtype=jnp.float32)
+        msg = str(ei.value)
+        assert "cpu" in msg and "interpret" in msg and "auto" in msg
+
+    def test_engine_paged_off_tpu_fails_at_construction(self, tiny_model):
+        cfg, model, variables = tiny_model
+        with pytest.raises(ValueError, match="interpret"):
+            SlotEngine(model, variables, n_slots=2, max_len=64,
+                       attention_backend="paged")
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="must be one of"):
+            resolve_attention_backend(
+                "flash", max_len=256, num_heads=8, num_kv_heads=4,
+                d_head=32)
+
+    def test_geometry_gate(self):
+        geo = paged_geometry(8192, 32, 8, 128, jnp.bfloat16)
+        assert geo is not None
+        assert 8192 % geo.tile == 0 and geo.tile <= 4096
+        assert geo.tile % 16 == 0                 # bf16 sublane
+        # a max_len no sublane-aligned tile divides: no geometry, and
+        # the explicit backends refuse while auto falls back
+        assert paged_geometry(100, 8, 4, 32, jnp.float32) is None
+        with pytest.raises(ValueError, match="no paged geometry"):
+            resolve_attention_backend("interpret", max_len=100,
+                                      num_heads=8, num_kv_heads=4,
+                                      d_head=32, dtype=jnp.float32)
+        assert resolve_attention_backend(
+            "auto", max_len=100, num_heads=8, num_kv_heads=4,
+            d_head=32, dtype=jnp.float32) == "dense"
+
+    def test_bucket_tiles_power_of_two_clamped(self):
+        from synapseml_tpu.models.llm import PagedGeometry
+        geo = PagedGeometry(tile=32, total_tiles=3, vmem_bytes=0)
+        assert span_bucket_tiles(1, geo) == 1
+        assert span_bucket_tiles(32, geo) == 1
+        assert span_bucket_tiles(33, geo) == 2
+        assert span_bucket_tiles(65, geo) == 3    # pow2=4 clamps to 3
+        assert span_bucket_tiles(96, geo) == 3
+
+
+class TestByteLedger:
+    def test_paged_under_dense_and_exact_formula(self):
+        spans = np.asarray([1, 33, 96, 58, 7])
+        tile, KV, D, item, L = 32, 4, 32, 4, 2
+        paged = paged_read_bytes(spans, tile, KV, D, item, L)
+        dense = dense_read_bytes(5, 96, KV, D, item, L)
+        expect = L * 2 * int(np.ceil(spans / tile).sum()) * tile \
+            * KV * D * item
+        assert paged == expect
+        assert paged < dense
+        # all-full spans round to exactly the dense read
+        assert paged_read_bytes([96] * 5, tile, KV, D, item, L) == dense
+
+    def test_engine_accounts_and_exports_bytes(self, tiny_model):
+        from synapseml_tpu.telemetry import get_registry
+        cfg, model, variables = tiny_model
+        eng = SlotEngine(model, variables, n_slots=4, max_len=64,
+                         attention_backend="interpret", name="t-paged")
+        dns = SlotEngine(model, variables, n_slots=4, max_len=64,
+                         attention_backend="dense", name="t-dense")
+        ids = _prompts(cfg, 2, 9, seed=13)
+        for e in (eng, dns):
+            e.admit(ids[0], 6)
+            e.admit(ids[1], 6)
+            e.run_to_completion()
+        assert 0 < eng.decode_attn_bytes < dns.decode_attn_bytes
+        g = get_registry().get("llm_decode_bytes_per_token")
+        assert g.value(engine="t-paged", backend="interpret") > 0
+        assert g.value(engine="t-dense", backend="dense") \
+            > g.value(engine="t-paged", backend="interpret")
+
+    def test_step_profiler_captures_decode_cost(self, tiny_model):
+        """The telemetry satellite: a capture_xla StepProfiler handed to
+        the engine records the decode step's XLA cost analysis under a
+        per-bucket key and times the step's compute segment."""
+        from synapseml_tpu.telemetry.gangplane import StepProfiler
+        cfg, model, variables = tiny_model
+        prof = StepProfiler("llm_decode_test", capture_xla=True)
+        eng = SlotEngine(model, variables, n_slots=2, max_len=64,
+                         attention_backend="dense", step_profiler=prof)
+        eng.admit(_prompts(cfg, 1, 6, seed=14)[0], 4)
+        eng.run_to_completion()
+        s = prof.summary()
+        assert s["steps"] >= 3
+        assert s["per_step_avg_seconds"]["compute"] > 0
+        keys = [k for k in s["roofline"] if k.startswith("llm_decode_step")]
+        assert keys, s["roofline"]
+        cost = s["roofline"][keys[0]]
+        assert cost and cost["bytes_accessed"] > 0
+        assert cost["bytes_per_sample"] and cost["bytes_per_sample"] > 0
