@@ -1269,25 +1269,78 @@ def bench_llm():
     except Exception as e:
         print(f"[secondary] int8 1B decode failed: {e}", file=sys.stderr)
 
-    # speculative decoding (prompt-lookup drafts, exact greedy): measured
-    # honestly against the SAME batch-8 config with greedy-equivalence
-    # asserted.  On random-init weights the continuation stream is mostly
-    # chaotic, so acceptance (and therefore the speedup) is data-bound —
-    # the acceptance rate rides along so the number reads in context.
+    # speculative decoding (prompt-lookup drafts, greedy): the
+    # llama1b_spec_* fields measure the FUSED SlotEngine path — the
+    # suffix-table n-gram drafter + multi-token verify step that
+    # serving actually runs — paired against the old fully-jitted
+    # fixed-k drafter (generate_speculative) as the BEFORE reading: it
+    # drafts k junk positions on every lookup miss, which is what
+    # crushed this leg to 0.091 acceptance / 1.63 tokens/step in
+    # BENCH_r05.  Token-exactness of the MECHANISM is pinned in tier-1
+    # at f32 (tests/test_llm_spec.py) where argmax is well-defined; on
+    # THIS leg's random-init bf16 weights the 128k-vocab logits sit
+    # one bf16 ulp apart (measured: top-4 within 0.25 of each other),
+    # so different compiled programs legitimately split exact argmax
+    # ties and the leg REPORTS cross-program token agreement instead
+    # of asserting it (real checkpoints have peaked logits; ties are a
+    # random-init artifact).
     spec_tps = spec_stats = None
     try:
-        from synapseml_tpu.models.llm import generate_speculative
+        from synapseml_tpu.models.llm import (SlotEngine,
+                                              generate_speculative)
         B = 8
         base = rng.integers(0, cfg.vocab_size, 8)
         pids = np.concatenate([base] * 4)[None, :].repeat(B, 0)
         ref = generate(model, variables, pids, max_new_tokens=NEW)
-        out, spec_stats = generate_speculative(model, variables, pids,
-                                               max_new_tokens=NEW)
-        assert np.array_equal(ref, out), "speculative != greedy"
+        out, before = generate_speculative(model, variables, pids,
+                                           max_new_tokens=NEW)
+
+        def match_fraction(rows):
+            return float(np.mean([np.mean(rows[i] == ref[i])
+                                  for i in range(B)]))
+
+        def engine_run():
+            eng = SlotEngine(model, variables, n_slots=B,
+                             max_len=cfg.max_len, spec_draft_len=7,
+                             name="llama1b-spec-bench")
+            slots = [eng.admit(pids[i], NEW).slot for i in range(B)]
+            row_steps = np.zeros(B)
+            while eng.active.any():
+                act = eng.active[slots].copy()
+                eng.step()
+                row_steps += act
+            return eng, slots, row_steps
+
+        eng, slots, row_steps = engine_run()
+        # per-ROW tokens/step averaged over rows — the old leg's stat
+        # exactly (a row's admit token came from prefill, not a step)
+        spec_stats = {
+            "tokens_per_step": float(np.mean(
+                (NEW - 1) / np.maximum(row_steps, 1))),
+            "acceptance_rate": eng.spec_acceptance_rate,
+        }
+        agree = match_fraction([eng.generated_ids(slots[i])
+                                for i in range(B)])
+        print("[secondary] llama1b self-draft fixed (jitted fixed-k -> "
+              "SlotEngine n-gram tables): acceptance "
+              f"{before['acceptance_rate']:.3f} -> "
+              f"{spec_stats['acceptance_rate']:.3f}, tokens/step "
+              f"{before['tokens_per_step']:.2f} -> "
+              f"{spec_stats['tokens_per_step']:.2f} "
+              "(BENCH_r05 before: 0.091 / 1.63); dense-greedy token "
+              f"agreement {match_fraction(out):.3f} jitted / "
+              f"{agree:.3f} engine (< 1.0 only via random-init bf16 "
+              "argmax ties; exactness pinned in tier-1 at f32)",
+              file=sys.stderr)
 
         def once():
-            generate_speculative(model, variables, pids,
-                                 max_new_tokens=NEW)
+            # engine construction rides INSIDE the timed call
+            # deliberately: a fresh engine is the serving cold path,
+            # and its cost is one cache allocation (~30 MB of zeros)
+            # against dozens of 1B-model forwards — but note the
+            # asymmetry vs the jitted before-leg, which only pays its
+            # prefill
+            engine_run()
             return B * NEW
         spec_tps = _median_rate(once)
     except Exception as e:
@@ -1401,9 +1454,14 @@ def bench_llm_8b_int8():
     return _median_rate(once), gb
 
 
-def bench_llm_serving():
+def bench_llm_serving(spec_only: bool = False):
     """Continuous batching vs static batch-8 under ragged open-loop
-    Poisson load (ROADMAP item 2's tentpole measurement).
+    Poisson load (ROADMAP item 2's tentpole measurement), plus the
+    continuous+SPEC leg (``llmserve_spec_*``: the same trace through a
+    speculative SlotEngine — n-gram self-drafts + multi-token verify —
+    paired against the continuous leg; ``spec_only=True`` skips the
+    static/fused/roofline legs so ``--only llmserve_spec`` re-measures
+    the spec pair in a fraction of the full sweep).
 
     One Poisson arrival trace (request rate sized at ~80% of the
     continuous leg's measured capacity; prompt lengths and token budgets
@@ -1493,7 +1551,7 @@ def bench_llm_serving():
         return (time.perf_counter() - t0) / 8
 
     step32_s = warm(N_SLOTS)
-    step8_s = warm(GROUP)
+    step8_s = None if spec_only else warm(GROUP)
     mean_new = float(np.mean(max_news))
     # offered load sits AT the continuous leg's estimated token capacity:
     # open-loop saturation is the throughput-comparison regime (the
@@ -1502,8 +1560,8 @@ def bench_llm_serving():
     offered_rps = (0.9 * N_SLOTS / step32_s) / mean_new
     arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, N_REQ))
 
-    def drive(n_slots, continuous):
-        eng = fresh(n_slots)
+    def drive(n_slots, continuous, spec=0):
+        eng = fresh(n_slots, **({"spec_draft_len": spec} if spec else {}))
         waiting = deque()
         ttfts, token_lats, occ = [], [], []
         done = nxt = 0
@@ -1540,8 +1598,16 @@ def bench_llm_serving():
                 events = eng.step()
                 dt = time.perf_counter() - ts
                 occ.append(eng.active_count / n_slots)
+                # per-token latency = step time amortized over the
+                # slot's committed span (a spec step commits several
+                # tokens per slot; appending dt per token would
+                # overcount it span-fold and break the pairing against
+                # the continuous leg's one-event-per-slot steps)
+                span = {}
                 for ev in events:
-                    token_lats.append(dt)
+                    span[ev.slot] = span.get(ev.slot, 0) + 1
+                for ev in events:
+                    token_lats.append(dt / span[ev.slot])
                     if ev.finished:
                         done += 1
             elif nxt < N_REQ:
@@ -1549,7 +1615,7 @@ def bench_llm_serving():
                     0.0, arrivals[nxt] - (time.perf_counter() - t0)))
         wall = time.perf_counter() - t0
         pct = lambda xs, q: float(np.percentile(np.asarray(xs), q))  # noqa: E731
-        return {
+        out = {
             "tokens_per_sec": eng.tokens_generated / wall,
             "ttft_p50_ms": pct(ttfts, 50) * 1e3,
             "ttft_p95_ms": pct(ttfts, 95) * 1e3,
@@ -1563,8 +1629,107 @@ def bench_llm_serving():
             "prefix_tokens_reused": eng.prefix_tokens_reused,
             "wall_s": wall,
         }
+        if spec:
+            tot = eng.spec_draft_hits + eng.spec_draft_misses
+            out["spec_acceptance_rate"] = eng.spec_acceptance_rate
+            out["spec_hit_rate"] = eng.spec_draft_hits / max(1, tot)
+        return out
 
     cont = drive(N_SLOTS, continuous=True)
+
+    def spec_pair():
+        """The continuous+spec leg (ISSUE 12): the SAME Poisson trace
+        through a speculative engine (n-gram self-drafts, multi-token
+        verify), paired against the continuous leg, plus a
+        full-occupancy CAPACITY window for the throughput comparison —
+        under the shared arrival trace the spec engine is
+        ARRIVAL-bound (it drains the same offered load with spare
+        capacity), so trace tokens/s alone would just re-measure the
+        trace; the capacity window measures what the engine can
+        actually commit per step at occupancy 1.0.
+
+        ``spec_throughput_ratio`` compares measured capacity
+        tokens/sec against the continuous leg's (N_SLOTS / step
+        seconds) on THIS backend.  On the 1-core CPU container a
+        verify step's S query rows cost ~S× a one-token step (dense
+        matmul scales with rows — the PR-8 honesty pattern), so the
+        measured ratio understates the chip; ``spec_step_cost_ratio``
+        quantifies exactly how much, and the step-NORMALIZED ratio —
+        what the ratio becomes where a verify step costs a plain step
+        (the TPU decode regime: both are weight-streaming-bound) —
+        equals committed tokens per slot-step by construction."""
+        SPEC_K = 7
+        budget = cfg.max_len - 33 - 1
+        # exactness pin first: spec+continuous greedy == dense greedy
+        peng = fresh(4, spec_draft_len=SPEC_K, name="llmserve-spec-pin")
+        ids4 = np.stack([p[:8] for p in prompts[:4]])
+        refs = generate(model, variables, ids4, max_new_tokens=24)
+        slots = {i: peng.admit(ids4[i], 24).slot for i in range(4)}
+        outs = peng.run_to_completion()
+        for i in range(4):
+            assert np.array_equal(outs[slots[i]], refs[i]), \
+                "spec serving output != dense greedy"
+        # capacity window at full occupancy (re-admitting retirements
+        # between timed steps); the unmeasured prologue compiles the
+        # verify S-buckets and settles the per-slot acceptance EWMAs
+        eng = fresh(N_SLOTS, spec_draft_len=SPEC_K,
+                    name="llmserve-spec-cap")
+        j = 0
+
+        def admit_full(j):
+            while eng.free_slot_count:
+                eng.admit(prompts[j % N_REQ], budget)
+                j += 1
+            return j
+
+        j = admit_full(j)
+        for _ in range(10):
+            eng.step()
+            j = admit_full(j)
+        tokens0, adm0 = eng.tokens_generated, eng.admissions
+        steps0 = eng.steps_run
+        slot_steps = 0
+        step_wall = 0.0
+        for _ in range(12):
+            slot_steps += eng.active_count
+            t0 = time.perf_counter()
+            eng.step()
+            step_wall += time.perf_counter() - t0
+            j = admit_full(j)
+        step_tokens = ((eng.tokens_generated - tokens0)
+                       - (eng.admissions - adm0))
+        steps_n = eng.steps_run - steps0
+        tps_slot = step_tokens / max(1, slot_steps)
+        spec_step_s = step_wall / max(1, steps_n)
+        spec = drive(N_SLOTS, continuous=True, spec=SPEC_K)
+        return {
+            "spec_tokens_per_sec": spec["tokens_per_sec"],
+            "spec_tokens_per_step": tps_slot,
+            "spec_acceptance_rate": spec["spec_acceptance_rate"],
+            "spec_draft_hit_rate": spec["spec_hit_rate"],
+            "spec_ttft_p50_ms": spec["ttft_p50_ms"],
+            "spec_ttft_p95_ms": spec["ttft_p95_ms"],
+            "spec_token_p95_ms": spec["token_p95_ms"],
+            "spec_slot_occupancy": spec["occupancy"],
+            "spec_step_cost_ratio": spec_step_s / step32_s,
+            "spec_throughput_ratio": ((step_tokens / step_wall)
+                                      / (N_SLOTS / step32_s)),
+            "spec_throughput_ratio_step_normalized": tps_slot,
+        }
+
+    spec_fields = spec_pair()
+
+    if spec_only:
+        # --only llmserve_spec: the spec pair + its continuous anchors,
+        # merged over a prior BENCH_latest.json by main()
+        return {
+            "continuous_tokens_per_sec": cont["tokens_per_sec"],
+            "continuous_ttft_p50_ms": cont["ttft_p50_ms"],
+            "continuous_ttft_p95_ms": cont["ttft_p95_ms"],
+            "slot_occupancy": cont["occupancy"],
+            **spec_fields,
+        }
+
     stat = drive(GROUP, continuous=False)
 
     def decode_roofline_pair():
@@ -1726,6 +1891,7 @@ def bench_llm_serving():
             / (step32_s / step8_s)),
         "static8_fused_tokens_per_sec": _median_rate(fused_once),
         **decode_pair,
+        **spec_fields,
     }
 
 
@@ -1756,7 +1922,8 @@ class _SkippedLeg(Exception):
 #: pair without the full 870s-class sweep.
 BENCH_LEGS = ("bert", "llm", "spec", "llm8b", "resnet_onnx", "vision",
               "gbdt", "gbdt_pair", "anchor", "streamed", "serving",
-              "gang", "resize", "guard", "comms", "llmserve", "obs")
+              "gang", "resize", "guard", "comms", "llmserve",
+              "llmserve_spec", "obs")
 
 
 def main(only=None):
@@ -2060,23 +2227,37 @@ def main(only=None):
 
     llmserve = None
     try:
-        if not want("llmserve"):
+        if not (want("llmserve") or want("llmserve_spec")):
             raise _SkippedLeg()
-        llmserve = bench_llm_serving()
-        print(f"[secondary] LLM continuous batching (Poisson open loop, "
-              f"{llmserve['offered_rps']:.1f} req/s offered): "
-              f"{llmserve['continuous_tokens_per_sec']:.0f} tok/s vs "
-              f"static-8 {llmserve['static8_tokens_per_sec']:.0f} tok/s "
-              f"({llmserve['throughput_ratio']:.2f}x) at per-token p95 "
-              f"{llmserve['token_latency_ratio_p95']:.2f}x; TTFT p50/p95 "
-              f"{llmserve['continuous_ttft_p50_ms']:.1f}/"
-              f"{llmserve['continuous_ttft_p95_ms']:.1f} ms vs "
-              f"{llmserve['static8_ttft_p50_ms']:.1f}/"
-              f"{llmserve['static8_ttft_p95_ms']:.1f} ms; occupancy "
-              f"{llmserve['slot_occupancy']:.2f}; fused-scan anchor "
-              f"{llmserve['static8_fused_tokens_per_sec']:.0f} tok/s",
+        llmserve = bench_llm_serving(spec_only=not want("llmserve"))
+        if "static8_tokens_per_sec" in llmserve:
+            print(f"[secondary] LLM continuous batching (Poisson open loop, "
+                  f"{llmserve['offered_rps']:.1f} req/s offered): "
+                  f"{llmserve['continuous_tokens_per_sec']:.0f} tok/s vs "
+                  f"static-8 {llmserve['static8_tokens_per_sec']:.0f} tok/s "
+                  f"({llmserve['throughput_ratio']:.2f}x) at per-token p95 "
+                  f"{llmserve['token_latency_ratio_p95']:.2f}x; TTFT p50/p95 "
+                  f"{llmserve['continuous_ttft_p50_ms']:.1f}/"
+                  f"{llmserve['continuous_ttft_p95_ms']:.1f} ms vs "
+                  f"{llmserve['static8_ttft_p50_ms']:.1f}/"
+                  f"{llmserve['static8_ttft_p95_ms']:.1f} ms; occupancy "
+                  f"{llmserve['slot_occupancy']:.2f}; fused-scan anchor "
+                  f"{llmserve['static8_fused_tokens_per_sec']:.0f} tok/s",
+                  file=sys.stderr)
+        print(f"[secondary] LLM continuous+spec (n-gram self-drafts, "
+              "multi-token verify, greedy-exact): "
+              f"{llmserve['spec_tokens_per_step']:.2f} tokens/step/slot "
+              f"at acceptance {llmserve['spec_acceptance_rate']:.3f} "
+              f"(draft hit rate {llmserve['spec_draft_hit_rate']:.2f}); "
+              f"capacity {llmserve['spec_throughput_ratio']:.2f}x "
+              "continuous as measured "
+              f"(verify step costs {llmserve['spec_step_cost_ratio']:.2f}x "
+              "a plain step on this backend), step-normalized "
+              f"{llmserve['spec_throughput_ratio_step_normalized']:.2f}x; "
+              f"trace TTFT p50 {llmserve['spec_ttft_p50_ms']:.1f} ms vs "
+              f"continuous {llmserve['continuous_ttft_p50_ms']:.1f} ms",
               file=sys.stderr)
-        if llmserve["step_cost_ratio"] > 1.5:
+        if llmserve.get("step_cost_ratio", 0) > 1.5:
             print(f"[secondary]   NOTE: a 32-slot step costs "
                   f"{llmserve['step_cost_ratio']:.2f}x an 8-slot step on "
                   "this backend (dense matmul scales with rows on CPU; "

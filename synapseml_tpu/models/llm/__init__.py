@@ -11,6 +11,7 @@ from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     LlamaConfig, LlamaModel, RMSNorm, apply_rope,
                     causal_lm_loss, init_cache, llama_from_pretrained,
                     rope_frequencies)
+from .drafter import NgramDrafter
 from .pallas_attn import (ATTENTION_BACKENDS, PagedGeometry,
                           dense_read_bytes, paged_decode_attention,
                           paged_geometry, paged_read_bytes,
@@ -22,7 +23,8 @@ __all__ = [
     "ATTENTION_BACKENDS",
     "LLM_LOGICAL_RULES", "AdmitResult", "CausalAttention", "DecoderBlock",
     "LLMTransformer",
-    "LlamaConfig", "LlamaModel", "PagedGeometry", "RMSNorm", "SlotEngine",
+    "LlamaConfig", "LlamaModel", "NgramDrafter", "PagedGeometry",
+    "RMSNorm", "SlotEngine",
     "StepEvent",
     "apply_rope", "causal_lm_loss",
     "cast_params", "dense_read_bytes", "finetune_lm", "generate",

@@ -167,12 +167,21 @@ def quantize_int8(variables: Any) -> Any:
 
 
 def _ngram_draft(ctx: jnp.ndarray, cur_len: jnp.ndarray, draft_len: int,
-                 ngram: int) -> jnp.ndarray:
+                 ngram: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Prompt-lookup drafting: find the latest earlier occurrence of the
     last ``ngram`` tokens in the context and propose the tokens that
     followed it.  No draft model — the context itself is the draft source
     (strong on repetitive/structured text, harmless elsewhere because
-    verification keeps greedy output exact).  → (B, draft_len) int32."""
+    verification keeps greedy output exact).
+
+    → ``(draft (B, draft_len) int32, vlen (B,) int32)`` where ``vlen``
+    is how many draft positions came from a REAL known continuation —
+    a row with no match (or a match whose continuation is shorter than
+    ``draft_len``) pads with repeats of the last token, which can only
+    be accepted by luck; counting those pads as "drafted" is the
+    accounting bug that reported the old llama1b leg at 0.091
+    acceptance (most of its "drafts" were never predictions at all).
+    Acceptance telemetry divides by ``vlen``, not ``draft_len``."""
     B, L = ctx.shape
     iota_l = jnp.arange(L)[None, :]
     # gathers (take_along_axis) are the TPU pathology — every dynamic
@@ -199,8 +208,13 @@ def _ngram_draft(ctx: jnp.ndarray, cur_len: jnp.ndarray, draft_len: int,
     draft = jnp.einsum("bkl,bl->bk", oh, ctx)
     last = jnp.sum(jnp.where(iota_l == cur_len[:, None] - 1, ctx, 0),
                    axis=1, keepdims=True)
+    vlen = jnp.where(
+        has,
+        jnp.clip(cur_len - (p_best + ngram), 0, draft_len),
+        0).astype(jnp.int32)
     return jnp.where(has[:, None], draft,
-                     jnp.broadcast_to(last, draft.shape)).astype(jnp.int32)
+                     jnp.broadcast_to(last, draft.shape)
+                     ).astype(jnp.int32), vlen
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -224,12 +238,12 @@ def _generate_spec_jit(model: LlamaModel, variables: Any,
                            positions=positions, cache=cache, cache_index=0)
 
     def cond(s):
-        ctx, cur_len, done, cache, steps, acc, row_steps = s
-        return (~jnp.all(done)) & (steps < max_new_tokens)
+        return (~jnp.all(s[2])) & (s[4] < max_new_tokens)
 
     def body(s):
-        ctx, cur_len, done, cache, steps, acc, row_steps = s
-        draft = _ngram_draft(ctx, cur_len, K, ngram)            # (B, K)
+        (ctx, cur_len, done, cache, steps, acc, row_steps, drafted,
+         acc_valid) = s
+        draft, vlen = _ngram_draft(ctx, cur_len, K, ngram)      # (B, K)
         last = jnp.sum(jnp.where(jnp.arange(L)[None, :]
                                  == cur_len[:, None] - 1, ctx, 0),
                        axis=1, keepdims=True)
@@ -260,19 +274,30 @@ def _generate_spec_jit(model: LlamaModel, variables: Any,
             done = done | jnp.any((g == eos_id) & take, axis=1)
         acc = acc + n_new
         row_steps = row_steps + (n_new > 0).astype(jnp.int32)
+        # honest acceptance accounting: only REAL draft positions
+        # (known continuations, see _ngram_draft's vlen) count as
+        # drafted, and an accepted prefix counts only up to vlen —
+        # lucky matches on pad repeats are free tokens, not draft
+        # skill.  n_new > 0 <=> the row entered this step live (a live
+        # row always commits >= 1 token; a done row is zeroed above)
+        live = (n_new > 0).astype(jnp.int32)
+        drafted = drafted + vlen * live
+        acc_valid = acc_valid + jnp.minimum(a, vlen) * live
         cur_len = cur_len + n_new
         # rows that reached their budget are done: keeping them in the
         # loop would burn full-model forwards and inflate the stats with
         # tokens the cropped output never shows
         done = done | (cur_len >= P + max_new_tokens)
-        return (ctx, cur_len, done, new_cache, steps + 1, acc, row_steps)
+        return (ctx, cur_len, done, new_cache, steps + 1, acc, row_steps,
+                drafted, acc_valid)
 
     done0 = jnp.zeros(B, bool)
     state = (ctx, jnp.full((B,), P, jnp.int32), done0, cache,
              jnp.zeros((), jnp.int32), jnp.zeros((B,), jnp.int32),
+             jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
              jnp.zeros((B,), jnp.int32))
-    (ctx, cur_len, done, cache, steps, acc,
-     row_steps) = lax.while_loop(cond, body, state)
+    (ctx, cur_len, done, cache, steps, acc, row_steps, drafted,
+     acc_valid) = lax.while_loop(cond, body, state)
     out = ctx[:, P:P + max_new_tokens]
     # pad everything past each sequence's end (eos freeze)
     keep = jnp.arange(max_new_tokens)[None, :] < (cur_len - P)[:, None]
@@ -282,26 +307,40 @@ def _generate_spec_jit(model: LlamaModel, variables: Any,
     # were the dominant per-call cost of the whole speculative path
     packed = jnp.concatenate(
         [out, acc[:, None], row_steps[:, None],
-         jnp.broadcast_to(steps, (B,))[:, None]], axis=1)
+         jnp.broadcast_to(steps, (B,))[:, None],
+         drafted[:, None], acc_valid[:, None]], axis=1)
     return packed
 
 
-def spec_unpack(packed, max_new_tokens: int, draft_len: int):
+def spec_unpack(packed, max_new_tokens: int, draft_len: int = 0):
     """Host-side unpack of a ``block=False`` speculative result →
     (tokens (B, max_new_tokens), stats dict) — same stats as the
     blocking path.  Publishes the acceptance telemetry (see
     :func:`_record_spec_stats`), so pipelined serving drains report the
-    same metrics as blocking calls."""
+    same metrics as blocking calls.  ``draft_len`` is unused (kept for
+    call-site compatibility): the acceptance denominator is the REAL
+    drafted count packed by the device loop, not the static k.
+
+    ``acceptance_rate`` is accepted-over-DRAFTED: only draft positions
+    backed by a real known continuation count (``_ngram_draft``'s
+    ``vlen``) — the old definition divided committed tokens by the full
+    static ``draft_len`` every step, so no-match steps (which draft
+    nothing real) crushed the rate toward zero (0.091 on the llama1b
+    leg) while saying nothing about draft quality."""
     packed = np.asarray(packed)
     out = packed[:, :max_new_tokens]
     acc = packed[:, max_new_tokens].astype(np.float64)
     row_steps = np.maximum(packed[:, max_new_tokens + 1].astype(np.float64),
                            1.0)
+    drafted = packed[:, max_new_tokens + 3].astype(np.float64)
+    acc_valid = packed[:, max_new_tokens + 4].astype(np.float64)
     tps = float(np.mean(acc / row_steps))
     stats = {"steps": int(packed[0, max_new_tokens + 2]),
              "accepted": int(acc.sum()),
+             "drafted": int(drafted.sum()),
              "tokens_per_step": tps,
-             "acceptance_rate": max(tps - 1.0, 0.0) / max(int(draft_len), 1)}
+             "acceptance_rate": float(acc_valid.sum())
+             / max(float(drafted.sum()), 1.0)}
     _record_spec_stats(stats)
     return out, stats
 
@@ -344,7 +383,7 @@ def generate_speculative(model: LlamaModel, variables: Any, prompt_ids,
     ``steps``/``accepted``/``tokens_per_step``).
 
     ``block=False`` instead returns the PACKED on-device
-    (B, max_new_tokens + 3) array without the host readback — serving
+    (B, max_new_tokens + 5) array without the host readback — serving
     loops dispatch the next request while this one runs and recover
     (tokens, stats) later with :func:`spec_unpack`; the tunnel round trip
     is paid once per pipeline drain instead of once per call.

@@ -262,17 +262,17 @@ class CausalAttention(nn.Module):
             causal = jnp.tril(jnp.ones((S, S), bool))[None]     # (1, S, S)
 
         if (attention_backend in ("paged", "interpret")
-                and cache is not None and jnp.ndim(cache_index) != 0
-                and S == 1):
+                and cache is not None and jnp.ndim(cache_index) != 0):
             # paged decode read: each slot attends ONLY its live K/V
-            # span [0, positions+1) through the Pallas online-softmax
-            # kernel — bytes scale with live tokens, not cache capacity
-            # (the vector-cache_index single-token step is the serving
-            # hot loop; prefill and training stay dense, where the
-            # full-row read is the work).  ``paged_tile`` is the
-            # engine-resolved geometry (the byte ledger prices the
-            # same tile by construction); absent it, re-derive — the
-            # direct-apply ergonomic path.
+            # span through the Pallas online-softmax kernel — bytes
+            # scale with live tokens, not cache capacity (the
+            # vector-cache_index step is the serving hot loop: S == 1
+            # plain decode, S > 1 the speculative-verify span whose S
+            # queries amortize one span read; prefill and training
+            # stay dense, where the full-row read is the work).
+            # ``paged_tile`` is the engine-resolved geometry (the byte
+            # ledger prices the same tile by construction); absent it,
+            # re-derive — the direct-apply ergonomic path.
             from .pallas_attn import paged_decode_attention, \
                 paged_geometry
             tile = paged_tile
@@ -285,9 +285,11 @@ class CausalAttention(nn.Module):
                         f"kv_heads={KV}, d_head={D} — resolve the "
                         "backend via resolve_attention_backend first")
                 tile = geo.tile
-            spans = positions[:, 0].astype(jnp.int32) + 1
+            # the LAST query's key count; earlier queries mask one key
+            # fewer each inside the kernel (the in-span causal mask)
+            spans = positions[:, -1].astype(jnp.int32) + 1
             out = paged_decode_attention(
-                q[:, 0], k_all, v_all, spans, tile=tile,
+                q, k_all, v_all, spans, tile=tile,
                 num_tiles=(paged_num_tiles or T // tile),
                 interpret=(attention_backend == "interpret")
             ).reshape(B, S, H * D)

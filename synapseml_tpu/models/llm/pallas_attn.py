@@ -86,8 +86,8 @@ class PagedGeometry:
 
 
 def paged_geometry(max_len: int, num_heads: int, num_kv_heads: int,
-                   d_head: int, dtype: Any = jnp.bfloat16
-                   ) -> Optional[PagedGeometry]:
+                   d_head: int, dtype: Any = jnp.bfloat16,
+                   max_query_span: int = 1) -> Optional[PagedGeometry]:
     """The VMEM gate: pick the key-tile length for a
     ``(max_len, num_kv_heads, d_head)`` cache row, or None when no
     geometry fits (the 'auto' backend then stays dense — the
@@ -98,16 +98,21 @@ def paged_geometry(max_len: int, num_heads: int, num_kv_heads: int,
     two tiles of span granularity (``tile <= max_len // 2``) — a
     one-tile "paged" read would just be the dense row with extra
     steps.  Working set: double-buffered K and V tiles plus the q/out
-    blocks and the f32 online-softmax scratch."""
+    blocks and the f32 online-softmax scratch — the latter three all
+    scale with ``max_query_span`` (the speculative verify step's S:
+    its q/out blocks are ``(1, S, H, D)`` and its scratch rows
+    ``S*H``), so a spec-enabled engine must gate at the WIDEST verify
+    it can launch, not at S=1."""
     itemsize = np.dtype(dtype).itemsize
     sub = _sublane(dtype)
+    s = max(1, int(max_query_span))
     for tile in _TILE_CANDIDATES:
         if tile % sub or max_len % tile or tile > max_len // 2:
             continue
         need = (2 * 2 * tile * num_kv_heads * d_head * itemsize  # K+V x2 buf
-                + 2 * num_heads * d_head * itemsize              # q + out
-                + num_heads * d_head * 4                         # f32 acc
-                + 2 * num_heads * 128 * 4)                       # m + l
+                + s * 2 * num_heads * d_head * itemsize          # q + out
+                + s * num_heads * d_head * 4                     # f32 acc
+                + s * 2 * num_heads * 128 * 4)                   # m + l
         if need <= _VMEM_BUDGET:
             return PagedGeometry(tile, max_len // tile, need)
     return None
@@ -115,8 +120,8 @@ def paged_geometry(max_len: int, num_heads: int, num_kv_heads: int,
 
 def resolve_attention_backend(backend: str, *, max_len: int,
                               num_heads: int, num_kv_heads: int,
-                              d_head: int, dtype: Any = jnp.bfloat16
-                              ) -> str:
+                              d_head: int, dtype: Any = jnp.bfloat16,
+                              max_query_span: int = 1) -> str:
     """The one parser for ``attention_backend`` (SlotEngine /
     LLMServer / bench) — returns the RESOLVED backend
     (``'dense'`` | ``'paged'`` | ``'interpret'``) or fails fast with an
@@ -137,7 +142,8 @@ def resolve_attention_backend(backend: str, *, max_len: int,
             f"{ATTENTION_BACKENDS}")
     if backend == "dense":
         return "dense"
-    geo = paged_geometry(max_len, num_heads, num_kv_heads, d_head, dtype)
+    geo = paged_geometry(max_len, num_heads, num_kv_heads, d_head, dtype,
+                         max_query_span=max_query_span)
     on_tpu = jax.default_backend() == "tpu"
     if backend == "auto":
         return "paged" if (on_tpu and geo is not None) else "dense"
@@ -207,16 +213,22 @@ def dense_read_bytes(n_slots: int, max_len: int, num_kv_heads: int,
 # the kernel
 # ---------------------------------------------------------------------------
 
-def _make_decode_kernel(kv_heads: int, group: int, tile: int, d_head: int):
+def _make_decode_kernel(kv_heads: int, group: int, tile: int, d_head: int,
+                        s_len: int):
     neg = float(np.finfo(np.float32).min)
 
     def kernel(spans_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
                l_ref):
         """Grid ``(n_slots, num_tiles)``, tile fastest.  q/out blocks
-        ``(1, H, D)`` constant per slot; K/V blocks ``(1, tile, KV, D)``
-        span-clamped (see ``_kv_index_map``); scratch: f32 accumulator
-        ``(H, D)`` plus running max / normalizer ``(H, 128)`` (lane 0
-        carries the value) — revisited across the tile dimension."""
+        ``(1, S, H, D)`` constant per slot (S == 1 is the plain decode
+        step; S > 1 the speculative-verify span, whose S query
+        positions amortize ONE span-bucketed K/V read); K/V blocks
+        ``(1, tile, KV, D)`` span-clamped (see ``_kv_index_map``);
+        scratch: f32 accumulator ``(S*H, D)`` plus running max /
+        normalizer ``(S*H, 128)`` (lane 0 carries the value), rows
+        HEAD-major — head h owns rows ``[h*S*group, (h+1)*S*group)`` so
+        each kv head's update touches one contiguous block — revisited
+        across the tile dimension."""
         s = pl.program_id(0)
         t = pl.program_id(1)
         span = spans_ref[s]
@@ -230,26 +242,31 @@ def _make_decode_kernel(kv_heads: int, group: int, tile: int, d_head: int):
 
         @pl.when(t < n_tiles)
         def _tile():
-            # the single query sits at position span-1 and attends keys
-            # <= span-1, i.e. key < span: the causal mask degenerates to
-            # the live-span mask (same finfo-min fill as the dense path
-            # — exp underflows to exactly 0.0 either way)
+            # ``span`` counts the keys the LAST query attends: query j
+            # sits at position span-S+j and attends keys <= itself,
+            # i.e. key < span-(S-1)+j — for S == 1 the causal mask
+            # degenerates to the live-span mask (same finfo-min fill as
+            # the dense path: exp underflows to probability 0.0 either
+            # way)
             kpos = t * tile + lax.broadcasted_iota(jnp.int32, (1, tile), 1)
-            valid = kpos < span                              # (1, tile)
+            qidx = lax.broadcasted_iota(jnp.int32, (s_len * group, tile),
+                                        0) // group       # query j per row
+            valid = kpos < span - (s_len - 1) + qidx      # (S*g, tile)
             for h in range(kv_heads):
-                rows = slice(h * group, (h + 1) * group)
-                q = q_ref[0, rows, :].astype(jnp.float32)    # (g, D)
+                rows = slice(h * s_len * group, (h + 1) * s_len * group)
+                q = q_ref[0, :, h * group:(h + 1) * group, :].reshape(
+                    s_len * group, d_head).astype(jnp.float32)
                 k = k_ref[0, :, h, :].astype(jnp.float32)    # (tile, D)
                 logits = lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) / np.sqrt(d_head)
-                logits = jnp.where(valid, logits, neg)       # (g, tile)
-                m_prev = m_ref[rows, 0:1]                    # (g, 1)
+                logits = jnp.where(valid, logits, neg)       # (S*g, tile)
+                m_prev = m_ref[rows, 0:1]                    # (S*g, 1)
                 l_prev = l_ref[rows, 0:1]
                 m_new = jnp.maximum(
                     m_prev, jnp.max(logits, -1, keepdims=True))
                 alpha = jnp.exp(m_prev - m_new)
-                p = jnp.exp(logits - m_new)                  # (g, tile)
+                p = jnp.exp(logits - m_new)                  # (S*g, tile)
                 v = v_ref[0, :, h, :].astype(jnp.float32)    # (tile, D)
                 pv = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -260,18 +277,22 @@ def _make_decode_kernel(kv_heads: int, group: int, tile: int, d_head: int):
 
         @pl.when(t == pl.num_programs(1) - 1)
         def _out():
-            # every live span holds >= 1 unmasked key whose probability
-            # at the running max is exp(0) = 1, so l >= 1; the floor
-            # only guards the impossible all-masked row
-            l = jnp.maximum(l_ref[:, 0:1], 1e-30)
-            o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+            # every live query attends >= 1 unmasked key whose
+            # probability at the running max is exp(0) = 1, so l >= 1;
+            # the floor only guards the impossible all-masked row
+            for h in range(kv_heads):
+                rows = slice(h * s_len * group, (h + 1) * s_len * group)
+                l = jnp.maximum(l_ref[rows, 0:1], 1e-30)
+                o_ref[0, :, h * group:(h + 1) * group, :] = (
+                    acc_ref[rows, :] / l).reshape(
+                        s_len, group, d_head).astype(o_ref.dtype)
 
     return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "num_tiles",
                                              "interpret"))
-def paged_decode_attention(q: jnp.ndarray,      # (B, H, D)
+def paged_decode_attention(q: jnp.ndarray,      # (B, H, D) | (B, S, H, D)
                            k: jnp.ndarray,      # (B, max_len, KV, D)
                            v: jnp.ndarray,      # (B, max_len, KV, D)
                            spans: jnp.ndarray,  # (B,) int32 live lengths
@@ -279,16 +300,24 @@ def paged_decode_attention(q: jnp.ndarray,      # (B, H, D)
                            num_tiles: int,
                            interpret: bool = False) -> jnp.ndarray:
     """One decode step's attention for every slot, reading only each
-    slot's live K/V span: → (B, H, D) in ``q.dtype``.
+    slot's live K/V span: → same shape as ``q``, in ``q.dtype``.
 
-    ``spans[b]`` is slot b's live length (the query attends keys
-    ``[0, spans[b])``; the query's own K/V must already be written —
-    the engine's scatter runs BEFORE attention, as in the dense path).
+    ``q`` may carry a query-span dimension ``S`` (``(B, S, H, D)`` —
+    the speculative-verify step, where slot b's query j sits at
+    position ``spans[b]-S+j``); a 3-D ``q`` is the plain S == 1 decode
+    step.  ``spans[b]`` is slot b's live length INCLUDING this step's
+    S written positions (the LAST query attends keys ``[0, spans[b])``;
+    earlier queries attend one key fewer each — the in-span causal
+    mask).  The queries' own K/V must already be written — the
+    engine's scatter runs BEFORE attention, as in the dense path.
     ``num_tiles`` is the static bucketed grid length from
     :func:`span_bucket_tiles`; spans beyond ``num_tiles * tile`` would
     be silently truncated, so the caller's bucket must cover the
     longest live span."""
-    B, H, D = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    B, S, H, D = q.shape
     KV = k.shape[2]
     assert H % KV == 0, (H, KV)
     group = H // KV
@@ -305,20 +334,22 @@ def paged_decode_attention(q: jnp.ndarray,      # (B, H, D)
         num_scalar_prefetch=1,
         grid=(B, num_tiles),
         in_specs=[
-            pl.BlockSpec((1, H, D), lambda s, t, *_: (s, 0, 0)),
+            pl.BlockSpec((1, S, H, D), lambda s, t, *_: (s, 0, 0, 0)),
             pl.BlockSpec((1, tile, KV, D), kv_index_map),
             pl.BlockSpec((1, tile, KV, D), kv_index_map),
         ],
-        out_specs=pl.BlockSpec((1, H, D), lambda s, t, *_: (s, 0, 0)),
+        out_specs=pl.BlockSpec((1, S, H, D),
+                               lambda s, t, *_: (s, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((H, D), jnp.float32),     # online-softmax acc
-            pltpu.VMEM((H, 128), jnp.float32),   # running max (lane 0)
-            pltpu.VMEM((H, 128), jnp.float32),   # normalizer (lane 0)
+            pltpu.VMEM((S * H, D), jnp.float32),   # online-softmax acc
+            pltpu.VMEM((S * H, 128), jnp.float32),  # running max (lane 0)
+            pltpu.VMEM((S * H, 128), jnp.float32),  # normalizer (lane 0)
         ],
     )
-    return pl.pallas_call(
-        _make_decode_kernel(KV, group, tile, D),
+    out = pl.pallas_call(
+        _make_decode_kernel(KV, group, tile, D, S),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
         interpret=interpret,
     )(spans.astype(jnp.int32), q, k, v)
+    return out[:, 0] if squeeze else out

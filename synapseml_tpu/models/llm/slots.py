@@ -37,6 +37,17 @@ Mechanics:
 - **retirement** — EOS or the per-request token budget frees the slot;
   its K/V and token buffer persist as prefix-cache until the slot is
   reclaimed (least-recently-retired first).
+- **speculative decoding** (``spec_draft_len > 0``, greedy only) —
+  before each step the per-slot :class:`~synapseml_tpu.models.llm
+  .drafter.NgramDrafter` proposes a continuation span from the slot's
+  own prompt+generated ids (zero model calls); any hit upgrades the
+  step to a multi-token VERIFY: one jitted forward scores all S
+  positions, the longest exact-greedy draft prefix plus the model's
+  bonus token commit, and every slot advances by its own accepted
+  span.  Rejected positions' K/V lands beyond the committed length —
+  the junk-write invariant below already covers it.  Output stays
+  token-exact greedy: a draft token is committed ONLY when it equals
+  the model's argmax.
 
 Junk-write safety: padded prefill rows and pre-copy leftovers only ever
 land at positions strictly beyond a slot's current length; decode writes
@@ -62,6 +73,7 @@ import numpy as np
 from jax import lax
 
 from ...telemetry import get_registry
+from .drafter import NgramDrafter
 from .generate import sample_logits
 from .model import LlamaModel, init_cache
 from .pallas_attn import (dense_read_bytes, paged_geometry,
@@ -125,6 +137,39 @@ def _decode_step_jit(model: LlamaModel, variables: Any, cache: Any,
     return cache, nxt, key
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "model", "attention_backend", "paged_num_tiles", "paged_tile"),
+    donate_argnums=(2,))
+def _verify_step_jit(model: LlamaModel, variables: Any, cache: Any,
+                     tokens: jnp.ndarray, lengths: jnp.ndarray,
+                     active: jnp.ndarray,
+                     attention_backend: str = "dense",
+                     paged_num_tiles: Optional[int] = None,
+                     paged_tile: Optional[int] = None):
+    """One speculative VERIFY step: feed every slot its pending token
+    plus its drafted span (``tokens`` is ``(n_slots, S)`` — column 0
+    the pending token, columns 1..S-1 the draft, pad beyond) at
+    positions ``lengths-1 .. lengths-1+S-1``, and return the model's
+    greedy continuation at EVERY position (``(n_slots, S)`` int32).
+
+    The host accepts the longest prefix where draft == greedy and
+    commits ``accepted + 1`` tokens — one compiled program per S
+    bucket, costing one model forward however many tokens it commits.
+    Writes ride the same slot_mask-gated batched scatter as the plain
+    step; a REJECTED draft position's K/V lands beyond the committed
+    length, where the junk-write invariant already holds (overwritten
+    before it is ever attendable).  Greedy only: acceptance compares
+    argmax, which is exactly the temperature-0 sampling rule."""
+    positions = (lengths - 1)[:, None] + jnp.arange(tokens.shape[1])[None, :]
+    logits, cache = model.apply(variables, tokens, positions=positions,
+                                cache=cache, cache_index=lengths - 1,
+                                slot_mask=active,
+                                attention_backend=attention_backend,
+                                paged_num_tiles=paged_num_tiles,
+                                paged_tile=paged_tile)
+    return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _copy_prefix_jit(cache: Any, src: jnp.ndarray, dst: jnp.ndarray,
                      length: jnp.ndarray):
@@ -137,6 +182,16 @@ def _copy_prefix_jit(cache: Any, src: jnp.ndarray, dst: jnp.ndarray,
         return lax.dynamic_update_slice_in_dim(
             c, jnp.where(m, row, old), dst, axis=0)
     return jax.tree.map(cp, cache)
+
+
+def _next_pow2(n: int) -> int:
+    """Smallest power of two >= n — the ONE round-up behind the verify
+    S bucket and the VMEM gate's widest-span pricing (they must agree,
+    or the gate admits geometries the verify launch exceeds)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
 
 
 @dataclasses.dataclass
@@ -179,7 +234,9 @@ class SlotEngine:
                  top_p: float = 1.0, eos_id: Optional[int] = None,
                  pad_id: int = 0, min_prefix: int = 8,
                  min_bucket: int = 8, seed: int = 0, name: str = "llm",
-                 attention_backend: str = "auto", step_profiler=None):
+                 attention_backend: str = "auto", step_profiler=None,
+                 spec_draft_len: int = 0, spec_ngram: int = 3,
+                 spec_adapt: bool = True):
         self.model = model
         self.variables = variables
         self.cfg = model.cfg
@@ -189,16 +246,21 @@ class SlotEngine:
         # kernel on TPU when the geometry fits VMEM, dense otherwise;
         # 'paged'/'interpret' fail fast when they cannot run (the
         # resolve_collective_config validation idiom)
+        # the widest verify step a spec-enabled engine can launch (the
+        # pow2 S bucket over pending + longest draft) — the VMEM gate
+        # must price ITS q/scratch working set, not the S=1 step's
+        spec_span = _next_pow2(1 + max(0, int(spec_draft_len)))
         self.attention_backend = resolve_attention_backend(
             attention_backend, max_len=self.max_len,
             num_heads=self.cfg.num_heads,
             num_kv_heads=self.cfg.num_kv_heads,
-            d_head=self.cfg.d_head, dtype=self.cfg.dtype)
+            d_head=self.cfg.d_head, dtype=self.cfg.dtype,
+            max_query_span=spec_span)
         self._paged_geo = (None if self.attention_backend == "dense"
                           else paged_geometry(
                               self.max_len, self.cfg.num_heads,
                               self.cfg.num_kv_heads, self.cfg.d_head,
-                              self.cfg.dtype))
+                              self.cfg.dtype, max_query_span=spec_span))
         #: optional telemetry.gangplane.StepProfiler — decode steps run
         #: under step/mark and (capture_xla) the per-bucket step program
         #: goes through capture_cost for the roofline gauges
@@ -210,6 +272,19 @@ class SlotEngine:
         self.pad_id = int(pad_id)
         self.min_prefix = max(1, int(min_prefix))
         self.name = name
+        # speculative decoding: n-gram self-drafts verified in a
+        # multi-token step (spec_draft_len == 0 keeps the engine on the
+        # plain one-token step — the pre-spec behavior exactly)
+        self.spec_draft_len = max(0, int(spec_draft_len))
+        self.spec_adapt = bool(spec_adapt)
+        if self.spec_draft_len and self.temperature > 0:
+            raise ValueError(
+                "spec_draft_len > 0 requires greedy decoding "
+                "(temperature <= 0): speculative verification accepts a "
+                "draft token only when it equals the model's argmax, "
+                "which is only the sampling rule at temperature 0")
+        self._drafter = (NgramDrafter(int(n_slots), ngram=int(spec_ngram))
+                         if self.spec_draft_len else None)
         self._key = jax.random.PRNGKey(seed)
         self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
         # prompt-length buckets: powers of two, so the prefill compiles
@@ -233,6 +308,20 @@ class SlotEngine:
         # hashed prefix index: first-min_prefix-tokens hash -> slots
         self._prefix_index: Dict[int, Set[int]] = {}
         self._slot_hash: List[Optional[int]] = [None] * n
+        # per-slot draft-length adaptation (AIMD over a rolling
+        # acceptance EWMA): caps start at a cheap 2-token probe, DOUBLE
+        # on a fully-accepted draft, HALVE when under half the draft
+        # survives, and collapse to a 1-token probe on persistent
+        # badness (EWMA < 0.2) — so predictable text climbs to the
+        # full cap in ~log2(spec_draft_len) steps while mediocre text
+        # keeps its drafts short (expected acceptance of a k-token
+        # draft falls with k when the per-token match probability is
+        # middling, so short drafts are what keep acceptance — and the
+        # verify width's cost — honest)
+        self._spec_k0 = min(2, self.spec_draft_len) if self.spec_draft_len \
+            else 0
+        self._spec_k = np.full(n, self._spec_k0, np.int64)
+        self._spec_ewma = np.ones(n)
         reg = get_registry()
         self._m_admit = reg.counter(
             "llm_admissions_total", "sequences admitted into a slot",
@@ -257,6 +346,19 @@ class SlotEngine:
             "decode-attention K/V bytes read per generated token this "
             "step (exact DMA ledger for the paged kernel; the full-"
             "capacity read model for dense)", ("engine", "backend"))
+        self._m_spec_span = reg.histogram(
+            "llm_spec_accepted_span_size",
+            "tokens committed per slot per speculative verify step "
+            "(accepted draft prefix + the bonus token)", ("engine",),
+            buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
+        self._m_spec_hit = reg.counter(
+            "llm_spec_draft_hit_total",
+            "slot-steps where the n-gram drafter proposed a span",
+            ("engine",))
+        self._m_spec_miss = reg.counter(
+            "llm_spec_draft_miss_total",
+            "slot-steps where the n-gram drafter had no match (the slot "
+            "rode the plain one-token step)", ("engine",))
         self.admissions = 0
         self.evictions = 0
         self.prefix_hits = 0
@@ -265,6 +367,16 @@ class SlotEngine:
         #: cumulative decode-attention K/V bytes (the ledger feeding the
         #: gauge above; bench reads it for the paired roofline block)
         self.decode_attn_bytes = 0
+        #: speculative-decode accounting (bench's llmserve_spec_* /
+        #: llama1b_spec_* fields read these): steps_run counts EVERY
+        #: engine step (plain or verify), spec_* only drafted work
+        self.steps_run = 0
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_draft_hits = 0
+        self.spec_draft_misses = 0
+        self._tps_ewma: Optional[float] = None
 
     # -- capacity ----------------------------------------------------------
     @property
@@ -283,6 +395,22 @@ class SlotEngine:
             return None
         rem = (self._max_new - self._generated)[self.active]
         return int(rem.min())
+
+    def tokens_per_step_estimate(self) -> float:
+        """Committed tokens per engine step, EWMA over recent steps —
+        >= 1.0 always (a plain step commits one token per active slot).
+        The serving loop divides its remaining-token floor by this so
+        SLO projections track SPEC throughput (remaining-tokens /
+        accepted-tokens-per-step) instead of assuming one token per
+        step."""
+        return max(1.0, self._tps_ewma or 1.0)
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Accepted / drafted tokens, cumulative — only REAL drafts
+        count (a drafter miss costs no verify positions and dilutes
+        nothing)."""
+        return self.spec_accepted / max(1, self.spec_drafted)
 
     # -- prefix reuse ------------------------------------------------------
     def _prefix_key(self, ids: np.ndarray) -> Optional[int]:
@@ -420,6 +548,13 @@ class SlotEngine:
         self._max_new[slot] = max_new
         self._generated[slot] = 1
         self._register_prefix(slot, prompt)
+        if self._drafter is not None:
+            # (re)build the slot's n-gram tables from prompt + first
+            # token — a REUSED prefix feeds the table identically (the
+            # tables index tokens, which admit always has in full)
+            self._spec_k[slot] = self._spec_k0
+            self._spec_ewma[slot] = 1.0
+            self._drafter.begin(slot, self.ctx[slot], plen + 1)
         self.admissions += 1
         self._m_admit.inc(1, engine=self.name)
         self.tokens_generated += 1
@@ -469,14 +604,21 @@ class SlotEngine:
         self.lengths[:] = 0
         self._prefix_index.clear()
         self._slot_hash = [None] * self.n_slots
+        if self._drafter is not None:
+            for slot in range(self.n_slots):
+                self._drafter.forget(slot)
+            self._spec_k[:] = self._spec_k0
+            self._spec_ewma[:] = 1.0
         self._m_occ.set(0.0, engine=self.name)
 
-    def _decode_step_args(self):
+    def _decode_step_args(self, extra_span: int = 0):
         """(jit kwargs, spans) for THIS step: the span-bucketed grid
         length for the paged backends (one compiled program per power-
         of-two tile bucket, so short batches never iterate a long
         cache's grid) and the per-slot live spans the byte ledger
-        prices."""
+        prices.  ``extra_span`` is the verify step's S-1 additional
+        written positions — the bucket must cover the LAST query's key
+        count, ``lengths + S - 1``."""
         lengths = np.where(self.active, self.lengths, 1)
         kw = {"attention_backend": self.attention_backend,
               "paged_num_tiles": None, "paged_tile": None}
@@ -485,7 +627,7 @@ class SlotEngine:
             # kernel and the byte ledger can never price different
             # geometries
             kw["paged_num_tiles"] = span_bucket_tiles(
-                int(lengths.max()), self._paged_geo)
+                int(lengths.max()) + extra_span, self._paged_geo)
             kw["paged_tile"] = self._paged_geo.tile
         return kw, lengths
 
@@ -512,10 +654,42 @@ class SlotEngine:
 
     def step(self) -> List[StepEvent]:
         """One decode step across every active slot.  Returns the
-        per-slot events (token + retirement verdicts); empty when no
-        slot is active."""
+        per-slot events (token + retirement verdicts, possibly SEVERAL
+        per slot when a drafted span is accepted); empty when no slot
+        is active.
+
+        With ``spec_draft_len > 0`` the engine asks the n-gram drafter
+        for a span per slot first: any hit upgrades the step to a
+        multi-token VERIFY (every slot advances by its accepted span);
+        an all-miss step falls back to the plain one-token step — a
+        miss costs nothing."""
         if not self.active.any():
             return []
+        if self._drafter is not None:
+            s_cap = self._spec_headroom()
+            drafts = self._collect_drafts(s_cap)
+            if drafts:
+                return self._finish_step(self._verify_step(drafts, s_cap))
+        return self._finish_step(self._plain_step())
+
+    def _finish_step(self, events: List[StepEvent]) -> List[StepEvent]:
+        """Common step epilogue: retirement, counters, and the
+        per-slot tokens-per-step EWMA (the serving loop's SLO
+        projection divides its remaining-token floor by this)."""
+        for ev in events:
+            if ev.finished:
+                self._retire(ev.slot, ev.reason)
+        self.steps_run += 1
+        slots = len({ev.slot for ev in events})
+        tps = len(events) / max(1, slots)
+        self._tps_ewma = (tps if self._tps_ewma is None
+                          else 0.8 * self._tps_ewma + 0.2 * tps)
+        self._m_tokens.inc(len(events), engine=self.name)
+        self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
+        return events
+
+    def _plain_step(self) -> List[StepEvent]:
+        """The one-token step (the pre-spec decode path)."""
         idx = np.arange(self.n_slots)
         kw, lengths = self._decode_step_args()
         tokens = np.where(self.active,
@@ -554,13 +728,170 @@ class SlotEngine:
             self.kv_len[slot] = ln        # the fed token's K/V just landed
             self._generated[slot] += 1
             self.tokens_generated += 1
+            if self._drafter is not None:
+                self._drafter.extend(slot, self.ctx[slot], ln, ln + 1)
             finished, reason = self._finish_reason(slot, tok)
-            if finished:
-                self._retire(slot, reason)
             events.append(StepEvent(slot, tok, finished, reason))
-        self._m_tokens.inc(len(events), engine=self.name)
-        self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
         return events
+
+    # -- speculative decoding ----------------------------------------------
+    def _spec_headroom(self) -> int:
+        """Cache headroom for THIS step's verify width: every written
+        position must fit ``max_len``, so S cannot exceed
+        ``max_len - longest_active_length + 1`` (>= 2 always — admit
+        guarantees ``plen + max_new + 1 <= max_len``).  Computed once
+        per step and threaded to draft collection AND the verify
+        launch so they can never cap at different values."""
+        return self.max_len - int(self.lengths[self.active].max()) + 1
+
+    def _collect_drafts(self, s_cap: int) -> Dict[int, np.ndarray]:
+        """Ask the drafter for a span per active slot.  A slot's draft
+        is capped by its remaining budget (committing past the budget
+        is wasted verify work), its ADAPTIVE cap (the acceptance EWMA),
+        and the step's cache headroom ``s_cap``."""
+        out: Dict[int, np.ndarray] = {}
+        hits = misses = 0
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            rem = int(self._max_new[slot] - self._generated[slot])
+            k_cap = min(self.spec_draft_len, int(self._spec_k[slot]),
+                        rem - 1, s_cap - 1)
+            if k_cap < 1:
+                continue            # no draft possible: not a miss
+            d = self._drafter.draft(slot, self.ctx[slot],
+                                    int(self.lengths[slot]), k_cap)
+            if len(d):
+                out[slot] = d
+                hits += 1
+            else:
+                misses += 1
+        self.spec_draft_hits += hits
+        self.spec_draft_misses += misses
+        if hits:
+            self._m_spec_hit.inc(hits, engine=self.name)
+        if misses:
+            self._m_spec_miss.inc(misses, engine=self.name)
+        return out
+
+    def _spec_bucket(self, max_k: int, s_cap: int) -> int:
+        """Static S for this verify step: the next power of two
+        covering pending + longest draft, shrunk to the cache headroom
+        — one compiled verify program per (S, span-bucket) pair,
+        O(log(spec_draft_len) * log(max_len/tile)) programs total."""
+        s = max(2, _next_pow2(1 + max_k))
+        while s > s_cap and s > 2:
+            s //= 2
+        return s
+
+    def _verify_step(self, drafts: Dict[int, np.ndarray],
+                     s_cap: int) -> List[StepEvent]:
+        """One multi-token verify step: score every slot's draft span
+        against the model in ONE forward, accept the longest
+        exact-greedy prefix, commit accepted + 1 tokens through the
+        slot_mask-gated scatter (already landed — only COMMITTED
+        positions become attendable via ``lengths``/``kv_len``)."""
+        idx = np.arange(self.n_slots)
+        S = self._spec_bucket(max(len(d) for d in drafts.values()), s_cap)
+        kw, lengths = self._decode_step_args(extra_span=S - 1)
+        tokens = np.full((self.n_slots, S), self.pad_id, np.int32)
+        tokens[:, 0] = np.where(
+            self.active, self.ctx[idx, np.maximum(self.lengths - 1, 0)],
+            self.pad_id)
+        klen = np.zeros(self.n_slots, np.int64)
+        for slot, d in drafts.items():
+            d = d[:S - 1]
+            tokens[slot, 1:1 + len(d)] = d
+            klen[slot] = len(d)
+        prof = self.step_profiler
+        if prof is not None:
+            if getattr(prof, "capture_xla", False):
+                nt = kw["paged_num_tiles"]
+                prof.capture_cost(
+                    f"llm_verify_step_{self.attention_backend}_s{S}"
+                    + (f"_nt{nt}" if nt is not None else ""),
+                    _verify_step_jit, self.model, self.variables,
+                    self.cache, jnp.asarray(tokens),
+                    jnp.asarray(lengths.astype(np.int32)),
+                    jnp.asarray(self.active),
+                    items=float(self.active_count), **kw)
+            prof.step_begin()
+        self.cache, g = _verify_step_jit(
+            self.model, self.variables, self.cache, jnp.asarray(tokens),
+            jnp.asarray(lengths.astype(np.int32)),
+            jnp.asarray(self.active), **kw)
+        g = np.asarray(g)
+        if prof is not None:
+            prof.mark("compute")      # np.asarray synchronized the step
+            prof.step_end()
+        self.spec_steps += 1
+        events: List[StepEvent] = []
+        served = 0
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            ln = int(self.lengths[slot])
+            k_s = int(klen[slot])
+            row = g[slot]
+            # longest exact-greedy prefix of the draft, then the bonus
+            # token the model produced after it (Leviathan-style greedy
+            # verification: every committed token IS the argmax token)
+            a = 0
+            while a < k_s and int(tokens[slot, a + 1]) == int(row[a]):
+                a += 1
+            commit = row[:a + 1]
+            rem = int(self._max_new[slot] - self._generated[slot])
+            commit = commit[:rem]
+            if self.eos_id is not None:
+                eos = np.flatnonzero(commit == self.eos_id)
+                if len(eos):
+                    commit = commit[:int(eos[0]) + 1]
+            c = len(commit)
+            self.ctx[slot, ln:ln + c] = commit
+            self.lengths[slot] = ln + c
+            # positions ln-1 .. ln+c-2 were fed the COMMITTED tokens,
+            # so exactly those K/V rows are valid; rejected positions
+            # beyond hold junk the next step overwrites before any
+            # query can attend it (the prefill-padding invariant)
+            self.kv_len[slot] = ln + c - 1
+            self._generated[slot] += c
+            self.tokens_generated += c
+            served += c
+            if k_s:
+                self.spec_drafted += k_s
+                self.spec_accepted += min(a, k_s)
+                self._m_spec_span.observe(c, engine=self.name)
+                if self.spec_adapt:
+                    self._adapt_slot(slot, min(a, k_s) / k_s)
+            if self._drafter is not None:
+                self._drafter.extend(slot, self.ctx[slot], ln, ln + c)
+            finished, reason = self._finish_reason(slot, int(commit[-1]))
+            for j, tok in enumerate(commit):
+                last = j == c - 1
+                events.append(StepEvent(slot, int(tok),
+                                        finished and last,
+                                        reason if last else None))
+        self._account_decode_bytes(lengths + (S - 1), max(1, served))
+        return events
+
+    def _adapt_slot(self, slot: int, acceptance: float) -> None:
+        """Fold one verify outcome into the slot's rolling acceptance
+        EWMA and AIMD the slot's draft cap: a FULLY-accepted draft
+        doubles the cap (toward ``spec_draft_len``), a draft that lost
+        more than half its tokens halves it, and PERSISTENT badness —
+        EWMA under 0.2 — collapses straight to the 1-token probe
+        instead of paying the halving ladder down.  A slot in
+        predictable text climbs to wide verifies in a few steps; a
+        slot that left its predictable region stops paying for them
+        while still probing cheaply enough to notice recovery."""
+        w = 0.3
+        e = (1 - w) * self._spec_ewma[slot] + w * acceptance
+        self._spec_ewma[slot] = e
+        k = int(self._spec_k[slot])
+        if e < 0.2:
+            self._spec_k[slot] = 1
+        elif acceptance >= 1.0:
+            self._spec_k[slot] = min(self.spec_draft_len, max(2, 2 * k))
+        elif acceptance < 0.5:
+            self._spec_k[slot] = max(1, k // 2)
 
     # -- output ------------------------------------------------------------
     def generated_ids(self, slot: int) -> np.ndarray:

@@ -40,7 +40,11 @@ class LLMServer:
     ``Retry-After``.  ``attention_backend`` selects the decode-step
     attention read (``'auto'`` = the Pallas paged kernel on TPU when
     the geometry fits VMEM, dense otherwise — see
-    docs/api/serving.md "Paged decode attention")."""
+    docs/api/serving.md "Paged decode attention").  ``spec_draft_len``
+    turns on speculative decoding (greedy only): every slot advances
+    by its accepted n-gram-drafted span per step and the SLO
+    projection divides by the engine's accepted-tokens-per-step — see
+    docs/api/serving.md "Speculative decoding"."""
 
     def __init__(self, model: Any = None, variables: Any = None, *,
                  engine: Any = None, tokenizer: Any = None,
@@ -54,6 +58,7 @@ class LLMServer:
                  top_p: float = 1.0, min_prefix: int = 8,
                  max_queue: int = 1024, reply_timeout_s: float = 30.0,
                  attention_backend: str = "auto",
+                 spec_draft_len: int = 0, spec_ngram: int = 3,
                  engine_kwargs: Optional[Dict[str, Any]] = None):
         if engine is None:
             from ..models.llm import SlotEngine
@@ -62,6 +67,8 @@ class LLMServer:
                                 top_k=top_k, top_p=top_p, eos_id=eos_id,
                                 pad_id=pad_id, min_prefix=min_prefix,
                                 attention_backend=attention_backend,
+                                spec_draft_len=spec_draft_len,
+                                spec_ngram=spec_ngram,
                                 **(engine_kwargs or {}))
         self.engine = engine
         self.tokenizer = tokenizer

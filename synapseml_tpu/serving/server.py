@@ -1123,8 +1123,13 @@ class _DecodeLoop:
 
     The engine is duck-typed (``admit``/``step``/``cancel``/
     ``n_slots``/``active_count``/``free_slot_count``/
-    ``min_remaining_tokens``) so this module never imports jax; pass a
-    :class:`synapseml_tpu.models.llm.SlotEngine`.
+    ``min_remaining_tokens``, plus optional
+    ``tokens_per_step_estimate`` — a speculative engine's
+    accepted-tokens-per-step EWMA, folded into the SLO projection) so
+    this module never imports jax; pass a
+    :class:`synapseml_tpu.models.llm.SlotEngine`.  A ``step()`` may
+    return SEVERAL events per slot (a speculative engine commits whole
+    accepted spans); the loop streams each committed token in order.
     """
 
     def __init__(self, server: ServingServer, api: ApiHandle, engine: Any,
@@ -1218,14 +1223,22 @@ class _DecodeLoop:
         inter-retirement interval from the recent window (the honest
         estimate when EOS retires sequences far under budget —
         budget-based projection alone would shed requests that real
-        retirement traffic was about to serve)."""
+        retirement traffic was about to serve).  A SPECULATIVE engine
+        advances each slot by its accepted span, so the floor divides
+        by the engine's accepted-tokens-per-step estimate
+        (``tokens_per_step_estimate``, optional in the duck-type
+        contract): remaining-tokens ÷ accepted-tokens-per-step steps
+        remain, not remaining-tokens steps — without this the
+        projection over-sheds by the whole speculative speedup."""
         waited = time.monotonic() - seq.req.enqueued_at
         if self.engine.free_slot_count > 0:
             return waited
         rem = self.engine.min_remaining_tokens()
         if rem is None or self._step_ewma is None:
             return waited
-        next_free = rem * self._step_ewma
+        tps_fn = getattr(self.engine, "tokens_per_step_estimate", None)
+        tps = max(1.0, float(tps_fn())) if tps_fn is not None else 1.0
+        next_free = rem / tps * self._step_ewma
         now = time.monotonic()
         recent = [t for t in self._retired_window if now - t < 5.0]
         if recent:
@@ -1365,11 +1378,19 @@ class _DecodeLoop:
         dt = time.perf_counter() - t0
         self._step_ewma = (dt if self._step_ewma is None
                            else 0.8 * self._step_ewma + 0.2 * dt)
+        # a speculative engine commits a SPAN per slot per step: the
+        # per-token latency observation is the step time amortized
+        # over the slot's committed span (observing the full dt once
+        # per token would overcount it span-fold and read as spec
+        # WORSENING token latency when it improved it)
+        span: Dict[int, int] = {}
+        for ev in events:
+            span[ev.slot] = span.get(ev.slot, 0) + 1
         for ev in events:
             seq = self._by_slot.get(ev.slot)
             if seq is None:         # cancelled under us
                 continue
-            self._m_tok_lat.observe(dt, api=self.api.path)
+            self._m_tok_lat.observe(dt / span[ev.slot], api=self.api.path)
             self._on_token(seq, ev.token, ev.finished)
         if events and dt > 0:
             self._m_rps.set(len(events) / dt, api=self.api.path)

@@ -74,6 +74,12 @@ GANG_METRICS = frozenset({
     "postmortem_bundles_total", "train_step_seconds", "train_steps_total",
     "serving_replica_probe_status", "train_step_bytes_per_sample",
     "train_step_mfu",
+    # serving-plane speculative-decode metrics (registered by
+    # models.llm.SlotEngine): mirrored through this plane when serving
+    # runs in a gang worker, and held to the same documentation bar by
+    # the hygiene sweep
+    "llm_spec_accepted_span_size", "llm_spec_draft_hit_total",
+    "llm_spec_draft_miss_total",
 })
 
 
