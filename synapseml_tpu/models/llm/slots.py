@@ -201,12 +201,17 @@ class AdmitResult:
     time-to-first-token moment), whether the sequence already finished
     (eos on token one / budget of one), how many prompt tokens were
     served from a reused prefix, and the prefill's last-token logits
-    (f32 host copy — the prefix-reuse exactness surface)."""
+    (f32 host copy — the prefix-reuse exactness surface).  ``bucket``
+    (the padded prefill bucket) and ``reason`` (the finish verdict,
+    when ``finished``) feed the request-scoped trace the serving loop
+    keeps per request."""
     slot: int
     token: int
     finished: bool
     reused_tokens: int
     logits: np.ndarray
+    bucket: int = 0
+    reason: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -236,7 +241,7 @@ class SlotEngine:
                  min_bucket: int = 8, seed: int = 0, name: str = "llm",
                  attention_backend: str = "auto", step_profiler=None,
                  spec_draft_len: int = 0, spec_ngram: int = 3,
-                 spec_adapt: bool = True):
+                 spec_adapt: bool = True, trace_sink=None):
         self.model = model
         self.variables = variables
         self.cfg = model.cfg
@@ -265,6 +270,13 @@ class SlotEngine:
         #: under step/mark and (capture_xla) the per-bucket step program
         #: goes through capture_cost for the roofline gauges
         self.step_profiler = step_profiler
+        #: optional request-trace hook ``sink(slot, event, **attrs)`` —
+        #: the serving loop installs one mapping slots to trace ids, and
+        #: the engine reports per-slot step outcomes through it
+        #: (``decode`` with tokens=1, ``verify`` with drafted/accepted/
+        #: committed span sizes).  None costs one attribute check per
+        #: slot per step.
+        self.trace_sink = trace_sink
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -563,7 +575,8 @@ class SlotEngine:
         if finished:
             self._retire(slot, reason)
         self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
-        return AdmitResult(slot, tok, finished, lcp, logits)
+        return AdmitResult(slot, tok, finished, lcp, logits,
+                           bucket=pb, reason=reason)
 
     # -- stepping ----------------------------------------------------------
     def _finish_reason(self, slot: int,
@@ -730,6 +743,8 @@ class SlotEngine:
             self.tokens_generated += 1
             if self._drafter is not None:
                 self._drafter.extend(slot, self.ctx[slot], ln, ln + 1)
+            if self.trace_sink is not None:
+                self.trace_sink(slot, "decode", tokens=1)
             finished, reason = self._finish_reason(slot, tok)
             events.append(StepEvent(slot, tok, finished, reason))
         return events
@@ -863,6 +878,9 @@ class SlotEngine:
                     self._adapt_slot(slot, min(a, k_s) / k_s)
             if self._drafter is not None:
                 self._drafter.extend(slot, self.ctx[slot], ln, ln + c)
+            if self.trace_sink is not None:
+                self.trace_sink(slot, "verify", tokens=c, drafted=k_s,
+                                accepted=min(a, k_s) if k_s else 0)
             finished, reason = self._finish_reason(slot, int(commit[-1]))
             for j, tok in enumerate(commit):
                 last = j == c - 1

@@ -191,6 +191,15 @@ class ReplicaRouter:
             "serving_replica_probe_status",
             "last probe verdict per replica: 1 healthy, 0.5 draining, "
             "0 dead", ("router", "rank"))
+        # session-affinity visibility: hit (pinned replica served),
+        # miss (first route for a session — a cold pin), repin (pinned
+        # replica unroutable, fell back to round-robin and re-pinned —
+        # the prefix cache was lost).  A rising repin rate after a
+        # resize is the router-side smoking gun for cold-prefill TTFT
+        # regressions.
+        self._m_affinity = get_registry().counter(
+            "serving_affinity_total",
+            "session-affinity routing outcomes", ("router", "outcome"))
         self._apply_table(table)
 
     def _breaker_key(self, host: str, port: int) -> str:
@@ -325,8 +334,10 @@ class ReplicaRouter:
         ``router.table[rank]`` read)."""
         with self._lock:
             n = len(self.table)
+            pinned = False
             if session is not None:
                 addr = self._sessions.get(session)
+                pinned = addr is not None
                 if addr is not None:
                     r = self._addr_rank.get(addr)
                     if (r is not None and self._status[r] == HEALTHY
@@ -335,6 +346,8 @@ class ReplicaRouter:
                         # pinned traffic must not skew the rotation the
                         # unpinned traffic balances on
                         self._sessions.move_to_end(session)
+                        self._m_affinity.inc(1, router=self.name,
+                                             outcome="hit")
                         return r, addr, self.url_for(r, path)
             start = self._rr
             for i in range(n):
@@ -349,6 +362,13 @@ class ReplicaRouter:
                     self._sessions.move_to_end(session)
                     while len(self._sessions) > self._session_cap:
                         self._sessions.popitem(last=False)
+                    # a pinned session falling through to round-robin
+                    # lost its replica (resize/death/breaker): that is a
+                    # REPIN (prefix cache gone); a first-ever route for
+                    # the session is a plain miss (cold by definition)
+                    self._m_affinity.inc(
+                        1, router=self.name,
+                        outcome="repin" if pinned else "miss")
                 return r, self.table[r], self.url_for(r, path)
             statuses = {
                 r: (self._status[r] if self._status[r] != HEALTHY
@@ -456,6 +476,26 @@ class DistributedServingServer:
         a concurrent table refresh renumbering the ranks (see
         :meth:`ReplicaRouter.route_addr`)."""
         return self.router.route_addr(path, session=session)
+
+    def route_request(self, path: str = "/",
+                      session: Optional[str] = None,
+                      trace_id: Optional[str] = None
+                      ) -> Tuple[int, Tuple[str, int], str, Dict[str, str]]:
+        """:meth:`route_addr` plus request-trace propagation: mints a
+        trace id at THIS hop when the caller has none, records the
+        routing decision on the hop's flight recorder (trace id, rank,
+        session), and returns the headers to attach to the forwarded
+        request (``X-SML-Trace-Id``) — the replica's decode loop adopts
+        the id (propagated ids are always sampled), so a session-
+        affinity hop chain stays attributable end to end:
+        ``(rank, (host, port), url, headers)``."""
+        from ..telemetry.tracing import mint_trace_id
+        from .server import TRACE_HEADER
+        tid = trace_id or mint_trace_id()
+        rank, addr, url = self.router.route_addr(path, session=session)
+        flight_record("route", router=self.router.name, trace_id=tid,
+                      rank=rank, session=session)
+        return rank, addr, url, {TRACE_HEADER: tid}
 
     def probe_replicas(self) -> Dict[int, str]:
         return self.router.probe_all()

@@ -37,7 +37,12 @@ class LLMServer:
     requests carry ``"prompt"`` text instead of raw ``"ids"``.
     ``ttft_slo_s`` arms SLO-aware admission control: queued requests
     whose projected time-to-first-token exceeds it answer 503 +
-    ``Retry-After``.  ``attention_backend`` selects the decode-step
+    ``Retry-After`` — and it doubles as the windowed SLO plane's TTFT
+    objective (``GET /sloz``; ``token_slo_s`` optionally declares a
+    per-token one).  Every request is traced per-request at admission
+    (sampling via ``trace_sample_every``; ``GET /tracez``) and the
+    propagated ``X-SML-Trace-Id`` header keeps cross-replica hops
+    attributable.  ``attention_backend`` selects the decode-step
     attention read (``'auto'`` = the Pallas paged kernel on TPU when
     the geometry fits VMEM, dense otherwise — see
     docs/api/serving.md "Paged decode attention").  ``spec_draft_len``
@@ -53,12 +58,14 @@ class LLMServer:
                  api_path: str = "/generate",
                  max_new_tokens_default: int = 32,
                  ttft_slo_s: Optional[float] = None,
+                 token_slo_s: Optional[float] = None,
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, min_prefix: int = 8,
                  max_queue: int = 1024, reply_timeout_s: float = 30.0,
                  attention_backend: str = "auto",
                  spec_draft_len: int = 0, spec_ngram: int = 3,
+                 trace_sample_every: Optional[int] = None,
                  engine_kwargs: Optional[Dict[str, Any]] = None):
         if engine is None:
             from ..models.llm import SlotEngine
@@ -80,7 +87,8 @@ class LLMServer:
             input_parser=self._parse,
             output_formatter=self._format,
             max_new_tokens_default=max_new_tokens_default,
-            ttft_slo_s=ttft_slo_s)
+            ttft_slo_s=ttft_slo_s, token_slo_s=token_slo_s,
+            trace_sample_every=trace_sample_every)
 
     # -- request/reply shaping --------------------------------------------
     def _parse(self, req: ServingRequest) -> Dict[str, Any]:

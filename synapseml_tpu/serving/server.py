@@ -48,8 +48,27 @@ import numpy as np
 from ..core.dataset import Dataset
 from ..core.pipeline import Transformer
 from ..resilience.health import HealthState, retry_after_from_depth
-from ..telemetry import (PROMETHEUS_CONTENT_TYPE, get_registry, render_json,
+from ..telemetry import (PROMETHEUS_CONTENT_TYPE, SERVING_TOKEN_LATENCY_BUCKETS,
+                         SERVING_TTFT_BUCKETS, check_sloz, get_registry,
+                         get_request_tracer, get_slo_store, render_json,
                          render_prometheus)
+
+#: request header (lower-cased, as the listener normalizes) carrying a
+#: propagated request trace id across serving hops; replies echo it
+#: back in canonical case so a client/balancer can stitch the hop chain
+TRACE_HEADER = "x-sml-trace-id"
+#: the reply-side spelling of the SAME contract — derived, so a header
+#: rename can never desync the echo from what clients read
+TRACE_HEADER_CANONICAL = "-".join(
+    p.upper() if p == "sml" else p.capitalize()
+    for p in TRACE_HEADER.split("-"))
+
+#: every reserved ``GET`` path a ServingServer listener answers before
+#: API routing.  The tier-1 endpoint-docs lint asserts (a) this tuple
+#: and ``ServingServer._reserved_handler`` agree with the dispatch
+#: source and (b) each path is documented in docs/api/serving.md — a
+#: future endpoint cannot land undocumented.
+RESERVED_GET_PATHS = ("/metrics", "/healthz", "/readyz", "/tracez", "/sloz")
 
 
 @dataclass
@@ -63,6 +82,10 @@ class ServingRequest:
     body: bytes
     #: monotonic enqueue time — lets serving loops bound queue wait
     enqueued_at: float = 0.0
+    #: propagated request trace id (the ``X-SML-Trace-Id`` header when
+    #: the client/balancer minted one upstream; None ⇒ the serving loop
+    #: mints its own subject to sampling)
+    trace_id: Optional[str] = None
 
     def json(self) -> Any:
         return json.loads(self.body.decode("utf-8"))
@@ -610,33 +633,85 @@ class ServingServer:
         ra = retry_after_from_depth(self._queue_depth(), self._drain_rps())
         return {"Retry-After": str(ra)}
 
+    # -- reserved GET endpoints --------------------------------------------
+    def _reserved_handler(self, bare: str):
+        """Handler for one RESERVED_GET_PATHS entry (None when ``bare``
+        is not reserved) — served before API routing, even while
+        draining.  One map, one tuple: the tier-1 endpoint-docs lint
+        cross-checks both against docs/api/serving.md."""
+        return {"/metrics": self._serve_metrics,
+                "/healthz": self._serve_healthz,
+                "/readyz": self._serve_readyz,
+                "/tracez": self._serve_tracez,
+                "/sloz": self._serve_sloz}.get(bare)
+
+    def _serve_healthz(self, query: str, headers: Dict[str, str]):
+        return self.health.healthz()
+
+    def _serve_readyz(self, query: str, headers: Dict[str, str]):
+        return self.health.readyz(self._queue_depth(), self._drain_rps())
+
+    def _serve_metrics(self, query: str, headers: Dict[str, str]):
+        # the process metrics registry as Prometheus text, or JSON with
+        # ?format=json / an application/json Accept header
+        want_json = ("format=json" in query
+                     or "application/json" in headers.get("accept", ""))
+        if want_json:
+            body, ctype = render_json().encode("utf-8"), "application/json"
+        else:
+            body, ctype = (render_prometheus().encode("utf-8"),
+                           PROMETHEUS_CONTENT_TYPE)
+        return 200, body, {"Content-Type": ctype}
+
+    def _serve_tracez(self, query: str, headers: Dict[str, str]):
+        """Recent request timelines from the process
+        :class:`~synapseml_tpu.telemetry.tracing.RequestTraceStore`;
+        ``?id=<trace_id>`` exports ONE request as Chrome-trace JSON
+        (chrome://tracing / Perfetto), ``?limit=N`` bounds the listing."""
+        from urllib.parse import parse_qs
+        params = parse_qs(query)
+        store = get_request_tracer()
+        trace_id = (params.get("id") or [None])[0]
+        if trace_id is not None:
+            trace = store.chrome_trace(trace_id)
+            if trace is None:
+                return (404, json.dumps(
+                    {"error": f"no trace {trace_id!r} retained"}).encode(),
+                    {"Content-Type": "application/json"})
+            payload = trace
+        else:
+            try:
+                limit = int((params.get("limit") or ["50"])[0])
+            except ValueError:
+                limit = 50
+            payload = store.snapshot(limit)
+        return 200, json.dumps(payload).encode("utf-8"), {
+            "Content-Type": "application/json"}
+
+    def _serve_sloz(self, query: str, headers: Dict[str, str]):
+        """The windowed SLO snapshot (the autoscaler input contract):
+        schema-validated BEFORE serving — a malformed window answers
+        500, never a silently wrong consumer input."""
+        snap = get_slo_store().snapshot()
+        try:
+            check_sloz(snap)
+        except ValueError as e:
+            return (500, json.dumps(
+                {"error": f"sloz snapshot failed validation: {e}"}).encode(),
+                {"Content-Type": "application/json"})
+        return 200, json.dumps(snap).encode("utf-8"), {
+            "Content-Type": "application/json"}
+
     async def _dispatch(self, method: str, path: str,
                         headers: Dict[str, str], body: bytes):
         bare, _, query = path.partition("?")
-        if bare.rstrip("/") == "/healthz" and method in ("GET", "HEAD"):
-            status, hbody, hheaders = self.health.healthz()
+        reserved = self._reserved_handler(bare.rstrip("/"))
+        if reserved is not None and method in ("GET", "HEAD"):
+            # HEAD gets an empty body — the generic writer emits whatever
+            # body we return, and body bytes after a HEAD reply desync
+            # the keep-alive connection
+            status, hbody, hheaders = reserved(query, headers)
             return status, (b"" if method == "HEAD" else hbody), hheaders
-        if bare.rstrip("/") == "/readyz" and method in ("GET", "HEAD"):
-            status, hbody, hheaders = self.health.readyz(
-                self._queue_depth(), self._drain_rps())
-            return status, (b"" if method == "HEAD" else hbody), hheaders
-        if bare.rstrip("/") == "/metrics" and method in ("GET", "HEAD"):
-            # reserved exposition path (served before API routing): the
-            # process metrics registry as Prometheus text, or JSON with
-            # ?format=json / an application/json Accept header.  HEAD
-            # gets an empty body — the generic writer emits whatever body
-            # we return, and body bytes after a HEAD reply desync the
-            # keep-alive connection
-            want_json = ("format=json" in query
-                         or "application/json" in headers.get("accept", ""))
-            if want_json:
-                body, ctype = (render_json().encode("utf-8"),
-                               "application/json")
-            else:
-                body, ctype = (render_prometheus().encode("utf-8"),
-                               PROMETHEUS_CONTENT_TYPE)
-            return 200, (b"" if method == "HEAD" else body), {
-                "Content-Type": ctype}
         api = self._route(path)
         if api is None:
             return 404, b'{"error": "no API registered at this path"}', {}
@@ -644,7 +719,8 @@ class ServingServer:
             return (503, b'{"error": "server draining"}',
                     self._shed_headers())
         req = ServingRequest(id=uuid.uuid4().hex, method=method, path=path,
-                             headers=headers, body=body)
+                             headers=headers, body=body,
+                             trace_id=headers.get(TRACE_HEADER))
         ex = api.submit(req)
         if ex is None:                                 # backpressure
             return (503, b'{"error": "serving queue saturated"}',
@@ -1094,6 +1170,9 @@ class _DecodeSeq:
     tokens: List[int] = field(default_factory=list)
     stream_obj: Optional[_TokenStream] = None
     first_token_at: Optional[float] = None
+    #: request-scoped trace id (None ⇒ not sampled — every trace call
+    #: with a None id is a no-op)
+    trace_id: Optional[str] = None
 
 
 class _DecodeLoop:
@@ -1125,11 +1204,25 @@ class _DecodeLoop:
     ``n_slots``/``active_count``/``free_slot_count``/
     ``min_remaining_tokens``, plus optional
     ``tokens_per_step_estimate`` — a speculative engine's
-    accepted-tokens-per-step EWMA, folded into the SLO projection) so
-    this module never imports jax; pass a
+    accepted-tokens-per-step EWMA, folded into the SLO projection —
+    and optional ``trace_sink``: when present and unset the loop
+    installs its request-trace hook so the engine's per-slot
+    decode/verify outcomes land on the request timelines) so this
+    module never imports jax; pass a
     :class:`synapseml_tpu.models.llm.SlotEngine`.  A ``step()`` may
     return SEVERAL events per slot (a speculative engine commits whole
     accepted spans); the loop streams each committed token in order.
+
+    **Observability**: every request gets a ``trace_id`` at admission
+    into the plane (or adopts the propagated ``X-SML-Trace-Id``) and a
+    sampled per-request timeline — queued → shed/admitted →
+    prefill(bucket) → decode/verify steps → retired/cancelled/expired
+    — in the process :class:`~synapseml_tpu.telemetry.tracing.
+    RequestTraceStore` (served at ``GET /tracez``); TTFT, per-token
+    latency, occupancy, and admission/shed/retirement counts
+    additionally feed the windowed SLO plane
+    (:mod:`synapseml_tpu.telemetry.slo`, served at ``GET /sloz``) with
+    ``ttft_slo_s``/``token_slo_s`` as its declared objectives.
     """
 
     def __init__(self, server: ServingServer, api: ApiHandle, engine: Any,
@@ -1138,7 +1231,10 @@ class _DecodeLoop:
                      Callable[[List[int]], Dict[str, Any]]] = None,
                  max_new_tokens_default: int = 32,
                  ttft_slo_s: Optional[float] = None,
-                 idle_timeout_s: float = 0.02):
+                 token_slo_s: Optional[float] = None,
+                 idle_timeout_s: float = 0.02,
+                 trace_sample_every: Optional[int] = None,
+                 request_tracer=None, slo_window=None):
         self.server = server
         self.api = api
         self.engine = engine
@@ -1147,21 +1243,38 @@ class _DecodeLoop:
             lambda ids: {"ids": [int(t) for t in ids]})
         self.max_new_tokens_default = int(max_new_tokens_default)
         self.ttft_slo_s = ttft_slo_s
+        self.token_slo_s = token_slo_s
         self.idle_timeout_s = idle_timeout_s
         self._waiting: List[_DecodeSeq] = []
         self._by_slot: Dict[int, _DecodeSeq] = {}
         self._step_ewma: Optional[float] = None
         self._retired_window: List[float] = []
+        # request-scoped tracing: the process store by default (so the
+        # listener's /tracez sees this loop's requests); the sampling
+        # knob adjusts THAT store (process-wide — /tracez is one surface)
+        self._tracer = request_tracer or get_request_tracer()
+        if trace_sample_every is not None:
+            self._tracer.sample_every = max(0, int(trace_sample_every))
+        # the engine reports per-slot step outcomes (decode/verify with
+        # span sizes) through its optional trace_sink hook; only claim
+        # an unset one — a caller-installed sink wins
+        if getattr(engine, "trace_sink", "absent") is None:
+            engine.trace_sink = self._engine_trace
+        # windowed SLO plane (served at /sloz): one plane per API path
+        self._slo = slo_window or get_slo_store().window(api.path)
+        if ttft_slo_s is not None:
+            self._slo.set_objective("ttft", float(ttft_slo_s))
+        if token_slo_s is not None:
+            self._slo.set_objective("token_latency", float(token_slo_s))
+        self._slo_export_at = 0.0
         reg = get_registry()
         self._m_ttft = reg.histogram(
             "llm_ttft_seconds", "request arrival to first generated token",
-            ("api",), buckets=(.005, .01, .025, .05, .1, .25, .5, 1, 2.5,
-                               5, 10, 30))
+            ("api",), buckets=SERVING_TTFT_BUCKETS)
         self._m_tok_lat = reg.histogram(
             "llm_token_latency_seconds",
             "per-token decode latency (one observation per emitted token)",
-            ("api",), buckets=(.0005, .001, .0025, .005, .01, .025, .05,
-                               .1, .25, 1))
+            ("api",), buckets=SERVING_TOKEN_LATENCY_BUCKETS)
         self._m_tokens = reg.counter(
             "llm_tokens_total", "tokens streamed/replied by the decode "
             "loop", ("api",))
@@ -1183,6 +1296,23 @@ class _DecodeLoop:
     # -- shared with _ApiLoop ---------------------------------------------
     def _safe_reply(self, request_id: str, rep: ServingReply) -> bool:
         return _reply_never_raises(self.api, request_id, rep)
+
+    # -- request-scoped tracing -------------------------------------------
+    def _engine_trace(self, slot: int, name: str, **attrs) -> None:
+        """The engine's ``trace_sink``: map the slot back to its
+        sequence and append the step event to the request timeline
+        (cancelled-under-us slots and unsampled requests no-op)."""
+        seq = self._by_slot.get(slot)
+        if seq is not None and seq.trace_id is not None:
+            self._tracer.event(seq.trace_id, name, slot=slot, **attrs)
+
+    @staticmethod
+    def _trace_headers(seq: _DecodeSeq) -> Dict[str, str]:
+        """Reply header echoing the request's trace id (sampled
+        requests only) — lets a client/balancer stitch the hop chain."""
+        if seq.trace_id is None:
+            return {}
+        return {TRACE_HEADER_CANONICAL: seq.trace_id}
 
     # -- admission ---------------------------------------------------------
     def _pump_queue(self) -> None:
@@ -1209,8 +1339,17 @@ class _DecodeLoop:
                 self._safe_reply(req.id, ServingReply(400, json.dumps(
                     {"error": f"unparseable record: {e}"}).encode()))
                 continue
-            self._waiting.append(_DecodeSeq(
-                req, ids, max_new, bool(spec.get("stream", False))))
+            seq = _DecodeSeq(req, ids, max_new,
+                             bool(spec.get("stream", False)))
+            # trace minted here (admission into the serving plane) or
+            # adopted from the upstream hop (always sampled: a
+            # propagated request is never half-traced)
+            seq.trace_id = self._tracer.begin(req.trace_id,
+                                              api=self.api.path)
+            self._tracer.event(seq.trace_id, "queued",
+                               prompt_tokens=len(ids), max_new=max_new,
+                               stream=seq.stream)
+            self._waiting.append(seq)
 
     def _projected_ttft(self, seq: _DecodeSeq, position: int) -> float:
         """Projection of this request's TTFT if admitted as soon as
@@ -1258,10 +1397,13 @@ class _DecodeLoop:
     def _shed(self, seq: _DecodeSeq, reason: str) -> None:
         self._m_sheds.inc(1, api=self.api.path, reason=reason)
         self._m_errors.inc(1, api=self.api.path, kind="shed")
+        self._slo.count("shed")
+        self._tracer.event(seq.trace_id, "shed", reason=reason)
+        self._tracer.finish(seq.trace_id, "shed")
         self._safe_reply(seq.req.id, ServingReply(
             503, json.dumps({"error": "projected time-to-first-token "
                              "exceeds the serving SLO"}).encode(),
-            self._shed_headers()))
+            {**self._shed_headers(), **self._trace_headers(seq)}))
 
     def _admit_waiting(self) -> None:
         keep: List[_DecodeSeq] = []
@@ -1277,6 +1419,7 @@ class _DecodeLoop:
                 res = self.engine.admit(seq.ids, seq.max_new)
             except ValueError as e:             # prompt cannot fit
                 self._m_errors.inc(1, api=self.api.path, kind="parse")
+                self._tracer.finish(seq.trace_id, "error", error=str(e))
                 self._safe_reply(seq.req.id, ServingReply(
                     400, json.dumps({"error": str(e)}).encode()))
                 continue
@@ -1285,32 +1428,45 @@ class _DecodeLoop:
                 continue
             seq.slot = res.slot
             seq.first_token_at = time.monotonic()
-            self._m_ttft.observe(
-                seq.first_token_at - seq.req.enqueued_at,
-                api=self.api.path)
+            ttft = seq.first_token_at - seq.req.enqueued_at
+            self._m_ttft.observe(ttft, api=self.api.path)
+            self._slo.observe_ttft(ttft)
+            self._slo.count("admitted")
+            self._tracer.event(
+                seq.trace_id, "admitted", slot=res.slot,
+                reused_tokens=getattr(res, "reused_tokens", 0))
+            self._tracer.event(seq.trace_id, "prefill", slot=res.slot,
+                               bucket=getattr(res, "bucket", 0))
             if seq.stream:
                 seq.stream_obj = _TokenStream()
                 if not self._safe_reply(seq.req.id, ServingReply(
                         200, seq.stream_obj,
-                        {"Content-Type": "application/json"})):
+                        {"Content-Type": "application/json",
+                         **self._trace_headers(seq)})):
                     self.engine.cancel(res.slot)
+                    # the reply window expired before admission: close
+                    # the timeline like every other termination path —
+                    # /tracez must not show this request live forever
+                    self._tracer.finish(seq.trace_id, "expired")
                     continue
             self._by_slot[res.slot] = seq
-            self._on_token(seq, res.token, res.finished)
+            self._on_token(seq, res.token, res.finished,
+                           getattr(res, "reason", None))
         self._waiting = keep
 
     # -- token/retirement handling ----------------------------------------
-    def _on_token(self, seq: _DecodeSeq, token: int,
-                  finished: bool) -> None:
+    def _on_token(self, seq: _DecodeSeq, token: int, finished: bool,
+                  reason: Optional[str] = None) -> None:
         seq.tokens.append(int(token))
         self._m_tokens.inc(1, api=self.api.path)
         if seq.stream_obj is not None:
             seq.stream_obj.push(
                 json.dumps({"token": int(token)}).encode() + b"\n")
         if finished:
-            self._finish(seq)
+            self._finish(seq, reason)
 
-    def _finish(self, seq: _DecodeSeq) -> None:
+    def _finish(self, seq: _DecodeSeq,
+                reason: Optional[str] = None) -> None:
         self._by_slot.pop(seq.slot, None)
         now = time.monotonic()
         # prune at the append site: the window must stay ~5s of
@@ -1318,6 +1474,11 @@ class _DecodeLoop:
         self._retired_window = [t for t in self._retired_window
                                 if now - t < 5.0]
         self._retired_window.append(now)
+        self._slo.count("retired")
+        self._tracer.event(seq.trace_id, "retired",
+                           tokens=len(seq.tokens), reason=reason)
+        self._tracer.finish(seq.trace_id, "retired",
+                            tokens=len(seq.tokens), reason=reason)
         payload = self.output_formatter(seq.tokens)
         if seq.stream_obj is not None:
             payload["done"] = True
@@ -1327,7 +1488,8 @@ class _DecodeLoop:
         else:
             ok = self._safe_reply(seq.req.id, ServingReply(
                 200, json.dumps(payload).encode(),
-                {"Content-Type": "application/json"}))
+                {"Content-Type": "application/json",
+                 **self._trace_headers(seq)}))
             if ok:
                 self._m_records.inc(1, api=self.api.path)
 
@@ -1352,6 +1514,9 @@ class _DecodeLoop:
                 self.engine.cancel(slot)
                 self._by_slot.pop(slot, None)
                 self._m_errors.inc(1, api=self.api.path, kind=kind)
+                self._tracer.event(seq.trace_id, "cancelled", reason=kind)
+                self._tracer.finish(seq.trace_id, kind,
+                                    tokens=len(seq.tokens))
 
     # -- the loop ----------------------------------------------------------
     def _loop(self) -> None:
@@ -1371,6 +1536,7 @@ class _DecodeLoop:
         self._pump_queue()
         self._admit_waiting()
         self._cancel_expired()
+        self._export_slo()
         if not self.engine.active_count:
             return
         t0 = time.perf_counter()
@@ -1390,10 +1556,26 @@ class _DecodeLoop:
             seq = self._by_slot.get(ev.slot)
             if seq is None:         # cancelled under us
                 continue
-            self._m_tok_lat.observe(dt / span[ev.slot], api=self.api.path)
-            self._on_token(seq, ev.token, ev.finished)
+            tok_s = dt / span[ev.slot]
+            self._m_tok_lat.observe(tok_s, api=self.api.path)
+            self._slo.observe_token_latency(tok_s)
+            self._on_token(seq, ev.token, ev.finished, ev.reason)
         if events and dt > 0:
             self._m_rps.set(len(events) / dt, api=self.api.path)
+
+    def _export_slo(self) -> None:
+        """Refresh the plane's /metrics gauges from the windows on a
+        ~1 s cadence.  Occupancy is sampled HERE — time-uniformly,
+        idle ticks included — not per decode step: per-step sampling
+        only ever sees busy instants, so a plane idle 59 s of every 60
+        would read ~1.0 occupancy and the autoscaler consuming /sloz
+        ("shrink on idle occupancy") would never scale it down."""
+        now = time.monotonic()
+        if now - self._slo_export_at >= 1.0:
+            self._slo_export_at = now
+            self._slo.observe_occupancy(
+                self.engine.active_count / max(1, self.engine.n_slots))
+            self._slo.export_gauges()
 
     def _fail_inflight(self, e: Exception) -> None:
         """Answer every in-flight sequence 500 (streams get a final
@@ -1410,6 +1592,7 @@ class _DecodeLoop:
                 seq.stream_obj.finish()
             else:
                 self._safe_reply(seq.req.id, ServingReply(500, body))
+            self._tracer.finish(seq.trace_id, "error", error=str(e))
             self._by_slot.pop(slot, None)
         self._m_errors.inc(1, api=self.api.path, kind="transform")
         # the engine's jitted programs donate their cache buffers: an

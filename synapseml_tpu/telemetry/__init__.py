@@ -69,16 +69,25 @@ from .exposition import (PROMETHEUS_CONTENT_TYPE, render_json,
 from .flight import FlightRecorder, get_flight
 from .gangplane import (GangPlane, StepProfiler, TM_MARKER,
                         check_postmortem, parse_telemetry, write_postmortem)
-from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
-                       MetricsRegistry, get_registry)
+from .registry import (DEFAULT_BUCKETS, SERVING_TOKEN_LATENCY_BUCKETS,
+                       SERVING_TTFT_BUCKETS, Counter, Gauge, Histogram,
+                       MetricsRegistry, bucket_quantile, get_registry)
 from .roofline import (ROOFLINE_BLOCK_KEYS, check_roofline_block,
                        paired_roofline, roofline_block)
-from .tracing import Span, Tracer, get_tracer, span
+from .slo import (SLO_METRICS, SLOZ_SCHEMA, SloStore, SloWindow,
+                  WindowedCounter, WindowedHistogram, check_sloz,
+                  get_slo_store)
+from .tracing import (RequestTraceStore, Span, Tracer, get_request_tracer,
+                      get_tracer, mint_trace_id, span)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
-    "DEFAULT_BUCKETS",
+    "DEFAULT_BUCKETS", "SERVING_TTFT_BUCKETS",
+    "SERVING_TOKEN_LATENCY_BUCKETS", "bucket_quantile",
     "Span", "Tracer", "get_tracer", "span",
+    "RequestTraceStore", "get_request_tracer", "mint_trace_id",
+    "SloStore", "SloWindow", "WindowedCounter", "WindowedHistogram",
+    "check_sloz", "get_slo_store", "SLOZ_SCHEMA", "SLO_METRICS",
     "render_prometheus", "render_json", "PROMETHEUS_CONTENT_TYPE",
     "SchemaError", "check_schema", "dumps_checked", "write_json",
     "read_json",

@@ -28,7 +28,8 @@ import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "DEFAULT_BUCKETS"]
+           "get_registry", "DEFAULT_BUCKETS", "SERVING_TTFT_BUCKETS",
+           "SERVING_TOKEN_LATENCY_BUCKETS", "bucket_quantile"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -36,6 +37,53 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 #: Prometheus' default latency buckets (seconds) + +Inf implicit
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: serving-tuned TTFT buckets (seconds): the default ladder starts at
+#: 5 ms, which collapses a whole low-latency serving regime into one
+#: bucket — these add 1/2.5 ms resolution below it and keep the long
+#: tail out to 30 s (queueing under overload).  Shared by the live
+#: ``llm_ttft_seconds`` histogram and the SLO window digests, so both
+#: surfaces quantize identically.
+SERVING_TTFT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0)
+
+#: serving-tuned per-token decode-latency buckets (seconds): decode
+#: steps on real chips are sub-millisecond, where the Prometheus
+#: defaults have zero resolution — the ladder starts at 100 µs.
+SERVING_TOKEN_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 1.0)
+
+
+def bucket_quantile(bounds: Sequence[float], cumulative: Sequence[int],
+                    count: int, q: float) -> float:
+    """Bucket-interpolated quantile over Prometheus-style CUMULATIVE
+    bucket counts (``cumulative[i]`` = observations <= ``bounds[i]``;
+    ``count`` includes the implicit +Inf bucket).
+
+    Linear interpolation inside the bucket holding the q-rank, assuming
+    a uniform spread (the ``histogram_quantile`` model) and a lower
+    edge of 0 for the first bucket — the estimator for non-negative
+    observations (latencies).  Ranks landing in the +Inf bucket clamp
+    to the highest finite bound.  The estimate is exact at bucket
+    boundaries and off by at most one bucket width anywhere else —
+    which is why live percentile gauges can ride this instead of
+    retaining raw samples.  NaN when the window is empty."""
+    if count <= 0:
+        return float("nan")
+    q = min(1.0, max(0.0, float(q)))
+    rank = q * count
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in zip(bounds, cumulative):
+        if cum >= rank:
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return float(bound)
+            frac = (rank - prev_cum) / in_bucket
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = float(bound), int(cum)
+    return float(bounds[-1])
 
 
 class _Metric:
@@ -174,6 +222,15 @@ class Histogram(_Metric):
                         "sum": 0.0, "count": 0}
             return {"buckets": list(st["buckets"]),   # type: ignore[index]
                     "sum": st["sum"], "count": st["count"]}  # type: ignore[index]
+
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-interpolated quantile estimate for one label set (see
+        :func:`bucket_quantile`): live percentile gauges without raw-
+        sample retention, accurate to within one bucket width.  NaN
+        when the series has no observations."""
+        st = self.stats(**labels)
+        return bucket_quantile(self.buckets, st["buckets"],  # type: ignore[arg-type]
+                               int(st["count"]), q)  # type: ignore[arg-type]
 
 
 class MetricsRegistry:

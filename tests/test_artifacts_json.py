@@ -48,6 +48,14 @@ LLMSERVE_REQUIRED = (
 #: headline, acceptance/hit-rate context, and BOTH throughput ratios
 #: with the step-cost honesty field that relates them — so a
 #: partially-failed spec leg can't ship a tokens/step claim alone
+#: the bare-vs-traced serving pair (ISSUE 13): an overhead claim must
+#: ship with both sides of the pair that produced it
+LLMSERVE_TRACE_REQUIRED = (
+    "llmserve_trace_overhead_pct",
+    "llmserve_trace_bare_step_ms",
+    "llmserve_trace_traced_step_ms",
+)
+
 LLMSERVE_SPEC_REQUIRED = (
     "llmserve_spec_tokens_per_sec",
     "llmserve_spec_tokens_per_step",
@@ -182,6 +190,21 @@ def test_llmserve_spec_fields_complete():
         missing = [k for k in LLMSERVE_SPEC_REQUIRED if k not in rec]
         assert not missing, (
             f"{name}: incomplete llmserve_spec block: {missing}")
+
+
+def test_llmserve_trace_pair_complete():
+    """ISSUE 13: a record carrying any ``llmserve_trace_`` field (the
+    bare-vs-traced serving observability pair) carries the WHOLE
+    triple — overhead % plus both per-step timings — each numeric or
+    null (numerics already swept by test_llmserve_fields_complete via
+    the shared prefix)."""
+    for name, rec in _bench_records():
+        if not any(k.startswith("llmserve_trace_") for k in rec) \
+                or _labeled_partial(rec):
+            continue
+        missing = [k for k in LLMSERVE_TRACE_REQUIRED if k not in rec]
+        assert not missing, (
+            f"{name}: incomplete llmserve_trace pair: {missing}")
 
 
 def test_llmserve_decode_requires_paired_roofline():

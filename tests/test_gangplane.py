@@ -708,6 +708,16 @@ class TestMetricHygiene:
         # the worker-mirroring rule itself is documented
         assert "worker_" in docs and "SMLMP_TM" in docs
 
+    def test_every_slo_plane_metric_is_documented(self):
+        """ISSUE 13: the serving-observability plane's metric names
+        (windowed SLO gauges + affinity counters) are held to the same
+        docs bar as GANG_METRICS."""
+        from synapseml_tpu.telemetry.slo import SLO_METRICS
+        docs = "\n".join(p.read_text(encoding="utf-8")
+                         for p in (REPO / "docs" / "api").glob("*.md"))
+        missing = sorted(n for n in SLO_METRICS if n not in docs)
+        assert not missing, f"SLO-plane metrics absent from docs: {missing}"
+
     def test_registry_sees_no_duplicate_kind_at_runtime(self):
         """Importing the wired modules must not blow up on registration
         conflicts (the registry raises on kind/label mismatches)."""
