@@ -926,7 +926,13 @@ n = len(jax.devices())
 mesh = data_parallel_mesh(n)
 reg = get_registry()
 out = {"devices": n}
-I8 = CollectiveConfig(compression="int8", error_feedback=True)
+# codec pairs pin strategy="flat": these legs isolate CODEC effects
+# (routing isolation is bench_comms_topology's job), and on a trusted
+# real-TPU topology the default 'auto' would route — landing the wire
+# bytes under strategy='ring'/'hierarchical' so the flat-pinned
+# _metric queries below would read 0.0
+I8 = CollectiveConfig(compression="int8", error_feedback=True,
+                      strategy="flat")
 
 
 def _metric(name, **labels):
@@ -943,7 +949,7 @@ try:
     vals = np.random.default_rng(0).normal(
         size=(n, 4 * 1024 * 1024)).astype(np.float32)      # 16 MB/rank f32
     x = jnp.asarray(vals)
-    BF = CollectiveConfig(compression="bf16")
+    BF = CollectiveConfig(compression="bf16", strategy="flat")
     fns = {"f32": allreduce_fn(mesh), "int8": allreduce_fn(mesh, config=I8),
            "bf16": allreduce_fn(mesh, config=BF)}
     for f in fns.values():
@@ -1003,7 +1009,8 @@ try:
                           schedule="constant", grad_clip_norm=1.0)
 
     legs = {}
-    for name, ccfg in (("f32", CollectiveConfig(manual=True)),
+    for name, ccfg in (("f32", CollectiveConfig(manual=True,
+                                                strategy="flat")),
                        ("int8", I8)):
         model = TextEncoder(tcfg)
         tr = DLTrainer(model, opt, mesh, collective=ccfg)
@@ -1053,7 +1060,7 @@ try:
         "collective_bytes_total", op="grad_sync", axis=DATA_AXIS)
     out["bert_grad_sync_wire_bytes"] = _metric(
         "collective_wire_bytes_total", op="grad_sync", axis=DATA_AXIS,
-        codec="int8")
+        codec="int8", strategy="flat")
 except Exception as e:
     out["bert_error"] = repr(e)
 
@@ -1072,9 +1079,14 @@ try:
     G_ITERS = 12
 
     def gcfg(comp):
+        # flat-pinned for the same reason as I8 above: this pair
+        # isolates the codec, and the flat-labeled wire query below
+        # must see the bytes on any topology
+        cc = (None if comp == "none" else CollectiveConfig(
+            compression=comp, error_feedback=True, strategy="flat"))
         return BoostingConfig(objective="binary", num_iterations=G_ITERS,
                               num_leaves=31, max_bin=63,
-                              collective_compression=comp)
+                              collective_compression=cc)
 
     def leg(comp):
         t0 = time.perf_counter()
@@ -1102,7 +1114,7 @@ try:
         "collective_bytes_total", op="gbdt_hist_psum", axis=DATA_AXIS)
     out["gbdt_hist_wire_bytes"] = _metric(
         "collective_wire_bytes_total", op="gbdt_hist_psum", axis=DATA_AXIS,
-        codec="int8")
+        codec="int8", strategy="flat")
 except Exception as e:
     out["gbdt_error"] = repr(e)
 
@@ -1147,6 +1159,149 @@ def bench_comms_compression():
         [sys.executable, "-c", _COMMS_CHILD, force_host, str(gbdt_rows),
          repo],
         capture_output=True, text=True, timeout=3000)
+    if r.returncode != 0:
+        raise RuntimeError(r.stderr[-800:])
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+_COMMS_TOPO_CHILD = r'''
+import json, os, sys, time
+sys.path.insert(0, sys.argv[2])
+if sys.argv[1] == "1":
+    # CPU-only parent: 8 host devices form the synthetic 2-host gang
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np
+import jax, jax.numpy as jnp
+import synapseml_tpu                                       # jax-compat shim
+from synapseml_tpu.parallel.collectives import allreduce_fn
+from synapseml_tpu.parallel.compression import CollectiveConfig
+from synapseml_tpu.parallel.mesh import DATA_AXIS, data_parallel_mesh
+from synapseml_tpu.parallel.planner import TopologySpec, get_planner
+from synapseml_tpu.telemetry import get_registry
+from synapseml_tpu.telemetry.gangplane import StepProfiler
+
+HOSTS, PER_HOST = 2, 4
+n = len(jax.devices())
+mesh = data_parallel_mesh(n)
+reg = get_registry()
+# the synthetic topology the planner routes on — INJECTED (this
+# container has no device coords to discover; stated caveat: the
+# "inter-host" legs ride shared memory here, so the routing speedup
+# needs real ICI/DCN — the same honesty note as the codec pairs)
+get_planner().set_spec(TopologySpec(n_hosts=HOSTS,
+                                    devices_per_host=n // HOSTS))
+out = {"comms_topo_devices": n, "comms_topo_hosts": HOSTS}
+
+LARGE = 4 * 1024 * 1024            # 16 MB f32/rank: bandwidth class
+SMALL = 16 * 1024                  # 64 KB f32/rank: latency class
+
+
+def leg(fn, x, name, steps=3):
+    """min-of-blocks collective-segment ms for one allreduce leg —
+    timed through the watched dispatch (block_until_ready inside the
+    profiled window), the instrument real train steps report through."""
+    prof = StepProfiler("comms_topo_" + name)
+    for i in range(steps):
+        with prof.step(i):
+            fn(x, timeout_s=600.0)
+    s = prof.summary()
+    return (s["per_step_avg_seconds"]["collective"] * 1000.0,
+            s["collective_seconds_by_strategy"])
+
+
+try:
+    rng = np.random.default_rng(0)
+    xl = jnp.asarray(rng.normal(size=(n, LARGE)).astype(np.float32))
+    xs = jnp.asarray(rng.normal(size=(n, SMALL)).astype(np.float32))
+    FLAT8 = CollectiveConfig(compression="int8", strategy="flat",
+                             error_feedback=True)
+    AUTO8 = CollectiveConfig(compression="int8", strategy="auto",
+                             error_feedback=True)
+    FLATF = CollectiveConfig(strategy="flat", manual=True)
+    AUTOF = CollectiveConfig(strategy="auto", manual=True)
+    fns = {"large_flat": allreduce_fn(mesh, config=FLAT8),
+           "large_planned": allreduce_fn(mesh, config=AUTO8),
+           "small_flat": allreduce_fn(mesh, config=FLATF),
+           "small_planned": allreduce_fn(mesh, config=AUTOF)}
+    for k, f in fns.items():
+        np.asarray(f(xl if k.startswith("large") else xs))  # compile+warm
+    times = {k: None for k in fns}
+    strategies = {}
+    for b in range(3):
+        order = list(fns) if b % 2 == 0 else list(fns)[::-1]
+        for k in order:
+            ms, by_s = leg(fns[k], xl if k.startswith("large") else xs, k)
+            times[k] = ms if times[k] is None else min(times[k], ms)
+            for s, sec in by_s.items():
+                strategies[s] = strategies.get(s, 0.0) + sec
+    for k, ms in times.items():
+        out[f"comms_topo_{k}_ms"] = ms
+    for s in ("flat", "ring", "tree", "hierarchical"):
+        out[f"comms_topo_segment_seconds_{s}"] = strategies.get(s, 0.0)
+    out["comms_topo_routing_speedup_large"] = (
+        times["large_flat"] / times["large_planned"]
+        if times["large_planned"] else None)
+    out["comms_topo_routing_speedup_small"] = (
+        times["small_flat"] / times["small_planned"]
+        if times["small_planned"] else None)
+    # per-strategy plan counts (the strategy histogram) + wire bytes
+    plans = reg.get("collective_plans_total")
+    counts = {}
+    if plans is not None:
+        for (strategy, reason), v in plans.series().items():
+            counts[strategy] = counts.get(strategy, 0.0) + float(v)
+    for s in ("flat", "ring", "tree", "hierarchical"):
+        out[f"comms_topo_plans_{s}"] = counts.get(s, 0.0)
+    wires = reg.get("collective_wire_bytes_total")
+    wb = {}
+    if wires is not None:
+        for key, v in wires.series().items():
+            labels = dict(zip(wires.labelnames, key))
+            if labels.get("op") == "allreduce_fn":
+                s = labels.get("strategy", "flat")
+                wb[s] = wb.get(s, 0.0) + float(v)
+    for s in ("flat", "ring", "tree", "hierarchical"):
+        out[f"comms_topo_wire_bytes_{s}"] = wb.get(s, 0.0)
+except Exception as e:
+    out["comms_topo_error"] = repr(e)
+
+print(json.dumps(out))
+'''
+
+
+def bench_comms_topology():
+    """Paired flat-vs-planned ROUTING legs over a synthetic 2-host
+    ``TopologySpec`` (ISSUE 14; the ``bench_comms_compression``
+    methodology applied to the planner): the same codec both sides of
+    each pair, only the route differs — large int8 payloads contrast
+    the flat reduce-scatter+all-gather against the two-level
+    hierarchical form (intra-host f32, inter-host int8), small f32
+    payloads the flat psum against the recursive-doubling tree — timed
+    as the StepProfiler collective segment through the watched
+    dispatch, with the per-strategy plan counts and strategy-labeled
+    wire bytes read back from the same /metrics series operators see.
+
+    CPU caveat (stated, PR 6's honesty pattern): on this container the
+    "inter-host" wire is shared memory, so the routing speedup needs
+    real ICI/DCN — the emitted numbers pin the MECHANISM (strategy
+    histogram, wire accounting, segment split), not a chip win.
+
+    → dict of ``comms_topo_*`` fields (schema-held in
+    tests/test_artifacts_json.py)."""
+    import subprocess
+
+    import jax
+
+    import synapseml_tpu
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.abspath(synapseml_tpu.__file__)))
+    force_host = "1" if jax.default_backend() == "cpu" else "0"
+    r = subprocess.run(
+        [sys.executable, "-c", _COMMS_TOPO_CHILD, force_host, repo],
+        capture_output=True, text=True, timeout=1800)
     if r.returncode != 0:
         raise RuntimeError(r.stderr[-800:])
     return json.loads(r.stdout.strip().splitlines()[-1])
@@ -2045,7 +2200,7 @@ class _SkippedLeg(Exception):
 #: pair without the full 870s-class sweep.
 BENCH_LEGS = ("bert", "llm", "spec", "llm8b", "resnet_onnx", "vision",
               "gbdt", "gbdt_pair", "anchor", "streamed", "serving",
-              "gang", "resize", "guard", "comms", "llmserve",
+              "gang", "resize", "guard", "comms", "comms_topo", "llmserve",
               "llmserve_spec", "llmserve_trace", "obs")
 
 
@@ -2348,6 +2503,29 @@ def main(only=None):
         print(f"[secondary] comms-compression bench failed: {e}",
               file=sys.stderr)
 
+    comms_topo = None
+    try:
+        if not want("comms_topo"):
+            raise _SkippedLeg()
+        comms_topo = bench_comms_topology()
+        if "comms_topo_error" not in comms_topo:
+            print(f"[secondary] topology-planned collectives (synthetic "
+                  f"{comms_topo['comms_topo_hosts']}-host spec, "
+                  f"{comms_topo['comms_topo_devices']} ranks): large int8 "
+                  f"flat {comms_topo['comms_topo_large_flat_ms']:.1f} → "
+                  f"planned {comms_topo['comms_topo_large_planned_ms']:.1f}"
+                  f" ms, small f32 flat "
+                  f"{comms_topo['comms_topo_small_flat_ms']:.2f} → tree "
+                  f"{comms_topo['comms_topo_small_planned_ms']:.2f} ms "
+                  "(shared-memory wire: routing win needs real ICI/DCN)",
+                  file=sys.stderr)
+        else:
+            print(f"[secondary] comms-topology child error: "
+                  f"{comms_topo['comms_topo_error']}", file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] comms-topology bench failed: {e}",
+              file=sys.stderr)
+
     llmserve = None
     try:
         if not (want("llmserve") or want("llmserve_spec")):
@@ -2580,6 +2758,9 @@ def main(only=None):
                            else v)
             for k, v in comms.items()
             if k != "allreduce_compression_speedup"} if comms else {}),
+        # comms_topo_* keys arrive pre-prefixed from the child
+        **({k: (round(v, 6) if isinstance(v, (int, float)) else v)
+            for k, v in comms_topo.items()} if comms_topo else {}),
         "allreduce_compression_speedup": (
             round(comms["allreduce_compression_speedup"], 3)
             if comms and comms.get("allreduce_compression_speedup")

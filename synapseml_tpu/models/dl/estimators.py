@@ -239,6 +239,29 @@ class _CheckpointLoop:
         self._config["codec_chunk"] = float(
             cc.chunk if cc is not None and cc.compression == "int8"
             else 0.0)
+        # the RESOLVED planner routing (ISSUE 14): 0.0 when every plan
+        # under this config is the flat dispatch (strategy='flat', or
+        # 'auto' with no trusted topology — every pre-planner
+        # checkpoint), else 1 + the strategy's index.  A routing switch
+        # changes the gradient-sync numerics (hierarchical quantizes
+        # intra-host sums; ring/tree reassociate), so it refuses like a
+        # codec toggle — the satellite's "loud refusal" contract.
+        from ...parallel.planner import STRATEGIES, get_planner
+        # the stamp must name a route the gradient sync can actually
+        # run: a config that neither compresses nor explicitly routes
+        # leaves compressed_tree_sync's big-leaf set empty (bare 'auto'
+        # syncs flat even on a trusted topology), and the ZeRO-1
+        # sharded_update step reduce-scatters directly without ever
+        # consulting the planner — both stamp flat, else the guard
+        # would refuse resumes against numerically identical syncs
+        unroutable = (cc is None or cc.sharded_update
+                      or (not cc.compresses and not cc.routes))
+        routing = ("flat" if unroutable
+                   else get_planner().resolved_routing(
+                       cc, world=int(trainer.mesh.shape["data"])))
+        self._config["routing"] = (
+            0.0 if routing == "flat"
+            else float(1 + STRATEGIES.index(routing)))
         # precision changes the numerics the resumed batches train under
         # ('bf16_grad' rounds the gradient stream); rematPolicy is
         # deliberately ABSENT — remat is bit-exact by construction, so a
@@ -265,7 +288,8 @@ class _CheckpointLoop:
         # the saved∩current intersection
         for k in ("compression", "sharded_update", "error_feedback",
                   "manual_step", "codec_min_size", "codec_chunk",
-                  "precision"):       # pre-precision checkpoints = 'bf16'
+                  "precision",        # pre-precision checkpoints = 'bf16'
+                  "routing"):         # pre-planner checkpoints = flat
             saved_cfg.setdefault(k, 0.0)
         # "shards" is the one WORLD-SIZE key: a mismatch there is an
         # elastic gang resize, not a config error — the checkpoint is

@@ -853,18 +853,33 @@ def _mesh_world_size(mesh: Optional[Mesh]) -> int:
     return int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
 
 
-def _effective_wire_key(config: "BoostingConfig", mesh_present: bool):
+def _effective_wire_key(config: "BoostingConfig", mesh: Optional[Mesh]):
     """The histogram-psum wire a fit ACTUALLY uses, as a comparable key:
-    ``None`` for the f32 wire (no codec, or :func:`_hist_psum_nulled`),
-    else ``(compression, min_size, chunk)`` with chunk zeroed for
-    non-int8 codecs (bf16 never chunks).  DL-only fields
-    (error_feedback/sharded_update/manual) never enter the key."""
+    ``None`` for the flat f32 wire (no codec, or
+    :func:`_hist_psum_nulled`), else ``(compression, min_size, chunk)``
+    with chunk zeroed for non-int8 codecs (bf16 never chunks) — plus
+    the RESOLVED planner routing as a 4th element when it is anything
+    but certainly-flat (ISSUE 14: a hierarchical route quantizes
+    intra-host SUMS where flat quantizes per-rank payloads — different
+    histogram numerics, so a routing toggle against an existing
+    checkpoint refuses exactly like a codec toggle; 'auto' on unknown
+    topology resolves flat and keeps pre-planner 3-element keys
+    comparing equal).  DL-only fields (error_feedback/sharded_update/
+    manual) never enter the key."""
     cc = resolve_collective_config(config.collective_compression)
-    if (cc is None or not cc.compresses
-            or _hist_psum_nulled(config, mesh_present)):
+    if cc is None or _hist_psum_nulled(config, mesh is not None):
         return None
-    return (cc.compression, cc.min_size,
+    from ...parallel.planner import get_planner
+    routing = get_planner().resolved_routing(
+        cc, world=_mesh_world_size(mesh))
+    if not cc.compresses and routing == "flat":
+        return None
+    key = ((cc.compression, cc.min_size,
             cc.chunk if cc.compression == "int8" else 0)
+           if cc.compresses else ("none", 0, 0))
+    if routing != "flat":
+        key = key + (routing,)
+    return key
 
 
 def _latest_checkpoint(directory: str) -> Optional[Booster]:
@@ -1078,7 +1093,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
                 # unstamped checkpoint: the codec fields did not exist
                 # when it was written, so it trained on the f32 wire
                 saved_cc = None
-            cur_cc = _effective_wire_key(config, mesh is not None)
+            cur_cc = _effective_wire_key(config, mesh)
             if saved_cc != cur_cc:
                 raise ValueError(
                     f"checkpoint at {checkpoint_dir} was trained with "
@@ -1126,7 +1141,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
         # checkpoints carry (the guard above reads it back; JSON
         # round-trips the tuple as a list), plus the writer's device
         # count for resize observability
-        key = _effective_wire_key(config, mesh is not None)
+        key = _effective_wire_key(config, mesh)
         config = dataclasses.replace(config, pass_through={
             **config.pass_through,
             "_codec_wire_key": list(key) if key is not None else None,

@@ -38,8 +38,7 @@ import numpy as np
 from jax import lax
 
 from ... import telemetry as _telemetry
-from ...parallel.collectives import psum as _c_psum
-from ...parallel.compression import compressed_psum as _c_compressed_psum
+from ...parallel.planner import planned_psum as _c_planned_psum
 
 
 def _tl_gauge(grower: str, active: bool) -> None:
@@ -753,17 +752,19 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     num_bins_c = -(-num_bins // (1 << SH))
 
     def ar(x):
-        # routed through the instrumented wrapper so the histogram
+        # routed through the planner dispatch so the histogram
         # allreduce — THE data-parallel hot collective — shows up in
-        # collective_{calls,bytes}_total (recorded per traced program);
-        # with a compression config the wire rides the quantized
-        # reduce-scatter + all-gather instead of the f32 psum
+        # collective_{calls,bytes}_total (recorded per traced program)
+        # AND takes the topology-planned route: with a compression
+        # config the wire rides the quantized reduce-scatter +
+        # all-gather (or the two-level hierarchical form on a known
+        # multi-host topology); without one this traces exactly the
+        # bare f32 psum it always did
         if not axis_name or voting:
             return x
-        if cconfig is not None and cconfig.compresses:
-            return _c_compressed_psum(x, axis_name, cconfig,
-                                      op="gbdt_hist_psum")
-        return _c_psum(x, axis_name)
+        return _c_planned_psum(
+            x, axis_name, cconfig,
+            op="gbdt_hist_psum" if cconfig is not None else "psum")
 
     def unb(hist3, g, h, c):
         if bundle_map is None:
@@ -1182,12 +1183,13 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     rows = jnp.arange(N)
 
     def ar(x):
+        # same planner dispatch as grow_tree's: planned route when a
+        # config is in play, the bare f32 psum trace otherwise
         if not axis_name:
             return x
-        if cconfig is not None and cconfig.compresses:
-            return _c_compressed_psum(x, axis_name, cconfig,
-                                      op="gbdt_hist_psum")
-        return _c_psum(x, axis_name)
+        return _c_planned_psum(
+            x, axis_name, cconfig,
+            op="gbdt_hist_psum" if cconfig is not None else "psum")
 
     vals8, scales = (prep_hist_vals(grad, hess, row_valid) if use_pallas
                      else (None, None))

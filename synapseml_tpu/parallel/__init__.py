@@ -14,5 +14,8 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    batch_sharding, data_parallel_mesh, dp_ep_mesh, dp_sp_tp_mesh,
                    dp_tp_mesh, local_mesh_devices, make_mesh, pad_to_multiple,
                    replicated, shard_batch)
-from .placement import PlacementMap, place_partitions, rows_for_rank
+from .placement import (PlacementMap, partition_assignment,
+                        place_partitions, rows_for_rank)
+from .planner import (CollectivePlanner, ReductionPlan, TopologySpec,
+                      get_planner, planned_psum, set_planner)
 from .topology import Topology, get_num_rows_per_partition, get_topology

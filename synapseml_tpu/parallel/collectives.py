@@ -38,22 +38,37 @@ class CollectiveTimeout(RuntimeError):
     A rank stuck in an allreduce whose peer died would otherwise freeze
     silently until the gang's global timeout; this converts the freeze
     into a structured failure carrying enough to diagnose it — the op,
-    the mesh axis, the per-shard payload, and the deadline that expired —
-    and the gang supervisor treats it as a whole-gang failure (the
-    blocked native dispatch itself cannot be cancelled; the raising
-    process exits and the supervisor relaunches)."""
+    the mesh axis, the per-shard payload, the deadline that expired,
+    and (for planner-routed dispatches) the ROUTE: the resolved
+    strategy plus the wire phases the compiled program comprises, so a
+    watchdogged hierarchical leg names what it was executing
+    (``intra_reduce_scatter@f32 | inter_allreduce@int8 | ...``) instead
+    of one opaque op name — and the gang supervisor treats it as a
+    whole-gang failure (the blocked native dispatch itself cannot be
+    cancelled; the raising process exits and the supervisor
+    relaunches)."""
 
     def __init__(self, op: str, axis, timeout_s: float,
-                 payload_bytes: Optional[int] = None):
+                 payload_bytes: Optional[int] = None,
+                 strategy: Optional[str] = None,
+                 phases: Optional[Sequence[str]] = None):
         extra = (f", {payload_bytes} payload bytes"
                  if payload_bytes is not None else "")
+        route = ""
+        if strategy is not None:
+            route = f" [strategy={strategy}"
+            if phases:
+                route += " phases=" + " | ".join(phases)
+            route += "]"
         super().__init__(
             f"collective {op!r} over axis {axis!r} still blocked after "
-            f"{timeout_s:.3f}s{extra}")
+            f"{timeout_s:.3f}s{extra}{route}")
         self.op = op
         self.axis = str(axis)
         self.timeout_s = float(timeout_s)
         self.payload_bytes = payload_bytes
+        self.strategy = strategy
+        self.phases = tuple(phases) if phases else None
 
 
 class _ShapeOnly:
@@ -85,7 +100,9 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
                       deadline=None, timeout_s: Optional[float] = None,
                       payload_bytes: Optional[int] = None,
                       codec: str = "none",
-                      logical_bytes: Optional[int] = None, **kw):
+                      logical_bytes: Optional[int] = None,
+                      strategy: Optional[str] = None,
+                      phases: Optional[Sequence[str]] = None, **kw):
     """Run a blocking dispatch under a host-side watchdog timer.
 
     ``deadline`` (a :class:`~synapseml_tpu.resilience.Deadline`) and/or
@@ -102,10 +119,14 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
     """
     # compressed ops tag their flight events with the codec and BOTH
     # byte counts (``nbytes`` is what moved on the wire, ``logical_nbytes``
-    # what it represents); the "none" path emits the identical event
+    # what it represents); planner-routed ops additionally carry the
+    # resolved strategy; the bare "none" path emits the identical event
     # payload it always did
     extra = ({"codec": codec, "logical_nbytes": logical_bytes}
              if codec != "none" else {})
+    if strategy is not None and strategy != "flat":
+        extra["strategy"] = strategy
+    seg_strategy = strategy or "flat"
     if deadline is not None:
         timeout_s = deadline.limit(timeout_s)
     if timeout_s is None:
@@ -118,7 +139,7 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
         dt = time.perf_counter() - t0
         flight_record("collective.end", op=op, axis=str(axis),
                       nbytes=payload_bytes, seconds=round(dt, 6), **extra)
-        observe_collective(dt, payload_bytes or 0)
+        observe_collective(dt, payload_bytes or 0, strategy=seg_strategy)
         return out
     box: dict = {}
     done = threading.Event()
@@ -148,7 +169,8 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
                       nbytes=payload_bytes, timeout_s=float(timeout_s),
                       **extra)
         raise CollectiveTimeout(op, axis, float(timeout_s),
-                                payload_bytes=payload_bytes)
+                                payload_bytes=payload_bytes,
+                                strategy=strategy, phases=phases)
     dt = time.perf_counter() - t0
     if "error" in box:
         # failed collectives leave the `begin` unpaired, matching the
@@ -156,11 +178,12 @@ def dispatch_watchdog(fn: Callable, *args, op: str, axis=DATA_AXIS,
         raise box["error"]
     flight_record("collective.end", op=op, axis=str(axis),
                   nbytes=payload_bytes, seconds=round(dt, 6), **extra)
-    observe_collective(dt, payload_bytes or 0)
+    observe_collective(dt, payload_bytes or 0, strategy=seg_strategy)
     return box["value"]
 
 
-def _record(op: str, axis, x, config=None, channel_major: bool = False) -> None:
+def _record(op: str, axis, x, config=None, channel_major: bool = False,
+            strategy: str = "flat") -> None:
     """EQuARX-style per-collective accounting (arXiv:2506.17615): count +
     payload bytes per (op, axis) into the process metrics registry.
     ``collective_bytes_total`` stays LOGICAL bytes (the signal the op
@@ -187,7 +210,8 @@ def _record(op: str, axis, x, config=None, channel_major: bool = False) -> None:
                         nbytes, **labels)
         if config is not None and config.compresses:
             record_compressed(op, axis, x, config,
-                              channel_major=channel_major)
+                              channel_major=channel_major,
+                              strategy=strategy)
     except Exception:
         pass
 
@@ -278,7 +302,14 @@ def ring_allreduce(x, axis: str = DATA_AXIS):
     axis size.  Returns the SUM over ranks, replicated (== lax.psum).
     """
     _record("ring_allreduce", axis, x)
-    n = lax.axis_size(axis)
+    return _ring_core(x, axis, int(lax.axis_size(axis)))
+
+
+def _ring_core(x, axis, n: int):
+    """The unrecorded ring schedule :func:`ring_allreduce` documents —
+    shared with the collective planner's ``ring`` strategy
+    (:mod:`~synapseml_tpu.parallel.planner`), which does its own
+    strategy-labeled accounting."""
     if n == 1:
         return x
     me = lax.axis_index(axis)
@@ -386,7 +417,8 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS,
     resilience.Deadline`) or ``timeout_s=`` per call and an
     indefinitely-blocked dispatch raises :class:`CollectiveTimeout`
     instead of freezing the rank (see :func:`dispatch_watchdog`)."""
-    from .compression import codec_eligible, compressed_psum
+    from .compression import codec_eligible, record_compressed
+    from .planner import planned_psum
     compresses = config is not None and config.compresses
     codec = config.compression if compresses else "none"
 
@@ -394,20 +426,27 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS,
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P())
     def _allreduce(x):
-        # x.sum(0) handles both one and several stacked values per shard
+        # x.sum(0) handles both one and several stacked values per shard.
+        # record=False: the host wrapper below accounts this op once
+        # (per call, on the full stacked payload) — recording the
+        # traced inner reduce too would double-count the series.
+        # planned_psum resolves the route at trace time; config=None and
+        # strategy-flat configs delegate to the exact pre-planner
+        # dispatch (compressed_psum / bare lax.psum), byte-identically.
         local = x.sum(0)
-        if compresses:
-            # record=False: the host wrapper below accounts this op once
-            # (per call, on the full stacked payload) — recording the
-            # traced inner reduce too would double-count the series
-            return compressed_psum(local, axis, config, op="allreduce_fn",
-                                   record=False)
-        return lax.psum(local, axis_name=axis)
+        return planned_psum(local, axis, config, op="allreduce_fn",
+                            record=False)
 
     latency = get_registry().histogram(
         "collective_latency_seconds",
         "host-observed latency of host-dispatched collectives",
         ("op", "axis"))
+    #: payload signature -> ReductionPlan (or None), resolved at the
+    #: FIRST dispatch of each signature — exactly when jit traces it —
+    #: and pinned, so the host labels keep naming the route the
+    #: already-compiled program runs even after a planner refresh or
+    #: set_spec re-routes plans for signatures not yet traced
+    plans: dict = {}
 
     @functools.wraps(_allreduce)
     def timed(x, *, deadline=None, timeout_s=None):
@@ -418,23 +457,69 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS,
         # reported that way — not as int8 wire that never existed
         inner = getattr(x, "shape", ())[1:]
         dtype = getattr(x, "dtype", jnp.float32)
+        # the planner resolves the ROUTE the traced body takes for this
+        # payload class — same planner, same cache key as the traced
+        # planned_psum, resolved once per payload signature (the jit
+        # cache key) and pinned in ``plans``, so the host-side labels
+        # (strategy on metrics, flight events, StepProfiler segment,
+        # CollectiveTimeout phases) name the route the compiled program
+        # really runs even after a mid-life planner refresh/set_spec
+        sig = (tuple(getattr(x, "shape", ())), str(np.dtype(dtype)))
+        if sig in plans:
+            plan = plans[sig]
+        else:
+            plan = None
+            if config is not None and config.strategy != "flat":
+                from .planner import get_planner
+                nbytes = (int(np.prod(inner)) if inner else 1) \
+                    * np.dtype(dtype).itemsize
+                plan = get_planner().plan(nbytes, int(mesh.shape[axis]),
+                                          config, axis=str(axis),
+                                          op="allreduce_fn")
+            plans[sig] = plan
+        routed = plan is not None and plan.strategy != "flat"
+        strategy = plan.strategy if routed else "flat"
         active = codec_eligible(inner, dtype, config)
+        # a routed plan may demote the codec for its route (tree runs
+        # latency-bound payloads at the logical dtype)
+        eff_codec = (plan.wire_codec(tuple(inner), dtype) if routed
+                     else (codec if active else "none"))
+        wire_active = eff_codec != "none"
         # the traced compressed_psum lays the ndim>=2 LOCAL (*H) out
         # channel-major (per-channel chunk padding), so the stacked
         # account is S x the padded local — padding the stacked array
         # itself would miscount the pad bytes the wire really ships
         cm = len(inner) >= 2
-        if active:
+        if wire_active:
             S = int(getattr(x, "shape", (1,))[0])
             payload = [_ShapeOnly(inner, dtype)] * S
         else:
             payload = x
-        _record("allreduce_fn", axis, payload,
-                config=config if active else None, channel_major=cm)
-        wire = _payload_bytes(payload, config if active else None,
-                              channel_major=cm)
-        extra = ({"codec": codec, "logical_nbytes": _payload_bytes(x)}
-                 if active else {})
+        if routed:
+            # calls/logical series, then the strategy-labeled wire
+            # series at the codec and bytes the route REALLY ships
+            # (uncompressed routes land wire == logical so the
+            # per-strategy wire histogram covers f32 routes too;
+            # hierarchical counts its intra-host f32 legs — see
+            # ReductionPlan.wire_nbytes)
+            wire = plan.wire_nbytes(payload, eff_codec,
+                                    channel_major=cm)
+            _record("allreduce_fn", axis, payload)
+            record_compressed("allreduce_fn", axis, payload,
+                              config if wire_active else None,
+                              channel_major=cm, strategy=strategy,
+                              codec=eff_codec, wire=wire)
+        else:
+            _record("allreduce_fn", axis, payload,
+                    config=config if wire_active else None,
+                    channel_major=cm, strategy=strategy)
+            wire = _payload_bytes(payload,
+                                  config if wire_active else None,
+                                  channel_major=cm)
+        extra = ({"codec": eff_codec, "logical_nbytes": _payload_bytes(x)}
+                 if wire_active else {})
+        if routed:
+            extra["strategy"] = strategy
         t0 = time.perf_counter()
         if deadline is None and timeout_s is None:
             out = _allreduce(x)
@@ -442,7 +527,7 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS,
             # collective segment + the flight ring (the watched leg below
             # goes through dispatch_watchdog, which does both itself)
             dt = time.perf_counter() - t0
-            observe_collective(dt, wire)
+            observe_collective(dt, wire, strategy=strategy)
             flight_record("collective.end", op="allreduce_fn",
                           axis=str(axis), nbytes=wire,
                           seconds=round(dt, 6), **extra)
@@ -454,8 +539,10 @@ def allreduce_fn(mesh: Mesh, axis: str = DATA_AXIS,
                 lambda v: jax.block_until_ready(_allreduce(v)), x,
                 op="allreduce_fn", axis=axis,
                 deadline=deadline, timeout_s=timeout_s,
-                payload_bytes=wire, codec=codec if active else "none",
-                logical_bytes=_payload_bytes(x))
+                payload_bytes=wire, codec=eff_codec,
+                logical_bytes=_payload_bytes(x),
+                strategy=strategy if routed else None,
+                phases=plan.phases(eff_codec) if routed else None)
         latency.observe(time.perf_counter() - t0, op="allreduce_fn",
                         axis=str(axis))
         return out

@@ -83,6 +83,15 @@ class CollectiveConfig:
     #: pins BOTH legs to the manual mode (the bench_obs_overhead
     #: same-dispatch-mode methodology)
     manual: bool = False
+    #: reduction ROUTE (:mod:`~synapseml_tpu.parallel.planner`):
+    #: 'auto' (default — per-payload planner choice; resolves 'flat'
+    #: wherever the topology is unknown, so defaults trace byte-
+    #: identically to the pre-planner dispatch) | 'flat' (whatever
+    #: jax.lax emits — today's path, pinned) | 'ring' | 'tree' |
+    #: 'hierarchical' (intra-host f32, inter-host through the codec).
+    #: A non-auto routing strategy also engages the manual dispatch
+    #: paths (the route must be ours to schedule).
+    strategy: str = "auto"
 
     def __post_init__(self):
         if self.compression not in CODECS:
@@ -90,11 +99,23 @@ class CollectiveConfig:
                 f"compression={self.compression!r}: must be one of {CODECS}")
         if self.chunk < 8:
             raise ValueError(f"chunk={self.chunk}: must be >= 8")
+        from .planner import STRATEGIES
+        if self.strategy not in STRATEGIES:
+            raise ValueError(
+                f"strategy={self.strategy!r}: must be one of {STRATEGIES}")
 
     @property
     def enabled(self) -> bool:
         return (self.compression != "none" or self.sharded_update
-                or self.manual)
+                or self.manual or self.routes)
+
+    @property
+    def routes(self) -> bool:
+        """An EXPLICIT routing request ('auto' alone does not enable a
+        config — on unknown topology it is indistinguishable from flat,
+        and on known topology it engages wherever the codec/manual
+        knobs already put dispatch in our hands)."""
+        return self.strategy in ("ring", "tree", "hierarchical")
 
     @property
     def compresses(self) -> bool:
@@ -132,6 +153,21 @@ def resolve_collective_config(value: Any) -> Optional[CollectiveConfig]:
         f"got {type(value).__name__}")
 
 
+def stream_eligible(shape, dtype,
+                    config: Optional[CollectiveConfig]) -> bool:
+    """The size/dtype half of the eligibility predicate: does a payload
+    of this shape/dtype belong to the big flat stream at all (large
+    float, ``min_size`` or more elements) — before asking whether the
+    codec engages on it?  The routing-only stream
+    (:func:`compressed_tree_sync` under an explicit strategy with
+    ``compression='none'``) partitions leaves by THIS, so the big/small
+    split can never disagree between compressing and routing-only
+    configs."""
+    return (config is not None
+            and int(np.prod(shape)) >= config.min_size
+            and jnp.issubdtype(dtype, jnp.floating))
+
+
 def codec_eligible(shape, dtype, config: Optional[CollectiveConfig]) -> bool:
     """THE eligibility predicate — does the codec engage for a payload of
     this shape/dtype under ``config``?  One implementation on purpose:
@@ -141,8 +177,7 @@ def codec_eligible(shape, dtype, config: Optional[CollectiveConfig]) -> bool:
     (``collectives.allreduce_fn``) must all agree, or metrics report
     int8 wire for ops that really reduced in f32."""
     return (config is not None and config.compresses
-            and int(np.prod(shape)) >= config.min_size
-            and jnp.issubdtype(dtype, jnp.floating))
+            and stream_eligible(shape, dtype, config))
 
 
 # -- wire accounting ---------------------------------------------------------
@@ -199,26 +234,38 @@ def wire_nbytes(x, config: Optional[CollectiveConfig],
 
 def record_compressed(op: str, axis, x,
                       config: Optional[CollectiveConfig],
-                      channel_major: bool = False) -> None:
+                      channel_major: bool = False,
+                      strategy: str = "flat",
+                      codec: Optional[str] = None,
+                      wire: Optional[int] = None) -> None:
     """Trace-time wire/logical accounting for a compressed collective —
     the codec-aware counterpart of ``collectives._record`` (which
     assumed logical dtype size for every op and would double-count and
-    mis-rank codecs).  Telemetry must never break a trace."""
+    mis-rank codecs).  ``strategy`` is the planner route the bytes take
+    (ISSUE 14: every strategy choice attributable), 'flat' for the
+    direct dispatch.  ``codec``/``wire`` override the config-derived
+    label and byte model for routed dispatches whose wire differs from
+    the flat one (a tree that demoted int8 ships f32; hierarchical adds
+    intra-host f32 legs — see ``ReductionPlan.wire_nbytes``).
+    Telemetry must never break a trace."""
     try:
-        codec = config.compression if config is not None else "none"
+        if codec is None:
+            codec = config.compression if config is not None else "none"
         logical = logical_nbytes(x)
-        wire = wire_nbytes(x, config, channel_major=channel_major)
+        if wire is None:
+            wire = wire_nbytes(x, config, channel_major=channel_major)
         reg = get_registry()
-        labels = dict(op=op, axis=str(axis), codec=codec)
+        labels = dict(op=op, axis=str(axis), codec=codec, strategy=strategy)
         reg.counter(
             "collective_wire_bytes_total",
             "per-shard bytes collectives actually put on the wire, by "
-            "op, mesh axis and codec", ("op", "axis", "codec")).inc(
-                wire, **labels)
+            "op, mesh axis, codec and routing strategy",
+            ("op", "axis", "codec", "strategy")).inc(wire, **labels)
         reg.gauge(
             "collective_compression_ratio",
             "logical / wire bytes of the last traced collective, by op, "
-            "mesh axis and codec", ("op", "axis", "codec")).set(
+            "mesh axis, codec and routing strategy",
+            ("op", "axis", "codec", "strategy")).set(
                 (logical / wire) if wire else 1.0, **labels)
     except Exception:
         pass
@@ -497,8 +544,13 @@ def compressed_tree_sync(tree, axis: Optional[str],
         n = lax.axis_size(axis)
     else:
         n = 1
+    # the big-leaf stream: codec-eligible leaves, plus — for a
+    # routing-only config (strategy set, compression 'none') — the same
+    # large-float class routed at f32, so an explicit ring/tree/
+    # hierarchical request still schedules the gradient stream
     big = [i for i, lf in enumerate(leaves)
-           if codec_eligible(lf.shape, lf.dtype, config)]
+           if stream_eligible(lf.shape, lf.dtype, config)
+           and (config.compresses or config.routes)]
     small = [i for i in range(len(leaves)) if i not in big]
 
     out = list(leaves)
@@ -511,16 +563,62 @@ def compressed_tree_sync(tree, axis: Optional[str],
         for j, i in enumerate(small):
             out[i] = summed[j] / n if mean else summed[j]
     if big:
-        _record(op, axis, [leaves[i] for i in big], config=config)
+        # the planner resolves the gradient stream's route at trace
+        # time (flat everywhere topology is unknown — the pre-planner
+        # jaxpr, byte-identical); non-flat routes reduce through
+        # ReductionPlan.reduce_flat with the SAME per-leaf EF contract
+        plan = None
+        if axis is not None and getattr(config, "strategy",
+                                        "flat") != "flat":
+            from .planner import get_planner
+            size_est = int(sum(leaves[i].size for i in big)) * 4
+            plan = get_planner().plan(size_est, int(n), config,
+                                      axis=str(axis), op=op)
+        routed = plan is not None and plan.strategy != "flat"
         size = int(sum(leaves[i].size for i in big))
+        big_leaves = [leaves[i] for i in big]
+        codec = (plan.wire_codec((size,), jnp.float32) if routed
+                 else None)
+        if routed:
+            # calls/logical series, then the strategy-labeled wire
+            # series at the codec and bytes the resolved route REALLY
+            # ships (a tree route demotes int8 to the f32 wire;
+            # hierarchical counts its intra-host f32 legs plus the
+            # 1/inner codec shard) — flat-model accounting here would
+            # claim int8 wire for a route that ships f32
+            _record(op, axis, big_leaves)
+            record_compressed(op, axis, big_leaves,
+                              config if codec != "none" else None,
+                              strategy=plan.strategy, codec=codec,
+                              wire=plan.wire_nbytes(big_leaves, codec))
+        else:
+            _record(op, axis, big_leaves, config=config, strategy="flat")
         flat = flatten_with_residuals(leaves, big, new_res, size)
-        if config.compression == "bf16":
+        want_err = new_res is not None and config.error_feedback
+        if routed:
+            flat_p = _pad_to(flat, plan.pad_unit(codec))
+            total_p, err_p = plan.reduce_flat(flat_p, axis, codec,
+                                              want_err=want_err)
+            total = total_p[:size]
+            if want_err:
+                new_res = unpack_residuals(err_p[:size], big, leaves,
+                                           new_res)
+        elif not config.compresses:
+            # a routing-only stream whose plan resolved flat (unknown
+            # topology / structural fallback): plain f32 psum — the
+            # same wire the small-leaf path rides
+            total = (lax.psum(flat, axis_name=axis)
+                     if axis is not None else flat)
+        elif config.compression == "bf16":
             sent = bf16_decode(bf16_encode(flat))
             if axis is not None:
                 total = bf16_decode(lax.psum(bf16_encode(flat),
                                              axis_name=axis))
             else:
                 total = sent
+            if want_err:
+                new_res = unpack_residuals(flat - sent[:size], big,
+                                           leaves, new_res)
         else:
             flat_p = _pad_to(flat, int(n) * config.chunk)
             q, s = int8_encode(flat_p, config.chunk)
@@ -530,10 +628,9 @@ def compressed_tree_sync(tree, axis: Optional[str],
                 total = int8_all_gather(shard, axis, config.chunk)[:size]
             else:
                 total = sent
-        # with EF off, residuals stay zero (the caller may not carry any)
-        if new_res is not None and config.error_feedback:
-            new_res = unpack_residuals(flat - sent[:size], big, leaves,
-                                       new_res)
+            if want_err:
+                new_res = unpack_residuals(flat - sent[:size], big,
+                                           leaves, new_res)
         offset = 0
         for i in big:
             sz = leaves[i].size

@@ -5,8 +5,14 @@ ordering — machines sorted by (host, min partition id), executor→partition
 map broadcast from the driver (reference: NetworkManager.scala:171-180,
 309-315; PartitionTaskContext offsets BasePartitionTask.scala:105-112).
 Here the same contract maps Dataset partitions onto mesh coordinates:
-partition ids are assigned round-robin over the data axis in device order,
-which is itself deterministic (mesh device grid order).
+partition ids are assigned in CONTIGUOUS BLOCKS over the data axis in
+device order (like Spark's executor→partition grouping; the device order
+is itself deterministic — mesh device grid order), or round-robin when a
+caller asks for ``strategy="round_robin"`` interleaving.  The same
+assignment core (:func:`partition_assignment`) groups gang ranks into
+intra-host blocks for the collective planner's hierarchical strategies
+(:mod:`synapseml_tpu.parallel.planner`), so placement and reduction
+grouping cannot drift apart.
 """
 
 from __future__ import annotations
@@ -14,10 +20,12 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Tuple
 
-import numpy as np
 from jax.sharding import Mesh
 
 from .mesh import DATA_AXIS
+
+#: accepted :func:`place_partitions` strategies
+PLACEMENT_STRATEGIES = ("block", "round_robin")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,33 +39,68 @@ class PlacementMap:
         return self.rank_to_partitions.get(rank, [])
 
 
-def place_partitions(num_partitions: int, mesh: Mesh,
-                     axis: str = DATA_AXIS) -> PlacementMap:
-    """Deterministically assign partitions to data-axis ranks.
+def partition_assignment(num_partitions: int, num_ranks: int,
+                         strategy: str = "block") -> PlacementMap:
+    """The mesh-free assignment core behind :func:`place_partitions`.
 
-    Contiguous block assignment (like Spark's executor→partition grouping):
-    rank r gets partitions [r*k, (r+1)*k) with the remainder spread over the
-    first ranks — stable across runs for a given (num_partitions, mesh).
+    ``"block"``: rank r gets the contiguous run ``[r*k, (r+1)*k)`` with
+    the remainder spread over the first ranks.  ``"round_robin"``:
+    partition p goes to rank ``p % num_ranks``.  Both are stable across
+    runs for a given ``(num_partitions, num_ranks)``.  Also used by the
+    collective planner to carve gang ranks into intra-host groups
+    (partitions = global ranks, ranks = hosts).
     """
-    num_ranks = mesh.shape[axis]
-    base, rem = divmod(num_partitions, num_ranks)
+    if strategy not in PLACEMENT_STRATEGIES:
+        raise ValueError(f"strategy={strategy!r}: must be one of "
+                         f"{PLACEMENT_STRATEGIES}")
+    num_ranks = int(num_ranks)
     p2r: Dict[int, int] = {}
     r2p: Dict[int, List[int]] = {r: [] for r in range(num_ranks)}
-    pid = 0
-    for r in range(num_ranks):
-        count = base + (1 if r < rem else 0)
-        for _ in range(count):
+    if strategy == "round_robin":
+        for pid in range(num_partitions):
+            r = pid % num_ranks
             p2r[pid] = r
             r2p[r].append(pid)
-            pid += 1
+    else:
+        base, rem = divmod(num_partitions, num_ranks)
+        pid = 0
+        for r in range(num_ranks):
+            count = base + (1 if r < rem else 0)
+            for _ in range(count):
+                p2r[pid] = r
+                r2p[r].append(pid)
+                pid += 1
     return PlacementMap(p2r, r2p, num_ranks)
+
+
+def place_partitions(num_partitions: int, mesh: Mesh,
+                     axis: str = DATA_AXIS,
+                     strategy: str = "block") -> PlacementMap:
+    """Deterministically assign partitions to data-axis ranks.
+
+    Default ``strategy="block"`` is contiguous block assignment (like
+    Spark's executor→partition grouping) — the layout
+    :func:`rows_for_rank` relies on to return one contiguous row range.
+    ``strategy="round_robin"`` interleaves partitions over ranks
+    instead (load-levelling when partition sizes trend — the ordering
+    the module docstring historically promised; now it is a knob, not a
+    misdescription).
+    """
+    return partition_assignment(num_partitions, mesh.shape[axis], strategy)
 
 
 def rows_for_rank(ds, placement: PlacementMap, rank: int) -> Tuple[int, int]:
     """Row range [start, end) owned by a data-axis rank, following the
-    contiguous partition blocks."""
+    contiguous partition blocks (requires a ``"block"`` placement —
+    round-robin ranks own non-contiguous partitions, so a single range
+    cannot describe them)."""
     parts = placement.partitions_for_rank(rank)
     bounds = ds.partition_bounds()
     if not parts:
         return (0, 0)
+    if parts != list(range(parts[0], parts[-1] + 1)):
+        raise ValueError(
+            f"rank {rank} owns non-contiguous partitions {parts} "
+            "(round_robin placement?) — rows_for_rank needs block "
+            "placement")
     return (bounds[parts[0]][0], bounds[parts[-1]][1])

@@ -538,6 +538,23 @@ class GangSupervisor:
             flight_record("gang_resize", task=self.task, **event)
         except Exception:
             pass
+        # world size changed → topology snapshot refreshed → reduction
+        # plan cache invalidated (ISSUE 14: the collective planner
+        # re-plans at every resize boundary; workers are fresh
+        # processes, so their planners rebuild at relaunch — this keeps
+        # the DRIVER-side planner honest too)
+        self._replan(f"resize_{direction}", new_size)
+
+    def _replan(self, reason: str, world_size: int) -> None:
+        """Invalidate the process collective-plan cache (recorded in the
+        fault call log + flight ring as ``plan.refresh`` /
+        ``plan_invalidate``).  Never raises: re-planning is advisory —
+        a failed refresh must not take the supervisor down with it."""
+        try:
+            from .planner import get_planner
+            get_planner().refresh(reason, world_size=int(world_size))
+        except Exception:
+            pass
 
     def _resize_budget_ok(self) -> bool:
         return self._resizes_done < self.max_resizes
@@ -670,6 +687,10 @@ class GangSupervisor:
                 get_faults().note("gang.restart", attempt=attempt,
                                   restart=self.restarts, causes={},
                                   watermark=watermark, resize=True)
+                # every relaunch boundary re-plans (a resize teardown
+                # already refreshed in _apply_resize when the size
+                # changes; this covers same-size interrupts too)
+                self._replan("relaunch", self.world_size)
                 continue
             except WorkerFailure as e:
                 self.last_failure = e
@@ -697,6 +718,9 @@ class GangSupervisor:
                                   restart=self.restarts,
                                   causes=dict(e.causes),
                                   watermark=watermark)
+                # relaunch boundary: the failed attempt's topology may
+                # be gone (that is often WHY it failed) — re-plan
+                self._replan("relaunch", self.world_size)
                 policy.sleep(policy.backoff_s(attempt),
                              site="launcher.backoff")
                 attempt += 1

@@ -6,12 +6,20 @@ getNumTasksPerExecutor/getNumRowsPerPartition/getDriverHost/getExecutors).
 On TPU the topology is the JAX process/device mesh: hosts are TPU-VM
 workers, "tasks" are chips, and placement is mesh coordinates instead of
 executor ids.
+
+Beyond the host/chip counts, the snapshot now carries the ICI/DCN
+*structure* the collective planner (:mod:`synapseml_tpu.parallel.planner`)
+routes by: per-device mesh ``coords`` and ``slice_index`` where the
+backend exposes them, ``None`` where it does not (the CPU container, older
+jaxlibs) — no fabricated topology, the same honesty contract as the
+roofline spec tables (``telemetry.roofline``: unknown backend ⇒ claim
+nothing).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -29,16 +37,63 @@ class HostInfo:
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """Snapshot of the cluster topology."""
+    """Snapshot of the cluster topology.
+
+    ``coords`` / ``slice_indices`` are per-device, in ``jax.devices()``
+    order, and ``None``-valued on backends that do not expose them
+    (CPU/host platform) — consumers must treat ``None`` as "link
+    structure unknown", never substitute a guess.
+    """
     num_processes: int
     process_index: int
     num_devices: int
     num_local_devices: int
     platform: str
     hosts: List[HostInfo]
+    #: per-device chip mesh coordinates (e.g. ``(x, y, z)`` on TPU), or
+    #: ``None`` per device where the backend has no coords
+    coords: List[Optional[Tuple[int, ...]]] = dataclasses.field(
+        default_factory=list)
+    #: per-device pod-slice index (DCN boundary marker on multi-slice
+    #: deployments), or ``None`` per device where unexposed
+    slice_indices: List[Optional[int]] = dataclasses.field(
+        default_factory=list)
 
     def devices_per_host(self) -> int:
         return self.num_devices // max(1, self.num_processes)
+
+    @property
+    def coords_known(self) -> bool:
+        """True only when EVERY device reported mesh coordinates."""
+        return bool(self.coords) and all(c is not None for c in self.coords)
+
+    def num_slices(self) -> Optional[int]:
+        """Distinct pod slices, or ``None`` when the backend does not
+        expose slice indices (no fabricated DCN structure)."""
+        if not self.slice_indices or any(s is None
+                                         for s in self.slice_indices):
+            return None
+        return len(set(self.slice_indices))
+
+
+def _device_coords(d) -> Optional[Tuple[int, ...]]:
+    """A device's chip coords as a tuple, ``None`` when unexposed (CPU
+    devices have no ``coords``; some backends raise on access)."""
+    try:
+        coords = getattr(d, "coords", None)
+        if coords is None:
+            return None
+        return tuple(int(c) for c in coords)
+    except Exception:
+        return None
+
+
+def _device_slice_index(d) -> Optional[int]:
+    try:
+        s = getattr(d, "slice_index", None)
+        return int(s) if s is not None else None
+    except Exception:
+        return None
 
 
 def get_topology(devices: Optional[Sequence[jax.Device]] = None) -> Topology:
@@ -55,6 +110,8 @@ def get_topology(devices: Optional[Sequence[jax.Device]] = None) -> Topology:
         num_local_devices=jax.local_device_count(),
         platform=devs[0].platform if devs else jax.default_backend(),
         hosts=hosts,
+        coords=[_device_coords(d) for d in devs],
+        slice_indices=[_device_slice_index(d) for d in devs],
     )
 
 

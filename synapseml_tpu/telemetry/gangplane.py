@@ -505,13 +505,17 @@ def current_profiler() -> Optional["StepProfiler"]:
     return getattr(_active, "profiler", None)
 
 
-def observe_collective(seconds: float, nbytes: int = 0) -> None:
+def observe_collective(seconds: float, nbytes: int = 0,
+                       strategy: str = "flat") -> None:
     """Collective-dispatch hook: attributes host-observed collective
-    time to the open step's ``collective`` segment.  Called by
-    ``parallel.collectives``; free when no step is open."""
+    time to the open step's ``collective`` segment, split by the
+    planner route that dispatched it (``strategy`` — 'flat' for the
+    direct dispatch), so bench pairs isolate routing from codec
+    effects.  Called by ``parallel.collectives``; free when no step is
+    open."""
     prof = getattr(_active, "profiler", None)
     if prof is not None:
-        prof._note_collective(seconds, nbytes)
+        prof._note_collective(seconds, nbytes, strategy=strategy)
 
 
 class StepProfiler:
@@ -564,6 +568,11 @@ class StepProfiler:
         self.totals: Dict[str, float] = {s: 0.0 for s in
                                          (*self.SEGMENTS, "total")}
         self.collective_bytes = 0
+        #: hook-fed collective seconds by planner route ('flat' = the
+        #: direct dispatch) — the strategy split of the collective
+        #: segment, so a flat-vs-planned bench pair attributes its
+        #: delta to routing rather than codec
+        self.collective_by_strategy: Dict[str, float] = {}
         self.costs: Dict[str, Optional[Dict[str, float]]] = {}
         #: per-device items (samples/rows) one step processes, by capture
         #: key — feeds the per-sample gauges in :meth:`summary`
@@ -659,12 +668,16 @@ class StepProfiler:
                 st["t_last"] = time.perf_counter()
 
     # -- collective hook ---------------------------------------------------
-    def _note_collective(self, seconds: float, nbytes: int = 0) -> None:
+    def _note_collective(self, seconds: float, nbytes: int = 0,
+                         strategy: str = "flat") -> None:
         st = self._open
         if st is not None:
             st["collective"] += float(seconds)
         with self._lock:
             self.collective_bytes += int(nbytes)
+            self.collective_by_strategy[strategy] = \
+                self.collective_by_strategy.get(strategy, 0.0) \
+                + float(seconds)
 
     # -- XLA cost analysis -------------------------------------------------
     def capture_cost(self, key: str, fn, *args, items: Optional[float] = None,
@@ -698,6 +711,7 @@ class StepProfiler:
             steps = self.steps
             totals = dict(self.totals)
             cbytes = self.collective_bytes
+            by_strategy = dict(self.collective_by_strategy)
             tail = list(self._tail)
         avg = {s: (totals[s] / steps if steps else 0.0) for s in totals}
         roofline = {}
@@ -739,6 +753,7 @@ class StepProfiler:
         return {"model": self.model, "steps": steps, "seconds": totals,
                 "per_step_avg_seconds": avg,
                 "collective_bytes": cbytes,
+                "collective_seconds_by_strategy": by_strategy,
                 "roofline": roofline, "last_steps": tail[-16:]}
 
     def export(self, path: str) -> Dict[str, Any]:

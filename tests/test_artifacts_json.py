@@ -56,6 +56,31 @@ LLMSERVE_TRACE_REQUIRED = (
     "llmserve_trace_traced_step_ms",
 )
 
+#: the flat-vs-planned routing pair (ISSUE 14): a record carrying ANY
+#: ``comms_topo_`` key must carry the whole paired set — both sides of
+#: the large (int8 flat vs hierarchical) and small (f32 flat vs tree)
+#: routing pairs, the per-strategy plan-count histogram, and the
+#: strategy-labeled wire bytes — so a partially-failed routing leg
+#: cannot ship a speedup claim without its anchors (CPU caveat lives in
+#: the leg docstring: the shared-memory wire means the routing win
+#: needs real ICI/DCN)
+COMMS_TOPO_REQUIRED = (
+    "comms_topo_devices",
+    "comms_topo_hosts",
+    "comms_topo_large_flat_ms",
+    "comms_topo_large_planned_ms",
+    "comms_topo_small_flat_ms",
+    "comms_topo_small_planned_ms",
+    "comms_topo_routing_speedup_large",
+    "comms_topo_routing_speedup_small",
+    "comms_topo_plans_flat",
+    "comms_topo_plans_ring",
+    "comms_topo_plans_tree",
+    "comms_topo_plans_hierarchical",
+    "comms_topo_wire_bytes_flat",
+    "comms_topo_wire_bytes_hierarchical",
+)
+
 LLMSERVE_SPEC_REQUIRED = (
     "llmserve_spec_tokens_per_sec",
     "llmserve_spec_tokens_per_step",
@@ -205,6 +230,25 @@ def test_llmserve_trace_pair_complete():
         missing = [k for k in LLMSERVE_TRACE_REQUIRED if k not in rec]
         assert not missing, (
             f"{name}: incomplete llmserve_trace pair: {missing}")
+
+
+def test_comms_topo_fields_complete():
+    """ISSUE 14: a record carrying any ``comms_topo_`` field (the
+    flat-vs-planned routing pair) carries the WHOLE set, each numeric
+    or null (``comms_topo_error`` is the labeled child-failure marker,
+    string by design — a record carrying it is exempt, like the
+    ``--only`` partial label)."""
+    for name, rec in _bench_records():
+        topo_keys = [k for k in rec if k.startswith("comms_topo_")]
+        if not topo_keys or _labeled_partial(rec) \
+                or "comms_topo_error" in rec:
+            continue
+        missing = [k for k in COMMS_TOPO_REQUIRED if k not in rec]
+        assert not missing, f"{name}: incomplete comms_topo block: {missing}"
+        bad = [k for k in topo_keys
+               if rec[k] is not None
+               and not isinstance(rec[k], (int, float))]
+        assert not bad, f"{name}: non-numeric comms_topo fields: {bad}"
 
 
 def test_llmserve_decode_requires_paired_roofline():

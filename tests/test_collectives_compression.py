@@ -243,7 +243,8 @@ class TestCompressedPsum:
 
         def wire():
             m = reg.get("collective_wire_bytes_total")
-            return (m.value(op="allreduce_fn", axis=DATA_AXIS, codec="int8")
+            return (m.value(op="allreduce_fn", axis=DATA_AXIS, codec="int8",
+                            strategy="flat")
                     if m else 0.0)
 
         before = wire()
@@ -257,7 +258,8 @@ class TestCompressedPsum:
         assert gained == wire_nbytes(jnp.asarray(x), cfg), gained
         assert 0 < gained <= logical / 1.8, (gained, logical)
         ratio = reg.get("collective_compression_ratio").value(
-            op="allreduce_fn", axis=DATA_AXIS, codec="int8")
+            op="allreduce_fn", axis=DATA_AXIS, codec="int8",
+            strategy="flat")
         assert ratio >= 1.8
         ends = [e for e in get_flight().events()
                 if e.get("kind") == "collective.end"
@@ -491,7 +493,7 @@ class TestGBDTParity:
         def wire():
             m = reg.get("collective_wire_bytes_total")
             return (m.value(op="gbdt_hist_psum", axis=DATA_AXIS,
-                            codec="int8") if m else 0.0)
+                            codec="int8", strategy="flat") if m else 0.0)
 
         before = wire()
         model = GBDTClassifier(numIterations=5, numLeaves=7, numShards=4,
