@@ -2173,6 +2173,140 @@ def bench_llm_trace_overhead():
     return delta_ms / base_ms * 100.0, base_ms, base_ms + delta_ms
 
 
+#: the cold/warm serving child (``bench_llm_warmup``): one fresh
+#: process per leg — jit dispatch caches are process-wide, so a "cold"
+#: leg in the bench process would silently reuse every program earlier
+#: legs compiled; subprocess isolation is what makes the pair honest.
+#: The child replays a seed-fixed Poisson trace through a SlotEngine
+#: constructed with warmup on or off and reports TTFT p99 + the jit
+#: cache delta across the serving window (the in-loop compile count,
+#: same counter the tier-1 pin uses), or just times construction for
+#: the persistent-cache pair.
+_WARMUP_CHILD = r"""
+import json, sys, time
+import numpy as np
+args = json.loads(sys.argv[1])
+import jax, jax.numpy as jnp
+from synapseml_tpu.parallel import compilecache as cc
+if args.get("cache_dir"):
+    cc.enable_compilation_cache(args["cache_dir"])
+else:
+    cc.install_compile_listeners()
+from synapseml_tpu.models.llm import (LlamaConfig, LlamaModel, SlotEngine,
+                                      engine_jit_cache_size)
+cfg = LlamaConfig.tiny(vocab_size=512, d_model=128, num_layers=2,
+                       num_heads=4, num_kv_heads=2, max_len=64,
+                       dtype=jnp.float32)
+model = LlamaModel(cfg)
+variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+t0 = time.perf_counter()
+eng = SlotEngine(model, variables, n_slots=8, max_len=64, min_prefix=8,
+                 warmup=args["warmup"], name="warmup-bench")
+out = {"construct_s": time.perf_counter() - t0}
+plane = eng.compile_plane
+if plane is not None:
+    out["warmup_seconds"] = plane.warmup_seconds
+    out["programs"] = plane.snapshot()["programs_warm"]
+if args["mode"] == "serve":
+    rng = np.random.default_rng(11)
+    N_REQ, RPS = 48, 60.0
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            int(rng.integers(8, 33))).astype(np.int32)
+               for _ in range(N_REQ)]
+    max_news = [int(rng.integers(4, 17)) for _ in range(N_REQ)]
+    arrivals = np.cumsum(rng.exponential(1.0 / RPS, N_REQ))
+    size0 = engine_jit_cache_size()
+    ttfts, done, nxt = [], 0, 0
+    waiting = []
+    t0 = time.perf_counter()
+    while done < N_REQ:
+        now = time.perf_counter() - t0
+        while nxt < N_REQ and arrivals[nxt] <= now:
+            waiting.append(nxt)
+            nxt += 1
+        while waiting and eng.free_slot_count:
+            j = waiting.pop(0)
+            res = eng.admit(prompts[j], max_news[j])
+            ttfts.append((time.perf_counter() - t0) - arrivals[j])
+            if res.finished:
+                done += 1
+        if eng.active_count:
+            done += sum(1 for ev in eng.step() if ev.finished)
+        elif nxt < N_REQ:
+            time.sleep(max(0.0, arrivals[nxt]
+                           - (time.perf_counter() - t0)))
+    out["ttft_p99_s"] = float(np.percentile(np.asarray(ttfts), 99))
+    out["inloop_compiles"] = engine_jit_cache_size() - size0
+out.update(cc.cache_stats())
+print("WARMJSON:" + json.dumps(out))
+"""
+
+
+def bench_llm_warmup():
+    """The compile plane's paired legs (ISSUE 15), each in a FRESH
+    subprocess (see ``_WARMUP_CHILD``):
+
+    - **cold vs warm serving** — the same seed-fixed Poisson arrival
+      trace through a lazily-compiling engine (every first-hit bucket
+      stalls the loop mid-trace — the pre-plane behavior) and through
+      an AOT-warmed one (``warmup='sync'``; the trace must add ZERO
+      programs to the jit caches, the same counter the tier-1 pin
+      holds).  Cold-vs-warm TTFT p99 is the headline; the in-loop
+      compile counts are the mechanism check.
+    - **cache-off vs cache-on construction** — two children construct
+      the same warmed engine against one persistent-cache dir: the
+      first misses and stores, the second loads executables from disk
+      (``cache_second_hits`` > 0) and constructs measurably faster.
+
+    Honesty (the PR 6/9 pattern): this container's XLA-on-CPU compiles
+    are sub-second, so both deltas are small in absolute terms; the
+    multi-second win is the TPU regime where a single serving program
+    compiles for 10-100 s and the lattice is dozens of programs deep.
+    The MECHANISM (zero in-loop compiles, disk-cache hits) transfers
+    unchanged; the absolute seconds do not.
+    → the ``llmserve_warmup_*`` field dict."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    def child(warmup, mode, cache_dir=None):
+        payload = json.dumps({"warmup": warmup, "mode": mode,
+                              "cache_dir": cache_dir})
+        out = subprocess.run(
+            [sys.executable, "-c", _WARMUP_CHILD, payload],
+            capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(f"warmup child failed: "
+                               f"{out.stderr[-2000:]}")
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("WARMJSON:")][-1]
+        return json.loads(line[len("WARMJSON:"):])
+
+    cold = child("off", "serve")
+    warm = child("sync", "serve")
+    cache_root = tempfile.mkdtemp(prefix="smltpu-bench-xc-")
+    try:
+        first = child("sync", "construct", cache_dir=cache_root)
+        second = child("sync", "construct", cache_dir=cache_root)
+    finally:
+        shutil.rmtree(cache_root, ignore_errors=True)
+    return {
+        "llmserve_warmup_seconds": round(warm["warmup_seconds"], 4),
+        "llmserve_warmup_programs": warm["programs"],
+        "llmserve_warmup_cold_ttft_p99_s": round(cold["ttft_p99_s"], 5),
+        "llmserve_warmup_warm_ttft_p99_s": round(warm["ttft_p99_s"], 5),
+        "llmserve_warmup_cold_inloop_compiles": cold["inloop_compiles"],
+        "llmserve_warmup_warm_inloop_compiles": warm["inloop_compiles"],
+        "llmserve_warmup_cache_first_construct_s": round(
+            first["construct_s"], 4),
+        "llmserve_warmup_cache_second_construct_s": round(
+            second["construct_s"], 4),
+        "llmserve_warmup_cache_speedup": round(
+            first["construct_s"] / second["construct_s"], 4),
+        "llmserve_warmup_cache_second_hits": second["cache_hits"],
+    }
+
+
 def _nullify_nonfinite(obj):
     if isinstance(obj, dict):
         return {k: _nullify_nonfinite(v) for k, v in obj.items()}
@@ -2201,7 +2335,7 @@ class _SkippedLeg(Exception):
 BENCH_LEGS = ("bert", "llm", "spec", "llm8b", "resnet_onnx", "vision",
               "gbdt", "gbdt_pair", "anchor", "streamed", "serving",
               "gang", "resize", "guard", "comms", "comms_topo", "llmserve",
-              "llmserve_spec", "llmserve_trace", "obs")
+              "llmserve_spec", "llmserve_trace", "llmserve_warmup", "obs")
 
 
 def main(only=None):
@@ -2597,6 +2731,37 @@ def main(only=None):
         print(f"[secondary] serving trace-overhead bench failed: {e}",
               file=sys.stderr)
 
+    warmup_fields = None
+    try:
+        if not want("llmserve_warmup"):
+            raise _SkippedLeg()
+        warmup_fields = bench_llm_warmup()
+        print(f"[secondary] serving compile plane: warmup "
+              f"{warmup_fields['llmserve_warmup_seconds']:.2f} s for "
+              f"{warmup_fields['llmserve_warmup_programs']} programs; "
+              "cold vs warm TTFT p99 "
+              f"{warmup_fields['llmserve_warmup_cold_ttft_p99_s'] * 1e3:.1f}"
+              " → "
+              f"{warmup_fields['llmserve_warmup_warm_ttft_p99_s'] * 1e3:.1f}"
+              " ms (in-loop compiles "
+              f"{warmup_fields['llmserve_warmup_cold_inloop_compiles']} → "
+              f"{warmup_fields['llmserve_warmup_warm_inloop_compiles']}); "
+              "persistent-cache construction "
+              f"{warmup_fields['llmserve_warmup_cache_first_construct_s']:.2f}"
+              " → "
+              f"{warmup_fields['llmserve_warmup_cache_second_construct_s']:.2f}"
+              f" s ({warmup_fields['llmserve_warmup_cache_speedup']:.2f}x, "
+              f"{warmup_fields['llmserve_warmup_cache_second_hits']} disk "
+              "hits)", file=sys.stderr)
+        print("[secondary]   NOTE: XLA-on-CPU compiles are sub-second at "
+              "these shapes — the multi-second warmup/cache win is the "
+              "TPU regime; the mechanism (zero in-loop compiles, "
+              "disk-cache hits) is what this container verifies",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] serving warmup bench failed: {e}",
+              file=sys.stderr)
+
     obs_pct = obs_bare_ms = obs_observed_ms = None
     obs_step_decomp = None
     try:
@@ -2719,6 +2884,10 @@ def main(only=None):
             "llmserve_trace_bare_step_ms": round(trace_bare_ms, 4),
             "llmserve_trace_traced_step_ms": round(trace_traced_ms, 4)}
            if trace_pct is not None else {}),
+        # compile-plane pair (ISSUE 15): cold-vs-warm serving over one
+        # arrival trace + the persistent-cache construction pair,
+        # emitted all-or-nothing and schema-held by test_artifacts_json
+        **(warmup_fields or {}),
         "serving_continuous_ms_per_record": (
             round(serving_marg_ms, 4) if serving_marg_ms else None),
         "serving_solo_rtt_ms": (round(serving_solo_ms, 3)

@@ -18,19 +18,25 @@ from .pallas_attn import (ATTENTION_BACKENDS, PagedGeometry,
                           resolve_attention_backend, span_bucket_tiles)
 from .slots import AdmitResult, SlotEngine, StepEvent
 from .stage import LLMTransformer
+from .warmup import (CompilePlane, ProgramSpec, engine_jit_cache_size,
+                     program_lattice)
 
 __all__ = [
     "ATTENTION_BACKENDS",
+    "CompilePlane",
     "LLM_LOGICAL_RULES", "AdmitResult", "CausalAttention", "DecoderBlock",
     "LLMTransformer",
     "LlamaConfig", "LlamaModel", "NgramDrafter", "PagedGeometry",
+    "ProgramSpec",
     "RMSNorm", "SlotEngine",
     "StepEvent",
     "apply_rope", "causal_lm_loss",
-    "cast_params", "dense_read_bytes", "finetune_lm", "generate",
+    "cast_params", "dense_read_bytes", "engine_jit_cache_size",
+    "finetune_lm", "generate",
     "generate_speculative",
     "init_cache", "llama_from_pretrained", "make_lm_train_step",
     "paged_decode_attention", "paged_geometry", "paged_read_bytes",
+    "program_lattice",
     "quantize_int8",
     "resolve_attention_backend", "rope_frequencies", "sample_logits",
     "span_bucket_tiles", "spec_unpack",

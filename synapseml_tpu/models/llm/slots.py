@@ -62,6 +62,7 @@ approximation.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import time
@@ -194,6 +195,25 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _decode_program_key(backend: str, nt: Optional[int]) -> str:
+    """Stable label for one compiled decode-step program — THE naming
+    contract between the step dispatch below and the warmup lattice
+    (:mod:`~synapseml_tpu.models.llm.warmup` imports these, so the
+    lattice can never warm under one name what serving runs under
+    another)."""
+    return f"decode_{backend}" + ("" if nt is None else f"_nt{nt}")
+
+
+def _verify_program_key(backend: str, s: int, nt: Optional[int]) -> str:
+    """Stable label for one compiled (S, span-bucket) verify program."""
+    return f"verify_{backend}_s{s}" + ("" if nt is None else f"_nt{nt}")
+
+
+def _prefill_program_key(pb: int) -> str:
+    """Stable label for one compiled prefill-bucket program."""
+    return f"prefill_b{pb}"
+
+
 @dataclasses.dataclass
 class AdmitResult:
     """What :meth:`SlotEngine.admit` hands back: the slot, the FIRST
@@ -241,7 +261,8 @@ class SlotEngine:
                  min_bucket: int = 8, seed: int = 0, name: str = "llm",
                  attention_backend: str = "auto", step_profiler=None,
                  spec_draft_len: int = 0, spec_ngram: int = 3,
-                 spec_adapt: bool = True, trace_sink=None):
+                 spec_adapt: bool = True, trace_sink=None,
+                 warmup: str = "off"):
         self.model = model
         self.variables = variables
         self.cfg = model.cfg
@@ -376,6 +397,28 @@ class SlotEngine:
         self.prefix_hits = 0
         self.prefix_tokens_reused = 0
         self.tokens_generated = 0
+        # the compile plane (ISSUE 15): 'sync' blocks construction until
+        # the full program lattice — every prefill bucket, decode span
+        # bucket, (S, span) verify pair, and the prefix copy — is
+        # AOT-compiled; 'background' warms on a daemon thread (serve
+        # readiness through compile_plane.is_warm / the LLMServer
+        # /readyz gate); 'off' keeps the pre-plane lazy-compile
+        # behavior exactly.  Programs are warmed through the REAL
+        # jitted entry points against scratch state, so the first
+        # serving hit is a dispatch-cache hit, not a compile.
+        if warmup in (None, False):
+            warmup = "off"
+        elif warmup is True:
+            warmup = "sync"
+        if warmup not in ("off", "sync", "background"):
+            raise ValueError(
+                f"warmup={warmup!r}: must be 'off', 'sync', or "
+                "'background'")
+        self.compile_plane = None
+        if warmup != "off":
+            from .warmup import CompilePlane
+            self.compile_plane = CompilePlane(self, name=name)
+            self.compile_plane.start(background=(warmup == "background"))
         #: cumulative decode-attention K/V bytes (the ledger feeding the
         #: gauge above; bench reads it for the paired roofline block)
         self.decode_attn_bytes = 0
@@ -398,6 +441,29 @@ class SlotEngine:
     @property
     def free_slot_count(self) -> int:
         return self.n_slots - self.active_count
+
+    # -- compile plane -----------------------------------------------------
+    def _program_region(self, key: str):
+        """Wrap one jitted serving call: attributes any compile inside
+        it to ``key`` and counts in-loop compiles as stalls.  A plane-
+        less engine pays nothing (nullcontext)."""
+        plane = self.compile_plane
+        return (contextlib.nullcontext() if plane is None
+                else plane.step_region(key))
+
+    def admission_ready(self, prompt_len: int) -> bool:
+        """Would admitting a ``prompt_len``-token prompt stall on an
+        XLA compile?  Always True without a compile plane (lazy
+        compiles are the pre-plane contract) and once the plane is
+        warm; during a background warmup, True only when the prompt's
+        prefill bucket and the decode/copy/verify base programs are
+        compiled (a cold bucket is bumped to the front of the
+        remaining lattice).  The serving loop holds not-ready requests
+        in queue
+        — exempt from SLO shedding — instead of admitting them into a
+        compile stall."""
+        plane = self.compile_plane
+        return plane is None or plane.admission_ready(prompt_len)
 
     def min_remaining_tokens(self) -> Optional[int]:
         """Smallest remaining token budget across active slots — the
@@ -533,7 +599,9 @@ class SlotEngine:
         src, lcp = self._best_prefix(prompt, slot)
         if src is not None and lcp > 0:
             if src != slot:
-                self.cache = _copy_prefix_jit(self.cache, src, slot, lcp)
+                with self._program_region("prefix_copy"):
+                    self.cache = _copy_prefix_jit(self.cache, src, slot,
+                                                  lcp)
             # src == slot: in-place resume — the reclaimed slot already
             # holds this conversation's prefix K/V, no copy needed
             self.prefix_hits += 1
@@ -546,9 +614,10 @@ class SlotEngine:
         pb = self._bucket(len(tail))
         padded = np.full(pb, self.pad_id, np.int32)
         padded[:len(tail)] = tail
-        self.cache, last = _prefill_slot_jit(
-            self.model, self.variables, self.cache, jnp.asarray(padded),
-            len(tail), slot, lcp)
+        with self._program_region(_prefill_program_key(pb)):
+            self.cache, last = _prefill_slot_jit(
+                self.model, self.variables, self.cache,
+                jnp.asarray(padded), len(tail), slot, lcp)
         logits = np.asarray(last, np.float32)
         tok = self._sample_host(logits)
         plen = len(prompt)
@@ -722,11 +791,14 @@ class SlotEngine:
                     self.top_k, self.top_p,
                     items=float(self.active_count), **kw)
             prof.step_begin()
-        self.cache, nxt, self._key = _decode_step_jit(
-            self.model, self.variables, self.cache, jnp.asarray(tokens),
-            jnp.asarray(lengths.astype(np.int32)), jnp.asarray(self.active),
-            self._key, self.temperature, self.top_k, self.top_p, **kw)
-        nxt = np.asarray(nxt)
+        with self._program_region(_decode_program_key(
+                self.attention_backend, kw["paged_num_tiles"])):
+            self.cache, nxt, self._key = _decode_step_jit(
+                self.model, self.variables, self.cache,
+                jnp.asarray(tokens), jnp.asarray(lengths.astype(np.int32)),
+                jnp.asarray(self.active), self._key, self.temperature,
+                self.top_k, self.top_p, **kw)
+            nxt = np.asarray(nxt)
         if prof is not None:
             prof.mark("compute")      # np.asarray synchronized the step
             prof.step_end()
@@ -830,11 +902,14 @@ class SlotEngine:
                     jnp.asarray(self.active),
                     items=float(self.active_count), **kw)
             prof.step_begin()
-        self.cache, g = _verify_step_jit(
-            self.model, self.variables, self.cache, jnp.asarray(tokens),
-            jnp.asarray(lengths.astype(np.int32)),
-            jnp.asarray(self.active), **kw)
-        g = np.asarray(g)
+        with self._program_region(_verify_program_key(
+                self.attention_backend, S, kw["paged_num_tiles"])):
+            self.cache, g = _verify_step_jit(
+                self.model, self.variables, self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths.astype(np.int32)),
+                jnp.asarray(self.active), **kw)
+            g = np.asarray(g)
         if prof is not None:
             prof.mark("compute")      # np.asarray synchronized the step
             prof.step_end()

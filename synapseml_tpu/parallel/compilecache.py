@@ -1,0 +1,210 @@
+"""Persistent XLA compilation cache + compile attribution.
+
+Two halves of the compile plane's substrate (the serving-side lattice
+warmup lives in :mod:`synapseml_tpu.models.llm.warmup`; this module is
+workload-agnostic — the DL/GBDT training steps reuse cached artifacts
+through the same knob):
+
+- **persistent cache** — :func:`enable_compilation_cache` wires
+  ``jax_compilation_cache_dir`` (plus the min-size/min-time thresholds,
+  floored so even this CPU container's sub-second programs land in the
+  cache) so a relaunched or resized gang re-loads compiled executables
+  from disk instead of re-running XLA.  The directory threads through
+  :class:`~synapseml_tpu.parallel.supervisor.GangSupervisor` to every
+  worker as ``SMLTPU_COMPILE_CACHE_DIR``; workers call
+  :func:`enable_from_env` before their task compiles anything.
+
+- **attribution** — :func:`install_compile_listeners` registers
+  ``jax.monitoring`` listeners once per process: every backend compile
+  lands in the ``llm_compile_seconds{program}`` histogram (labelled by
+  the thread's current :func:`compile_label`, ``unattributed``
+  otherwise) and the ``xla_compiles_total{program}`` counter; the
+  persistent cache's own hit/miss events land in
+  ``xla_compile_cache_hits_total`` / ``xla_compile_cache_misses_total``
+  — so "how long did this replica spend in XLA, on which program, and
+  did the cache help" is answerable from ``/metrics`` alone.
+
+Everything degrades to a no-op when the running jax predates an API
+(monitoring, a cache threshold option): the plane loses attribution or
+cache coverage, never correctness.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Iterator, Optional
+
+from ..telemetry import get_registry
+
+__all__ = [
+    "COMPILE_CACHE_ENV", "cache_stats", "compile_label",
+    "enable_compilation_cache", "enable_from_env",
+    "install_compile_listeners",
+]
+
+#: env var carrying the persistent compilation cache directory to every
+#: gang worker (the ``SMLTPU_CKPT_DIR`` idiom)
+COMPILE_CACHE_ENV = "SMLTPU_COMPILE_CACHE_DIR"
+
+#: the jax.monitoring event one backend (XLA) compile emits
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+#: persistent-cache verdict events (one per cacheable compile request)
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+#: histogram buckets for compile durations: CPU-container programs sit
+#: in the 10ms-1s decades, real TPU serving programs in the 1-100s ones
+_COMPILE_SECONDS_BUCKETS = (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0,
+                            100.0, 300.0)
+
+_lock = threading.Lock()
+_listeners_installed = False
+_cache_dir: Optional[str] = None
+#: thread-local compile attribution label (see :func:`compile_label`)
+_tls = threading.local()
+#: process-wide raw tallies, readable without the registry (the bench
+#: children and the gang cache-reuse pin read these)
+_counts = {"compiles": 0, "cache_hits": 0, "cache_misses": 0}
+
+
+def current_label() -> str:
+    return getattr(_tls, "label", None) or "unattributed"
+
+
+@contextlib.contextmanager
+def compile_label(label: str) -> Iterator[None]:
+    """Attribute any backend compile on THIS thread inside the block to
+    ``label`` (nests; the innermost label wins) — the warmup lattice and
+    the engine's step dispatch wrap their jitted calls in this so
+    ``llm_compile_seconds{program}`` names the program that compiled."""
+    prev = getattr(_tls, "label", None)
+    _tls.label = label
+    try:
+        yield
+    finally:
+        _tls.label = prev
+
+
+def install_compile_listeners() -> bool:
+    """Register the process-wide jax.monitoring listeners (idempotent).
+    Returns False when this jax has no monitoring API — attribution is
+    lost, nothing else."""
+    global _listeners_installed
+    with _lock:
+        if _listeners_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:  # noqa: BLE001 — jax too old / stripped
+            return False
+        reg = get_registry()
+        h_seconds = reg.histogram(
+            "llm_compile_seconds",
+            "backend (XLA) compile seconds per compiled program, "
+            "labelled by the compile plane's program key "
+            "(unattributed: a compile outside any labelled region)",
+            ("program",), buckets=_COMPILE_SECONDS_BUCKETS)
+        c_compiles = reg.counter(
+            "xla_compiles_total", "backend (XLA) compiles run by this "
+            "process", ("program",))
+        c_hits = reg.counter(
+            "xla_compile_cache_hits_total",
+            "compile requests served from the persistent compilation "
+            "cache", ())
+        c_misses = reg.counter(
+            "xla_compile_cache_misses_total",
+            "compile requests the persistent compilation cache could "
+            "not serve (compiled then stored)", ())
+
+        def on_duration(event: str, duration: float, **kw) -> None:
+            if event != _COMPILE_EVENT:
+                return
+            label = current_label()
+            _counts["compiles"] += 1
+            h_seconds.observe(duration, program=label)
+            c_compiles.inc(1, program=label)
+
+        def on_event(event: str, **kw) -> None:
+            if event == _CACHE_HIT_EVENT:
+                _counts["cache_hits"] += 1
+                c_hits.inc(1)
+            elif event == _CACHE_MISS_EVENT:
+                _counts["cache_misses"] += 1
+                c_misses.inc(1)
+
+        try:
+            monitoring.register_event_duration_secs_listener(on_duration)
+            monitoring.register_event_listener(on_event)
+        except Exception:  # noqa: BLE001 — listener API drift
+            return False
+        _listeners_installed = True
+        return True
+
+
+def cache_stats() -> Dict[str, int]:
+    """Raw process tallies: ``compiles`` / ``cache_hits`` /
+    ``cache_misses`` (zeros until :func:`install_compile_listeners` —
+    which every enable path runs — has been called)."""
+    return dict(_counts)
+
+
+def compilation_cache_dir() -> Optional[str]:
+    """The directory this process enabled, or None."""
+    return _cache_dir
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir`` and
+    floor the entry thresholds so every program caches (XLA's defaults
+    skip sub-second compiles — exactly the CPU-container regime, and
+    pointless filtering on TPU where the multi-second programs dominate
+    anyway).  Installs the attribution listeners as a side effect.
+    Idempotent per process; returns False (cache off, process fine)
+    when this jax has no persistent-cache support."""
+    global _cache_dir
+    install_compile_listeners()
+    try:
+        import jax
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                         ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(opt, val)
+            except Exception:  # noqa: BLE001 — older jax: coarser cache
+                pass
+        # jax latches the cache state at the FIRST compile: a process
+        # that already compiled anything before this call (an engine
+        # constructed, then the knob turned on) has the cache pinned
+        # "disabled" and ignores the config update — reset so the next
+        # compile re-initializes against the new dir.  Private API,
+        # best-effort: without it, only enable-before-first-compile
+        # processes (the worker path) get the cache.
+        try:
+            from jax._src import compilation_cache as _jcc
+            _jcc.reset_cache()
+        except Exception:  # noqa: BLE001
+            pass
+    except Exception:  # noqa: BLE001 — no jax / no cache support
+        return False
+    with _lock:
+        _cache_dir = str(cache_dir)
+    try:
+        from ..telemetry.flight import record as flight_record
+        flight_record("compile_cache", dir=str(cache_dir))
+    except Exception:  # noqa: BLE001 — flight is advisory
+        pass
+    return True
+
+
+def enable_from_env() -> Optional[str]:
+    """Worker-side: enable the cache when the supervisor threaded
+    ``SMLTPU_COMPILE_CACHE_DIR`` through (returns the dir), else just
+    install the attribution listeners (returns None)."""
+    cache_dir = os.environ.get(COMPILE_CACHE_ENV)
+    if cache_dir:
+        return cache_dir if enable_compilation_cache(cache_dir) else None
+    install_compile_listeners()
+    return None

@@ -296,7 +296,8 @@ class GangSupervisor:
                  shrink_after: int = 2,
                  resize_cooldown_s: float = 0.0,
                  max_resizes: int = 8,
-                 capacity_fn: Optional[Callable[[], int]] = None):
+                 capacity_fn: Optional[Callable[[], int]] = None,
+                 compile_cache_dir: Optional[str] = None):
         self.task = task
         self.n_processes = int(n_processes)
         self.devices_per_process = int(devices_per_process)
@@ -314,6 +315,20 @@ class GangSupervisor:
             checkpoint_dir = getattr(checkpoint_dir, "directory",
                                      checkpoint_dir)
         self.checkpoint_dir = checkpoint_dir
+        # persistent XLA compilation cache (ISSUE 15): the dir threads
+        # to every worker as SMLTPU_COMPILE_CACHE_DIR (the CKPT_DIR
+        # idiom) so relaunched AND resized gangs load compiled
+        # executables from disk instead of re-running XLA — the
+        # recompile-from-scratch tax was a visible slice of
+        # resize_recovery_seconds.  World-size-dependent programs
+        # (sharded train steps) key on their new shapes and simply
+        # miss; everything shape-stable hits.
+        self.compile_cache_dir = (str(compile_cache_dir)
+                                  if compile_cache_dir else None)
+        if self.compile_cache_dir:
+            from .compilecache import COMPILE_CACHE_ENV
+            self.env_extra.setdefault(COMPILE_CACHE_ENV,
+                                      self.compile_cache_dir)
         self.term_grace_s = float(term_grace_s)
         self.tail_lines = int(tail_lines)
         # the gang-wide observability plane: an obs dir turns wire export

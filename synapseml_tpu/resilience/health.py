@@ -59,6 +59,13 @@ class HealthState:
         self._ready = True
         self._draining = False
         self._closed = False
+        #: live warmup snapshot fn (compile plane): () -> dict with at
+        #: least {"state": ...}; cold/warming makes /readyz answer
+        #: 503 "warming" WITHOUT flipping :attr:`ready` — the listener
+        #: keeps accepting (requests queue behind the warming engine;
+        #: the decode loop holds them compile-aware) while balancers
+        #: stop routing.  None: no warmup axis (the pre-plane behavior).
+        self._warmup_fn = None
         reg = get_registry()
         self._g_ready = reg.gauge(
             "serving_ready", "1 while the server accepts new work",
@@ -87,6 +94,37 @@ class HealthState:
             self._ready = bool(ready)
             self._g_ready.set(1 if self.__effective_ready() else 0,
                               server=self.name)
+
+    # -- warmup axis (the serving compile plane) ---------------------------
+    def set_warmup(self, snapshot_fn) -> None:
+        """Install (or clear, with None) the warmup snapshot source.
+        The fn is called per /readyz — readiness follows the LIVE plane
+        state, no completion callback to race."""
+        with self._lock:
+            self._warmup_fn = snapshot_fn
+
+    def _warmup_snapshot(self):
+        with self._lock:
+            fn = self._warmup_fn
+        if fn is None:
+            return None
+        try:
+            snap = fn()
+        except Exception:  # noqa: BLE001 — a broken probe must not
+            #                 wedge readiness; the state is just unknown
+            return {"state": "unknown"}
+        return snap if isinstance(snap, dict) else {"state": str(snap)}
+
+    @staticmethod
+    def _snapshot_warming(snap) -> bool:
+        """Only a plane actively working toward warm gates readiness:
+        ``failed`` (the engine serves, programs compile lazily) and
+        ``unknown`` (broken snapshot fn) must NOT answer 503 forever —
+        a permanently-wedged-out-of-rotation healthy replica would be
+        strictly worse than the lazy compiles the plane exists to
+        avoid."""
+        return snap is not None and snap.get("state") in ("cold",
+                                                          "warming")
 
     def begin_drain(self) -> None:
         with self._lock:
@@ -119,9 +157,26 @@ class HealthState:
     def readyz(self, queue_depth: int = 0,
                drain_rps: float = 0.0) -> Tuple[int, bytes, dict]:
         """Readiness reply; 503 carries a Retry-After hint sized to the
-        current backlog while draining/unready."""
+        current backlog while draining/unready.  With a compile plane
+        installed (:meth:`set_warmup`) the payload carries its live
+        snapshot under ``"warmup"`` and a cold/warming plane answers
+        503 ``"warming"`` (balancers stop routing; the listener itself
+        still accepts, the decode loop holds queued work
+        compile-aware).  A ``failed`` plane un-gates — the replica
+        serves with lazy compiles, the failure visible in the
+        snapshot."""
+        warm = self._warmup_snapshot()
         if self.ready:
-            body = json.dumps({"status": "ready"}).encode()
+            if self._snapshot_warming(warm):
+                ra = retry_after_from_depth(queue_depth, drain_rps)
+                body = json.dumps({"status": "warming",
+                                   "warmup": warm}).encode()
+                return 503, body, {"Content-Type": "application/json",
+                                   "Retry-After": str(ra)}
+            payload = {"status": "ready"}
+            if warm is not None:
+                payload["warmup"] = warm
+            body = json.dumps(payload).encode()
             return 200, body, {"Content-Type": "application/json"}
         reason = "draining" if self.draining else "not_ready"
         ra = retry_after_from_depth(queue_depth, drain_rps)

@@ -31,6 +31,7 @@ so a resize drops nothing.  Health is exported as
 
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import threading
@@ -47,8 +48,13 @@ from ..telemetry import get_registry
 from ..telemetry.flight import record as flight_record
 from .server import ServingServer
 
-#: replica probe states
-HEALTHY, DRAINING, DEAD = "healthy", "draining", "dead"
+#: replica probe states.  WARMING is the compile plane's pre-ready
+#: window (readyz 503 with status "warming"): routable exactly like
+#: DRAINING — skipped without a breaker signal — so a resized-in
+#: replica absorbs no traffic until its program lattice is warm, and
+#: nobody's breaker opens over a replica that is merely compiling.
+HEALTHY, DRAINING, DEAD, WARMING = ("healthy", "draining", "dead",
+                                    "warming")
 
 
 class NoHealthyReplicaError(RuntimeError):
@@ -129,7 +135,9 @@ def exchange_routing_table(host: str, port: int,
 def probe_replica(host: str, port: int,
                   timeout_s: float = 1.0) -> str:
     """One replica's health, from its reserved paths: ``healthy`` (both
-    ``/healthz`` and ``/readyz`` answer 200), ``draining`` (alive but
+    ``/healthz`` and ``/readyz`` answer 200), ``warming`` (alive, but
+    the compile plane is still AOT-compiling its program lattice —
+    readyz 503 with body status ``"warming"``), ``draining`` (alive but
     readyz says stop routing — PR-2's drain/load-shed state), ``dead``
     (unreachable or healthz failing)."""
     base = f"http://{host}:{port}"
@@ -148,7 +156,13 @@ def probe_replica(host: str, port: int,
                                     timeout=timeout_s) as resp:
             return HEALTHY if resp.status == 200 else DRAINING
     except urllib.error.HTTPError as e:
-        return DRAINING if e.code == 503 else DEAD
+        if e.code != 503:
+            return DEAD
+        try:
+            status = json.loads(e.read().decode("utf-8")).get("status")
+        except Exception:  # noqa: BLE001 — unparseable body: draining
+            status = None
+        return WARMING if status == "warming" else DRAINING
     except Exception:
         return DEAD
 
@@ -275,9 +289,13 @@ class ReplicaRouter:
                         b.record_success()
                 elif status == DEAD:
                     b.record_failure()
-                # draining is deliberate, not a fault: no breaker signal
+                # draining is deliberate and warming is transient
+                # startup work, not faults: no breaker signal for
+                # either — a warming replica re-enters rotation the
+                # first probe after its lattice finishes
                 self._g_probe.set(
-                    {HEALTHY: 1.0, DRAINING: 0.5}.get(status, 0.0),
+                    {HEALTHY: 1.0, WARMING: 0.75,
+                     DRAINING: 0.5}.get(status, 0.0),
                     router=self.name, rank=str(rank))
                 self._update_gauge()
         get_faults().note("serving.replica_probe", rank=rank, status=status)

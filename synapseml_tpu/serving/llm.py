@@ -49,7 +49,11 @@ class LLMServer:
     turns on speculative decoding (greedy only): every slot advances
     by its accepted n-gram-drafted span per step and the SLO
     projection divides by the engine's accepted-tokens-per-step — see
-    docs/api/serving.md "Speculative decoding"."""
+    docs/api/serving.md "Speculative decoding".  ``warmup``
+    (``'background'``/``'sync'``; default ``'off'``) arms the compile
+    plane: the engine's full program lattice is AOT-compiled at
+    construction and ``/readyz`` answers 503 ``"warming"`` until it
+    finishes — see docs/api/serving.md "Warmup & compile plane"."""
 
     def __init__(self, model: Any = None, variables: Any = None, *,
                  engine: Any = None, tokenizer: Any = None,
@@ -66,6 +70,7 @@ class LLMServer:
                  attention_backend: str = "auto",
                  spec_draft_len: int = 0, spec_ngram: int = 3,
                  trace_sample_every: Optional[int] = None,
+                 warmup: str = "off",
                  engine_kwargs: Optional[Dict[str, Any]] = None):
         if engine is None:
             from ..models.llm import SlotEngine
@@ -75,13 +80,24 @@ class LLMServer:
                                 pad_id=pad_id, min_prefix=min_prefix,
                                 attention_backend=attention_backend,
                                 spec_draft_len=spec_draft_len,
-                                spec_ngram=spec_ngram,
+                                spec_ngram=spec_ngram, warmup=warmup,
                                 **(engine_kwargs or {}))
         self.engine = engine
         self.tokenizer = tokenizer
         self.server = ServingServer(host, port, api_path,
                                     reply_timeout_s=reply_timeout_s,
                                     max_queue=max_queue)
+        # compile-plane readiness gate (ISSUE 15): with a warming
+        # engine (warmup='background'/'sync', or a prebuilt engine
+        # constructed with one), /readyz answers 503 "warming" — with
+        # the plane's live snapshot in the payload — until the full
+        # program lattice is AOT-compiled, so a balancer never routes
+        # traffic this replica would stall on.  The listener itself
+        # keeps accepting: direct requests queue and the decode loop
+        # holds them compile-aware instead of shedding.
+        plane = getattr(engine, "compile_plane", None)
+        if plane is not None:
+            self.server.health.set_warmup(plane.snapshot)
         self._loop = _DecodeLoop(
             self.server, self.server._default, engine,
             input_parser=self._parse,

@@ -1173,6 +1173,10 @@ class _DecodeSeq:
     #: request-scoped trace id (None ⇒ not sampled — every trace call
     #: with a None id is a no-op)
     trace_id: Optional[str] = None
+    #: the request was held in queue for an in-flight program compile
+    #: at least once (the compile_wait trace event fires on the first
+    #: hold only)
+    compile_waited: bool = False
 
 
 class _DecodeLoop:
@@ -1205,9 +1209,13 @@ class _DecodeLoop:
     ``min_remaining_tokens``, plus optional
     ``tokens_per_step_estimate`` — a speculative engine's
     accepted-tokens-per-step EWMA, folded into the SLO projection —
-    and optional ``trace_sink``: when present and unset the loop
+    optional ``trace_sink``: when present and unset the loop
     installs its request-trace hook so the engine's per-slot
-    decode/verify outcomes land on the request timelines) so this
+    decode/verify outcomes land on the request timelines — and the
+    optional compile plane: ``admission_ready(prompt_len)`` holds a
+    request whose program is still compiling in queue instead of
+    admitting it into a stall, and ``compile_plane`` exempts the
+    pre-ready warmup window from the SLO shed projection) so this
     module never imports jax; pass a
     :class:`synapseml_tpu.models.llm.SlotEngine`.  A ``step()`` may
     return SEVERAL events per slot (a speculative engine commits whole
@@ -1351,6 +1359,24 @@ class _DecodeLoop:
                                stream=seq.stream)
             self._waiting.append(seq)
 
+    def _queue_waited(self, seq: _DecodeSeq) -> float:
+        """Seconds this request has spent as REAL queue pressure.
+        Warmup/compile time is not queue pressure: while the engine's
+        compile plane is still warming, the whole wait is exempt (a
+        cold replica would otherwise project absurd TTFTs and shed its
+        entire first wave the moment warmup gating lands), and once it
+        is warm the clock starts at plane-ready time for requests that
+        arrived during the warm — not at their enqueue time."""
+        anchor = seq.req.enqueued_at
+        plane = getattr(self.engine, "compile_plane", None)
+        if plane is not None:
+            if not plane.is_warm:
+                return 0.0
+            ready_at = plane.ready_at
+            if ready_at is not None and ready_at > anchor:
+                anchor = ready_at
+        return time.monotonic() - anchor
+
     def _projected_ttft(self, seq: _DecodeSeq, position: int) -> float:
         """Projection of this request's TTFT if admitted as soon as
         capacity allows: time already queued plus the soonest slot
@@ -1369,7 +1395,7 @@ class _DecodeLoop:
         contract): remaining-tokens ÷ accepted-tokens-per-step steps
         remain, not remaining-tokens steps — without this the
         projection over-sheds by the whole speculative speedup."""
-        waited = time.monotonic() - seq.req.enqueued_at
+        waited = self._queue_waited(seq)
         if self.engine.free_slot_count > 0:
             return waited
         rem = self.engine.min_remaining_tokens()
@@ -1407,7 +1433,20 @@ class _DecodeLoop:
 
     def _admit_waiting(self) -> None:
         keep: List[_DecodeSeq] = []
+        ready_fn = getattr(self.engine, "admission_ready", None)
         for pos, seq in enumerate(self._waiting):
+            if ready_fn is not None and not ready_fn(len(seq.ids)):
+                # a program this admission needs is still compiling
+                # (the compile plane bumped it to the front of the
+                # lattice): hold the request in queue — the decode
+                # loop keeps stepping already-warm buckets, and
+                # _queue_waited exempts the wait from SLO shedding
+                if not seq.compile_waited:
+                    seq.compile_waited = True
+                    self._tracer.event(seq.trace_id, "compile_wait",
+                                       prompt_tokens=len(seq.ids))
+                keep.append(seq)
+                continue
             if (self.ttft_slo_s is not None
                     and self._projected_ttft(seq, pos) > self.ttft_slo_s):
                 self._shed(seq, "slo")

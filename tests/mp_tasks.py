@@ -71,6 +71,25 @@ def distributed_serving_roundtrip(args):
             "results": results}
 
 
+def compile_cache_probe(args):
+    """Compile a jitted program and report the persistent compilation
+    cache's verdict counters — the worker enabled the cache from
+    ``SMLTPU_COMPILE_CACHE_DIR`` before this task ran, so a FIRST gang
+    launch reports misses (compiled + stored) and a RELAUNCH over the
+    same dir reports hits (loaded from disk, no XLA)."""
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.parallel.compilecache import (cache_stats,
+                                                     compilation_cache_dir)
+
+    f = jax.jit(lambda x: (x @ x.T).sum())
+    float(f(jnp.ones((96, 96))))
+    stats = cache_stats()
+    return {"rank": jax.process_index(),
+            "dir": compilation_cache_dir(), **stats}
+
+
 def sleep_task(args):
     """Sleep then echo — gang-supervision scaffolding: with a
     ``heartbeat.emit=hang:rank=k`` fault armed via env, rank k's emitter

@@ -81,6 +81,28 @@ COMMS_TOPO_REQUIRED = (
     "comms_topo_wire_bytes_hierarchical",
 )
 
+#: the compile-plane warmup sweep (ISSUE 15): a record carrying ANY
+#: ``llmserve_warmup_`` key must carry the whole paired set — the
+#: cold-vs-warm TTFT p99 pair over the same arrival trace WITH both
+#: legs' in-loop compile counts (the warm leg's must be zero — the pin
+#: lives in test_llm_warmup, the schema just refuses a lone claim),
+#: the warmup cost/size, and the cache-on first-vs-second engine
+#: construction pair with its speedup and the second child's hit count
+#: — so a partially-failed warmup leg cannot ship a TTFT win without
+#: its cold anchor or a cache claim without both constructions
+LLMSERVE_WARMUP_REQUIRED = (
+    "llmserve_warmup_seconds",
+    "llmserve_warmup_programs",
+    "llmserve_warmup_cold_ttft_p99_s",
+    "llmserve_warmup_warm_ttft_p99_s",
+    "llmserve_warmup_cold_inloop_compiles",
+    "llmserve_warmup_warm_inloop_compiles",
+    "llmserve_warmup_cache_first_construct_s",
+    "llmserve_warmup_cache_second_construct_s",
+    "llmserve_warmup_cache_speedup",
+    "llmserve_warmup_cache_second_hits",
+)
+
 LLMSERVE_SPEC_REQUIRED = (
     "llmserve_spec_tokens_per_sec",
     "llmserve_spec_tokens_per_step",
@@ -215,6 +237,20 @@ def test_llmserve_spec_fields_complete():
         missing = [k for k in LLMSERVE_SPEC_REQUIRED if k not in rec]
         assert not missing, (
             f"{name}: incomplete llmserve_spec block: {missing}")
+
+
+def test_llmserve_warmup_fields_complete():
+    """ISSUE 15: a record carrying any ``llmserve_warmup_`` field (the
+    cold-vs-warm serving pair + the persistent-cache construction
+    pair) carries the WHOLE set, each numeric or null (numerics swept
+    by test_llmserve_fields_complete via the shared prefix)."""
+    for name, rec in _bench_records():
+        if not any(k.startswith("llmserve_warmup_") for k in rec) \
+                or _labeled_partial(rec):
+            continue
+        missing = [k for k in LLMSERVE_WARMUP_REQUIRED if k not in rec]
+        assert not missing, (
+            f"{name}: incomplete llmserve_warmup block: {missing}")
 
 
 def test_llmserve_trace_pair_complete():
