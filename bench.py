@@ -838,6 +838,304 @@ def bench_elastic_resize():
     return shrink_recovery_s, grow_recovery_s, degraded_pct
 
 
+def bench_autoscale():
+    """SLO-driven autoscaling, measured by closing the loop for real.
+
+    Serving leg: a diurnal (sinusoidal-rate) then bursty-Poisson
+    arrival trace drives real HTTP requests through a ReplicaRouter
+    over live ServingServer replicas (each simulating a fixed
+    per-request service time, so capacity per replica is known); a real
+    :class:`Autoscaler` polls the windowed ``/sloz`` plane the client
+    feeds and grows/shrinks a :class:`ServingReplicaSet`.  The SAME
+    trace then replays against a statically max-provisioned pool —
+    the pair prices the autoscaler in both currencies: client-measured
+    SLO attainment AND chip-seconds.
+
+    Arbiter leg: ONE 4-chip budget shared between a REAL 3-rank
+    elastic-counter training gang and the serving pool.  A burst makes
+    training yield a rank (elastic shrink through the supervisor); the
+    quiet tail lets the arbiter reclaim it.  The leg verifies neither
+    side lost anything: every issued request answered, and the
+    trainer's final state bit-exact ``f^steps(seed)`` across both
+    controller-driven resizes.
+
+    → the ``autoscale_*`` field dict (all-or-nothing, schema-held by
+    test_artifacts_json)."""
+    import concurrent.futures
+    import random
+    import tempfile
+    import threading
+    import urllib.request
+
+    from synapseml_tpu.parallel import GangSupervisor
+    from synapseml_tpu.resilience import RetryPolicy
+    from synapseml_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                       CapacityArbiter, ReplicaRouter,
+                                       ServingReplicaSet, ServingReply,
+                                       ServingServer)
+    from synapseml_tpu.telemetry.flight import get_flight
+    from synapseml_tpu.telemetry.slo import SloStore
+
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+
+    SERVICE_S = 0.02              # per-request model time: 50 rps/replica
+    THRESH_S = 0.08               # TTFT objective
+
+    class _Replica:
+        """Live ServingServer whose worker burns SERVICE_S per request —
+        a replica with known capacity, so the traces can be sized to
+        genuinely need 1..4 of them."""
+
+        def __init__(self):
+            self.server = ServingServer()
+            self._stop = threading.Event()
+            t = threading.Thread(target=self._loop, daemon=True)
+            t.start()
+
+        def _loop(self):
+            while not self._stop.is_set():
+                for req in self.server.get_batch(max_rows=4,
+                                                 timeout_s=0.05):
+                    time.sleep(SERVICE_S)
+                    self.server.reply(req.id,
+                                      ServingReply(200, b'{"ok":1}'))
+
+        @property
+        def address(self):
+            return self.server.address
+
+        @property
+        def health(self):
+            return self.server.health
+
+        def drain(self, timeout_s=10.0):
+            return self.server.drain(timeout_s=timeout_s)
+
+        def close(self):
+            self._stop.set()
+            self.server.close()
+
+    def run_trace(pool, router, window, trace, seed=0):
+        """Open-loop arrival generator: trace is [(duration_s, rate_rps,
+        poisson?)]; every exchange feeds the SLO window (the
+        autoscaler's ONLY view of the world).  Returns issued/answered
+        latencies/shed plus the chip-seconds integral and peak size."""
+        rng = random.Random(seed)
+        latencies, shed = [], [0]
+        inflight = [0]
+        lock = threading.Lock()
+        chip_s, peak = [0.0], [pool.replica_count()]
+        stop = threading.Event()
+
+        def sampler():
+            last = time.monotonic()
+            while not stop.is_set():
+                time.sleep(0.1)
+                now = time.monotonic()
+                n = max(1, pool.replica_count())
+                chip_s[0] += pool.replica_count() * (now - last)
+                last = now
+                peak[0] = max(peak[0], pool.replica_count())
+                # queue-depth-per-replica occupancy proxy: >= 1 request
+                # in flight per replica means the pool is saturated
+                window.observe_occupancy(min(1.0, inflight[0] / n))
+
+        st = threading.Thread(target=sampler, daemon=True)
+        st.start()
+
+        def one():
+            with lock:
+                inflight[0] += 1
+            t0 = time.perf_counter()
+            try:
+                rank, url = router.route()
+                rep = urllib.request.urlopen(urllib.request.Request(
+                    url, data=b'{"x":1}'), timeout=15)
+                rep.read()
+                lat = time.perf_counter() - t0
+                router.report(rank, ok=True)
+                window.observe_ttft(lat)
+                window.count("admitted")
+                window.count("retired")
+                with lock:
+                    latencies.append(lat)
+            except Exception:  # noqa: BLE001 — a failed exchange IS the
+                #                shed signal the controller reacts to
+                window.count("shed")
+                with lock:
+                    shed[0] += 1
+            finally:
+                with lock:
+                    inflight[0] -= 1
+
+        issued = 0
+        with concurrent.futures.ThreadPoolExecutor(max_workers=64) as ex:
+            for dur, rate, poisson in trace:
+                end = time.monotonic() + dur
+                while time.monotonic() < end:
+                    ex.submit(one)
+                    issued += 1
+                    gap = (rng.expovariate(rate) if poisson
+                           else 1.0 / rate)
+                    time.sleep(min(gap, 0.25))
+        stop.set()
+        st.join(timeout=2.0)
+        return {"issued": issued, "latencies": latencies,
+                "shed": shed[0], "chip_seconds": chip_s[0],
+                "peak": peak[0]}
+
+    # diurnal sine (10 → 110 rps over two 4s periods) + 3s Poisson burst
+    diurnal = [(0.4, 60.0 + 50.0 * math.sin(2 * math.pi * t / 4.0), False)
+               for t in [0.4 * k for k in range(20)]]
+    trace = diurnal + [(3.0, 100.0, True)]
+    duration = sum(d for d, _, _ in trace)
+
+    def attainment(res):
+        ok = sum(1 for lat in res["latencies"] if lat <= THRESH_S)
+        return ok / res["issued"] if res["issued"] else None
+
+    # --- autoscaled run: start at 1 replica, let the controller work
+    pool = ServingReplicaSet(_Replica, drain_timeout_s=10.0)
+    flight_before = len([e for e in get_flight().events()
+                         if e["kind"] == "autoscale_decide"])
+    try:
+        pool.grow(1)
+        router = ReplicaRouter(pool.addresses(), name="bench-scale")
+        pool.router = router
+        store = SloStore()
+        w = store.window("bench", window_s=3.0, slices=6)
+        w.set_objective("ttft", threshold_s=THRESH_S, target=0.9)
+        scaler = Autoscaler(
+            pool, source=store,
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                   sustain_polls=2, grow_cooldown_s=1.0,
+                                   shrink_cooldown_s=2.5, occ_shrink=0.3),
+            name="bench", poll_interval_s=0.4).start()
+        auto = run_trace(pool, router, w, trace, seed=11)
+        scaler.stop()
+        verdicts = [d.verdict for d in scaler.decisions]
+    finally:
+        pool.close()
+    auto_att = attainment(auto)
+
+    # --- static baseline: the same trace, max-provisioned, no controller
+    static_pool = ServingReplicaSet(_Replica, drain_timeout_s=10.0)
+    try:
+        static_pool.grow(4)
+        static_router = ReplicaRouter(static_pool.addresses(),
+                                      name="bench-static")
+        static_pool.router = static_router
+        wstatic = SloStore().window("static", window_s=3.0, slices=6)
+        static = run_trace(static_pool, static_router, wstatic, trace,
+                           seed=11)
+    finally:
+        static_pool.close()
+    static_att = attainment(static)
+
+    flight_decisions = len([e for e in get_flight().events()
+                            if e["kind"] == "autoscale_decide"
+                            and e.get("sloz") is not None]) - flight_before
+
+    # --- arbiter leg: one 4-chip budget, training yields and reclaims
+    steps, seed = 50, 5
+    expected = seed
+    for _ in range(steps):
+        expected = (expected * 6364136223846793005
+                    + 1442695040888963407) % (1 << 63)
+    yields = reclaims = 0
+    state_ok = dropped = final_ranks = answered2 = None
+    with tempfile.TemporaryDirectory() as ckpt:
+        sup = GangSupervisor(
+            "mp_tasks:elastic_counter", n_processes=3,
+            devices_per_process=1,
+            task_args={"steps": steps, "step_sleep_s": 0.3, "seed": seed},
+            timeout_s=240.0, heartbeat_interval_s=0.25, min_ranks=1,
+            retry_policy=RetryPolicy(max_retries=3, base_s=0.01, seed=4),
+            checkpoint_dir=ckpt)
+        arb = CapacityArbiter(4, reclaim_after_s=2.0, name="bench")
+        arb.attach_training(sup, preferred_ranks=3, min_ranks=1)
+        arb.register_serving(1)
+        pool2 = ServingReplicaSet(_Replica, drain_timeout_s=10.0)
+        results = []
+        trainer = threading.Thread(target=lambda: results.append(sup.run()),
+                                   daemon=True)
+        try:
+            pool2.grow(1)
+            router2 = ReplicaRouter(pool2.addresses(), name="bench-arb")
+            pool2.router = router2
+            store2 = SloStore()
+            w2 = store2.window("arb", window_s=3.0, slices=6)
+            w2.set_objective("ttft", threshold_s=THRESH_S, target=0.9)
+            trainer.start()
+            time.sleep(1.5)                    # let the gang come up
+            marker = get_flight().events()
+            seq0 = len([e for e in marker if e["kind"] in
+                        ("arbiter_yield", "arbiter_reclaim")])
+            scaler2 = Autoscaler(
+                pool2, source=store2,
+                policy=AutoscalePolicy(min_replicas=1, max_replicas=3,
+                                       sustain_polls=2,
+                                       grow_cooldown_s=1.0,
+                                       shrink_cooldown_s=2.0,
+                                       occ_shrink=0.3),
+                arbiter=arb, name="bench-arb",
+                poll_interval_s=0.4).start()
+            res2 = run_trace(pool2, router2, w2,
+                             [(3.0, 90.0, True), (6.0, 4.0, False)],
+                             seed=13)
+            # keep polling until training reclaims its preferred size
+            # (or give up and report what happened)
+            deadline = time.monotonic() + 20.0
+            while (time.monotonic() < deadline
+                   and arb.training_chips() < 3):
+                time.sleep(0.3)
+            scaler2.stop()
+            trainer.join(timeout=120.0)
+            moves = [e for e in get_flight().events()
+                     if e["kind"] in ("arbiter_yield", "arbiter_reclaim")
+                     and e.get("arbiter") == "bench"][seq0:]
+            yields = sum(1 for e in moves if e["kind"] == "arbiter_yield")
+            reclaims = sum(1 for e in moves
+                           if e["kind"] == "arbiter_reclaim")
+            final_ranks = sup.world_size
+            answered2 = len(res2["latencies"])
+            dropped = res2["issued"] - answered2
+            state_ok = int(bool(results) and all(
+                r.get("state") == expected for r in results[0]))
+        finally:
+            pool2.close()
+
+    return {
+        "autoscale_requests": auto["issued"],
+        "autoscale_attainment": round(auto_att, 4)
+        if auto_att is not None else None,
+        "autoscale_shed_requests": auto["shed"],
+        "autoscale_chip_seconds": round(auto["chip_seconds"], 2),
+        "autoscale_peak_replicas": auto["peak"],
+        "autoscale_grow_decisions": verdicts.count("grow"),
+        "autoscale_shrink_decisions": verdicts.count("shrink"),
+        "autoscale_hold_decisions": verdicts.count("hold"),
+        "autoscale_flight_decisions": flight_decisions,
+        "autoscale_static_attainment": round(static_att, 4)
+        if static_att is not None else None,
+        "autoscale_static_chip_seconds": round(static["chip_seconds"], 2),
+        "autoscale_chip_savings_pct": round(
+            (1.0 - auto["chip_seconds"] / static["chip_seconds"])
+            * 100.0, 2) if static["chip_seconds"] else None,
+        "autoscale_trace_seconds": round(duration, 2),
+        "autoscale_arbiter_total_chips": 4,
+        "autoscale_arbiter_yields": yields,
+        "autoscale_arbiter_reclaims": reclaims,
+        "autoscale_arbiter_training_final_ranks": final_ranks,
+        "autoscale_arbiter_training_state_ok": state_ok,
+        "autoscale_arbiter_serving_answered": answered2,
+        "autoscale_arbiter_serving_dropped": dropped,
+    }
+
+
 def bench_obs_overhead():
     """Gang-observability overhead on the CLEAN training path: the same
     short GBDT train, bare (flight recorder disabled, no profiler — a
@@ -2335,7 +2633,8 @@ class _SkippedLeg(Exception):
 BENCH_LEGS = ("bert", "llm", "spec", "llm8b", "resnet_onnx", "vision",
               "gbdt", "gbdt_pair", "anchor", "streamed", "serving",
               "gang", "resize", "guard", "comms", "comms_topo", "llmserve",
-              "llmserve_spec", "llmserve_trace", "llmserve_warmup", "obs")
+              "llmserve_spec", "llmserve_trace", "llmserve_warmup", "obs",
+              "autoscale")
 
 
 def main(only=None):
@@ -2762,6 +3061,30 @@ def main(only=None):
         print(f"[secondary] serving warmup bench failed: {e}",
               file=sys.stderr)
 
+    autoscale_fields = None
+    try:
+        if not want("autoscale"):
+            raise _SkippedLeg()
+        autoscale_fields = bench_autoscale()
+        af = autoscale_fields
+        print(f"[secondary] SLO autoscaler: attainment "
+              f"{af['autoscale_attainment']} vs static "
+              f"{af['autoscale_static_attainment']} at "
+              f"{af['autoscale_chip_seconds']:.0f} vs "
+              f"{af['autoscale_static_chip_seconds']:.0f} chip-s "
+              f"({af['autoscale_chip_savings_pct']:.0f}% saved); "
+              f"{af['autoscale_grow_decisions']} grows / "
+              f"{af['autoscale_shrink_decisions']} shrinks over "
+              f"{af['autoscale_requests']} requests; arbiter "
+              f"{af['autoscale_arbiter_yields']} yields / "
+              f"{af['autoscale_arbiter_reclaims']} reclaims, training "
+              f"back at {af['autoscale_arbiter_training_final_ranks']} "
+              f"ranks, state_ok={af['autoscale_arbiter_training_state_ok']}, "
+              f"{af['autoscale_arbiter_serving_dropped']} dropped",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] autoscale bench failed: {e}", file=sys.stderr)
+
     obs_pct = obs_bare_ms = obs_observed_ms = None
     obs_step_decomp = None
     try:
@@ -2888,6 +3211,11 @@ def main(only=None):
         # arrival trace + the persistent-cache construction pair,
         # emitted all-or-nothing and schema-held by test_artifacts_json
         **(warmup_fields or {}),
+        # autoscaler pair (ISSUE 16): autoscaled-vs-static attainment +
+        # chip-seconds over the same diurnal/burst trace, plus the
+        # chip-budget arbiter's yield/reclaim accounting — emitted
+        # all-or-nothing and schema-held by test_artifacts_json
+        **(autoscale_fields or {}),
         "serving_continuous_ms_per_record": (
             round(serving_marg_ms, 4) if serving_marg_ms else None),
         "serving_solo_rtt_ms": (round(serving_solo_ms, 3)

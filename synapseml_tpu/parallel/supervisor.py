@@ -373,6 +373,12 @@ class GangSupervisor:
         self._resize_lock = threading.Lock()
         self._requested_size: Optional[int] = None
         self._interrupt = threading.Event()
+        #: callables invoked with each applied-resize event dict (the
+        #: same record appended to :attr:`resize_history`) — how a
+        #: budget holder (the serving CapacityArbiter) keeps its chip
+        #: accounting honest when the gang resizes for its OWN reasons
+        #: (failure-driven shrink, capacity probe), not just when asked
+        self._resize_listeners: List[Any] = []
 
         reg = get_registry()
         self._c_restarts = reg.counter(
@@ -386,6 +392,10 @@ class GangSupervisor:
             "gang_resizes_total",
             "applied elastic gang resizes, by direction",
             ("task", "direction"))
+        self._g_world = reg.gauge(
+            "gang_world_size",
+            "rank count of the live (or next) gang attempt", ("task",))
+        self._g_world.set(self.world_size, task=self.task)
 
     def _new_monitor(self, watermark: Optional[int],
                      failed_at: Optional[float]) -> Optional[HeartbeatMonitor]:
@@ -502,10 +512,22 @@ class GangSupervisor:
         the last durable checkpoint — so the request lands *between
         checkpoints*, never inside one.  An explicit request is an
         operator action: it bypasses the automatic ``max_resizes``
-        budget and the shrink cooldown (but still clamps to ≥ 1)."""
+        budget and the shrink cooldown — but NOT the validity floor:
+        ``n <= 0`` and ``n < min_ranks`` are caller errors rejected
+        here, loudly, instead of entering the relaunch path with a gang
+        shape the policy forbids."""
         n = int(n)
         if n < 1:
-            raise ValueError(f"resize({n}): need at least one rank")
+            raise ValueError(
+                f"resize({n}): a gang needs at least one rank — to stop "
+                "the gang, let the task finish or tear the supervisor "
+                "down; resize only changes a LIVE gang's shape")
+        if self.min_ranks is not None and n < self.min_ranks:
+            raise ValueError(
+                f"resize({n}): below this supervisor's elastic floor "
+                f"min_ranks={self.min_ranks} — shrink requests must stay "
+                f"in [{self.min_ranks}, ...]; raise min_ranks at "
+                "construction if the floor itself is wrong")
         with self._resize_lock:
             if n == self.world_size:
                 # already there: a no-op request must not tear down a
@@ -522,6 +544,15 @@ class GangSupervisor:
             # attempt for nothing
             self._requested_size = n
             self._interrupt.set()
+
+    def add_resize_listener(self, fn) -> None:
+        """Register ``fn(event_dict)`` to run on every APPLIED resize
+        (requested, failure-driven, or capacity-driven) — the
+        budget-aware hook: an external chip-budget holder stays
+        consistent with resizes it did not initiate.  Listener errors
+        are swallowed: accounting must not break the relaunch path."""
+        with self._resize_lock:
+            self._resize_listeners.append(fn)
 
     def _apply_resize(self, attempt: int, new_size: int, cause: str,
                       automatic: bool) -> None:
@@ -547,6 +578,14 @@ class GangSupervisor:
                  "direction": direction, "cause": cause}
         self.resize_history.append(event)
         self._c_resizes.inc(1, task=self.task, direction=direction)
+        self._g_world.set(new_size, task=self.task)
+        with self._resize_lock:
+            listeners = list(self._resize_listeners)
+        for fn in listeners:
+            try:
+                fn(dict(event))
+            except Exception:
+                pass
         get_faults().note("gang.resize", **event)
         try:
             from ..telemetry.flight import record as flight_record

@@ -89,6 +89,14 @@ class HealthState:
         with self._lock:
             return self._draining
 
+    @property
+    def warming(self) -> bool:
+        """True while an installed compile plane (:meth:`set_warmup`)
+        reports cold/warming — the same verdict ``/readyz`` answers 503
+        ``"warming"`` for, readable in-process so a local replica pool
+        can count capacity-in-flight without an HTTP probe."""
+        return self._snapshot_warming(self._warmup_snapshot())
+
     def set_ready(self, ready: bool) -> None:
         with self._lock:
             self._ready = bool(ready)

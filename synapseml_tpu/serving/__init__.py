@@ -1,5 +1,8 @@
 """Model serving (reference: core Spark Serving layer)."""
 
+from .autoscaler import (AutoscalePolicy, Autoscaler, CapacityArbiter,
+                         ScaleDecision, ServingReplicaSet, SupervisorPool,
+                         sloz_signals)
 from .continuous import ContinuousClient
 from .distributed import (DistributedServingServer, NoHealthyReplicaError,
                           ReplicaRouter, exchange_routing_table,
@@ -8,8 +11,11 @@ from .llm import LLMServer
 from .server import (ApiHandle, MultiPipelineServer, PipelineServer,
                      ServingReply, ServingRequest, ServingServer)
 
-__all__ = ["ApiHandle", "ContinuousClient", "DistributedServingServer",
+__all__ = ["ApiHandle", "AutoscalePolicy", "Autoscaler", "CapacityArbiter",
+           "ContinuousClient", "DistributedServingServer",
            "LLMServer",
            "MultiPipelineServer", "NoHealthyReplicaError", "PipelineServer",
-           "ReplicaRouter", "ServingReply", "ServingRequest",
-           "ServingServer", "exchange_routing_table", "probe_replica"]
+           "ReplicaRouter", "ScaleDecision", "ServingReplicaSet",
+           "ServingReply", "ServingRequest",
+           "ServingServer", "SupervisorPool", "exchange_routing_table",
+           "probe_replica", "sloz_signals"]

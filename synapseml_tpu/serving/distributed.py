@@ -312,6 +312,14 @@ class ReplicaRouter:
         with self._lock:
             return dict(self._status)
 
+    def warming_count(self) -> int:
+        """Replicas last probed WARMING — capacity already in flight
+        (the compile plane is AOT-warming a resized-in replica), which
+        the autoscaler must count against demand instead of growing
+        again while the previous grow is still becoming useful."""
+        with self._lock:
+            return sum(1 for s in self._status.values() if s == WARMING)
+
     def breaker(self, rank: int):
         return self._breakers[rank]
 

@@ -74,8 +74,8 @@ from .registry import (DEFAULT_BUCKETS, SERVING_TOKEN_LATENCY_BUCKETS,
                        MetricsRegistry, bucket_quantile, get_registry)
 from .roofline import (ROOFLINE_BLOCK_KEYS, check_roofline_block,
                        paired_roofline, roofline_block)
-from .slo import (SLO_METRICS, SLOZ_SCHEMA, SloStore, SloWindow,
-                  WindowedCounter, WindowedHistogram, check_sloz,
+from .slo import (SLO_METRICS, SLOZ_SCHEMA, SLOZ_SCHEMA_VERSION, SloStore,
+                  SloWindow, WindowedCounter, WindowedHistogram, check_sloz,
                   get_slo_store)
 from .tracing import (RequestTraceStore, Span, Tracer, get_request_tracer,
                       get_tracer, mint_trace_id, span)
@@ -87,7 +87,8 @@ __all__ = [
     "Span", "Tracer", "get_tracer", "span",
     "RequestTraceStore", "get_request_tracer", "mint_trace_id",
     "SloStore", "SloWindow", "WindowedCounter", "WindowedHistogram",
-    "check_sloz", "get_slo_store", "SLOZ_SCHEMA", "SLO_METRICS",
+    "check_sloz", "get_slo_store", "SLOZ_SCHEMA", "SLOZ_SCHEMA_VERSION",
+    "SLO_METRICS",
     "render_prometheus", "render_json", "PROMETHEUS_CONTENT_TYPE",
     "SchemaError", "check_schema", "dumps_checked", "write_json",
     "read_json",

@@ -74,6 +74,10 @@ GANG_METRICS = frozenset({
     "postmortem_bundles_total", "train_step_seconds", "train_steps_total",
     "serving_replica_probe_status", "train_step_bytes_per_sample",
     "train_step_mfu",
+    # live gang shape (registered by parallel.supervisor): the rank
+    # count the autoscaler's CapacityArbiter and operators read from
+    # /metrics instead of scraping resize_history
+    "gang_world_size",
     # serving-plane speculative-decode metrics (registered by
     # models.llm.SlotEngine): mirrored through this plane when serving
     # runs in a gang worker, and held to the same documentation bar by

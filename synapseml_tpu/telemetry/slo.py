@@ -49,7 +49,8 @@ from .registry import (SERVING_TOKEN_LATENCY_BUCKETS, SERVING_TTFT_BUCKETS,
                        bucket_quantile, get_registry)
 
 __all__ = ["WindowedHistogram", "WindowedCounter", "SloWindow", "SloStore",
-           "get_slo_store", "check_sloz", "SLOZ_SCHEMA", "SLO_METRICS",
+           "get_slo_store", "check_sloz", "SLOZ_SCHEMA",
+           "SLOZ_SCHEMA_VERSION", "SLO_METRICS",
            "DEFAULT_WINDOW_S", "DEFAULT_SLICES"]
 
 #: default sliding-window length (seconds) and slice count — six 10 s
@@ -59,7 +60,16 @@ DEFAULT_WINDOW_S = 60.0
 DEFAULT_SLICES = 6
 
 #: required top-level keys of a ``/sloz`` snapshot
-SLOZ_SCHEMA = ("generated_unix", "window_s", "planes")
+SLOZ_SCHEMA = ("schema_version", "generated_unix", "window_s", "planes")
+
+#: the ``/sloz`` contract version every snapshot is stamped with.  The
+#: unversioned PR-13 payload is retroactively version 1; version 2 is
+#: the first STAMPED shape (identical fields plus the stamp itself).
+#: Bump on any change to the plane-block layout — ``check_sloz``
+#: rejects a mismatched stamp, so a consumer built against this module
+#: (the autoscaler is the second consumer after ``/sloz`` itself) can
+#: never silently misread a snapshot from a different contract era.
+SLOZ_SCHEMA_VERSION = 2
 
 #: SLO-plane metric names (the metric-hygiene sweep holds every one of
 #: these to the docs bar, like GANG_METRICS)
@@ -402,7 +412,8 @@ class SloStore:
         lengths = {w.window_s for w in windows}
         common = (lengths.pop() if len(lengths) == 1
                   else DEFAULT_WINDOW_S if not lengths else None)
-        return {"generated_unix": time.time(),
+        return {"schema_version": SLOZ_SCHEMA_VERSION,
+                "generated_unix": time.time(),
                 "window_s": common,
                 "planes": {w.name: w.snapshot() for w in windows}}
 
@@ -426,6 +437,12 @@ def check_sloz(obj: Any) -> None:
     for key in SLOZ_SCHEMA:
         if key not in obj:
             raise ValueError(f"sloz snapshot missing key {key!r}")
+    version = obj["schema_version"]
+    if version != SLOZ_SCHEMA_VERSION:
+        raise ValueError(
+            f"sloz schema_version {version!r} unsupported (this consumer "
+            f"speaks version {SLOZ_SCHEMA_VERSION}); refusing to guess at "
+            "a foreign contract era")
     if not isinstance(obj["planes"], dict):
         raise ValueError("sloz planes must be a dict")
 

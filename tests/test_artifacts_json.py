@@ -103,6 +103,38 @@ LLMSERVE_WARMUP_REQUIRED = (
     "llmserve_warmup_cache_second_hits",
 )
 
+#: the SLO-driven autoscaler sweep (ISSUE 16): a record carrying ANY
+#: ``autoscale_`` key must carry the whole paired set — autoscaled AND
+#: static-provisioned attainment + chip-seconds over the same trace
+#: (with the savings they imply), the decision-mix counters with the
+#: flight-recorded count that must back them, and the chip-budget
+#: arbiter block (yield/reclaim moves, final training shape, the
+#: durable-step and zero-drop honesty bits) — so a partially-failed
+#: autoscale leg cannot ship a chip-savings claim without its static
+#: anchor or an arbiter claim without its loss accounting
+AUTOSCALE_REQUIRED = (
+    "autoscale_requests",
+    "autoscale_attainment",
+    "autoscale_shed_requests",
+    "autoscale_chip_seconds",
+    "autoscale_peak_replicas",
+    "autoscale_grow_decisions",
+    "autoscale_shrink_decisions",
+    "autoscale_hold_decisions",
+    "autoscale_flight_decisions",
+    "autoscale_static_attainment",
+    "autoscale_static_chip_seconds",
+    "autoscale_chip_savings_pct",
+    "autoscale_trace_seconds",
+    "autoscale_arbiter_total_chips",
+    "autoscale_arbiter_yields",
+    "autoscale_arbiter_reclaims",
+    "autoscale_arbiter_training_final_ranks",
+    "autoscale_arbiter_training_state_ok",
+    "autoscale_arbiter_serving_answered",
+    "autoscale_arbiter_serving_dropped",
+)
+
 LLMSERVE_SPEC_REQUIRED = (
     "llmserve_spec_tokens_per_sec",
     "llmserve_spec_tokens_per_step",
@@ -251,6 +283,22 @@ def test_llmserve_warmup_fields_complete():
         missing = [k for k in LLMSERVE_WARMUP_REQUIRED if k not in rec]
         assert not missing, (
             f"{name}: incomplete llmserve_warmup block: {missing}")
+
+
+def test_autoscale_fields_complete():
+    """ISSUE 16: a record carrying any ``autoscale_`` field (the
+    autoscaled-vs-static serving pair + the chip-budget arbiter block)
+    carries the WHOLE set, each numeric or null."""
+    for name, rec in _bench_records():
+        scale_keys = [k for k in rec if k.startswith("autoscale_")]
+        if not scale_keys or _labeled_partial(rec):
+            continue
+        missing = [k for k in AUTOSCALE_REQUIRED if k not in rec]
+        assert not missing, f"{name}: incomplete autoscale block: {missing}"
+        bad = [k for k in scale_keys
+               if rec[k] is not None
+               and not isinstance(rec[k], (int, float))]
+        assert not bad, f"{name}: non-numeric autoscale fields: {bad}"
 
 
 def test_llmserve_trace_pair_complete():

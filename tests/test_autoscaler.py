@@ -1,0 +1,540 @@
+"""SLO-driven autoscaler tests: the decision table under injectable
+clocks (zero real sleeps), the chip-budget arbiter's yield/reclaim
+accounting, the resize-validation and world-size-gauge satellites, the
+``/sloz`` schema_version handshake, and the zero-drop pin across a
+controller-initiated shrink (the PR-7 router harness, driven by
+:class:`ServingReplicaSet` this time)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from synapseml_tpu.parallel.supervisor import GangSupervisor
+from synapseml_tpu.serving import (AutoscalePolicy, Autoscaler,
+                                   CapacityArbiter, ReplicaRouter,
+                                   ServingReplicaSet, SupervisorPool,
+                                   sloz_signals)
+from synapseml_tpu.telemetry import get_registry
+from synapseml_tpu.telemetry.slo import (SLOZ_SCHEMA_VERSION, SloStore,
+                                         check_sloz)
+
+
+# ---------------------------------------------------------------------------
+# synthetic /sloz feeds + fake actuators
+# ---------------------------------------------------------------------------
+
+def make_sloz(burn=None, shed=0.0, occ=0.5, samples=10, planes=1):
+    """A check_sloz-valid snapshot with the decision inputs pinned."""
+    def plane():
+        sig = {"count": samples, "mean_s": 0.1, "p50_s": 0.1,
+               "p95_s": 0.2, "p99_s": 0.3}
+        slo = {}
+        if burn is not None:
+            slo["ttft"] = {"threshold_s": 0.5, "target": 0.95,
+                           "attainment": max(0.0, 1.0 - 0.05 * burn),
+                           "burn_rate": burn}
+        return {"window_s": 60.0, "slices": 6,
+                "signals": {"ttft": dict(sig), "token_latency": dict(sig)},
+                "occupancy": {"mean": occ, "samples": samples},
+                "rates": {"admitted_per_s": 1.0, "shed_per_s": shed,
+                          "retired_per_s": 1.0, "shed_ratio": shed},
+                "slo": slo}
+    snap = {"schema_version": SLOZ_SCHEMA_VERSION, "generated_unix": 0.0,
+            "window_s": 60.0,
+            "planes": {f"p{i}": plane() for i in range(planes)}}
+    check_sloz(snap)
+    return snap
+
+
+class FakePool:
+    def __init__(self, n=2, warming=0):
+        self.n, self.warming, self.calls = n, warming, []
+
+    def replica_count(self):
+        return self.n
+
+    def warming_count(self):
+        return self.warming
+
+    def grow(self, k=1):
+        self.n += k
+        self.calls.append(("grow", k))
+        return self.n
+
+    def shrink(self, k=1):
+        self.n -= k
+        self.calls.append(("shrink", k))
+        return self.n
+
+
+class FakeGang:
+    """The arbiter-facing supervisor duck-type: resize applies
+    instantly and listeners see the applied event."""
+
+    def __init__(self, world_size=3, min_ranks=1):
+        self.world_size = world_size
+        self.min_ranks = min_ranks
+        self.resizes = []
+        self._listeners = []
+
+    def resize(self, n):
+        if n < 1 or n < self.min_ranks:
+            raise ValueError(f"resize({n}) below min_ranks={self.min_ranks}")
+        self.resizes.append(n)
+        old, self.world_size = self.world_size, n
+        for fn in self._listeners:
+            fn({"from": old, "to": n, "cause": "resize_request"})
+
+    def add_resize_listener(self, fn):
+        self._listeners.append(fn)
+
+
+def scaler(pool, feed, arbiter=None, **policy_kw):
+    """An Autoscaler on a list-of-snapshots feed (last entry repeats)
+    and a policy tuned for deterministic single-digit-poll tests."""
+    policy_kw.setdefault("sustain_polls", 2)
+    policy_kw.setdefault("grow_cooldown_s", 10.0)
+    policy_kw.setdefault("shrink_cooldown_s", 10.0)
+    feed = list(feed)
+    state = {"i": 0}
+
+    def source():
+        snap = feed[min(state["i"], len(feed) - 1)]
+        state["i"] += 1
+        if isinstance(snap, Exception):
+            raise snap
+        return snap
+
+    return Autoscaler(pool, source=source,
+                      policy=AutoscalePolicy(**policy_kw),
+                      arbiter=arbiter, name="t-scale",
+                      clock=lambda: 0.0)
+
+
+# ---------------------------------------------------------------------------
+# the decision table (injectable clock, zero sleeps)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scale
+class TestDecisionTable:
+    def test_grow_on_sustained_shed(self):
+        pool = FakePool(n=2)
+        a = scaler(pool, [make_sloz(shed=0.2)])
+        assert a.poll_once(now=0.0).verdict == "hold"      # 1/2 sustained
+        d = a.poll_once(now=1.0)
+        assert (d.verdict, d.target) == ("grow", 3)
+        assert pool.calls == [("grow", 1)]
+
+    def test_grow_on_burn_over_one(self):
+        pool = FakePool(n=2)
+        a = scaler(pool, [make_sloz(burn=2.0)])
+        a.poll_once(now=0.0)
+        assert a.poll_once(now=1.0).verdict == "grow"
+
+    def test_one_hot_window_is_noise(self):
+        """A single bursty window must not resize anything: the steady
+        poll that follows resets the pressure streak."""
+        pool = FakePool(n=2)
+        a = scaler(pool, [make_sloz(shed=0.5), make_sloz(occ=0.6)])
+        for t in range(5):
+            a.poll_once(now=float(t))
+        assert pool.calls == []
+
+    def test_shrink_on_sustained_idle_occupancy(self):
+        pool = FakePool(n=3)
+        a = scaler(pool, [make_sloz(burn=0.1, occ=0.05)])
+        a.poll_once(now=0.0)
+        d = a.poll_once(now=1.0)
+        assert (d.verdict, d.target) == ("shrink", 2)
+
+    def test_hysteresis_band_holds(self):
+        """Idle occupancy but burn between the bands (shrink < burn <
+        grow): the controller parks at hold — attainment oscillating
+        around the objective never flaps the pool."""
+        pool = FakePool(n=3)
+        a = scaler(pool, [make_sloz(burn=0.7, occ=0.05)])
+        for t in range(6):
+            d = a.poll_once(now=float(t))
+            assert d.verdict == "hold"
+        assert "hysteresis" in d.reason and pool.calls == []
+
+    def test_grow_cooldown(self):
+        pool = FakePool(n=2)
+        a = scaler(pool, [make_sloz(shed=0.2)], sustain_polls=1)
+        assert a.poll_once(now=0.0).verdict == "grow"
+        assert a.poll_once(now=1.0).reason == "grow_cooldown"
+        assert a.poll_once(now=11.0).verdict == "grow"     # cooldown over
+
+    def test_shrink_cooldown(self):
+        pool = FakePool(n=4)
+        a = scaler(pool, [make_sloz(burn=0.1, occ=0.05)], sustain_polls=1)
+        assert a.poll_once(now=0.0).verdict == "shrink"
+        assert a.poll_once(now=1.0).reason == "shrink_cooldown"
+        assert a.poll_once(now=11.0).verdict == "shrink"
+
+    def test_warming_replica_is_capacity_in_flight(self):
+        """PR-15 readyz semantics: a warming replica means the previous
+        grow is still compiling toward useful — hold, don't stack
+        another grow on top of it."""
+        pool = FakePool(n=2, warming=1)
+        a = scaler(pool, [make_sloz(shed=0.3)], sustain_polls=1)
+        d = a.poll_once(now=0.0)
+        assert d.verdict == "hold" and d.reason.startswith("warming")
+        pool.warming = 0
+        assert a.poll_once(now=1.0).verdict == "grow"
+
+    def test_resize_budget_exhausts(self):
+        pool = FakePool(n=2)
+        a = scaler(pool, [make_sloz(shed=0.2)], sustain_polls=1,
+                   max_resizes=1, grow_cooldown_s=0.5)
+        assert a.poll_once(now=0.0).verdict == "grow"
+        d = a.poll_once(now=5.0)
+        assert d.verdict == "hold" and d.reason.startswith("budget_spent")
+
+    def test_min_max_clamps(self):
+        pool = FakePool(n=4)
+        a = scaler(pool, [make_sloz(shed=0.2)], sustain_polls=1,
+                   max_replicas=4)
+        assert a.poll_once(now=0.0).reason == "at_max: 4 replicas"
+        pool2 = FakePool(n=1)
+        b = scaler(pool2, [make_sloz(burn=0.1, occ=0.01)], sustain_polls=1)
+        assert b.poll_once(now=0.0).reason == "at_min: 1 replicas"
+
+    def test_empty_windows_hold_and_reset_streaks(self):
+        pool = FakePool(n=2)
+        a = scaler(pool, [make_sloz(shed=0.2), make_sloz(samples=0),
+                          make_sloz(shed=0.2)])
+        a.poll_once(now=0.0)                                # pressure 1/2
+        assert a.poll_once(now=1.0).reason.startswith("no_data")
+        d = a.poll_once(now=2.0)                            # back to 1/2
+        assert d.verdict == "hold" and "1/2" in d.reason
+
+    def test_broken_source_is_recorded_verdict(self):
+        pool = FakePool(n=2)
+        a = scaler(pool, [RuntimeError("socket down")])
+        d = a.poll_once(now=0.0)
+        assert d.verdict == "error" and "socket down" in d.reason
+        assert pool.calls == []
+
+    def test_foreign_schema_version_refused_at_the_door(self):
+        snap = make_sloz(shed=0.5)
+        snap["schema_version"] = 99
+        d = scaler(FakePool(), [snap]).poll_once(now=0.0)
+        assert d.verdict == "error" and "schema_version" in d.reason
+
+    def test_every_decision_flight_recorded_with_sloz(self, fault_registry):
+        from synapseml_tpu.telemetry.flight import get_flight
+        fault_registry.record_calls = True
+        snap = make_sloz(shed=0.2)
+        a = scaler(FakePool(n=2), [snap], sustain_polls=1)
+        a.poll_once(now=0.0)
+        evs = [e for e in get_flight().events()
+               if e["kind"] == "autoscale_decide"
+               and e.get("scaler") == "t-scale"]
+        assert evs and evs[-1]["verdict"] == "grow"
+        assert evs[-1]["sloz"]["schema_version"] == SLOZ_SCHEMA_VERSION
+        assert evs[-1]["sloz"]["planes"] == snap["planes"]
+        notes = fault_registry.calls_for("autoscale.decide")
+        assert notes and notes[-1]["verdict"] == "grow"
+        assert notes[-1]["sloz"] is snap
+
+    def test_decisions_ring_and_metrics(self):
+        a = scaler(FakePool(n=2), [make_sloz(occ=0.6)])
+        c = get_registry().counter("autoscale_decisions_total", "",
+                                   ("scaler", "verdict"))
+        before = c.value(scaler="t-scale", verdict="hold")
+        a.poll_once(now=0.0)
+        assert c.value(scaler="t-scale", verdict="hold") == before + 1
+        g = get_registry().gauge("autoscale_replicas", "", ("scaler",))
+        assert g.value(scaler="t-scale") == 2
+        assert a.decisions[-1].reason == "steady"
+
+    def test_policy_rejects_flappy_bands(self):
+        with pytest.raises(ValueError, match="hysteresis"):
+            AutoscalePolicy(burn_shrink=1.0, burn_grow=1.0)
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+    def test_sloz_signals_worst_case_across_planes(self):
+        snap = make_sloz(burn=0.3, shed=0.0, occ=0.8, planes=1)
+        hot = make_sloz(burn=2.0, shed=0.1, occ=0.1)["planes"]["p0"]
+        snap["planes"]["hot"] = hot
+        sig = sloz_signals(snap)
+        assert sig["max_burn"] == 2.0 and sig["max_shed"] == 0.1
+        assert sig["min_occupancy"] == 0.1 and sig["planes"] == 2
+
+
+# ---------------------------------------------------------------------------
+# /sloz schema_version handshake (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scale
+class TestSlozSchemaVersion:
+    def test_snapshot_stamps_version(self):
+        store = SloStore()
+        store.window("api", window_s=60.0)
+        snap = store.snapshot()
+        assert snap["schema_version"] == SLOZ_SCHEMA_VERSION
+        check_sloz(snap)
+
+    def test_check_sloz_rejects_unstamped_v1_payload(self):
+        snap = make_sloz()
+        del snap["schema_version"]
+        with pytest.raises(ValueError, match="schema_version"):
+            check_sloz(snap)
+
+    def test_check_sloz_rejects_foreign_version(self):
+        snap = make_sloz()
+        snap["schema_version"] = SLOZ_SCHEMA_VERSION + 1
+        with pytest.raises(ValueError, match="unsupported"):
+            check_sloz(snap)
+
+
+# ---------------------------------------------------------------------------
+# gang satellites: world-size gauge + resize validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scale
+class TestGangSatellites:
+    def _sup(self, **kw):
+        kw.setdefault("n_processes", 4)
+        kw.setdefault("min_ranks", 2)
+        return GangSupervisor("mp_tasks:never_runs", **kw)
+
+    def test_world_size_gauge_live(self):
+        g = get_registry().gauge("gang_world_size", "", ("task",))
+        sup = self._sup()
+        assert g.value(task="mp_tasks:never_runs") == 4
+        sup._apply_resize(0, 3, cause="exit", automatic=True)
+        assert g.value(task="mp_tasks:never_runs") == 3
+
+    def test_resize_rejects_nonpositive(self):
+        sup = self._sup()
+        for n in (0, -2):
+            with pytest.raises(ValueError, match="at least one rank"):
+                sup.resize(n)
+
+    def test_resize_rejects_below_floor(self):
+        with pytest.raises(ValueError, match="elastic floor"):
+            self._sup().resize(1)
+
+    def test_resize_listener_sees_applied_event(self):
+        sup = self._sup()
+        seen = []
+        sup.add_resize_listener(seen.append)
+        sup._apply_resize(0, 3, cause="exit", automatic=True)
+        assert seen and (seen[-1]["from"], seen[-1]["to"]) == (4, 3)
+
+
+# ---------------------------------------------------------------------------
+# the chip-budget arbiter
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scale
+class TestCapacityArbiter:
+    def _arb(self, total=4, gang=None, preferred=3, floor=1, **kw):
+        kw.setdefault("reclaim_after_s", 5.0)
+        arb = CapacityArbiter(total, name="t-arb", **kw)
+        if gang is not None:
+            arb.attach_training(gang, preferred_ranks=preferred,
+                                min_ranks=floor)
+        return arb
+
+    def test_free_pool_serves_first(self):
+        gang = FakeGang(world_size=2)
+        arb = self._arb(total=4, gang=gang, preferred=2)
+        arb.register_serving(1)
+        assert arb.acquire_serving(1, now=0.0)    # free chip available
+        assert gang.resizes == []                 # training untouched
+        assert (arb.serving_chips(), arb.free_chips()) == (2, 0)
+
+    def test_training_yields_under_pressure(self):
+        gang = FakeGang(world_size=3)
+        arb = self._arb(total=4, gang=gang)
+        arb.register_serving(1)                   # 1 + 3 = 4: no free
+        assert arb.acquire_serving(1, now=0.0)
+        assert gang.resizes == [2]                # one rank yielded
+        assert arb.training_chips() == 2 and arb.serving_chips() == 2
+
+    def test_floor_blocks_yield(self):
+        gang = FakeGang(world_size=2, min_ranks=2)
+        arb = self._arb(total=3, gang=gang, preferred=2, floor=2)
+        arb.register_serving(1)
+        assert not arb.acquire_serving(1, now=0.0)
+        assert gang.resizes == [] and arb.serving_chips() == 1
+
+    def test_reclaim_gated_until_quiet(self):
+        gang = FakeGang(world_size=3)
+        arb = self._arb(total=4, gang=gang, reclaim_after_s=5.0)
+        arb.register_serving(1)
+        arb.acquire_serving(1, now=0.0)           # yield 3 -> 2
+        arb.release_serving(1, now=1.0)           # serving shrank back
+        assert arb.reclaim(now=2.0) == 0          # pressure 2s ago: gated
+        assert arb.reclaim(now=6.0) == 1          # quiet 6s: reclaim
+        assert gang.resizes == [2, 3]
+        assert arb.training_chips() == 3 and arb.free_chips() == 0
+
+    def test_reclaim_without_free_chips_is_noop(self):
+        gang = FakeGang(world_size=3)
+        arb = self._arb(total=4, gang=gang)
+        arb.register_serving(1)
+        arb.acquire_serving(1, now=0.0)           # yielded; zero free
+        assert arb.reclaim(now=100.0) == 0
+        assert gang.world_size == 2
+
+    def test_listener_reconciles_failure_shrink(self):
+        """A gang resize the arbiter did NOT request (shrink-to-survive)
+        returns its chips to the free pool instead of leaking them."""
+        gang = FakeGang(world_size=3)
+        arb = self._arb(total=4, gang=gang)
+        gang.resize(2)                            # failure-driven shrink
+        assert arb.training_chips() == 2 and arb.free_chips() == 2
+
+    def test_gauges_track_sides(self):
+        gang = FakeGang(world_size=3)
+        arb = self._arb(total=4, gang=gang)
+        arb.register_serving(1)
+        g = get_registry().gauge("autoscale_chips", "",
+                                 ("arbiter", "side"))
+        assert g.value(arbiter="t-arb", side="serving") == 1
+        assert g.value(arbiter="t-arb", side="training") == 3
+        assert g.value(arbiter="t-arb", side="free") == 0
+
+    def test_autoscaler_holds_when_arbiter_denies(self):
+        gang = FakeGang(world_size=2, min_ranks=2)
+        arb = self._arb(total=3, gang=gang, preferred=2, floor=2)
+        arb.register_serving(1)
+        pool = FakePool(n=1)
+        a = scaler(pool, [make_sloz(shed=0.3)], arbiter=arb,
+                   sustain_polls=1)
+        d = a.poll_once(now=0.0)
+        assert d.verdict == "hold" and d.reason.startswith("no_chips")
+        assert pool.calls == []
+
+    def test_autoscaler_grow_and_shrink_move_chips(self):
+        gang = FakeGang(world_size=3)
+        arb = self._arb(total=4, gang=gang, reclaim_after_s=5.0)
+        arb.register_serving(1)
+        pool = FakePool(n=1)
+        a = scaler(pool, [make_sloz(shed=0.3), make_sloz(shed=0.3),
+                          make_sloz(burn=0.1, occ=0.05)],
+                   sustain_polls=1, arbiter=arb, shrink_cooldown_s=0.0)
+        assert a.poll_once(now=0.0).verdict == "grow"      # training yields
+        assert arb.serving_chips() == 2 and gang.world_size == 2
+        assert a.poll_once(now=1.0).reason == "grow_cooldown"
+        assert a.poll_once(now=2.0).verdict == "shrink"    # chips released
+        assert arb.serving_chips() == 1
+        assert a.poll_once(now=20.0).verdict in ("hold", "shrink")
+        assert gang.world_size == 3                        # reclaimed
+
+
+# ---------------------------------------------------------------------------
+# pools: SupervisorPool plumbing + zero-drop ServingReplicaSet shrink
+# ---------------------------------------------------------------------------
+
+@pytest.mark.scale
+class TestSupervisorPool:
+    def test_resize_plumbs_through_and_refreshes(self):
+        gang = FakeGang(world_size=3)
+        refreshed = []
+        pool = SupervisorPool(gang, refresh_fn=lambda: refreshed.append(1))
+        assert pool.replica_count() == 3
+        assert pool.grow(1) == 4 and gang.world_size == 4
+        assert pool.shrink(2) == 2 and gang.world_size == 2
+        assert len(refreshed) == 2
+
+    def test_warming_from_router(self):
+        class R:
+            def warming_count(self):
+                return 2
+        assert SupervisorPool(FakeGang(), router=R()).warming_count() == 2
+        assert SupervisorPool(FakeGang()).warming_count() == 0
+
+
+class _EchoReplica:
+    """A live ServingServer + reply thread, shaped for the pool's
+    replica duck-type (address / health / drain / close)."""
+
+    def __init__(self, i):
+        from synapseml_tpu.serving import ServingReply, ServingServer
+        self.i = i
+        self.server = ServingServer()
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.is_set():
+                for req in self.server.get_batch(max_rows=8, timeout_s=0.05):
+                    self.server.reply(req.id, ServingReply(
+                        200, json.dumps({"replica": i}).encode()))
+
+        self._t = threading.Thread(target=loop, daemon=True)
+        self._t.start()
+
+    @property
+    def address(self):
+        return self.server.address
+
+    @property
+    def health(self):
+        return self.server.health
+
+    def drain(self, timeout_s=10.0):
+        return self.server.drain(timeout_s=timeout_s)
+
+    def close(self):
+        self._stop.set()
+        self.server.close()
+
+
+@pytest.mark.scale
+@pytest.mark.elastic
+class TestControllerShrinkZeroDrop:
+    def test_controller_shrink_drops_nothing(self):
+        """The PR-7 pin, re-run with the CONTROLLER pulling the
+        trigger: ServingReplicaSet.shrink removes the departing address
+        from the routing table first, then drains — every issued
+        request is answered and no post-shrink route names the departed
+        replica."""
+        counter = iter(range(100))
+        pool = ServingReplicaSet(lambda: _EchoReplica(next(counter)),
+                                 drain_timeout_s=10.0)
+        try:
+            pool.grow(3)
+            router = ReplicaRouter(pool.addresses(), name="t-ctl-shrink")
+            pool.router = router
+            departed_addr = pool.addresses()[-1]
+            answered, routed_after = [], []
+            shrunk = threading.Event()
+            for k in range(60):
+                rank, url = router.route()
+                if shrunk.is_set():
+                    routed_after.append(url)
+                body = json.dumps({"x": k}).encode()
+                rep = urllib.request.urlopen(urllib.request.Request(
+                    url, data=body), timeout=10)
+                answered.append(json.loads(rep.read())["replica"])
+                router.report(rank, ok=True)
+                if k == 20:
+                    assert pool.shrink(1) == 2
+                    shrunk.set()
+            assert len(answered) == 60            # zero dropped exchanges
+            host = "http://" + ":".join(map(str, departed_addr)) \
+                if isinstance(departed_addr, tuple) else str(departed_addr)
+            assert all(host not in u for u in routed_after)
+            assert pool.replica_count() == 2
+        finally:
+            pool.close()
+
+    def test_warming_count_reads_health_in_process(self):
+        pool = ServingReplicaSet(lambda: _EchoReplica(99))
+        try:
+            pool.grow(1)
+            assert pool.warming_count() == 0      # no compile plane: warm
+            replica = pool.replicas()[0]
+            replica.health.set_warmup(lambda: {"state": "warming"})
+            assert pool.warming_count() == 1
+            replica.health.set_warmup(None)
+        finally:
+            pool.close()

@@ -718,6 +718,16 @@ class TestMetricHygiene:
         missing = sorted(n for n in SLO_METRICS if n not in docs)
         assert not missing, f"SLO-plane metrics absent from docs: {missing}"
 
+    def test_every_autoscale_metric_is_documented(self):
+        """ISSUE 16: the autoscaler's metric names (decision counters,
+        replica/chip gauges, arbiter movement counters) are held to the
+        same docs bar as GANG_METRICS / SLO_METRICS."""
+        from synapseml_tpu.serving.autoscaler import AUTOSCALE_METRICS
+        docs = "\n".join(p.read_text(encoding="utf-8")
+                         for p in (REPO / "docs" / "api").glob("*.md"))
+        missing = sorted(n for n in AUTOSCALE_METRICS if n not in docs)
+        assert not missing, f"autoscale metrics absent from docs: {missing}"
+
     def test_registry_sees_no_duplicate_kind_at_runtime(self):
         """Importing the wired modules must not blow up on registration
         conflicts (the registry raises on kind/label mismatches)."""
