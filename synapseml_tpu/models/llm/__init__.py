@@ -12,6 +12,9 @@ from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     causal_lm_loss, init_cache, llama_from_pretrained,
                     rope_frequencies)
 from .drafter import NgramDrafter
+from .kvtier import (KVTIER_METRICS, ChecksumError, HostKVArena,
+                     RadixPrefixIndex, SessionJournal, SessionState,
+                     kvtier_metrics)
 from .pallas_attn import (ATTENTION_BACKENDS, PagedGeometry,
                           dense_read_bytes, paged_decode_attention,
                           paged_geometry, paged_read_bytes,
@@ -23,13 +26,16 @@ from .warmup import (CompilePlane, ProgramSpec, engine_jit_cache_size,
 
 __all__ = [
     "ATTENTION_BACKENDS",
-    "CompilePlane",
+    "ChecksumError", "CompilePlane",
+    "HostKVArena", "KVTIER_METRICS",
     "LLM_LOGICAL_RULES", "AdmitResult", "CausalAttention", "DecoderBlock",
     "LLMTransformer",
     "LlamaConfig", "LlamaModel", "NgramDrafter", "PagedGeometry",
     "ProgramSpec",
-    "RMSNorm", "SlotEngine",
+    "RMSNorm", "RadixPrefixIndex", "SessionJournal", "SessionState",
+    "SlotEngine",
     "StepEvent",
+    "kvtier_metrics",
     "apply_rope", "causal_lm_loss",
     "cast_params", "dense_read_bytes", "engine_jit_cache_size",
     "finetune_lm", "generate",

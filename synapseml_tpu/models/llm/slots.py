@@ -74,7 +74,9 @@ import numpy as np
 from jax import lax
 
 from ...telemetry import get_registry
+from ...telemetry.flight import record as _flight_record
 from .drafter import NgramDrafter
+from .kvtier import ChecksumError, RadixPrefixIndex, kvtier_metrics
 from .generate import sample_logits
 from .model import LlamaModel, init_cache
 from .pallas_attn import (dense_read_bytes, paged_geometry,
@@ -185,6 +187,19 @@ def _copy_prefix_jit(cache: Any, src: jnp.ndarray, dst: jnp.ndarray,
     return jax.tree.map(cp, cache)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _restore_span_jit(cache: Any, rows: Any, slot: jnp.ndarray):
+    """Write a host-restored K/V span (``rows`` — per-layer ``k``/``v``
+    of shape ``(bucket, kv_heads, d_head)``, padded to a prefill
+    bucket) into positions ``[0, bucket)`` of row ``slot``.  No mask:
+    the pad rows land at positions the junk-write invariant already
+    covers (>= the restored ``kv_len``, overwritten by the tail prefill
+    or never attendable)."""
+    def wr(c, r):
+        return lax.dynamic_update_slice(c, r[None], (slot, 0, 0, 0))
+    return jax.tree.map(wr, cache, rows)
+
+
 def _next_pow2(n: int) -> int:
     """Smallest power of two >= n — the ONE round-up behind the verify
     S bucket and the VMEM gate's widest-span pricing (they must agree,
@@ -212,6 +227,12 @@ def _verify_program_key(backend: str, s: int, nt: Optional[int]) -> str:
 def _prefill_program_key(pb: int) -> str:
     """Stable label for one compiled prefill-bucket program."""
     return f"prefill_b{pb}"
+
+
+def _restore_program_key(pb: int) -> str:
+    """Stable label for one compiled host-restore program (one per
+    prefill bucket — the restored span pads to the same grid)."""
+    return f"restore_b{pb}"
 
 
 @dataclasses.dataclass
@@ -262,7 +283,7 @@ class SlotEngine:
                  attention_backend: str = "auto", step_profiler=None,
                  spec_draft_len: int = 0, spec_ngram: int = 3,
                  spec_adapt: bool = True, trace_sink=None,
-                 warmup: str = "off"):
+                 warmup: str = "off", kv_arena=None):
         self.model = model
         self.variables = variables
         self.cfg = model.cfg
@@ -338,9 +359,18 @@ class SlotEngine:
         self._retired_at = np.full(n, -np.inf)             # reclaim recency
         self._max_new = np.zeros(n, np.int64)
         self._generated = np.zeros(n, np.int64)
-        # hashed prefix index: first-min_prefix-tokens hash -> slots
-        self._prefix_index: Dict[int, Set[int]] = {}
-        self._slot_hash: List[Optional[int]] = [None] * n
+        # radix prefix index over slot contexts: longest_prefix is
+        # exact by construction (tokens, not hashes), so reuse finds
+        # the TRUE longest match with no candidate probe and no
+        # first-min_prefix-tokens blind spot
+        self._radix = RadixPrefixIndex()
+        #: optional :class:`~synapseml_tpu.models.llm.kvtier
+        #: .HostKVArena` — when attached, ``_retire`` spills the slot's
+        #: live K/V span to host RAM and ``admit`` restores warm
+        #: conversations from it instead of recomputing prefill
+        #: (token-exact; every degraded path cold-prefills)
+        self.kv_arena = kv_arena
+        self._mkv = kvtier_metrics()
         # per-slot draft-length adaptation (AIMD over a rolling
         # acceptance EWMA): caps start at a cheap 2-token probe, DOUBLE
         # on a fully-accepted draft, HALVE when under half the draft
@@ -491,68 +521,54 @@ class SlotEngine:
         return self.spec_accepted / max(1, self.spec_drafted)
 
     # -- prefix reuse ------------------------------------------------------
-    def _prefix_key(self, ids: np.ndarray) -> Optional[int]:
-        if len(ids) < self.min_prefix:
-            return None
-        return hash(ids[:self.min_prefix].tobytes())
-
     def _register_prefix(self, slot: int, ids: np.ndarray) -> None:
-        self._unregister_prefix(slot)
-        key = self._prefix_key(ids)
-        if key is not None:
-            self._prefix_index.setdefault(key, set()).add(slot)
-            self._slot_hash[slot] = key
+        if len(ids) < self.min_prefix:
+            self._radix.remove(slot)
+        else:
+            self._radix.insert(ids, slot)
 
     def _unregister_prefix(self, slot: int) -> None:
-        key = self._slot_hash[slot]
-        if key is not None:
-            slots = self._prefix_index.get(key)
-            if slots is not None:
-                slots.discard(slot)
-                if not slots:
-                    self._prefix_index.pop(key, None)
-            self._slot_hash[slot] = None
+        self._radix.remove(slot)
+
+    def _clamp_reuse(self, lcp: int, total: int) -> int:
+        """Shrink a reuse length until the remaining tail's PADDED
+        prefill bucket fits inside ``max_len`` — without the clamp a
+        long reuse pushes ``start + bucket`` past the cache end and
+        ``dynamic_update_slice`` silently CLAMPS the write start,
+        corrupting the reused prefix K/V.  ``lcp == total`` (a full
+        restore, no tail to prefill) passes through untouched."""
+        if lcp >= total:
+            return min(lcp, total)
+        while lcp >= self.min_prefix \
+                and lcp + self._bucket(total - lcp) > self.max_len:
+            # terminates — lcp strictly decreases (the violated bound
+            # implies lcp > max_len - bucket)
+            lcp = self.max_len - self._bucket(total - lcp)
+        return max(0, lcp)
 
     def _best_prefix(self, prompt: np.ndarray,
                      dst: int) -> Tuple[Optional[int], int]:
         """Longest common prefix between ``prompt`` and any indexed
-        slot's context (hash-filtered candidates, then exact token
-        comparison — a collision can never smuggle wrong K/V).  Reuse is
-        capped at ``len(prompt) - 1``: the prefill must always run at
-        least one token to produce next-token logits.
+        slot's context — one radix walk, exact by construction (the
+        trie compares tokens, so no collision can smuggle wrong K/V
+        and no hash window hides a longer match).  Reuse is capped at
+        ``len(prompt) - 1``: the prefill must always run at least one
+        token to produce next-token logits.
 
         ``dst`` itself is a valid source — the multi-turn sweet spot
         where the reclaimed slot already holds the conversation's
         earlier turns: the K/V is already in place, so the admit skips
         the copy and just prefills the tail (``dst`` wins ties for
-        that reason).  The returned lcp is additionally clamped so the
-        tail's PADDED prefill bucket fits inside ``max_len`` — without
-        the clamp a long reuse pushes ``start + bucket`` past the cache
-        end and ``dynamic_update_slice`` silently CLAMPS the write
-        start, corrupting the reused prefix K/V."""
-        key = self._prefix_key(prompt)
-        if key is None:
+        that reason).  The returned lcp is additionally bucket-clamped
+        (:meth:`_clamp_reuse`)."""
+        src, lcp = self._radix.longest_prefix(prompt, prefer=dst)
+        if src is None:
             return None, 0
-        best_slot, best_lcp = None, 0
-        for s in self._prefix_index.get(key, ()):
-            m = int(min(self.kv_len[s], len(prompt) - 1))
-            if m < self.min_prefix:
-                continue
-            neq = self.ctx[s, :m] != prompt[:m]
-            lcp = m if not neq.any() else int(np.argmax(neq))
-            if lcp >= self.min_prefix and (
-                    lcp > best_lcp or (lcp == best_lcp and s == dst)):
-                best_slot, best_lcp = s, lcp
-        lcp = best_lcp
-        while lcp >= self.min_prefix \
-                and lcp + self._bucket(len(prompt) - lcp) > self.max_len:
-            # shrink until the padded tail fits; terminates — lcp
-            # strictly decreases (the violated bound implies
-            # lcp > max_len - bucket)
-            lcp = self.max_len - self._bucket(len(prompt) - lcp)
+        lcp = int(min(lcp, self.kv_len[src], len(prompt) - 1))
+        lcp = self._clamp_reuse(lcp, len(prompt))
         if lcp < self.min_prefix:
             return None, 0
-        return best_slot, lcp
+        return src, lcp
 
     # -- admission ---------------------------------------------------------
     def _pick_slot(self) -> Optional[int]:
@@ -596,9 +612,24 @@ class SlotEngine:
         slot = self._pick_slot()
         if slot is None:
             return None
+        t0 = time.perf_counter()
         src, lcp = self._best_prefix(prompt, slot)
-        if src is not None and lcp > 0:
-            if src != slot:
+        restored = False
+        if self.kv_arena is not None:
+            # host tier: a spilled span longer than any device-resident
+            # prefix restores instead (device reuse is free-er, so it
+            # wins ties); every failure here degrades to the device/
+            # cold path below — never a wrong token
+            akey, alcp = self.kv_arena.longest_prefix(prompt)
+            alcp = self._clamp_reuse(int(min(alcp, len(prompt) - 1)),
+                                     len(prompt))
+            if akey is not None and alcp >= self.min_prefix \
+                    and alcp > lcp:
+                restored = self._restore_from_arena(akey, alcp, slot)
+                if restored:
+                    src, lcp = None, alcp
+        if restored or (src is not None and lcp > 0):
+            if not restored and src != slot:
                 with self._program_region("prefix_copy"):
                     self.cache = _copy_prefix_jit(self.cache, src, slot,
                                                   lcp)
@@ -644,6 +675,9 @@ class SlotEngine:
         if finished:
             self._retire(slot, reason)
         self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
+        self._mkv.admit_latency.observe(
+            time.perf_counter() - t0, engine=self.name,
+            path="restore" if restored else "cold")
         return AdmitResult(slot, tok, finished, lcp, logits,
                            bucket=pb, reason=reason)
 
@@ -661,6 +695,151 @@ class SlotEngine:
         self._retired_at[slot] = time.monotonic()
         self.evictions += 1
         self._m_evict.inc(1, engine=self.name, reason=reason)
+        span = int(self.kv_len[slot])
+        if reason != "reset" and span >= self.min_prefix:
+            # re-index the slot under its FULL retired context (prompt
+            # + generated tokens) so a follow-up turn's longer prompt
+            # matches through the generated span, not just the prompt
+            self._register_prefix(slot, self.ctx[slot, :span])
+            if self.kv_arena is not None:
+                self._spill_slot(slot, span,
+                                 "preempt" if reason == "preempted"
+                                 else "retire")
+
+    def _spill_slot(self, slot: int, span: int, kind: str) -> None:
+        """Spill the slot's live K/V span to the host arena.  Never
+        breaks retirement: any failure (a donated-then-deleted cache
+        after a failed jit, host OOM) is flight-recorded and the spill
+        is simply lost — the conversation cold-prefills later."""
+        try:
+            rows = [{"k": np.asarray(jax.device_get(layer["k"][slot, :span])),
+                     "v": np.asarray(jax.device_get(layer["v"][slot, :span]))}
+                    for layer in self.cache]
+            self.kv_arena.put(self.ctx[slot, :span], rows, kind=kind)
+        except Exception as exc:  # noqa: BLE001 — spill is best-effort
+            _flight_record("kvtier_spill_failed", engine=self.name,
+                           slot=int(slot), error=repr(exc))
+
+    def _restore_from_arena(self, key: int, span: int, slot: int) -> bool:
+        """Restore ``span`` K/V rows of arena entry ``key`` into
+        ``slot``.  False on any degraded outcome (checksum failure,
+        entry evicted since the probe) — counted, flight-recorded, and
+        the caller falls back to cold prefill."""
+        try:
+            rows = self.kv_arena.fetch(key, span)
+        except ChecksumError:
+            self._mkv.restores.inc(1, engine=self.name, source="host",
+                                   outcome="corrupt")
+            _flight_record("kvtier_restore_corrupt", engine=self.name,
+                           key=int(key), tokens=int(span))
+            return False
+        except KeyError:
+            self._mkv.restores.inc(1, engine=self.name, source="host",
+                                   outcome="miss")
+            return False
+        b = self._bucket(span)
+        padded = []
+        for r in rows:
+            k = np.zeros((b,) + r["k"].shape[1:], r["k"].dtype)
+            v = np.zeros((b,) + r["v"].shape[1:], r["v"].dtype)
+            k[:span], v[:span] = r["k"], r["v"]
+            padded.append({"k": jnp.asarray(k), "v": jnp.asarray(v)})
+        with self._program_region(_restore_program_key(b)):
+            self.cache = _restore_span_jit(self.cache, padded, slot)
+        self._mkv.restores.inc(1, engine=self.name, source="host",
+                               outcome="ok")
+        return True
+
+    # -- preemption --------------------------------------------------------
+    def preempt_slot(self) -> Optional[int]:
+        """The lowest-near-term-value ACTIVE slot — the one with the
+        most remaining token budget (it frees capacity the longest and
+        its progress is cheapest to set aside).  None when idle."""
+        if not self.active.any():
+            return None
+        rem = np.where(self.active, self._max_new - self._generated, -1)
+        return int(np.argmax(rem))
+
+    def preempt(self, slot: int) -> Optional[Dict[str, Any]]:
+        """Evict an ACTIVE slot mid-decode: spill its K/V to the arena
+        (when attached) and return a resume ticket — the full context
+        (including the pending sampled-but-unfed token), the valid K/V
+        span, and the budget position.  :meth:`resume` continues the
+        sequence token-exactly; eviction is just retirement + spill,
+        resume is restore + continue (the primitive QoS preemption
+        rides)."""
+        if not self.active[slot]:
+            return None
+        ticket = {"ids": self.ctx[slot, :int(self.lengths[slot])].copy(),
+                  "kv_len": int(self.kv_len[slot]),
+                  "generated": int(self._generated[slot]),
+                  "max_new": int(self._max_new[slot])}
+        self._retire(slot, "preempted")
+        self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
+        if self._drafter is not None:
+            self._drafter.forget(slot)
+        return ticket
+
+    def resume(self, ticket: Dict[str, Any]) -> Optional[int]:
+        """Re-admit a preempted ticket into a free slot and continue
+        decoding exactly where it left off.  The K/V span is restored
+        from the host arena when possible, copied from a device-
+        resident prefix otherwise, and cold-prefilled as the last
+        resort — all three paths reproduce the identical K/V, so the
+        continuation is token-exact regardless.  Returns the slot, or
+        None when every slot is busy."""
+        ids = np.asarray(ticket["ids"], np.int32).reshape(-1)
+        span = int(ticket["kv_len"])
+        if len(ids) == 0 or span < 1 or span >= len(ids):
+            # the pending token ids[span] must exist past the K/V span
+            raise ValueError("malformed resume ticket")
+        slot = self._pick_slot()
+        if slot is None:
+            return None
+        est = 0
+        if self.kv_arena is not None and span >= self.min_prefix:
+            akey, alcp = self.kv_arena.longest_prefix(ids[:span])
+            alcp = self._clamp_reuse(int(min(alcp, span)), span)
+            if akey is not None and alcp >= self.min_prefix \
+                    and self._restore_from_arena(akey, alcp, slot):
+                est = alcp
+        if est == 0:
+            src, dlcp = self._radix.longest_prefix(ids[:span], prefer=slot)
+            if src is not None:
+                dlcp = self._clamp_reuse(
+                    int(min(dlcp, self.kv_len[src], span)), span)
+                if dlcp >= self.min_prefix:
+                    if src != slot:
+                        with self._program_region("prefix_copy"):
+                            self.cache = _copy_prefix_jit(
+                                self.cache, src, slot, dlcp)
+                    est = dlcp
+        if est < span:
+            # cold tail: rebuild K/V for ids[est:span]; the logits are
+            # discarded — the pending token (ids[span]) is already
+            # sampled and committed, we only need the rows
+            tail = ids[est:span]
+            pb = self._bucket(len(tail))
+            padded = np.full(pb, self.pad_id, np.int32)
+            padded[:len(tail)] = tail
+            with self._program_region(_prefill_program_key(pb)):
+                self.cache, _ = _prefill_slot_jit(
+                    self.model, self.variables, self.cache,
+                    jnp.asarray(padded), len(tail), slot, est)
+        ln = len(ids)
+        self.ctx[slot, :ln] = ids
+        self.lengths[slot] = ln
+        self.kv_len[slot] = span
+        self.active[slot] = True
+        self._max_new[slot] = int(ticket["max_new"])
+        self._generated[slot] = int(ticket["generated"])
+        self._register_prefix(slot, ids[:span])
+        if self._drafter is not None:
+            self._spec_k[slot] = self._spec_k0
+            self._spec_ewma[slot] = 1.0
+            self._drafter.begin(slot, self.ctx[slot], ln)
+        self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
+        return slot
 
     def cancel(self, slot: int) -> None:
         """Retire ``slot`` early (client gone / reply window expired) —
@@ -684,8 +863,7 @@ class SlotEngine:
         # prefix source anymore
         self.kv_len[:] = 0
         self.lengths[:] = 0
-        self._prefix_index.clear()
-        self._slot_hash = [None] * self.n_slots
+        self._radix.clear()
         if self._drafter is not None:
             for slot in range(self.n_slots):
                 self._drafter.forget(slot)

@@ -53,7 +53,8 @@ from ...telemetry import get_registry
 from .model import init_cache
 from .slots import (_copy_prefix_jit, _decode_program_key,
                     _decode_step_jit, _next_pow2, _prefill_program_key,
-                    _prefill_slot_jit, _verify_program_key,
+                    _prefill_slot_jit, _restore_program_key,
+                    _restore_span_jit, _verify_program_key,
                     _verify_step_jit)
 
 __all__ = ["CompilePlane", "ProgramSpec", "REGISTERED_ENTRY_POINTS",
@@ -66,7 +67,7 @@ __all__ = ["CompilePlane", "ProgramSpec", "REGISTERED_ENTRY_POINTS",
 REGISTERED_ENTRY_POINTS = {
     "synapseml_tpu.models.llm.slots": frozenset({
         "_prefill_slot_jit", "_decode_step_jit", "_verify_step_jit",
-        "_copy_prefix_jit"}),
+        "_copy_prefix_jit", "_restore_span_jit"}),
     "synapseml_tpu.models.llm.pallas_attn": frozenset({
         "paged_decode_attention"}),
 }
@@ -75,7 +76,8 @@ REGISTERED_ENTRY_POINTS = {
 #: pin sums (``paged_decode_attention`` populates a cache only when
 #: called at top level — tests do, serving never does)
 _ENGINE_ENTRY_POINTS = (_prefill_slot_jit, _decode_step_jit,
-                        _verify_step_jit, _copy_prefix_jit)
+                        _verify_step_jit, _copy_prefix_jit,
+                        _restore_span_jit)
 
 
 def jit_entry_points(module) -> Dict[str, Any]:
@@ -202,6 +204,25 @@ def program_lattice(engine) -> List[ProgramSpec]:
             return cache
         specs.append(ProgramSpec(_prefill_program_key(pb), "prefill",
                                  run_prefill))
+
+    if getattr(engine, "kv_arena", None) is not None:
+        # host-restore programs: one per prefill bucket (the restored
+        # span pads to the same grid).  Only an arena-attached engine
+        # can dispatch them, so a plain engine's lattice stays exactly
+        # as before.
+        cfg = engine.cfg
+        for pb in engine._buckets:
+            def run_restore(cache, pb=pb):
+                rows = [{"k": jnp.zeros((pb, cfg.num_kv_heads,
+                                         cfg.d_head), cfg.dtype),
+                         "v": jnp.zeros((pb, cfg.num_kv_heads,
+                                         cfg.d_head), cfg.dtype)}
+                        for _ in range(cfg.num_layers)]
+                cache = _restore_span_jit(cache, rows, 0)
+                jax.block_until_ready(jax.tree.leaves(cache)[0])
+                return cache
+            specs.append(ProgramSpec(_restore_program_key(pb), "restore",
+                                     run_restore))
     return specs
 
 

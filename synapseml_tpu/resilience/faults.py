@@ -34,6 +34,10 @@ Fault kinds:
                 whose registry rank matches the rule's ``rank`` — the
                 per-rank form of ``kill`` for gang tests
 ``slow_rank``   recorded sleep of ``delay`` seconds (a straggler rank)
+``corrupt``     deterministic byte-flip on a payload registered at a
+                :meth:`FaultRegistry.corrupt_point` site — silent
+                bit-rot for checksum/fallback paths (only fires at
+                corrupt points; other sites ignore the kind)
 ==============  ============================================================
 
 Rule grammar (``SML_FAULTS``, rules joined by ``;``)::
@@ -290,6 +294,29 @@ class FaultRegistry:
         if rule.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
         self._execute_raise(site, rule)
+
+    def corrupt_point(self, site: str, payload: bytes, **ctx) -> bytes:
+        """Payload-carrying site: returns ``payload``, byte-flipped when
+        a ``corrupt`` rule fires (deterministic offset per firing, so a
+        seeded chaos run corrupts the same bytes every time).  ``kill``
+        SIGKILLs here too — a corrupt point is also a kill point (die
+        with the payload unwritten); other raise kinds apply as usual."""
+        rule = self.check(site, **ctx)
+        if rule is None:
+            return payload
+        if rule.kind == "corrupt":
+            if not len(payload):
+                return payload
+            buf = bytearray(payload)
+            # Knuth-hash the firing ordinal into an offset: stable
+            # across runs, scattered across the payload
+            off = ((rule.fired - 1) * 2654435761 + 1) % len(buf)
+            buf[off] ^= 0xFF
+            return bytes(buf)
+        if rule.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._execute_raise(site, rule)
+        return payload
 
     def _execute_raise(self, site: str, rule: FaultRule) -> None:
         if rule.kind in ("slow", "slow_rank"):

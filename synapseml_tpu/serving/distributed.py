@@ -347,17 +347,23 @@ class ReplicaRouter:
         elastic resize), the session falls back to round-robin and
         RE-PINS to the replica it gets — a cold prefill, never a
         failure."""
-        rank, addr, url = self.route_addr(path, session=session)
+        rank, addr, url, _outcome = self.route_addr(path, session=session)
         return rank, url
 
     def route_addr(self, path: str = "/",
                    session: Optional[str] = None
-                   ) -> Tuple[int, Tuple[str, int], str]:
+                   ) -> Tuple[int, Tuple[str, int], str, str]:
         """:meth:`route` plus the routed ``(host, port)`` captured under
         the same lock — hand that address back to :meth:`report` and the
         report survives a concurrent :meth:`refresh` renumbering the
         table (no lossy re-parse of the url, no racy
-        ``router.table[rank]`` read)."""
+        ``router.table[rank]`` read) — plus the session-affinity
+        OUTCOME: ``"hit"`` (pinned replica still routable — its KV
+        prefix is warm), ``"miss"`` (first route for the session, or no
+        session), ``"repin"`` (the pinned replica was LOST — the
+        session's device prefix cache is gone, so the caller should
+        engage a restore path instead of silently serving
+        context-free)."""
         with self._lock:
             n = len(self.table)
             pinned = False
@@ -374,7 +380,7 @@ class ReplicaRouter:
                         self._sessions.move_to_end(session)
                         self._m_affinity.inc(1, router=self.name,
                                              outcome="hit")
-                        return r, addr, self.url_for(r, path)
+                        return r, addr, self.url_for(r, path), "hit"
             start = self._rr
             for i in range(n):
                 r = (start + i) % n
@@ -395,7 +401,8 @@ class ReplicaRouter:
                     self._m_affinity.inc(
                         1, router=self.name,
                         outcome="repin" if pinned else "miss")
-                return r, self.table[r], self.url_for(r, path)
+                return (r, self.table[r], self.url_for(r, path),
+                        "repin" if pinned else "miss")
             statuses = {
                 r: (self._status[r] if self._status[r] != HEALTHY
                     else f"breaker {self._breakers[r].state}")
@@ -496,32 +503,41 @@ class DistributedServingServer:
 
     def route_addr(self, path: str = "/",
                    session: Optional[str] = None
-                   ) -> Tuple[int, Tuple[str, int], str]:
+                   ) -> Tuple[int, Tuple[str, int], str, str]:
         """:meth:`route` plus the routed ``(host, port)`` — pass it back
         through :meth:`report_result`'s ``addr=`` so the report survives
-        a concurrent table refresh renumbering the ranks (see
-        :meth:`ReplicaRouter.route_addr`)."""
+        a concurrent table refresh renumbering the ranks — plus the
+        affinity outcome (see :meth:`ReplicaRouter.route_addr`)."""
         return self.router.route_addr(path, session=session)
 
     def route_request(self, path: str = "/",
                       session: Optional[str] = None,
                       trace_id: Optional[str] = None
-                      ) -> Tuple[int, Tuple[str, int], str, Dict[str, str]]:
+                      ) -> Tuple[int, Tuple[str, int], str,
+                                 Dict[str, str], str]:
         """:meth:`route_addr` plus request-trace propagation: mints a
         trace id at THIS hop when the caller has none, records the
         routing decision on the hop's flight recorder (trace id, rank,
-        session), and returns the headers to attach to the forwarded
-        request (``X-SML-Trace-Id``) — the replica's decode loop adopts
-        the id (propagated ids are always sampled), so a session-
-        affinity hop chain stays attributable end to end:
-        ``(rank, (host, port), url, headers)``."""
+        session, affinity outcome), and returns the headers to attach
+        to the forwarded request (``X-SML-Trace-Id``) — the replica's
+        decode loop adopts the id (propagated ids are always sampled),
+        so a session-affinity hop chain stays attributable end to end:
+        ``(rank, (host, port), url, headers, outcome)``.
+
+        ``outcome == "repin"`` is the failover-restore trigger: the
+        session's pinned replica is GONE and with it the device prefix
+        cache, so the caller marks the forwarded request ``resume`` —
+        the new replica rebuilds the conversation from its session
+        journal (or host arena) instead of silently serving it
+        context-free."""
         from ..telemetry.tracing import mint_trace_id
         from .server import TRACE_HEADER
         tid = trace_id or mint_trace_id()
-        rank, addr, url = self.router.route_addr(path, session=session)
+        rank, addr, url, outcome = self.router.route_addr(
+            path, session=session)
         flight_record("route", router=self.router.name, trace_id=tid,
-                      rank=rank, session=session)
-        return rank, addr, url, {TRACE_HEADER: tid}
+                      rank=rank, session=session, affinity=outcome)
+        return rank, addr, url, {TRACE_HEADER: tid}, outcome
 
     def probe_replicas(self) -> Dict[int, str]:
         return self.router.probe_all()

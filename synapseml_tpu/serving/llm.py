@@ -71,7 +71,26 @@ class LLMServer:
                  spec_draft_len: int = 0, spec_ngram: int = 3,
                  trace_sample_every: Optional[int] = None,
                  warmup: str = "off",
+                 kv_arena: Any = None,
+                 kv_arena_bytes: Optional[int] = None,
+                 journal: Any = None,
+                 journal_dir: Optional[str] = None,
                  engine_kwargs: Optional[Dict[str, Any]] = None):
+        # session survivability plane (docs/api/serving.md "Session
+        # survivability & KV tiering"): kv_arena / kv_arena_bytes
+        # attach a host-RAM KV spill tier to the engine (retired slots
+        # spill, warm conversations restore token-exactly instead of
+        # cold-prefilling); journal / journal_dir arm the fsync'd
+        # per-session journal so a killed replica's conversations
+        # resume token-exactly here via {"session", "resume"} requests
+        if kv_arena is None and kv_arena_bytes:
+            from ..models.llm.kvtier import HostKVArena
+            kv_arena = HostKVArena(int(kv_arena_bytes),
+                                   name=api_path.strip("/") or "llm")
+        if journal is None and journal_dir:
+            from ..models.llm.kvtier import SessionJournal
+            journal = SessionJournal(journal_dir,
+                                     name=api_path.strip("/") or "llm")
         if engine is None:
             from ..models.llm import SlotEngine
             engine = SlotEngine(model, variables, n_slots=n_slots,
@@ -81,8 +100,11 @@ class LLMServer:
                                 attention_backend=attention_backend,
                                 spec_draft_len=spec_draft_len,
                                 spec_ngram=spec_ngram, warmup=warmup,
+                                kv_arena=kv_arena,
                                 **(engine_kwargs or {}))
         self.engine = engine
+        self.kv_arena = getattr(engine, "kv_arena", kv_arena)
+        self.journal = journal
         self.tokenizer = tokenizer
         self.server = ServingServer(host, port, api_path,
                                     reply_timeout_s=reply_timeout_s,
@@ -104,12 +126,18 @@ class LLMServer:
             output_formatter=self._format,
             max_new_tokens_default=max_new_tokens_default,
             ttft_slo_s=ttft_slo_s, token_slo_s=token_slo_s,
-            trace_sample_every=trace_sample_every)
+            trace_sample_every=trace_sample_every,
+            journal=journal)
 
     # -- request/reply shaping --------------------------------------------
     def _parse(self, req: ServingRequest) -> Dict[str, Any]:
         body = req.json()
         if "ids" in body:
+            spec = dict(body)
+        elif body.get("resume") and body.get("session") is not None \
+                and self.journal is not None:
+            # failover resume: the prompt + committed tokens come from
+            # the session journal replay, not the request body
             spec = dict(body)
         elif "prompt" in body and self.tokenizer is not None:
             # budget prompt tokens against the engine window, leaving

@@ -52,6 +52,7 @@ from ..telemetry import (PROMETHEUS_CONTENT_TYPE, SERVING_TOKEN_LATENCY_BUCKETS,
                          SERVING_TTFT_BUCKETS, check_sloz, get_registry,
                          get_request_tracer, get_slo_store, render_json,
                          render_prometheus)
+from ..telemetry.flight import record as _flight_record
 
 #: request header (lower-cased, as the listener normalizes) carrying a
 #: propagated request trace id across serving hops; replies echo it
@@ -1177,6 +1178,19 @@ class _DecodeSeq:
     #: at least once (the compile_wait trace event fires on the first
     #: hold only)
     compile_waited: bool = False
+    #: conversation key for the session journal / affinity plane
+    session: Optional[str] = None
+    #: the sequence was rebuilt from a journal replay: ``ids`` is the
+    #: journaled prompt + committed tokens, ``tokens`` pre-seeded with
+    #: the committed tokens, ``max_new`` the REMAINING budget — the
+    #: admit prefills the whole context and the continuation is
+    #: token-exact with the interrupted turn
+    resumed: bool = False
+    #: the journal replay already holds the turn's FULL token budget
+    #: (the crash landed after the last token commit but before the
+    #: reply) — the replay IS the reply; admitting it would decode one
+    #: token past the requested budget
+    replay_complete: bool = False
 
 
 class _DecodeLoop:
@@ -1242,10 +1256,18 @@ class _DecodeLoop:
                  token_slo_s: Optional[float] = None,
                  idle_timeout_s: float = 0.02,
                  trace_sample_every: Optional[int] = None,
-                 request_tracer=None, slo_window=None):
+                 request_tracer=None, slo_window=None, journal=None):
         self.server = server
         self.api = api
         self.engine = engine
+        #: optional session journal (duck-typed on the
+        #: :class:`~synapseml_tpu.models.llm.kvtier.SessionJournal`
+        #: surface — ``begin``/``append_tokens``/``retire``/``replay``
+        #: + a public ``metrics``/``name``): every committed token is
+        #: journaled fsync-first, and a ``resume`` request replays the
+        #: journal so a killed replica's conversation continues
+        #: token-exactly on this one
+        self.journal = journal
         self.input_parser = input_parser
         self.output_formatter = output_formatter or (
             lambda ids: {"ids": [int(t) for t in ids]})
@@ -1337,8 +1359,11 @@ class _DecodeLoop:
         for req in batch:
             try:
                 spec = self.input_parser(req)
-                ids = [int(t) for t in spec["ids"]]
-                if not ids:
+                ids = [int(t) for t in spec.get("ids", [])]
+                session = spec.get("session")
+                resume = bool(spec.get("resume", False)) \
+                    and session is not None and self.journal is not None
+                if not ids and not resume:
                     raise ValueError("empty prompt")
                 max_new = int(spec.get("max_new_tokens",
                                        self.max_new_tokens_default))
@@ -1349,6 +1374,27 @@ class _DecodeLoop:
                 continue
             seq = _DecodeSeq(req, ids, max_new,
                              bool(spec.get("stream", False)))
+            if session is not None:
+                seq.session = str(session)
+            if resume:
+                self._try_resume(seq)
+                if not seq.ids:
+                    # replay found nothing usable and the request
+                    # carried no prompt of its own: there is nothing
+                    # token-exact OR cold to serve
+                    self._m_errors.inc(1, api=self.api.path, kind="parse")
+                    self._safe_reply(req.id, ServingReply(
+                        404, json.dumps(
+                            {"error": "resume: no journaled state for "
+                             "session"}).encode()))
+                    continue
+                if seq.replay_complete:
+                    payload = self.output_formatter(seq.tokens)
+                    self._safe_reply(req.id, ServingReply(
+                        200, json.dumps(payload).encode(),
+                        {"Content-Type": "application/json"}))
+                    self._m_records.inc(1, api=self.api.path)
+                    continue
             # trace minted here (admission into the serving plane) or
             # adopted from the upstream hop (always sampled: a
             # propagated request is never half-traced)
@@ -1358,6 +1404,59 @@ class _DecodeLoop:
                                prompt_tokens=len(ids), max_new=max_new,
                                stream=seq.stream)
             self._waiting.append(seq)
+
+    def _try_resume(self, seq: _DecodeSeq) -> None:
+        """Rebuild an interrupted conversation from the session journal
+        (the crash-failover path: the router repinned this session here
+        after its replica died, or this replica relaunched).  On a
+        usable replay the sequence becomes journaled-prompt + committed
+        tokens with the REMAINING budget — prefill reproduces the dead
+        replica's state exactly, so the continuation is token-exact.
+        Every degraded outcome (no journal file, corrupt/truncated
+        state) is counted and the request falls back to its own ids —
+        a cold start, never a wrong token."""
+        m = self.journal.metrics
+        name = getattr(self.journal, "name", "llm")
+        try:
+            st = self.journal.replay(seq.session)
+        except Exception:  # noqa: BLE001 — degraded, never fatal
+            st = None
+        if st is None or not (st.prompt or st.committed):
+            m.restores.inc(1, engine=name, source="journal",
+                           outcome="miss")
+            return
+        if st.truncated:
+            # the size cap dropped oldest tokens: the journal holds a
+            # SUFFIX, and replaying a suffix is not token-exact
+            m.restores.inc(1, engine=name, source="journal",
+                           outcome="truncated")
+            return
+        committed = [int(t) for t in st.committed]
+        seq.ids = [int(t) for t in st.prompt] + committed
+        seq.tokens = list(committed)
+        remaining = int(st.max_new) - len(committed)
+        if remaining <= 0:
+            # every budgeted token was journaled before the crash —
+            # the turn finished, only the reply was lost
+            seq.replay_complete = True
+        seq.max_new = max(1, remaining)
+        seq.resumed = True
+        m.restores.inc(1, engine=name, source="journal", outcome="ok")
+        _flight_record("kvtier_session_resume", api=self.api.path,
+                       session=seq.session, committed=len(committed),
+                       remaining=seq.max_new)
+
+    def _journal_safe(self, fn) -> None:
+        """Run one journal operation without ever failing the serving
+        path — a full disk or unlinked root loses durability (flight-
+        recorded), not the conversation.  An armed ``kill`` fault
+        SIGKILLs inside ``fn`` before this frame can catch anything,
+        which is exactly the crash the journal protects against."""
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001 — serving must not die
+            _flight_record("kvtier_journal_error", api=self.api.path,
+                           error=repr(exc))
 
     def _queue_waited(self, seq: _DecodeSeq) -> float:
         """Seconds this request has spent as REAL queue pressure.
@@ -1489,6 +1588,13 @@ class _DecodeLoop:
                     self._tracer.finish(seq.trace_id, "expired")
                     continue
             self._by_slot[res.slot] = seq
+            if self.journal is not None and seq.session is not None:
+                # (re)baseline the journal BEFORE the first token lands:
+                # for a resumed turn ids already embeds the committed
+                # tokens, so a SECOND crash replays prompt' = prompt +
+                # committed and stays token-exact
+                self._journal_safe(lambda s=seq: self.journal.begin(
+                    s.session, s.ids, s.max_new))
             self._on_token(seq, res.token, res.finished,
                            getattr(res, "reason", None))
         self._waiting = keep
@@ -1496,6 +1602,13 @@ class _DecodeLoop:
     # -- token/retirement handling ----------------------------------------
     def _on_token(self, seq: _DecodeSeq, token: int, finished: bool,
                   reason: Optional[str] = None) -> None:
+        if self.journal is not None and seq.session is not None:
+            # journal BEFORE the client sees the token: a token the
+            # client received must survive a SIGKILL one instruction
+            # later (the append is fsync'd)
+            self._journal_safe(lambda s=seq, t=token:
+                               self.journal.append_tokens(s.session,
+                                                          [int(t)]))
         seq.tokens.append(int(token))
         self._m_tokens.inc(1, api=self.api.path)
         if seq.stream_obj is not None:
@@ -1518,6 +1631,13 @@ class _DecodeLoop:
                            tokens=len(seq.tokens), reason=reason)
         self._tracer.finish(seq.trace_id, "retired",
                             tokens=len(seq.tokens), reason=reason)
+        if self.journal is not None and seq.session is not None:
+            # compaction at retirement: the session's append history
+            # collapses to one state record (bounded file), kept on
+            # disk — it is the failover source for the NEXT turn and
+            # for a relaunch
+            self._journal_safe(lambda s=seq:
+                               self.journal.retire(s.session))
         payload = self.output_formatter(seq.tokens)
         if seq.stream_obj is not None:
             payload["done"] = True

@@ -56,6 +56,25 @@ LLMSERVE_TRACE_REQUIRED = (
     "llmserve_trace_traced_step_ms",
 )
 
+#: the session-survivability plane (ISSUE 17): when a record carries
+#: ANY ``kvtier_`` key it must carry the whole set — the restore-vs-
+#: cold TTFT pair with the admit counts that produced it, arena
+#: capacity, spill/restore counts, and the journal-failover recovery
+#: time — so a partially-failed survivability leg can't ship a restore
+#: win without its cold anchor
+KVTIER_REQUIRED = (
+    "kvtier_restore_ttft_p50_ms",
+    "kvtier_restore_ttft_p95_ms",
+    "kvtier_cold_ttft_p50_ms",
+    "kvtier_cold_ttft_p95_ms",
+    "kvtier_restored_admits",
+    "kvtier_cold_admits",
+    "kvtier_sessions_per_gb",
+    "kvtier_spills",
+    "kvtier_restores",
+    "kvtier_journal_replay_recovery_s",
+)
+
 #: the flat-vs-planned routing pair (ISSUE 14): a record carrying ANY
 #: ``comms_topo_`` key must carry the whole paired set — both sides of
 #: the large (int8 flat vs hierarchical) and small (f32 flat vs tree)
@@ -314,6 +333,23 @@ def test_llmserve_trace_pair_complete():
         missing = [k for k in LLMSERVE_TRACE_REQUIRED if k not in rec]
         assert not missing, (
             f"{name}: incomplete llmserve_trace pair: {missing}")
+
+
+def test_kvtier_fields_complete():
+    """ISSUE 17: a record carrying any ``kvtier_`` field (the session-
+    survivability plane) carries the WHOLE set, each numeric or null —
+    no restore-TTFT claim without its cold anchor and the counts that
+    produced both sides."""
+    for name, rec in _bench_records():
+        kv_keys = [k for k in rec if k.startswith("kvtier_")]
+        if not kv_keys or _labeled_partial(rec):
+            continue
+        missing = [k for k in KVTIER_REQUIRED if k not in rec]
+        assert not missing, f"{name}: incomplete kvtier block: {missing}"
+        bad = [k for k in kv_keys
+               if rec[k] is not None
+               and not isinstance(rec[k], (int, float))]
+        assert not bad, f"{name}: non-numeric kvtier fields: {bad}"
 
 
 def test_comms_topo_fields_complete():

@@ -693,7 +693,7 @@ class TestRouterResizeAbsorption:
             old_addr = table[0]
             # route_addr hands back the routed endpoint under the same
             # lock — the report token a renumber-safe caller carries
-            rank, addr, url = router.route_addr()
+            rank, addr, url, _outcome = router.route_addr()
             assert addr == table[rank] and url.startswith(
                 f"http://{addr[0]}:{addr[1]}")
             # rank 0's replica departs; ranks renumber: index 0 now

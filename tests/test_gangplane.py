@@ -728,6 +728,16 @@ class TestMetricHygiene:
         missing = sorted(n for n in AUTOSCALE_METRICS if n not in docs)
         assert not missing, f"autoscale metrics absent from docs: {missing}"
 
+    def test_every_kvtier_metric_is_documented(self):
+        """ISSUE 17: the session-survivability plane's metric names
+        (spill/restore counters, arena gauge, eviction counter, admit
+        latency histogram) are held to the same docs bar."""
+        from synapseml_tpu.models.llm.kvtier import KVTIER_METRICS
+        docs = "\n".join(p.read_text(encoding="utf-8")
+                         for p in (REPO / "docs" / "api").glob("*.md"))
+        missing = sorted(n for n in KVTIER_METRICS if n not in docs)
+        assert not missing, f"kvtier metrics absent from docs: {missing}"
+
     def test_registry_sees_no_duplicate_kind_at_runtime(self):
         """Importing the wired modules must not blow up on registration
         conflicts (the registry raises on kind/label mismatches)."""

@@ -1,0 +1,847 @@
+"""Session survivability plane tests (ISSUE 17).
+
+The contract under test:
+
+- ``RadixPrefixIndex`` returns the TRUE longest common prefix against
+  any indexed sequence (vs. a brute-force oracle) with deterministic
+  tie-breaking;
+- ``HostKVArena`` spill/restore is bit-lossless in the cache-native
+  dtype (bf16 rides as uint16 bit patterns — half the f32 width), is
+  byte-budgeted (LRU pressure drops, over-budget refusal), and a
+  checksum mismatch drops the entry and reports ``corrupt``;
+- restore-from-host ``admit()`` is TOKEN-EXACT vs. a cold prefill —
+  plain and speculative engines, across span buckets — and every
+  degraded path (corrupt entry, arena miss) falls back to cold prefill
+  with the outcome counted, never a wrong token;
+- preempt (mid-decode eviction = retirement + spill) then ``resume``
+  continues the sequence token-exactly, with or without the arena;
+- the session journal survives SIGKILL: fsync'd CRC-framed appends, a
+  torn tail truncates to the last valid record, the per-session byte
+  cap compacts/truncates (marked), and a relaunched replica continues
+  an interrupted conversation token-exactly via journal replay;
+- ``ReplicaRouter.route_addr`` surfaces the affinity outcome
+  (hit/miss/repin) so failover can engage restore;
+- a seeded chaos soak (corrupt spills + arena pressure + preemption +
+  a mid-soak engine relaunch + a foreign-rank kill rule) converges
+  with ZERO wrong tokens.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from synapseml_tpu.models.llm import (HostKVArena, LlamaConfig, LlamaModel,
+                                      RadixPrefixIndex, SessionJournal,
+                                      SlotEngine, generate)
+from synapseml_tpu.models.llm.kvtier import ChecksumError
+from synapseml_tpu.telemetry import get_registry
+
+pytestmark = pytest.mark.kvtier
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny(num_layers=2, max_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _prompts(cfg, n, length, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, cfg.vocab_size, (n, length)).astype(np.int32)
+
+
+def _metric(name, **labels):
+    m = get_registry().get(name)
+    return 0.0 if m is None else m.value(**labels)
+
+
+# ---------------------------------------------------------------------------
+# Radix prefix index
+# ---------------------------------------------------------------------------
+
+def _lcp(a, b):
+    n = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        n += 1
+    return n
+
+
+class TestRadixPrefixIndex:
+    def test_matches_brute_force_oracle(self):
+        """Random sequences with heavy shared prefixes: the trie's
+        ``longest_prefix`` equals the brute-force max-LCP, for every
+        query — including queries diverging mid-edge."""
+        rng = np.random.default_rng(7)
+        idx = RadixPrefixIndex()
+        seqs = {}
+        for ref in range(40):
+            stem = list(rng.integers(0, 4, rng.integers(1, 12)))
+            tail = list(rng.integers(0, 4, rng.integers(0, 8)))
+            seqs[ref] = stem + tail
+            idx.insert(seqs[ref], ref)
+        assert len(idx) == 40
+        for _ in range(120):
+            q = list(rng.integers(0, 4, rng.integers(1, 24)))
+            ref, depth = idx.longest_prefix(q)
+            best = max(_lcp(s, q) for s in seqs.values())
+            assert depth == best
+            if best > 0:
+                assert _lcp(seqs[ref], q) == best
+            else:
+                assert ref is None
+
+    def test_reinsert_replaces_and_remove_prunes(self):
+        idx = RadixPrefixIndex()
+        idx.insert([1, 2, 3, 4], "a")
+        idx.insert([1, 2, 9], "b")
+        assert idx.longest_prefix([1, 2, 3, 4]) == ("a", 4)
+        # re-insert under the same ref REPLACES the old sequence
+        idx.insert([5, 6, 7], "a")
+        ref, depth = idx.longest_prefix([1, 2, 3, 4])
+        assert (ref, depth) == ("b", 2)
+        assert idx.longest_prefix([5, 6]) == ("a", 2)
+        idx.remove("b")
+        assert idx.longest_prefix([1, 2, 3]) == (None, 0)
+        idx.remove("b")                        # double-remove is a no-op
+        assert len(idx) == 1
+        idx.clear()
+        assert len(idx) == 0
+        assert idx.longest_prefix([5, 6, 7]) == (None, 0)
+
+    def test_tie_prefers_hint_then_smallest(self):
+        idx = RadixPrefixIndex()
+        idx.insert([1, 2, 3, 7], 3)
+        idx.insert([1, 2, 3, 8], 1)
+        # both share [1,2,3] with the query; prefer= wins the tie
+        assert idx.longest_prefix([1, 2, 3, 9], prefer=3) == (3, 3)
+        # without a hint the smallest ref wins — deterministic
+        assert idx.longest_prefix([1, 2, 3, 9]) == (1, 3)
+        # a hint that is NOT among the deepest candidates is ignored
+        idx.insert([1, 2], 0)
+        assert idx.longest_prefix([1, 2, 3, 9], prefer=0)[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# Host KV arena
+# ---------------------------------------------------------------------------
+
+def _rows(rng, layers=2, span=6, kh=2, dh=4, dtype=np.float32):
+    def arr():
+        a = rng.standard_normal((span, kh, dh)).astype(np.float32)
+        if dtype == "bfloat16":
+            import ml_dtypes
+            return a.astype(ml_dtypes.bfloat16)
+        return a.astype(dtype)
+    return [{"k": arr(), "v": arr()} for _ in range(layers)]
+
+
+class TestHostKVArena:
+    def test_roundtrip_bit_exact_f32(self):
+        rng = np.random.default_rng(1)
+        arena = HostKVArena(1 << 20, name="t-arena-f32")
+        rows = _rows(rng, span=6)
+        ids = np.arange(1, 7, dtype=np.int32)
+        key = arena.put(ids, rows)
+        assert key is not None
+        got = arena.fetch(key, 6)
+        for r, g in zip(rows, got):
+            np.testing.assert_array_equal(r["k"], np.asarray(g["k"]))
+            np.testing.assert_array_equal(r["v"], np.asarray(g["v"]))
+        # partial fetch slices the span
+        part = arena.fetch(key, 3)
+        np.testing.assert_array_equal(rows[0]["k"][:3],
+                                      np.asarray(part[0]["k"]))
+
+    def test_bf16_packs_bit_patterns_half_width(self):
+        """A bf16 cache spills as uint16 bit patterns: bit-lossless AND
+        half the f32 blob (the colstore layout) — never rounded through
+        f32 or re-quantized."""
+        rng = np.random.default_rng(2)
+        a16 = HostKVArena(1 << 20, name="t-arena-bf16")
+        a32 = HostKVArena(1 << 20, name="t-arena-bf16f")
+        rows16 = _rows(rng, span=8, dtype="bfloat16")
+        rows32 = _rows(rng, span=8, dtype=np.float32)
+        ids = np.arange(1, 9, dtype=np.int32)
+        k16, k32 = a16.put(ids, rows16), a32.put(ids, rows32)
+        assert a16.bytes_resident * 2 == \
+            a32.bytes_resident + ids.nbytes          # ids stored once each
+        got = a16.fetch(k16, 8)
+        for r, g in zip(rows16, got):
+            np.testing.assert_array_equal(
+                np.asarray(r["k"]).view(np.uint16),
+                np.asarray(g["k"]).view(np.uint16))
+        assert str(np.asarray(got[0]["k"]).dtype) == "bfloat16"
+        a32.fetch(k32, 8)
+
+    def test_lru_pressure_drops_oldest(self):
+        rng = np.random.default_rng(3)
+        rows = _rows(rng, span=4)
+        per = sum(np.asarray(r[k]).nbytes for r in rows
+                  for k in ("k", "v")) + 4 * 4
+        arena = HostKVArena(per * 2 + 8, name="t-arena-lru")
+        k1 = arena.put([1, 2, 3, 4], _rows(rng, span=4))
+        k2 = arena.put([5, 6, 7, 8], _rows(rng, span=4))
+        # refresh k1 so k2 is the LRU tail, then overflow
+        arena.fetch(k1, 1)
+        k3 = arena.put([9, 10, 11, 12], _rows(rng, span=4))
+        assert len(arena) == 2
+        with pytest.raises(KeyError):
+            arena.fetch(k2, 1)
+        arena.fetch(k1, 1), arena.fetch(k3, 1)
+        assert _metric("kvtier_arena_evictions_total",
+                       engine="t-arena-lru", reason="pressure") == 1.0
+
+    def test_over_budget_entry_refused_not_torn(self):
+        rng = np.random.default_rng(4)
+        arena = HostKVArena(64, name="t-arena-tiny")
+        assert arena.put([1, 2, 3, 4], _rows(rng, span=4)) is None
+        assert len(arena) == 0 and arena.bytes_resident == 0
+
+    def test_longer_spill_supersedes_prefix(self):
+        """A new spill whose ids EXTEND a resident entry's ids replaces
+        it (every lookup the old entry could win, the new one wins at
+        least as long); an exact duplicate just refreshes LRU."""
+        rng = np.random.default_rng(5)
+        arena = HostKVArena(1 << 20, name="t-arena-sup")
+        arena.put([1, 2, 3, 4], _rows(rng, span=4))
+        assert arena.put([1, 2, 3, 4], _rows(rng, span=4)) is None
+        assert len(arena) == 1
+        k2 = arena.put([1, 2, 3, 4, 5, 6], _rows(rng, span=6))
+        assert k2 is not None and len(arena) == 1
+        key, lcp = arena.longest_prefix([1, 2, 3, 4, 5, 6, 7])
+        assert (key, lcp) == (k2, 6)
+        assert _metric("kvtier_arena_evictions_total",
+                       engine="t-arena-sup", reason="superseded") == 1.0
+
+    def test_corrupt_entry_dropped_at_fetch(self, fault_registry):
+        """An armed ``corrupt`` rule flips one stored byte between the
+        checksum and the store — exactly silent bit-rot.  Fetch raises
+        :class:`ChecksumError`, drops the entry, and counts it."""
+        rng = np.random.default_rng(6)
+        fault_registry.inject("kvtier.spill", "corrupt", times=1)
+        arena = HostKVArena(1 << 20, name="t-arena-rot")
+        key = arena.put([1, 2, 3, 4], _rows(rng, span=4))
+        with pytest.raises(ChecksumError):
+            arena.fetch(key, 4)
+        assert len(arena) == 0
+        with pytest.raises(KeyError):
+            arena.fetch(key, 4)                # dropped, not retried
+        assert _metric("kvtier_arena_evictions_total",
+                       engine="t-arena-rot", reason="corrupt") == 1.0
+        # the next spill (rule exhausted) stores clean
+        k2 = arena.put([1, 2, 3, 4], _rows(rng, span=4))
+        arena.fetch(k2, 4)
+
+
+# ---------------------------------------------------------------------------
+# Restore-from-host admit — the headline token-exact pin
+# ---------------------------------------------------------------------------
+
+class TestRestoreFromHostTokenExact:
+    @pytest.mark.parametrize("plen,spec", [(12, 0), (28, 0), (12, 4)],
+                             ids=["short", "long-bucket", "spec"])
+    def test_admit_restores_token_exact_vs_cold(self, tiny_model,
+                                                fault_registry,
+                                                plen, spec):
+        """The acceptance pin: a relaunched engine sharing the host
+        arena restores a spilled conversation span into a fresh slot
+        and the continuation is TOKEN-IDENTICAL to a cold prefill —
+        plain and speculative engines, across span buckets, under the
+        seeded fault registry (no rules armed: the registry itself is
+        live, as in production)."""
+        cfg, model, variables = tiny_model
+        name = f"t-restore-{plen}-{spec}"
+        arena = HostKVArena(1 << 22, name=name)
+        kw = dict(n_slots=2, max_len=96, min_prefix=8, name=name,
+                  spec_draft_len=spec, kv_arena=arena)
+        eng1 = SlotEngine(model, variables, **kw)
+        p1 = _prompts(cfg, 1, plen, seed=plen)[0]
+        r1 = eng1.admit(p1, 6)
+        out1 = eng1.run_to_completion()[r1.slot]
+        assert len(arena) >= 1                 # retirement spilled
+        # turn 2 lands on a RELAUNCHED engine (fresh device cache, no
+        # radix) that only shares the host arena — the failover shape
+        suffix = _prompts(cfg, 1, 5, seed=plen + 1)[0]
+        p2 = np.concatenate([p1, out1, suffix])
+        ref = generate(model, variables, p2[None], max_new_tokens=6)[0]
+        eng2 = SlotEngine(model, variables, **kw)
+        ok0 = _metric("kvtier_restores_total", engine=name,
+                      source="host", outcome="ok")
+        r2 = eng2.admit(p2, 6)
+        assert r2.reused_tokens > 0            # restored, not cold
+        assert _metric("kvtier_restores_total", engine=name,
+                       source="host", outcome="ok") == ok0 + 1
+        np.testing.assert_array_equal(eng2.run_to_completion()[r2.slot],
+                                      ref)
+        # and the latency histogram saw both paths for this engine
+        hist = get_registry().get("kvtier_admit_latency_seconds")
+        assert hist.stats(engine=name, path="restore")["count"] >= 1
+        assert hist.stats(engine=name, path="cold")["count"] >= 1
+
+    def test_corrupt_spill_falls_back_cold(self, tiny_model,
+                                           fault_registry):
+        """Satellite pin (c): a corrupt spill entry is detected at
+        fetch, counted ``outcome="corrupt"``, and the admit degrades to
+        a full cold prefill — same tokens, never wrong ones."""
+        cfg, model, variables = tiny_model
+        name = "t-restore-rot"
+        arena = HostKVArena(1 << 22, name=name)
+        kw = dict(n_slots=2, max_len=96, min_prefix=8, name=name,
+                  kv_arena=arena)
+        eng1 = SlotEngine(model, variables, **kw)
+        p1 = _prompts(cfg, 1, 16, seed=40)[0]
+        fault_registry.inject("kvtier.spill", "corrupt")
+        r1 = eng1.admit(p1, 6)
+        out1 = eng1.run_to_completion()[r1.slot]
+        p2 = np.concatenate([p1, out1,
+                             _prompts(cfg, 1, 5, seed=41)[0]])
+        ref = generate(model, variables, p2[None], max_new_tokens=6)[0]
+        eng2 = SlotEngine(model, variables, **kw)
+        c0 = _metric("kvtier_restores_total", engine=name,
+                     source="host", outcome="corrupt")
+        r2 = eng2.admit(p2, 6)
+        assert r2.reused_tokens == 0           # degraded to cold
+        assert _metric("kvtier_restores_total", engine=name,
+                       source="host", outcome="corrupt") == c0 + 1
+        np.testing.assert_array_equal(eng2.run_to_completion()[r2.slot],
+                                      ref)
+
+    def test_arena_miss_between_probe_and_fetch_is_cold(self, tiny_model):
+        """An entry dropped under pressure between the probe and the
+        fetch (the TOCTOU window) is a counted miss → cold prefill."""
+        cfg, model, variables = tiny_model
+        name = "t-restore-miss"
+        arena = HostKVArena(1 << 22, name=name)
+        eng1 = SlotEngine(model, variables, n_slots=2, max_len=96,
+                          min_prefix=8, name=name, kv_arena=arena)
+        p1 = _prompts(cfg, 1, 16, seed=42)[0]
+        r1 = eng1.admit(p1, 6)
+        out1 = eng1.run_to_completion()[r1.slot]
+        p2 = np.concatenate([p1, out1])
+
+        class _Racy:
+            """Arena proxy whose entry vanishes after the probe."""
+            def longest_prefix(self, ids):
+                key, lcp = arena.longest_prefix(ids)
+                arena.clear()
+                return key, lcp
+
+            def fetch(self, key, length):
+                return arena.fetch(key, length)
+
+            def put(self, *a, **k):
+                return None
+
+        ref = generate(model, variables, p2[None], max_new_tokens=4)[0]
+        eng2 = SlotEngine(model, variables, n_slots=2, max_len=96,
+                          min_prefix=8, name=name, kv_arena=_Racy())
+        m0 = _metric("kvtier_restores_total", engine=name,
+                     source="host", outcome="miss")
+        r2 = eng2.admit(p2, 4)
+        assert r2.reused_tokens == 0
+        assert _metric("kvtier_restores_total", engine=name,
+                       source="host", outcome="miss") == m0 + 1
+        np.testing.assert_array_equal(eng2.run_to_completion()[r2.slot],
+                                      ref)
+
+
+# ---------------------------------------------------------------------------
+# Preemptible eviction
+# ---------------------------------------------------------------------------
+
+class TestPreemptResume:
+    def test_preempt_resume_token_exact_with_arena(self, tiny_model):
+        """Mid-decode eviction (retirement + spill) then resume
+        (restore + continue) reproduces the exact greedy continuation."""
+        cfg, model, variables = tiny_model
+        name = "t-preempt"
+        arena = HostKVArena(1 << 22, name=name)
+        eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                         min_prefix=8, name=name, kv_arena=arena)
+        p = _prompts(cfg, 1, 14, seed=50)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=12)[0]
+        r = eng.admit(p, 12)
+        for _ in range(4):
+            eng.step()
+        victim = eng.preempt_slot()
+        assert victim == r.slot                # only active slot
+        ticket = eng.preempt(victim)
+        assert not eng.active[victim]
+        assert eng.preempt(victim) is None     # already evicted
+        # another tenant churns the freed capacity meanwhile
+        other = eng.admit(_prompts(cfg, 1, 10, seed=51)[0], 4)
+        eng.run_to_completion()
+        assert other is not None
+        slot2 = eng.resume(ticket)
+        assert slot2 is not None
+        eng.run_to_completion()
+        np.testing.assert_array_equal(eng.generated_ids(slot2), ref)
+
+    def test_resume_cold_on_fresh_engine(self, tiny_model):
+        """The last-resort path: resume on an engine with NO arena and
+        no device-resident prefix cold-rebuilds the K/V span from the
+        ticket's ids — still token-exact."""
+        cfg, model, variables = tiny_model
+        eng1 = SlotEngine(model, variables, n_slots=2, max_len=96,
+                          min_prefix=8, name="t-preempt-cold")
+        p = _prompts(cfg, 1, 14, seed=52)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=10)[0]
+        r = eng1.admit(p, 10)
+        for _ in range(3):
+            eng1.step()
+        ticket = eng1.preempt(r.slot)
+        eng2 = SlotEngine(model, variables, n_slots=2, max_len=96,
+                          min_prefix=8, name="t-preempt-cold2")
+        slot2 = eng2.resume(ticket)
+        eng2.run_to_completion()
+        np.testing.assert_array_equal(eng2.generated_ids(slot2), ref)
+
+    def test_malformed_ticket_rejected(self, tiny_model):
+        cfg, model, variables = tiny_model
+        eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                         name="t-preempt-bad")
+        with pytest.raises(ValueError):
+            eng.resume({"ids": [], "kv_len": 0,
+                        "generated": 0, "max_new": 4})
+        with pytest.raises(ValueError):
+            # span must leave the pending token past it
+            eng.resume({"ids": [1, 2, 3], "kv_len": 3,
+                        "generated": 1, "max_new": 4})
+
+
+# ---------------------------------------------------------------------------
+# Session journal
+# ---------------------------------------------------------------------------
+
+class TestSessionJournal:
+    def test_begin_append_replay_roundtrip(self, tmp_path):
+        j = SessionJournal(str(tmp_path), name="t-jnl")
+        j.begin("s1", [1, 2, 3], 10)
+        j.append_tokens("s1", [7])
+        j.append_tokens("s1", [8, 9])
+        st = j.replay("s1")
+        assert st.prompt == [1, 2, 3] and st.committed == [7, 8, 9]
+        assert st.max_new == 10 and st.truncated == 0
+        assert st.ids == [1, 2, 3, 7, 8, 9]
+        assert j.sessions() == ["s1"]
+        # a new turn resets committed atomically
+        j.begin("s1", st.ids + [4], 6)
+        st2 = j.replay("s1")
+        assert st2.committed == [] and st2.prompt[-1] == 4
+        j.drop("s1")
+        assert j.replay("s1") is None and j.sessions() == []
+
+    def test_torn_tail_truncates_to_last_valid_record(self, tmp_path):
+        """The SIGKILL shape: a half-written final line fails its CRC;
+        replay returns everything before it and truncates the file so
+        the torn bytes never resurface."""
+        j = SessionJournal(str(tmp_path), name="t-jnl-torn")
+        j.begin("s", [1, 2], 8)
+        j.append_tokens("s", [5])
+        path = j.path("s")
+        good = os.path.getsize(path)
+        with open(path, "ab") as f:
+            f.write(b"deadbeef {\"op\": \"tok")     # torn mid-record
+        st = j.replay("s")
+        assert st.committed == [5]
+        assert os.path.getsize(path) == good
+        # a CORRUPT middle record drops it and everything after
+        j.append_tokens("s", [6])
+        with open(path, "r+b") as f:
+            f.seek(good + 12)
+            f.write(b"\xff")
+        assert j.replay("s").committed == [5]
+
+    def test_corrupt_fault_at_append_is_survivable(self, tmp_path,
+                                                   fault_registry):
+        fault_registry.inject("kvtier.journal_append", "corrupt",
+                              after=1, times=1)
+        j = SessionJournal(str(tmp_path), name="t-jnl-rot")
+        j.begin("s", [1, 2], 8)
+        j.append_tokens("s", [5])                 # clean
+        j.append_tokens("s", [6])                 # corrupted on disk
+        assert j.replay("s").committed == [5]
+        j.append_tokens("s", [7])                 # clean again, appends
+        assert j.replay("s").committed == [5, 7]
+
+    def test_compaction_bounds_the_file(self, tmp_path):
+        """Prune-at-append: the per-session cap compacts the append
+        history into one state record, so a long conversation's file
+        stays bounded instead of growing one line per token."""
+        j = SessionJournal(str(tmp_path), max_bytes_per_session=512,
+                           name="t-jnl-cap")
+        j.begin("s", [1, 2, 3], 64)
+        for t in range(40):
+            j.append_tokens("s", [t % 7 + 1])
+        assert os.path.getsize(j.path("s")) <= 512 + 64
+        st = j.replay("s")
+        assert len(st.committed) == 40 and st.truncated == 0
+        # retirement consolidates to a single state record
+        j.retire("s")
+        with open(j.path("s"), "rb") as f:
+            assert f.read().count(b"\n") == 1
+        assert j.replay("s").committed == st.committed
+
+    def test_oversize_conversation_truncates_marked(self, tmp_path):
+        """When the conversation ITSELF outgrows the cap, oldest tokens
+        are dropped and the state is MARKED truncated — a suffix replay
+        is not token-exact, so the caller must cold-start."""
+        j = SessionJournal(str(tmp_path), max_bytes_per_session=256,
+                           name="t-jnl-trunc")
+        j.begin("s", list(range(1, 120)), 8)
+        j.append_tokens("s", [7])
+        j.compact("s")
+        st = j.replay("s")
+        assert st.truncated > 0
+        assert len(st.ids) <= max(16, 256 // 8)
+
+    def test_unrelated_files_ignored(self, tmp_path):
+        (tmp_path / "notes.txt").write_text("not a journal")
+        (tmp_path / "garbage.jnl").write_bytes(b"\x00\x01\x02")
+        j = SessionJournal(str(tmp_path), name="t-jnl-mix")
+        j.begin("s", [1], 4)
+        assert j.sessions() == ["s"]
+
+
+# ---------------------------------------------------------------------------
+# Router affinity outcome (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestRouterAffinityOutcome:
+    def test_miss_hit_repin_surfaced(self):
+        """``route_addr`` returns the affinity outcome so the serving
+        layer can tell 'pinned replica lost — engage restore' (repin)
+        from a first route (miss); ``route`` keeps its 2-tuple shape."""
+        from synapseml_tpu.serving import ReplicaRouter
+        table = [("127.0.0.1", 9001), ("127.0.0.1", 9002)]
+        router = ReplicaRouter(table, name="t-kvtier-aff",
+                               failure_threshold=1)
+        rank, addr, url, outcome = router.route_addr(session="conv")
+        assert outcome == "miss" and addr == table[rank]
+        assert router.route_addr(session="conv")[3] == "hit"
+        assert router.route_addr()[3] == "miss"    # no session: miss
+        # the pinned replica dies: the session repins — the caller's
+        # cue that the device prefix cache is gone and journal/arena
+        # restore must engage
+        router.report(rank, ok=False, addr=addr)
+        r2, a2, _, outcome2 = router.route_addr(session="conv")
+        assert outcome2 == "repin" and a2 != addr
+        assert router.route_addr(session="conv")[3] == "hit"
+        assert len(router.route()) == 2
+
+    def test_route_request_threads_outcome(self):
+        """``DistributedServingServer.route_request`` hands the outcome
+        through (5-tuple) alongside the trace headers."""
+        from synapseml_tpu.serving import ReplicaRouter
+        from synapseml_tpu.serving.distributed import (
+            DistributedServingServer)
+        from synapseml_tpu.serving.server import TRACE_HEADER
+
+        class _Stub:
+            router = ReplicaRouter([("127.0.0.1", 9011)],
+                                   name="t-kvtier-req")
+
+        stub = _Stub()
+        rank, addr, url, headers, outcome = \
+            DistributedServingServer.route_request(stub, session="conv2")
+        assert outcome == "miss" and TRACE_HEADER in headers
+        assert DistributedServingServer.route_request(
+            stub, session="conv2")[4] == "hit"
+
+
+# ---------------------------------------------------------------------------
+# Serving-loop journal wiring + crash failover
+# ---------------------------------------------------------------------------
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+class TestServerJournalResume:
+    def test_resume_continues_interrupted_turn_token_exact(
+            self, tiny_model, tmp_path):
+        """A journal holding a partially-committed turn (the state a
+        SIGKILL leaves) resumes through ``{"session", "resume"}``: the
+        reply carries the committed tokens plus the exactly-greedy
+        remainder — identical to the uninterrupted reference."""
+        from synapseml_tpu.serving import LLMServer
+        cfg, model, variables = tiny_model
+        p = _prompts(cfg, 1, 12, seed=60)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=8)[0]
+        jdir = str(tmp_path / "jnl")
+        pre = SessionJournal(jdir, name="t-resume")
+        pre.begin("conv", [int(t) for t in p], 8)
+        pre.append_tokens("conv", [int(t) for t in ref[:3]])
+        srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                        journal=SessionJournal(jdir, name="t-resume"),
+                        engine_kwargs={"name": "t-resume"})
+        try:
+            ok0 = _metric("kvtier_restores_total", engine="t-resume",
+                          source="journal", outcome="ok")
+            status, body, _ = _post(srv.url, {"session": "conv",
+                                              "resume": True})
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref]
+            assert _metric("kvtier_restores_total", engine="t-resume",
+                           source="journal", outcome="ok") == ok0 + 1
+            # unknown session: counted miss, clean 4xx — never a
+            # silently context-free generation
+            m0 = _metric("kvtier_restores_total", engine="t-resume",
+                         source="journal", outcome="miss")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(srv.url, {"session": "ghost", "resume": True})
+            assert exc.value.code == 404
+            assert _metric("kvtier_restores_total", engine="t-resume",
+                           source="journal", outcome="miss") == m0 + 1
+        finally:
+            srv.close()
+
+    def test_resume_of_fully_committed_turn_replies_without_decoding(
+            self, tiny_model, tmp_path):
+        """The crash can land AFTER the last token commit but before
+        the reply: the journal then holds the turn's full budget and
+        the replay IS the reply — resume returns exactly the committed
+        tokens, it must not decode a token past the budget."""
+        from synapseml_tpu.serving import LLMServer
+        cfg, model, variables = tiny_model
+        p = _prompts(cfg, 1, 12, seed=61)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=5)[0]
+        jdir = str(tmp_path / "jnl")
+        pre = SessionJournal(jdir, name="t-resume-c")
+        pre.begin("conv", [int(t) for t in p], 5)
+        pre.append_tokens("conv", [int(t) for t in ref])
+        srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                        journal=SessionJournal(jdir, name="t-resume-c"),
+                        engine_kwargs={"name": "t-resume-c"})
+        try:
+            status, body, _ = _post(srv.url, {"session": "conv",
+                                              "resume": True})
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref]
+        finally:
+            srv.close()
+
+    def test_truncated_journal_refuses_suffix_replay(self, tiny_model,
+                                                     tmp_path):
+        """A size-cap-truncated journal is NOT token-exact material:
+        resume answers 404 with the outcome counted ``truncated`` —
+        the client cold-starts instead of getting wrong tokens."""
+        from synapseml_tpu.serving import LLMServer
+        cfg, model, variables = tiny_model
+        jdir = str(tmp_path / "jnl")
+        pre = SessionJournal(jdir, max_bytes_per_session=256,
+                             name="t-resume-tr")
+        pre.begin("conv", list(range(1, 120)), 8)
+        pre.compact("conv")
+        assert pre.replay("conv").truncated > 0
+        srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                        journal=SessionJournal(jdir, name="t-resume-tr"),
+                        engine_kwargs={"name": "t-resume-tr"})
+        try:
+            t0 = _metric("kvtier_restores_total", engine="t-resume-tr",
+                         source="journal", outcome="truncated")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(srv.url, {"session": "conv", "resume": True})
+            assert exc.value.code == 404
+            assert _metric("kvtier_restores_total", engine="t-resume-tr",
+                           source="journal",
+                           outcome="truncated") == t0 + 1
+        finally:
+            srv.close()
+
+
+_CRASH_CHILD = textwrap.dedent("""
+    import os, sys, json, urllib.request
+
+    import jax, jax.numpy as jnp, numpy as np
+    from synapseml_tpu.models.llm import LlamaConfig, LlamaModel
+    from synapseml_tpu.resilience import get_faults
+    from synapseml_tpu.serving import LLMServer
+
+    cfg = LlamaConfig.tiny(num_layers=2, max_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    p1 = np.random.default_rng(70).integers(
+        1, cfg.vocab_size, 10).astype(np.int32)
+    srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                    journal_dir=os.environ["SML_TEST_JDIR"],
+                    engine_kwargs={"name": "crash-child"})
+
+    def post(payload):
+        req = urllib.request.Request(
+            srv.url, data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    out1 = post({"ids": [int(t) for t in p1], "session": "conv",
+                 "max_new_tokens": 5})["ids"]
+    print("TURN1", json.dumps(out1), flush=True)
+    # arm the kill AFTER turn 1: turn 2 journals 3 tokens, then the
+    # 4th append SIGKILLs the process mid-decode — the crash the
+    # journal exists for
+    get_faults().configure("kvtier.journal_append=kill:after=3")
+    p2 = [int(t) for t in p1] + out1 + [3, 1, 4, 1, 5]
+    post({"ids": p2, "session": "conv", "max_new_tokens": 8})
+    print("UNREACHABLE", flush=True)
+""")
+
+
+class TestCrashFailoverSIGKILL:
+    def test_sigkilled_replica_session_resumes_token_exact(
+            self, tiny_model, tmp_path):
+        """The acceptance pin (b): a replica SIGKILLed mid-turn (armed
+        ``kill`` at the journal-append site — the token is journaled
+        fsync-first, so exactly the journaled tokens survive) leaves a
+        journal a relaunched replica replays; the resumed reply equals
+        the uninterrupted greedy reference token-for-token."""
+        from synapseml_tpu.serving import LLMServer
+        cfg, model, variables = tiny_model
+        jdir = str(tmp_path / "jnl")
+        env = dict(os.environ, SML_TEST_JDIR=jdir)
+        env.pop("SML_FAULTS", None)
+        proc = subprocess.run([sys.executable, "-c", _CRASH_CHILD],
+                              capture_output=True, text=True,
+                              timeout=240, env=env, cwd="/root/repo")
+        assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+        assert "UNREACHABLE" not in proc.stdout
+        turn1 = next(line for line in proc.stdout.splitlines()
+                     if line.startswith("TURN1"))
+        out1 = json.loads(turn1.split(None, 1)[1])
+        # the same deterministic tiny model in THIS process: the child's
+        # turn-1 reply must match our dense reference, and turn 2's
+        # reference is what the resumed replica must complete
+        p1 = np.random.default_rng(70).integers(
+            1, cfg.vocab_size, 10).astype(np.int32)
+        ref1 = generate(model, variables, p1[None], max_new_tokens=5)[0]
+        assert out1 == [int(t) for t in ref1]
+        p2 = np.concatenate([p1, ref1,
+                             np.array([3, 1, 4, 1, 5], np.int32)])
+        ref2 = generate(model, variables, p2[None], max_new_tokens=8)[0]
+        # the journal holds the interrupted turn: prompt2 + exactly the
+        # tokens committed before the kill
+        st = SessionJournal(jdir, name="probe").replay("conv")
+        assert st is not None
+        assert st.prompt == [int(t) for t in p2]
+        assert st.committed == [int(t) for t in ref2[:3]]
+        # failover: a fresh replica (this process) with the same
+        # journal root continues the conversation
+        srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                        journal_dir=jdir,
+                        engine_kwargs={"name": "crash-parent"})
+        try:
+            status, body, _ = _post(srv.url, {"session": "conv",
+                                              "resume": True})
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref2]
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos soak (satellite pin d)
+# ---------------------------------------------------------------------------
+
+class TestChaosSoak:
+    @pytest.mark.fault
+    def test_soak_zero_wrong_tokens(self, tiny_model, fault_registry):
+        """Chaos mix under the seeded registry: probabilistic corrupt
+        spills, a tiny arena (constant LRU pressure), mid-decode
+        preemption every round, a mid-soak engine relaunch sharing the
+        arena, and a foreign-rank ``kill_rank`` rule (rank-gated: must
+        NEVER fire on this rank).  Every turn of every session is
+        token-exact vs. the dense greedy reference — degraded paths
+        cost latency, never correctness."""
+        cfg, model, variables = tiny_model
+        fault_registry.inject("kvtier.spill", "corrupt", p=0.35)
+        kill_rule = fault_registry.inject("kvtier.restore", "kill_rank",
+                                          rank=1)   # foreign rank
+        name = "t-soak"
+        arena = HostKVArena(96 * 1024, name=name)   # pressure-sized
+        kw = dict(n_slots=3, max_len=96, min_prefix=8, name=name,
+                  kv_arena=arena)
+        eng = SlotEngine(model, variables, **kw)
+        sessions = {i: _prompts(cfg, 1, 10, seed=80 + i)[0]
+                    for i in range(4)}
+        for rnd in range(3):
+            for i, ids in sorted(sessions.items()):
+                ref = generate(model, variables, ids[None],
+                               max_new_tokens=6)[0]
+                r = eng.admit(ids, 6)
+                assert r is not None
+                slot = r.slot
+                if i == 0 and not r.finished:
+                    # mid-decode eviction + resume, every round
+                    eng.step()
+                    ticket = eng.preempt(slot)
+                    if ticket is not None:
+                        slot = eng.resume(ticket)
+                eng.run_to_completion()
+                got = eng.generated_ids(slot)
+                np.testing.assert_array_equal(got, ref)
+                sessions[i] = np.concatenate(
+                    [ids, got, _prompts(cfg, 1, 4,
+                                        seed=90 + 10 * rnd + i)[0]])
+            if rnd == 1:
+                # replica relaunch mid-soak: fresh device state, same
+                # host arena — round 3 restores across the restart
+                eng = SlotEngine(model, variables, **kw)
+        assert kill_rule.fired == 0            # rank gate held
+        assert _metric("kvtier_spills_total", engine=name,
+                       kind="retire") > 0
+        assert _metric("kvtier_spills_total", engine=name,
+                       kind="preempt") > 0
+
+
+# ---------------------------------------------------------------------------
+# Warmup lattice + metric surface hygiene
+# ---------------------------------------------------------------------------
+
+class TestKVTierSurface:
+    def test_program_lattice_covers_restore(self, tiny_model):
+        """An arena-attached engine's program lattice includes the
+        restore programs (one per span bucket), so AOT warmup leaves
+        nothing for the first failover restore to compile; without an
+        arena the lattice stays restore-free."""
+        from synapseml_tpu.models.llm import program_lattice
+        cfg, model, variables = tiny_model
+        arena = HostKVArena(1 << 20, name="t-lattice")
+        warm = SlotEngine(model, variables, n_slots=2, max_len=64,
+                          name="t-lattice", kv_arena=arena)
+        kinds = {s.kind for s in program_lattice(warm)}
+        assert "restore" in kinds
+        plain = SlotEngine(model, variables, n_slots=2, max_len=64,
+                           name="t-lattice-plain")
+        assert "restore" not in {s.kind
+                                 for s in program_lattice(plain)}
+
+    def test_metric_names_follow_conventions(self):
+        from synapseml_tpu.models.llm import KVTIER_METRICS
+        assert len(KVTIER_METRICS) == len(set(KVTIER_METRICS))
+        for n in KVTIER_METRICS:
+            assert n.startswith("kvtier_")
+        reg = get_registry()
+        from synapseml_tpu.models.llm import kvtier_metrics
+        kvtier_metrics()                       # registers (idempotent)
+        for n in KVTIER_METRICS:
+            assert reg.get(n) is not None, n
