@@ -2772,6 +2772,225 @@ def bench_session_survivability():
     }
 
 
+def bench_qos():
+    """Multi-tenant QoS noisy-neighbor trace (ISSUE 18): one flooding
+    tenant burst-enqueues ~10x the victim's traffic in front of every
+    victim request, through the REAL serving stack (HTTP listener ->
+    decode loop -> slotted engine).
+
+    - **victim TTFT, three ways** — solo (no neighbor), FIFO (the
+      pre-QoS aggregate queue: every request one tenant, arrival
+      order), and QoS (priority classes + weighted-fair admission +
+      preemption).  The FIFO-vs-solo ratio is the damage an aggregate
+      queue hides; the QoS-vs-solo ratio is what the scheduling plane
+      buys back.  Victim TTFT is measured client-side as streaming
+      time-to-first-byte (the stream opens at admission with the first
+      token).
+    - **preemptions + budget sheds** — the QoS leg counts ticket-path
+      preemptions; a follow-up burst against a rate-limited flood
+      tenant counts 429 budget sheds (victim untouched).
+    - **per-tenant attainment** — from the ``/sloz?tenant=`` planes,
+      objective set to 2x the solo p99 (the acceptance bar).
+    - **weighted share convergence** — a saturated 3:1-weight pair;
+      committed-token shares, their error vs the configured weights,
+      and Jain fairness (raw and weight-normalized).
+
+    CPU honesty: on CPU every decode step shares one host, so absolute
+    TTFTs are orders slower than TPU and preemption spill/restore is a
+    host memcpy both ways — the RATIOS (fifo-vs-solo, qos-vs-solo) and
+    the share/shed/preemption accounting are the portable part, not
+    the milliseconds.
+
+    → the ``qos_*`` field dict (all-or-nothing, schema-held by
+    tests/test_artifacts_json.py)."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.models.llm import LlamaConfig, LlamaModel
+    from synapseml_tpu.serving import (LLMServer, QosScheduler,
+                                       TenantPolicy, jain_fairness)
+    from synapseml_tpu.telemetry.slo import (get_slo_store,
+                                             tenant_plane_name)
+
+    cfg = LlamaConfig.tiny(vocab_size=512, d_model=128, num_layers=2,
+                           num_heads=4, num_kv_heads=2, max_len=96,
+                           dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = np.random.default_rng(18)
+    N_SLOTS, GEN, PLEN = 2, 6, 16
+    PROBES, FLOOD_BURST = 8, 12
+
+    def prompt():
+        return [int(t) for t in
+                rng.integers(1, cfg.vocab_size, PLEN)]
+
+    def post(url, payload, tenant=None, timeout=120):
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers["X-SML-Tenant"] = tenant
+        req = urllib.request.Request(
+            url, data=_json.dumps(payload).encode(), method="POST",
+            headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+
+    def stream_ttfb(url, payload, tenant=None):
+        """Seconds from request send to the first streamed byte — the
+        stream opens at admission carrying the first token, so this IS
+        the client-observed TTFT."""
+        headers = {"Content-Type": "application/json"}
+        if tenant:
+            headers["X-SML-Tenant"] = tenant
+        req = urllib.request.Request(
+            url, data=_json.dumps({**payload, "stream": True}).encode(),
+            method="POST", headers=headers)
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.read(1)
+            dt = time.perf_counter() - t0
+            r.read()
+        return dt
+
+    def make_server(tag, qos=None):
+        return LLMServer(model, variables, n_slots=N_SLOTS,
+                         max_len=cfg.max_len, min_prefix=8,
+                         api_path=f"/qos-{tag}", qos=qos,
+                         engine_kwargs={"name": f"qos-bench-{tag}"})
+
+    def probe_leg(srv, victim_tenant, flood_tenant):
+        """PROBES rounds: burst FLOOD_BURST neighbor requests, then
+        time the victim's streaming TTFT behind them."""
+        ttfts = []
+        for _ in range(PROBES):
+            threads = [threading.Thread(
+                target=lambda p=prompt(): _swallow(
+                    post, srv.url, {"ids": p, "max_new_tokens": GEN},
+                    flood_tenant))
+                for _ in range(FLOOD_BURST)]
+            for t in threads:
+                t.start()
+            time.sleep(0.01)       # the burst enqueues first
+            ttfts.append(stream_ttfb(
+                srv.url, {"ids": prompt(), "max_new_tokens": GEN},
+                victim_tenant))
+            for t in threads:
+                t.join(timeout=120)
+        return ttfts
+
+    def _swallow(fn, *args):
+        try:
+            fn(*args)
+        except (urllib.error.HTTPError, urllib.error.URLError,
+                ConnectionError, OSError):
+            pass
+
+    def pct(xs, q):
+        return float(np.percentile(np.asarray(xs), q)) * 1e3
+
+    # -- solo baseline (untimed warm pass compiles every program) ----------
+    srv = make_server("solo")
+    for _ in range(3):
+        post(srv.url, {"ids": prompt(), "max_new_tokens": GEN})
+    solo_ts = [stream_ttfb(srv.url, {"ids": prompt(),
+                                     "max_new_tokens": GEN})
+               for _ in range(PROBES)]
+    srv.close()
+    solo_p99 = pct(solo_ts, 99)
+
+    # -- FIFO aggregate queue: every request the same tenant ---------------
+    srv = make_server("fifo")
+    fifo_ts = probe_leg(srv, victim_tenant=None, flood_tenant=None)
+    srv.close()
+
+    # -- QoS: priority classes + weighted-fair admission + preemption ------
+    qos = QosScheduler(policies={
+        "victim": TenantPolicy(priority=2, weight=1.0),
+        "flood": TenantPolicy(priority=0, weight=1.0)},
+        preempt_min_interval_s=0.0)
+    srv = make_server("qos", qos=qos)
+    qos_ts = probe_leg(srv, victim_tenant="victim", flood_tenant="flood")
+    preemptions = int(qos.preemptions)
+    # rate-budget burst: the flood tenant rate-limited, victim untouched
+    qos.set_policy("flood", TenantPolicy(
+        priority=0, rate_tokens_per_s=1.0, burst_tokens=float(GEN)))
+    for _ in range(8):
+        _swallow(post, srv.url, {"ids": prompt(),
+                                 "max_new_tokens": GEN}, "flood")
+    post(srv.url, {"ids": prompt(), "max_new_tokens": GEN}, "victim")
+    budget_sheds = int(qos.budget_sheds.get("flood", 0))
+    srv.close()
+    # per-tenant attainment vs the acceptance bar (2x solo p99), read
+    # from the same attribution planes /sloz?tenant= serves
+    attain = {}
+    for tenant in ("victim", "flood"):
+        w = get_slo_store().window(
+            tenant_plane_name("/qos-qos", tenant))
+        w.set_objective("ttft", 2.0 * solo_p99 / 1e3)
+        attain[tenant] = w.attainment("ttft")
+
+    # -- weighted share convergence: saturated 3:1 pair --------------------
+    share_qos = QosScheduler(policies={
+        "heavy": TenantPolicy(weight=3.0),
+        "light": TenantPolicy(weight=1.0)})
+    srv = make_server("share", qos=share_qos)
+    stop = threading.Event()
+
+    def saturate(tenant):
+        while not stop.is_set():
+            _swallow(post, srv.url, {"ids": prompt(),
+                                     "max_new_tokens": GEN}, tenant)
+    threads = [threading.Thread(target=saturate, args=(t,))
+               for t in ("heavy", "light") for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)                   # warm + fill both backlogs
+    share_qos.reset()                 # measure from a clean ledger
+    time.sleep(6.0)
+    shares = share_qos.committed_share()
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+    srv.close()
+    share_h = float(shares.get("heavy", 0.0))
+    share_l = float(shares.get("light", 0.0))
+    err_pct = abs(share_h - 0.75) / 0.75 * 100.0
+
+    fifo_p99, qos_p99 = pct(fifo_ts, 99), pct(qos_ts, 99)
+    return {
+        "qos_victim_ttft_p50_ms_solo": round(pct(solo_ts, 50), 3),
+        "qos_victim_ttft_p99_ms_solo": round(solo_p99, 3),
+        "qos_victim_ttft_p99_ms_fifo": round(fifo_p99, 3),
+        "qos_victim_ttft_p99_ms_qos": round(qos_p99, 3),
+        "qos_victim_ttft_ratio_fifo": round(fifo_p99 / solo_p99, 3),
+        "qos_victim_ttft_ratio_qos": round(qos_p99 / solo_p99, 3),
+        "qos_preemptions": preemptions,
+        "qos_flood_budget_sheds": budget_sheds,
+        "qos_victim_attainment_qos": (
+            round(attain["victim"], 4)
+            if attain["victim"] is not None else None),
+        "qos_flood_attainment_qos": (
+            round(attain["flood"], 4)
+            if attain["flood"] is not None else None),
+        "qos_share_heavy": round(share_h, 4),
+        "qos_share_light": round(share_l, 4),
+        "qos_share_target_heavy": 0.75,
+        "qos_share_err_pct": round(err_pct, 2),
+        "qos_fairness_jain_raw": round(
+            jain_fairness([share_h, share_l]), 4),
+        "qos_fairness_jain_weighted": round(
+            jain_fairness([share_h / 3.0, share_l / 1.0]), 4),
+        "qos_probes": PROBES,
+        "qos_flood_burst": FLOOD_BURST,
+    }
+
+
 def _nullify_nonfinite(obj):
     if isinstance(obj, dict):
         return {k: _nullify_nonfinite(v) for k, v in obj.items()}
@@ -2801,7 +3020,7 @@ BENCH_LEGS = ("bert", "llm", "spec", "llm8b", "resnet_onnx", "vision",
               "gbdt", "gbdt_pair", "anchor", "streamed", "serving",
               "gang", "resize", "guard", "comms", "comms_topo", "llmserve",
               "llmserve_spec", "llmserve_trace", "llmserve_warmup", "obs",
-              "autoscale", "kvtier")
+              "autoscale", "kvtier", "qos")
 
 
 def main(only=None):
@@ -3255,6 +3474,35 @@ def main(only=None):
         print(f"[secondary] session-survivability bench failed: {e}",
               file=sys.stderr)
 
+    qos_fields = None
+    try:
+        if not want("qos"):
+            raise _SkippedLeg()
+        qos_fields = bench_qos()
+        qf = qos_fields
+        print(f"[secondary] multi-tenant QoS: victim TTFT p99 "
+              f"{qf['qos_victim_ttft_p99_ms_solo']:.1f} ms solo -> "
+              f"{qf['qos_victim_ttft_p99_ms_fifo']:.1f} ms FIFO "
+              f"({qf['qos_victim_ttft_ratio_fifo']:.1f}x) -> "
+              f"{qf['qos_victim_ttft_p99_ms_qos']:.1f} ms QoS "
+              f"({qf['qos_victim_ttft_ratio_qos']:.1f}x) under a "
+              f"{qf['qos_flood_burst']}-deep neighbor burst; "
+              f"{qf['qos_preemptions']} preemptions, "
+              f"{qf['qos_flood_budget_sheds']} flood budget sheds; "
+              f"3:1-weight committed share {qf['qos_share_heavy']:.2f}/"
+              f"{qf['qos_share_light']:.2f} "
+              f"(err {qf['qos_share_err_pct']:.1f}%, weighted Jain "
+              f"{qf['qos_fairness_jain_weighted']:.3f})",
+              file=sys.stderr)
+        print("[secondary]   NOTE: on CPU every decode step shares one "
+              "host, so the absolute TTFTs are not TPU numbers — the "
+              "fifo-vs-solo and qos-vs-solo RATIOS and the share/shed/"
+              "preemption accounting are the portable part",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] multi-tenant QoS bench failed: {e}",
+              file=sys.stderr)
+
     autoscale_fields = None
     try:
         if not want("autoscale"):
@@ -3414,6 +3662,10 @@ def main(only=None):
         # arena capacity, and journal failover recovery — emitted
         # all-or-nothing and schema-held by test_artifacts_json
         **(kvtier_fields or {}),
+        # multi-tenant QoS plane (ISSUE 18): victim TTFT three ways,
+        # preemption/shed accounting, weighted share convergence —
+        # emitted all-or-nothing and schema-held by test_artifacts_json
+        **(qos_fields or {}),
         "serving_continuous_ms_per_record": (
             round(serving_marg_ms, 4) if serving_marg_ms else None),
         "serving_solo_rtt_ms": (round(serving_solo_ms, 3)

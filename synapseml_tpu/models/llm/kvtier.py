@@ -278,6 +278,7 @@ class _ArenaEntry:
     dtype_name: str
     packed_bf16: bool               # stored as uint16 bit patterns
     nbytes: int
+    tenant: str = "default"         # namespace: lookups never cross tenants
 
 
 class HostKVArena:
@@ -301,7 +302,11 @@ class HostKVArena:
         self.name = name
         self._lock = threading.Lock()
         self._entries: "OrderedDict[int, _ArenaEntry]" = OrderedDict()
-        self._radix = RadixPrefixIndex()
+        #: one radix index PER TENANT — a lookup can only ever match a
+        #: span the same tenant spilled, so a cross-tenant session-id
+        #: (or prompt-prefix) collision cannot leak another tenant's
+        #: K/V bytes through the restore path
+        self._radices: Dict[str, RadixPrefixIndex] = {}
         self._next_key = 0
         self._bytes = 0
         self._m = kvtier_metrics()
@@ -317,12 +322,20 @@ class HostKVArena:
         with self._lock:
             return len(self._entries)
 
+    def _radix_for(self, tenant: str) -> RadixPrefixIndex:
+        # caller holds the lock
+        idx = self._radices.get(tenant)
+        if idx is None:
+            idx = self._radices[tenant] = RadixPrefixIndex()
+        return idx
+
     # -- spill -------------------------------------------------------------
     def put(self, ids, rows: List[Dict[str, np.ndarray]],
-            kind: str = "retire") -> Optional[int]:
-        """Spill one K/V span.  Returns the entry key, or None when the
-        entry was refused (over-budget even alone, or an exact/shorter
-        duplicate of what is already resident)."""
+            kind: str = "retire", tenant: str = "default") -> Optional[int]:
+        """Spill one K/V span into ``tenant``'s namespace.  Returns the
+        entry key, or None when the entry was refused (over-budget even
+        alone, or an exact/shorter duplicate of what the same tenant
+        already has resident)."""
         ids = np.asarray(ids, np.int32).reshape(-1)
         if len(ids) == 0 or not rows:
             return None
@@ -335,9 +348,10 @@ class HostKVArena:
         # the fault site sits BETWEEN checksum and store: an armed
         # ``corrupt`` rule flips a stored byte and the mismatch is
         # caught at fetch — exactly silent bit-rot; ``kill`` dies here
-        blob = faults.corrupt_point("kvtier.spill", blob)
+        blob = faults.corrupt_point("kvtier.spill", blob, tenant=tenant)
         entry = _ArenaEntry(0, ids, blob, crc, stacked.shape, dtype_name,
-                            packed_bf16, len(blob) + ids.nbytes)
+                            packed_bf16, len(blob) + ids.nbytes,
+                            tenant=str(tenant))
         with self._lock:
             if entry.nbytes > self.max_bytes:
                 self._m.arena_evictions.inc(1, engine=self.name,
@@ -345,8 +359,11 @@ class HostKVArena:
                 return None
             # a resident entry this one extends (or duplicates) is
             # superseded: its tokens are a prefix of ours, so every
-            # lookup it could win, we win at least as long
-            old_key, lcp = self._radix.longest_prefix(ids)
+            # lookup it could win, we win at least as long — scoped to
+            # THIS tenant's index (another tenant's identical tokens
+            # are a different namespace, never deduplicated across)
+            radix = self._radix_for(entry.tenant)
+            old_key, lcp = radix.longest_prefix(ids)
             if old_key is not None:
                 old = self._entries.get(old_key)
                 if old is not None and lcp == len(old.ids):
@@ -358,7 +375,10 @@ class HostKVArena:
             self._next_key += 1
             self._entries[entry.key] = entry
             self._bytes += entry.nbytes
-            self._radix.insert(ids, entry.key)
+            # re-fetch: _drop prunes a tenant's radix from the map when
+            # it empties, so the supersede path may have orphaned the
+            # local reference — inserting into it would strand the entry
+            self._radix_for(entry.tenant).insert(ids, entry.key)
             while self._bytes > self.max_bytes and len(self._entries) > 1:
                 tail_key = next(iter(self._entries))
                 if tail_key == entry.key:
@@ -367,7 +387,8 @@ class HostKVArena:
             self._m.arena_bytes.set(self._bytes, engine=self.name)
         self._m.spills.inc(1, engine=self.name, kind=kind)
         flight_record("kvtier_spill", engine=self.name, spill_kind=kind,
-                      tokens=int(len(ids)), bytes=entry.nbytes)
+                      tenant=entry.tenant, tokens=int(len(ids)),
+                      bytes=entry.nbytes)
         return entry.key
 
     def _drop(self, key: int, reason: str) -> None:
@@ -376,27 +397,38 @@ class HostKVArena:
         if entry is None:
             return
         self._bytes -= entry.nbytes
-        self._radix.remove(key)
+        radix = self._radices.get(entry.tenant)
+        if radix is not None:
+            radix.remove(key)
+            if not len(radix):
+                del self._radices[entry.tenant]
         self._m.arena_evictions.inc(1, engine=self.name, reason=reason)
         self._m.arena_bytes.set(self._bytes, engine=self.name)
 
     # -- restore -----------------------------------------------------------
-    def longest_prefix(self, ids) -> Tuple[Optional[int], int]:
+    def longest_prefix(self, ids,
+                       tenant: str = "default") -> Tuple[Optional[int], int]:
         with self._lock:
-            key, lcp = self._radix.longest_prefix(ids)
+            radix = self._radices.get(str(tenant))
+            if radix is None:
+                return None, 0
+            key, lcp = radix.longest_prefix(ids)
             if key is not None:
                 self._entries.move_to_end(key)
             return key, lcp
 
-    def fetch(self, key: int, length: int) -> List[Dict[str, np.ndarray]]:
+    def fetch(self, key: int, length: int,
+              tenant: str = "default") -> List[Dict[str, np.ndarray]]:
         """K/V rows ``[0, length)`` of entry ``key`` as per-layer
         ``{"k", "v"}`` arrays in the cache-native dtype.  Raises
-        ``KeyError`` (miss — dropped under pressure since the probe) or
-        :class:`ChecksumError` (corrupt; the entry is removed)."""
-        get_faults().kill_point("kvtier.restore")
+        ``KeyError`` (miss — dropped under pressure since the probe, OR
+        a key from another tenant's namespace: a leaked key must read
+        as a miss, never as data) or :class:`ChecksumError` (corrupt;
+        the entry is removed)."""
+        get_faults().kill_point("kvtier.restore", tenant=tenant)
         with self._lock:
             entry = self._entries.get(key)
-            if entry is None:
+            if entry is None or entry.tenant != str(tenant):
                 raise KeyError(key)
             if zlib.crc32(entry.blob) != entry.crc:
                 self._drop(key, "corrupt")
@@ -414,7 +446,7 @@ class HostKVArena:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
-            self._radix.clear()
+            self._radices.clear()
             self._bytes = 0
             self._m.arena_bytes.set(0, engine=self.name)
 
@@ -450,12 +482,15 @@ class SessionState:
     prompt, the tokens committed so far, the turn's original token
     budget, and how many OLDEST tokens the size cap truncated away
     (``truncated > 0`` ⇒ the remaining ids are a SUFFIX and a
-    token-exact resume is impossible — cold-start instead)."""
+    token-exact resume is impossible — cold-start instead).
+    ``tenant`` is the namespace the turn was journaled under — replay
+    for any other tenant answers None, exactly like a missing session."""
     session: str
     prompt: List[int]
     committed: List[int]
     max_new: int
     truncated: int = 0
+    tenant: str = "default"
 
     @property
     def ids(self) -> List[int]:
@@ -484,22 +519,30 @@ class SessionJournal:
         #: metric handles instead of importing this package
         self.metrics = kvtier_metrics()
 
-    def path(self, session: str) -> str:
-        digest = hashlib.sha1(str(session).encode()).hexdigest()[:24]
+    def path(self, session: str, tenant: str = "default") -> str:
+        """The session's journal file, namespaced by tenant: the digest
+        covers ``tenant NUL session``, so two tenants using the SAME
+        session id journal to two different files — a cross-tenant
+        session-id collision can never replay (or truncate, or drop)
+        another tenant's conversation."""
+        digest = hashlib.sha1(
+            f"{tenant}\x00{session}".encode()).hexdigest()[:24]
         return os.path.join(self.root, f"{digest}.jnl")
 
     # -- writes ------------------------------------------------------------
-    def begin(self, session: str, prompt_ids, max_new: int) -> None:
+    def begin(self, session: str, prompt_ids, max_new: int,
+              tenant: str = "default") -> None:
         """Start (or reset) a turn: the journal's state becomes exactly
         ``prompt_ids`` with no committed tokens.  Atomic rewrite — a
         kill mid-begin leaves the previous turn's state intact."""
         state = SessionState(str(session),
                              [int(t) for t in prompt_ids], [],
-                             int(max_new))
+                             int(max_new), tenant=str(tenant))
         with self._lock:
             self._write_state(state)
 
-    def append_tokens(self, session: str, tokens) -> None:
+    def append_tokens(self, session: str, tokens,
+                      tenant: str = "default") -> None:
         """Append committed tokens; fsync'd before return, so a token
         acknowledged here survives a SIGKILL one instruction later.
         Over the per-session byte cap the journal compacts in place
@@ -507,39 +550,46 @@ class SessionJournal:
         itself outgrows the cap — truncates oldest tokens, marked."""
         rec = {"op": "tokens", "ids": [int(t) for t in tokens]}
         with self._lock:
-            self._append(session, rec)
-            path = self.path(str(session))
+            self._append(session, rec, tenant=str(tenant))
+            path = self.path(str(session), str(tenant))
             try:
                 size = os.path.getsize(path)
             except OSError:
                 return
             if size > self.max_bytes_per_session:
-                self._compact(str(session))
+                self._compact(str(session), str(tenant))
 
-    def compact(self, session: str) -> None:
+    def compact(self, session: str, tenant: str = "default") -> None:
         """Consolidate the session's records into one state record
         (called at retirement — a long-lived conversation's file stays
         one bounded record, not an unbounded append history)."""
         with self._lock:
-            self._compact(str(session))
+            self._compact(str(session), str(tenant))
 
     retire = compact
 
-    def drop(self, session: str) -> None:
+    def drop(self, session: str, tenant: str = "default") -> None:
         with self._lock:
             try:
-                os.unlink(self.path(str(session)))
+                os.unlink(self.path(str(session), str(tenant)))
             except OSError:
                 pass
 
     # -- replay ------------------------------------------------------------
-    def replay(self, session: str) -> Optional[SessionState]:
+    def replay(self, session: str,
+               tenant: str = "default") -> Optional[SessionState]:
         """Rebuild the session's state, truncating the file back to the
         last valid record when the tail is torn or a record is corrupt
         (everything after the first bad record is dropped — later
-        records may depend on the lost one)."""
+        records may depend on the lost one).  Namespaced: replaying a
+        session id under the wrong tenant answers None (belt: the path
+        digest differs; braces: a recorded state whose tenant mismatches
+        is refused even if the file were somehow shared)."""
         with self._lock:
-            return self._replay(str(session))
+            state = self._replay(str(session), str(tenant))
+            if state is not None and state.tenant != str(tenant):
+                return None
+            return state
 
     def sessions(self) -> List[str]:
         """Names of every replayable session in the journal root."""
@@ -558,13 +608,15 @@ class SessionJournal:
         text = json.dumps(rec, separators=(",", ":"), sort_keys=True)
         return (f"{zlib.crc32(text.encode()):08x} {text}\n").encode()
 
-    def _append(self, session: str, rec: Dict[str, Any]) -> None:
+    def _append(self, session: str, rec: Dict[str, Any],
+                tenant: str = "default") -> None:
         line = self._frame(rec)
         # the fault site covers the whole append: ``kill`` dies with
         # the record unwritten (the previous fsync'd state survives),
         # ``corrupt`` flips a stored byte so replay truncates here
-        line = get_faults().corrupt_point("kvtier.journal_append", line)
-        fd = os.open(self.path(session),
+        line = get_faults().corrupt_point("kvtier.journal_append", line,
+                                          tenant=tenant)
+        fd = os.open(self.path(session, tenant),
                      os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
         try:
             os.write(fd, line)
@@ -577,8 +629,9 @@ class SessionJournal:
         import tempfile
         rec = {"op": "state", "session": state.session,
                "prompt": state.prompt, "committed": state.committed,
-               "max_new": state.max_new, "truncated": state.truncated}
-        path = self.path(state.session)
+               "max_new": state.max_new, "truncated": state.truncated,
+               "tenant": state.tenant}
+        path = self.path(state.session, state.tenant)
         fd, tmp = tempfile.mkstemp(dir=self.root,
                                    prefix=os.path.basename(path) + ".tmp.")
         try:
@@ -608,8 +661,8 @@ class SessionJournal:
             except OSError:  # pragma: no cover — platform without dir fsync
                 pass
 
-    def _compact(self, session: str) -> None:
-        state = self._replay(session)
+    def _compact(self, session: str, tenant: str = "default") -> None:
+        state = self._replay(session, tenant)
         if state is None:
             return
         cap = self.max_bytes_per_session
@@ -634,8 +687,9 @@ class SessionJournal:
                           session=session, dropped=drop)
         self._write_state(state)
 
-    def _replay(self, session: str) -> Optional[SessionState]:
-        return self._replay_path(self.path(session), truncate=True)
+    def _replay(self, session: str,
+                tenant: str = "default") -> Optional[SessionState]:
+        return self._replay_path(self.path(session, tenant), truncate=True)
 
     def _replay_path(self, path: str,
                      truncate: bool = False) -> Optional[SessionState]:
@@ -663,7 +717,8 @@ class SessionJournal:
                     [int(t) for t in rec.get("prompt", [])],
                     [int(t) for t in rec.get("committed", [])],
                     int(rec.get("max_new", 0)),
-                    int(rec.get("truncated", 0)))
+                    int(rec.get("truncated", 0)),
+                    tenant=str(rec.get("tenant", "default")))
             elif rec.get("op") == "tokens" and state is not None:
                 state.committed.extend(int(t) for t in rec.get("ids", []))
         if truncate and valid_end < len(data):

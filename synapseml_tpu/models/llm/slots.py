@@ -359,11 +359,18 @@ class SlotEngine:
         self._retired_at = np.full(n, -np.inf)             # reclaim recency
         self._max_new = np.zeros(n, np.int64)
         self._generated = np.zeros(n, np.int64)
-        # radix prefix index over slot contexts: longest_prefix is
-        # exact by construction (tokens, not hashes), so reuse finds
-        # the TRUE longest match with no candidate probe and no
-        # first-min_prefix-tokens blind spot
-        self._radix = RadixPrefixIndex()
+        # radix prefix indices over slot contexts, ONE PER TENANT:
+        # longest_prefix is exact by construction (tokens, not hashes),
+        # so reuse finds the TRUE longest match with no candidate probe
+        # and no first-min_prefix-tokens blind spot — and a lookup can
+        # only ever match a slot the SAME tenant filled, so identical
+        # prompts from two tenants never share device K/V
+        self._radices: Dict[str, RadixPrefixIndex] = {}
+        #: per-slot owning tenant (admission sets it; sticky through
+        #: retirement so the retired prefix stays in its owner's index)
+        self._slot_tenant: List[str] = ["default"] * n
+        #: slot -> tenant whose radix currently indexes the slot
+        self._slot_radix: Dict[int, str] = {}
         #: optional :class:`~synapseml_tpu.models.llm.kvtier
         #: .HostKVArena` — when attached, ``_retire`` spills the slot's
         #: live K/V span to host RAM and ``admit`` restores warm
@@ -388,10 +395,10 @@ class SlotEngine:
         reg = get_registry()
         self._m_admit = reg.counter(
             "llm_admissions_total", "sequences admitted into a slot",
-            ("engine",))
+            ("engine", "tenant"))
         self._m_evict = reg.counter(
             "llm_evictions_total", "sequences retired from a slot",
-            ("engine", "reason"))
+            ("engine", "reason", "tenant"))
         self._m_tokens = reg.counter(
             "llm_engine_tokens_total", "tokens generated by the engine",
             ("engine",))
@@ -521,14 +528,37 @@ class SlotEngine:
         return self.spec_accepted / max(1, self.spec_drafted)
 
     # -- prefix reuse ------------------------------------------------------
+    def _radix_for(self, tenant: str) -> RadixPrefixIndex:
+        idx = self._radices.get(tenant)
+        if idx is None:
+            idx = self._radices[tenant] = RadixPrefixIndex()
+        return idx
+
     def _register_prefix(self, slot: int, ids: np.ndarray) -> None:
+        tenant = self._slot_tenant[slot]
+        prev = self._slot_radix.get(slot)
+        if prev is not None and prev != tenant:
+            # the slot changed hands: its old owner's index must not
+            # keep pointing at K/V the new owner is about to overwrite
+            idx = self._radices.get(prev)
+            if idx is not None:
+                idx.remove(slot)
+            del self._slot_radix[slot]
         if len(ids) < self.min_prefix:
-            self._radix.remove(slot)
+            idx = self._radices.get(tenant)
+            if idx is not None:
+                idx.remove(slot)
+            self._slot_radix.pop(slot, None)
         else:
-            self._radix.insert(ids, slot)
+            self._radix_for(tenant).insert(ids, slot)
+            self._slot_radix[slot] = tenant
 
     def _unregister_prefix(self, slot: int) -> None:
-        self._radix.remove(slot)
+        prev = self._slot_radix.pop(slot, None)
+        if prev is not None:
+            idx = self._radices.get(prev)
+            if idx is not None:
+                idx.remove(slot)
 
     def _clamp_reuse(self, lcp: int, total: int) -> int:
         """Shrink a reuse length until the remaining tail's PADDED
@@ -560,8 +590,13 @@ class SlotEngine:
         earlier turns: the K/V is already in place, so the admit skips
         the copy and just prefills the tail (``dst`` wins ties for
         that reason).  The returned lcp is additionally bucket-clamped
-        (:meth:`_clamp_reuse`)."""
-        src, lcp = self._radix.longest_prefix(prompt, prefer=dst)
+        (:meth:`_clamp_reuse`).  The walk is scoped to the admitting
+        slot's TENANT index — another tenant's identical tokens are
+        never a reuse source."""
+        radix = self._radices.get(self._slot_tenant[dst])
+        if radix is None:
+            return None, 0
+        src, lcp = radix.longest_prefix(prompt, prefer=dst)
         if src is None:
             return None, 0
         lcp = int(min(lcp, self.kv_len[src], len(prompt) - 1))
@@ -592,10 +627,14 @@ class SlotEngine:
         return int(sample_logits(jnp.asarray(logits)[None, :], sub,
                                  self.temperature, self.top_k, self.top_p)[0])
 
-    def admit(self, prompt_ids, max_new_tokens: int) -> Optional[AdmitResult]:
+    def admit(self, prompt_ids, max_new_tokens: int,
+              tenant: str = "default") -> Optional[AdmitResult]:
         """Admit one sequence into a free slot (prefill + first token).
         Returns None when every slot is busy — the caller queues or
-        sheds.  Raises ``ValueError`` for a prompt that cannot fit."""
+        sheds.  Raises ``ValueError`` for a prompt that cannot fit.
+        ``tenant`` namespaces every cache surface the sequence touches
+        (device radix, host arena, spill tickets) and labels the
+        admission/eviction counters."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -613,6 +652,10 @@ class SlotEngine:
         if slot is None:
             return None
         t0 = time.perf_counter()
+        tenant = str(tenant)
+        # the slot's tenant is set BEFORE any cache lookup: _best_prefix
+        # and _register_prefix scope themselves by it
+        self._slot_tenant[slot] = tenant
         src, lcp = self._best_prefix(prompt, slot)
         restored = False
         if self.kv_arena is not None:
@@ -620,12 +663,14 @@ class SlotEngine:
             # prefix restores instead (device reuse is free-er, so it
             # wins ties); every failure here degrades to the device/
             # cold path below — never a wrong token
-            akey, alcp = self.kv_arena.longest_prefix(prompt)
+            akey, alcp = self.kv_arena.longest_prefix(prompt,
+                                                      tenant=tenant)
             alcp = self._clamp_reuse(int(min(alcp, len(prompt) - 1)),
                                      len(prompt))
             if akey is not None and alcp >= self.min_prefix \
                     and alcp > lcp:
-                restored = self._restore_from_arena(akey, alcp, slot)
+                restored = self._restore_from_arena(akey, alcp, slot,
+                                                    tenant=tenant)
                 if restored:
                     src, lcp = None, alcp
         if restored or (src is not None and lcp > 0):
@@ -668,7 +713,7 @@ class SlotEngine:
             self._spec_ewma[slot] = 1.0
             self._drafter.begin(slot, self.ctx[slot], plen + 1)
         self.admissions += 1
-        self._m_admit.inc(1, engine=self.name)
+        self._m_admit.inc(1, engine=self.name, tenant=tenant)
         self.tokens_generated += 1
         self._m_tokens.inc(1, engine=self.name)
         finished, reason = self._finish_reason(slot, tok)
@@ -694,7 +739,8 @@ class SlotEngine:
         self.active[slot] = False
         self._retired_at[slot] = time.monotonic()
         self.evictions += 1
-        self._m_evict.inc(1, engine=self.name, reason=reason)
+        self._m_evict.inc(1, engine=self.name, reason=reason,
+                          tenant=self._slot_tenant[slot])
         span = int(self.kv_len[slot])
         if reason != "reset" and span >= self.min_prefix:
             # re-index the slot under its FULL retired context (prompt
@@ -715,18 +761,20 @@ class SlotEngine:
             rows = [{"k": np.asarray(jax.device_get(layer["k"][slot, :span])),
                      "v": np.asarray(jax.device_get(layer["v"][slot, :span]))}
                     for layer in self.cache]
-            self.kv_arena.put(self.ctx[slot, :span], rows, kind=kind)
+            self.kv_arena.put(self.ctx[slot, :span], rows, kind=kind,
+                              tenant=self._slot_tenant[slot])
         except Exception as exc:  # noqa: BLE001 — spill is best-effort
             _flight_record("kvtier_spill_failed", engine=self.name,
                            slot=int(slot), error=repr(exc))
 
-    def _restore_from_arena(self, key: int, span: int, slot: int) -> bool:
+    def _restore_from_arena(self, key: int, span: int, slot: int,
+                            tenant: str = "default") -> bool:
         """Restore ``span`` K/V rows of arena entry ``key`` into
         ``slot``.  False on any degraded outcome (checksum failure,
-        entry evicted since the probe) — counted, flight-recorded, and
-        the caller falls back to cold prefill."""
+        entry evicted since the probe, a cross-tenant key) — counted,
+        flight-recorded, and the caller falls back to cold prefill."""
         try:
-            rows = self.kv_arena.fetch(key, span)
+            rows = self.kv_arena.fetch(key, span, tenant=tenant)
         except ChecksumError:
             self._mkv.restores.inc(1, engine=self.name, source="host",
                                    outcome="corrupt")
@@ -773,7 +821,8 @@ class SlotEngine:
         ticket = {"ids": self.ctx[slot, :int(self.lengths[slot])].copy(),
                   "kv_len": int(self.kv_len[slot]),
                   "generated": int(self._generated[slot]),
-                  "max_new": int(self._max_new[slot])}
+                  "max_new": int(self._max_new[slot]),
+                  "tenant": self._slot_tenant[slot]}
         self._retire(slot, "preempted")
         self._m_occ.set(self.active_count / self.n_slots, engine=self.name)
         if self._drafter is not None:
@@ -796,15 +845,21 @@ class SlotEngine:
         slot = self._pick_slot()
         if slot is None:
             return None
+        tenant = str(ticket.get("tenant", "default"))
+        self._slot_tenant[slot] = tenant
         est = 0
         if self.kv_arena is not None and span >= self.min_prefix:
-            akey, alcp = self.kv_arena.longest_prefix(ids[:span])
+            akey, alcp = self.kv_arena.longest_prefix(ids[:span],
+                                                      tenant=tenant)
             alcp = self._clamp_reuse(int(min(alcp, span)), span)
             if akey is not None and alcp >= self.min_prefix \
-                    and self._restore_from_arena(akey, alcp, slot):
+                    and self._restore_from_arena(akey, alcp, slot,
+                                                 tenant=tenant):
                 est = alcp
         if est == 0:
-            src, dlcp = self._radix.longest_prefix(ids[:span], prefer=slot)
+            radix = self._radices.get(tenant)
+            src, dlcp = (radix.longest_prefix(ids[:span], prefer=slot)
+                         if radix is not None else (None, 0))
             if src is not None:
                 dlcp = self._clamp_reuse(
                     int(min(dlcp, self.kv_len[src], span)), span)
@@ -863,7 +918,8 @@ class SlotEngine:
         # prefix source anymore
         self.kv_len[:] = 0
         self.lengths[:] = 0
-        self._radix.clear()
+        self._radices.clear()
+        self._slot_radix.clear()
         if self._drafter is not None:
             for slot in range(self.n_slots):
                 self._drafter.forget(slot)

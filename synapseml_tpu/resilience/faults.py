@@ -48,10 +48,14 @@ with keys ``times`` (max firings, default unlimited), ``after`` (skip the
 first N matching calls), ``p`` (firing probability, drawn from the seeded
 RNG), ``delay`` (seconds, for ``slow``/``slow_rank``/``hang``), ``status``
 (override the HTTP code), ``retry_after`` (seconds, emitted as a
-``Retry-After`` header) and ``rank`` (the rule fires only on the process
+``Retry-After`` header), ``rank`` (the rule fires only on the process
 whose :attr:`FaultRegistry.rank` matches — workers set it from
 ``SMLTPU_PROCESS_ID``, so one ``SML_FAULTS`` string shared by a whole
-gang can target a single rank).
+gang can target a single rank) and ``tenant`` (the rule fires only for
+calls whose context carries that tenant id — the multi-tenant QoS plane
+passes ``tenant=`` at its kvtier/journal sites, so a noisy-neighbor
+chaos soak can corrupt or kill ONE tenant's spills while the victim
+tenant's are untouched).
 ``SML_FAULTS_SEED`` seeds the RNG (default 0).  Example::
 
     SML_FAULTS="http.send=http_503:times=2:retry_after=0.05;gbdt.checkpoint=kill:after=1:times=1"
@@ -127,6 +131,10 @@ class FaultRule:
     retry_after_s: Optional[float] = None
     #: only fire on the process whose registry rank matches (gang tests)
     rank: Optional[int] = None
+    #: only fire for calls whose ctx carries this tenant id (the
+    #: multi-tenant mirror of ``rank``; a call with NO tenant in its
+    #: ctx never matches a tenant-gated rule)
+    tenant: Optional[str] = None
     #: programmatic-only context predicate — the rule fires only for
     #: calls whose ctx satisfies it (a non-matching call does not even
     #: count toward ``after``)
@@ -169,9 +177,10 @@ class FaultRegistry:
                after: int = 0, p: float = 1.0, delay_s: float = 0.0,
                status: Optional[int] = None,
                retry_after_s: Optional[float] = None,
-               rank: Optional[int] = None, when=None) -> FaultRule:
+               rank: Optional[int] = None, tenant: Optional[str] = None,
+               when=None) -> FaultRule:
         rule = FaultRule(site, kind, times, after, p, delay_s, status,
-                         retry_after_s, rank, when)
+                         retry_after_s, rank, tenant, when)
         with self._lock:
             self._rules.append(rule)
         return rule
@@ -205,6 +214,8 @@ class FaultRegistry:
                     kw["retry_after_s"] = float(v)
                 elif k == "rank":
                     kw["rank"] = int(v)
+                elif k == "tenant":
+                    kw["tenant"] = str(v)
                 else:
                     raise ValueError(f"unknown fault option {k!r} in {part!r}")
             self.inject(site.strip(), kind, **kw)
@@ -255,6 +266,9 @@ class FaultRegistry:
                     continue
                 if rule.rank is not None and rule.rank != self.rank:
                     continue           # another rank's fault, not ours
+                if rule.tenant is not None \
+                        and ctx.get("tenant") != rule.tenant:
+                    continue           # another tenant's fault, not ours
                 if rule.when is not None and not rule.when(ctx):
                     continue           # ctx miss: not a matching call at all
                 rule.matched += 1

@@ -8,6 +8,7 @@ from .distributed import (DistributedServingServer, NoHealthyReplicaError,
                           ReplicaRouter, exchange_routing_table,
                           probe_replica)
 from .llm import LLMServer
+from .qos import QosScheduler, TenantPolicy, jain_fairness
 from .server import (ApiHandle, MultiPipelineServer, PipelineServer,
                      ServingReply, ServingRequest, ServingServer)
 
@@ -15,7 +16,8 @@ __all__ = ["ApiHandle", "AutoscalePolicy", "Autoscaler", "CapacityArbiter",
            "ContinuousClient", "DistributedServingServer",
            "LLMServer",
            "MultiPipelineServer", "NoHealthyReplicaError", "PipelineServer",
-           "ReplicaRouter", "ScaleDecision", "ServingReplicaSet",
-           "ServingReply", "ServingRequest",
-           "ServingServer", "SupervisorPool", "exchange_routing_table",
+           "QosScheduler", "ReplicaRouter", "ScaleDecision",
+           "ServingReplicaSet", "ServingReply", "ServingRequest",
+           "ServingServer", "SupervisorPool", "TenantPolicy",
+           "exchange_routing_table", "jain_fairness",
            "probe_replica", "sloz_signals"]

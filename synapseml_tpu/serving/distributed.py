@@ -182,18 +182,29 @@ class ReplicaRouter:
     def __init__(self, table: List[Tuple[str, int]], name: str = "serving",
                  failure_threshold: int = 3, cooldown_s: float = 5.0,
                  probe_timeout_s: float = 1.0,
-                 session_cache_size: int = 4096):
+                 session_cache_size: int = 4096,
+                 tenant_pin_cap: Optional[int] = None):
         self.name = name
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self._lock = threading.Lock()
         self._rr = 0
-        #: session key -> (host, port) — keyed by ADDRESS, not rank, so
-        #: an elastic resize renumbering the table cannot silently remap
-        #: a session onto a stranger's prefix cache.  Bounded LRU.
+        #: (tenant, session) -> (host, port) — keyed by ADDRESS, not
+        #: rank, so an elastic resize renumbering the table cannot
+        #: silently remap a session onto a stranger's prefix cache; and
+        #: by TENANT, so two tenants reusing one session id can never
+        #: share a pin.  Bounded LRU with per-tenant fairness: overflow
+        #: evicts from the largest-pinning tenant (its own oldest pin),
+        #: so one tenant's session churn cannot strip every other
+        #: tenant's pins; ``tenant_pin_cap`` additionally hard-caps one
+        #: tenant's pins (its cap overflow evicts only its own oldest).
         self._session_cap = int(session_cache_size)
-        self._sessions: "OrderedDict[str, Tuple[str, int]]" = OrderedDict()
+        self._tenant_pin_cap = (int(tenant_pin_cap)
+                                if tenant_pin_cap is not None else None)
+        self._sessions: "OrderedDict[Tuple[str, str], Tuple[str, int]]" \
+            = OrderedDict()
+        self._tenant_pins: Dict[str, int] = {}
         self._g_healthy = get_registry().gauge(
             "serving_replicas_healthy",
             "replicas currently probed healthy with a non-open breaker",
@@ -257,8 +268,47 @@ class ReplicaRouter:
         self._addr_rank = {addr: r for r, addr in enumerate(self.table)}
         for key in [s for s, addr in self._sessions.items()
                     if addr not in self._addr_rank]:
-            del self._sessions[key]
+            self._drop_pin(key)
         self._update_gauge()
+
+    # -- session-affinity pin bookkeeping (caller holds the lock) ----------
+    def _drop_pin(self, key: Tuple[str, str]) -> None:
+        if self._sessions.pop(key, None) is not None:
+            n = self._tenant_pins.get(key[0], 0) - 1
+            if n > 0:
+                self._tenant_pins[key[0]] = n
+            else:
+                self._tenant_pins.pop(key[0], None)
+
+    def _oldest_pin_of(self, tenant: str) -> Optional[Tuple[str, str]]:
+        for key in self._sessions:          # LRU order: oldest first
+            if key[0] == tenant:
+                return key
+        return None
+
+    def _insert_pin(self, key: Tuple[str, str],
+                    addr: Tuple[str, int]) -> None:
+        tenant = key[0]
+        if key not in self._sessions:
+            cap = self._tenant_pin_cap
+            if cap is not None and self._tenant_pins.get(tenant, 0) >= cap:
+                # the tenant's own oldest pin makes room: a hard-capped
+                # tenant's churn only ever evicts itself
+                old = self._oldest_pin_of(tenant)
+                if old is not None:
+                    self._drop_pin(old)
+            self._tenant_pins[tenant] = self._tenant_pins.get(tenant, 0) + 1
+        self._sessions[key] = addr
+        self._sessions.move_to_end(key)
+        while len(self._sessions) > self._session_cap:
+            # fairness at overflow: evict the LARGEST-pinning tenant's
+            # oldest pin, not the global LRU head — one flooding
+            # tenant's churn cannot strip every other tenant's pins
+            big = max(self._tenant_pins,
+                      key=lambda t: (self._tenant_pins[t], t))
+            old = self._oldest_pin_of(big)
+            self._drop_pin(old if old is not None
+                           else next(iter(self._sessions)))
 
     def _update_gauge(self) -> None:
         healthy = sum(1 for r in self._status
@@ -330,7 +380,8 @@ class ReplicaRouter:
         return f"http://{h}:{p}{'' if path == '/' else path}"
 
     def route(self, path: str = "/",
-              session: Optional[str] = None) -> Tuple[int, str]:
+              session: Optional[str] = None,
+              tenant: str = "default") -> Tuple[int, str]:
         """Next routable replica (round-robin) → ``(rank, url)``.
 
         Skips replicas probed dead or draining and replicas whose
@@ -346,12 +397,15 @@ class ReplicaRouter:
         unroutable (dead, draining, breaker-open, or dropped by an
         elastic resize), the session falls back to round-robin and
         RE-PINS to the replica it gets — a cold prefill, never a
-        failure."""
-        rank, addr, url, _outcome = self.route_addr(path, session=session)
+        failure.  Pins are namespaced by ``tenant``: two tenants
+        reusing one session id never share a replica pin."""
+        rank, addr, url, _outcome = self.route_addr(path, session=session,
+                                                    tenant=tenant)
         return rank, url
 
     def route_addr(self, path: str = "/",
-                   session: Optional[str] = None
+                   session: Optional[str] = None,
+                   tenant: str = "default"
                    ) -> Tuple[int, Tuple[str, int], str, str]:
         """:meth:`route` plus the routed ``(host, port)`` captured under
         the same lock — hand that address back to :meth:`report` and the
@@ -367,8 +421,10 @@ class ReplicaRouter:
         with self._lock:
             n = len(self.table)
             pinned = False
-            if session is not None:
-                addr = self._sessions.get(session)
+            key = (str(tenant), str(session)) if session is not None \
+                else None
+            if key is not None:
+                addr = self._sessions.get(key)
                 pinned = addr is not None
                 if addr is not None:
                     r = self._addr_rank.get(addr)
@@ -377,7 +433,7 @@ class ReplicaRouter:
                         # affinity hit: round-robin cursor untouched —
                         # pinned traffic must not skew the rotation the
                         # unpinned traffic balances on
-                        self._sessions.move_to_end(session)
+                        self._sessions.move_to_end(key)
                         self._m_affinity.inc(1, router=self.name,
                                              outcome="hit")
                         return r, addr, self.url_for(r, path), "hit"
@@ -389,11 +445,8 @@ class ReplicaRouter:
                 if not self._breakers[r].allow():
                     continue
                 self._rr = (r + 1) % n
-                if session is not None:
-                    self._sessions[session] = self.table[r]
-                    self._sessions.move_to_end(session)
-                    while len(self._sessions) > self._session_cap:
-                        self._sessions.popitem(last=False)
+                if key is not None:
+                    self._insert_pin(key, self.table[r])
                     # a pinned session falling through to round-robin
                     # lost its replica (resize/death/breaker): that is a
                     # REPIN (prefix cache gone); a first-ever route for
@@ -495,24 +548,27 @@ class DistributedServingServer:
 
     # -- failover ----------------------------------------------------------
     def route(self, path: str = "/",
-              session: Optional[str] = None) -> Tuple[int, str]:
+              session: Optional[str] = None,
+              tenant: str = "default") -> Tuple[int, str]:
         """Next healthy replica for a request; ``session`` pins
-        multi-turn requests to the replica holding their prefix cache
-        (see :meth:`ReplicaRouter.route`)."""
-        return self.router.route(path, session=session)
+        multi-turn requests to the replica holding their prefix cache,
+        namespaced by ``tenant`` (see :meth:`ReplicaRouter.route`)."""
+        return self.router.route(path, session=session, tenant=tenant)
 
     def route_addr(self, path: str = "/",
-                   session: Optional[str] = None
+                   session: Optional[str] = None,
+                   tenant: str = "default"
                    ) -> Tuple[int, Tuple[str, int], str, str]:
         """:meth:`route` plus the routed ``(host, port)`` — pass it back
         through :meth:`report_result`'s ``addr=`` so the report survives
         a concurrent table refresh renumbering the ranks — plus the
         affinity outcome (see :meth:`ReplicaRouter.route_addr`)."""
-        return self.router.route_addr(path, session=session)
+        return self.router.route_addr(path, session=session, tenant=tenant)
 
     def route_request(self, path: str = "/",
                       session: Optional[str] = None,
-                      trace_id: Optional[str] = None
+                      trace_id: Optional[str] = None,
+                      tenant: str = "default"
                       ) -> Tuple[int, Tuple[str, int], str,
                                  Dict[str, str], str]:
         """:meth:`route_addr` plus request-trace propagation: mints a
@@ -531,13 +587,17 @@ class DistributedServingServer:
         journal (or host arena) instead of silently serving it
         context-free."""
         from ..telemetry.tracing import mint_trace_id
-        from .server import TRACE_HEADER
+        from .server import TENANT_HEADER, TRACE_HEADER
         tid = trace_id or mint_trace_id()
         rank, addr, url, outcome = self.router.route_addr(
-            path, session=session)
+            path, session=session, tenant=tenant)
         flight_record("route", router=self.router.name, trace_id=tid,
-                      rank=rank, session=session, affinity=outcome)
-        return rank, addr, url, {TRACE_HEADER: tid}, outcome
+                      rank=rank, session=session, tenant=tenant,
+                      affinity=outcome)
+        headers = {TRACE_HEADER: tid}
+        if tenant != "default":
+            headers[TENANT_HEADER] = tenant
+        return rank, addr, url, headers, outcome
 
     def probe_replicas(self) -> Dict[int, str]:
         return self.router.probe_all()

@@ -75,6 +75,8 @@ class LLMServer:
                  kv_arena_bytes: Optional[int] = None,
                  journal: Any = None,
                  journal_dir: Optional[str] = None,
+                 qos: Any = None,
+                 tenant_policies: Optional[Dict[str, Any]] = None,
                  engine_kwargs: Optional[Dict[str, Any]] = None):
         # session survivability plane (docs/api/serving.md "Session
         # survivability & KV tiering"): kv_arena / kv_arena_bytes
@@ -120,6 +122,15 @@ class LLMServer:
         plane = getattr(engine, "compile_plane", None)
         if plane is not None:
             self.server.health.set_warmup(plane.snapshot)
+        # multi-tenant QoS (docs/api/serving.md "Multi-tenant QoS"):
+        # pass a prebuilt QosScheduler via qos=, or just per-tenant
+        # TenantPolicy contracts via tenant_policies= — requests carry
+        # their tenant in the X-SML-Tenant header or "tenant" payload
+        # field, and everything without one bills the default tenant
+        if qos is None and tenant_policies is not None:
+            from .qos import QosScheduler
+            qos = QosScheduler(policies=dict(tenant_policies))
+        self.qos = qos
         self._loop = _DecodeLoop(
             self.server, self.server._default, engine,
             input_parser=self._parse,
@@ -127,7 +138,11 @@ class LLMServer:
             max_new_tokens_default=max_new_tokens_default,
             ttft_slo_s=ttft_slo_s, token_slo_s=token_slo_s,
             trace_sample_every=trace_sample_every,
-            journal=journal)
+            journal=journal, qos=qos)
+        # the loop constructs a default scheduler when none was given —
+        # surface THAT one so callers can set policies/read attribution
+        if self.qos is None:
+            self.qos = self._loop.qos
 
     # -- request/reply shaping --------------------------------------------
     def _parse(self, req: ServingRequest) -> Dict[str, Any]:

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import threading
 import time
 import uuid
@@ -64,6 +65,15 @@ TRACE_HEADER_CANONICAL = "-".join(
     p.upper() if p == "sml" else p.capitalize()
     for p in TRACE_HEADER.split("-"))
 
+#: request header (lower-cased) naming the tenant a request bills to —
+#: the multi-tenant QoS plane keys admission weights, shed budgets, and
+#: SLO attribution by it; absent ⇒ the default tenant, so single-tenant
+#: traffic is untouched
+TENANT_HEADER = "x-sml-tenant"
+TENANT_HEADER_CANONICAL = "-".join(
+    p.upper() if p == "sml" else p.capitalize()
+    for p in TENANT_HEADER.split("-"))
+
 #: every reserved ``GET`` path a ServingServer listener answers before
 #: API routing.  The tier-1 endpoint-docs lint asserts (a) this tuple
 #: and ``ServingServer._reserved_handler`` agree with the dispatch
@@ -87,6 +97,13 @@ class ServingRequest:
     #: the client/balancer minted one upstream; None ⇒ the serving loop
     #: mints its own subject to sampling)
     trace_id: Optional[str] = None
+    #: billing/QoS tenant (the ``X-SML-Tenant`` header, overridable by
+    #: a ``tenant`` payload field); every pre-existing caller lands on
+    #: the default tenant with unchanged behavior
+    tenant: str = "default"
+    #: priority class override carried by the request (``priority``
+    #: payload field); None ⇒ the tenant policy's class applies
+    priority: Optional[int] = None
 
     def json(self) -> Any:
         return json.loads(self.body.decode("utf-8"))
@@ -692,10 +709,20 @@ class ServingServer:
     def _serve_sloz(self, query: str, headers: Dict[str, str]):
         """The windowed SLO snapshot (the autoscaler input contract):
         schema-validated BEFORE serving — a malformed window answers
-        500, never a silently wrong consumer input."""
+        500, never a silently wrong consumer input.  ``?tenant=<id>``
+        filters to that tenant's attribution planes (named
+        ``<base>@tenant=<id>``) so one tenant's burn rate is readable
+        without digging it out of aggregate percentiles."""
+        from urllib.parse import parse_qs
+        from ..telemetry.slo import plane_tenant
+        tenant = (parse_qs(query).get("tenant") or [None])[0]
         snap = get_slo_store().snapshot()
+        if tenant is not None:
+            snap["planes"] = {name: plane
+                              for name, plane in snap["planes"].items()
+                              if plane_tenant(name) == tenant}
         try:
-            check_sloz(snap)
+            check_sloz(snap, tenant=tenant)
         except ValueError as e:
             return (500, json.dumps(
                 {"error": f"sloz snapshot failed validation: {e}"}).encode(),
@@ -721,7 +748,8 @@ class ServingServer:
                     self._shed_headers())
         req = ServingRequest(id=uuid.uuid4().hex, method=method, path=path,
                              headers=headers, body=body,
-                             trace_id=headers.get(TRACE_HEADER))
+                             trace_id=headers.get(TRACE_HEADER),
+                             tenant=headers.get(TENANT_HEADER, "default"))
         ex = api.submit(req)
         if ex is None:                                 # backpressure
             return (503, b'{"error": "serving queue saturated"}',
@@ -1191,6 +1219,23 @@ class _DecodeSeq:
     #: reply) — the replay IS the reply; admitting it would decode one
     #: token past the requested budget
     replay_complete: bool = False
+    #: QoS tenant this sequence bills to (from the ``X-SML-Tenant``
+    #: header or the ``tenant`` payload field)
+    tenant: str = "default"
+    #: per-request priority-class override (None ⇒ tenant policy)
+    priority: Optional[int] = None
+    #: preemption ticket from ``engine.preempt`` while parked — the
+    #: sequence holds no slot and re-enters via ``engine.resume``
+    ticket: Optional[Dict[str, Any]] = None
+    #: the per-tenant rate budget was already charged for this request
+    #: (charged once, at first admission consideration)
+    budget_spent: bool = False
+
+    @property
+    def remaining(self) -> int:
+        """Tokens left in this sequence's budget (the preemption
+        victim tie-break: longest-remaining is cheapest to set aside)."""
+        return max(0, int(self.max_new) - len(self.tokens))
 
 
 class _DecodeLoop:
@@ -1256,7 +1301,8 @@ class _DecodeLoop:
                  token_slo_s: Optional[float] = None,
                  idle_timeout_s: float = 0.02,
                  trace_sample_every: Optional[int] = None,
-                 request_tracer=None, slo_window=None, journal=None):
+                 request_tracer=None, slo_window=None, journal=None,
+                 qos=None):
         self.server = server
         self.api = api
         self.engine = engine
@@ -1275,8 +1321,31 @@ class _DecodeLoop:
         self.ttft_slo_s = ttft_slo_s
         self.token_slo_s = token_slo_s
         self.idle_timeout_s = idle_timeout_s
+        #: the multi-tenant scheduling policy: weighted-fair admission
+        #: order, per-tenant rate budgets, and preemption verdicts all
+        #: come from here (jax-free; a default scheduler treats every
+        #: tenant equally, so single-tenant traffic behaves exactly as
+        #: the old FIFO did)
+        from .qos import QosScheduler
+        self.qos = qos if qos is not None else QosScheduler()
         self._waiting: List[_DecodeSeq] = []
+        #: preempted sequences holding a resume ticket instead of a
+        #: slot — auto-resumed token-exactly once pressure clears
+        self._parked: List[_DecodeSeq] = []
         self._by_slot: Dict[int, _DecodeSeq] = {}
+        # duck-typed engine/journal compatibility: only thread tenant
+        # kwargs through surfaces that declare them (test fakes and
+        # older engines keep working untouched)
+        import inspect
+        def _takes_tenant(fn) -> bool:
+            try:
+                return "tenant" in inspect.signature(fn).parameters
+            except (TypeError, ValueError):
+                return False
+        self._engine_tenant_kw = _takes_tenant(
+            getattr(engine, "admit", lambda: None))
+        self._journal_tenant_kw = journal is not None and _takes_tenant(
+            getattr(journal, "begin", lambda: None))
         self._step_ewma: Optional[float] = None
         self._retired_window: List[float] = []
         # request-scoped tracing: the process store by default (so the
@@ -1296,6 +1365,13 @@ class _DecodeLoop:
             self._slo.set_objective("ttft", float(ttft_slo_s))
         if token_slo_s is not None:
             self._slo.set_objective("token_latency", float(token_slo_s))
+        #: lazily-created per-tenant attribution planes (named
+        #: ``<api>@tenant=<id>``; filtered by ``/sloz?tenant=``) — fed
+        #: alongside the aggregate plane so a noisy tenant cannot hide
+        #: inside aggregate percentiles.  Occupancy is engine-wide, not
+        #: per-tenant, so tenant planes never observe it (their null
+        #: occupancy is skipped by the autoscaler reduction).
+        self._tenant_windows: Dict[str, Any] = {}
         self._slo_export_at = 0.0
         reg = get_registry()
         self._m_ttft = reg.histogram(
@@ -1310,7 +1386,10 @@ class _DecodeLoop:
             "loop", ("api",))
         self._m_sheds = reg.counter(
             "llm_sheds_total", "requests shed by the decode loop",
-            ("api", "reason"))
+            ("api", "reason", "tenant"))
+        self._m_preempt = reg.counter(
+            "llm_qos_preemptions_total", "slots preempted by the QoS "
+            "plane for a higher priority class", ("api", "tenant"))
         self._m_errors = reg.counter(
             "serving_errors_total", "batches failed (500) or shed (503)",
             ("api", "kind"))
@@ -1347,11 +1426,16 @@ class _DecodeLoop:
     # -- admission ---------------------------------------------------------
     def _pump_queue(self) -> None:
         """Move newly-arrived requests into the waiting list.  Blocks
-        only when the loop is otherwise idle; the pull is capped so the
-        bounded api queue keeps providing saturation backpressure."""
-        room = max(0, 2 * self.engine.n_slots - len(self._waiting))
-        if room == 0:
-            return
+        only when the loop is otherwise idle.  The pull DRAINS the api
+        queue: QoS admission (priority tiers, weighted-fair order,
+        tenant budgets) can only reorder what it has seen, so capping
+        the pull at a few slots' worth would leave a high-priority
+        tenant head-of-line-blocked in the raw FIFO behind a flooding
+        neighbor's burst.  Saturation backpressure still holds — the
+        api queue itself is bounded (``max_queue`` ⇒ enqueue-time
+        503), and the waiting list is bounded by that same cap."""
+        room = max(2 * self.engine.n_slots,
+                   getattr(self.api, "max_queue", 1024))
         if self.engine.active_count or self._waiting:
             batch = self.api.poll(room)
         else:
@@ -1367,13 +1451,20 @@ class _DecodeLoop:
                     raise ValueError("empty prompt")
                 max_new = int(spec.get("max_new_tokens",
                                        self.max_new_tokens_default))
+                # payload wins over the X-SML-Tenant header (a gateway
+                # may inject the header; an authenticated body field is
+                # more specific); absent both ⇒ the default tenant
+                tenant = str(spec.get("tenant") or req.tenant or "default")
+                prio = spec.get("priority", req.priority)
+                prio = int(prio) if prio is not None else None
             except Exception as e:  # noqa: BLE001 — isolated to record
                 self._m_errors.inc(1, api=self.api.path, kind="parse")
                 self._safe_reply(req.id, ServingReply(400, json.dumps(
                     {"error": f"unparseable record: {e}"}).encode()))
                 continue
             seq = _DecodeSeq(req, ids, max_new,
-                             bool(spec.get("stream", False)))
+                             bool(spec.get("stream", False)),
+                             tenant=tenant, priority=prio)
             if session is not None:
                 seq.session = str(session)
             if resume:
@@ -1418,7 +1509,14 @@ class _DecodeLoop:
         m = self.journal.metrics
         name = getattr(self.journal, "name", "llm")
         try:
-            st = self.journal.replay(seq.session)
+            # tenant-namespaced replay: the journal both hashes the
+            # tenant into the file path and refuses a state recorded
+            # under another tenant, so a cross-tenant session-id
+            # collision reads as a miss (→ 404), never as tenant B's
+            # committed tokens
+            st = (self.journal.replay(seq.session, tenant=seq.tenant)
+                  if self._journal_tenant_kw
+                  else self.journal.replay(seq.session))
         except Exception:  # noqa: BLE001 — degraded, never fatal
             st = None
         if st is None or not (st.prompt or st.committed):
@@ -1519,10 +1617,28 @@ class _DecodeLoop:
         rps = len(self._retired_window) / 5.0
         return {"Retry-After": str(retry_after_from_depth(depth, rps))}
 
+    def _tenant_slo(self, tenant: str):
+        """Get-or-create the per-tenant attribution plane (same
+        objectives as the aggregate plane, so burn rate is comparable
+        per tenant)."""
+        w = self._tenant_windows.get(tenant)
+        if w is None:
+            from ..telemetry.slo import tenant_plane_name
+            w = get_slo_store().window(
+                tenant_plane_name(self.api.path, tenant))
+            if self.ttft_slo_s is not None:
+                w.set_objective("ttft", float(self.ttft_slo_s))
+            if self.token_slo_s is not None:
+                w.set_objective("token_latency", float(self.token_slo_s))
+            self._tenant_windows[tenant] = w
+        return w
+
     def _shed(self, seq: _DecodeSeq, reason: str) -> None:
-        self._m_sheds.inc(1, api=self.api.path, reason=reason)
+        self._m_sheds.inc(1, api=self.api.path, reason=reason,
+                          tenant=seq.tenant)
         self._m_errors.inc(1, api=self.api.path, kind="shed")
         self._slo.count("shed")
+        self._tenant_slo(seq.tenant).count("shed")
         self._tracer.event(seq.trace_id, "shed", reason=reason)
         self._tracer.finish(seq.trace_id, "shed")
         self._safe_reply(seq.req.id, ServingReply(
@@ -1530,10 +1646,61 @@ class _DecodeLoop:
                              "exceeds the serving SLO"}).encode(),
             {**self._shed_headers(), **self._trace_headers(seq)}))
 
+    def _shed_budget(self, seq: _DecodeSeq, retry_after_s: float) -> None:
+        """Per-tenant rate-budget shed: 429 with the budget's own
+        refill horizon as ``Retry-After`` — the throttled tenant gets
+        an honest backoff hint, every other tenant is untouched."""
+        self._m_sheds.inc(1, api=self.api.path, reason="budget",
+                          tenant=seq.tenant)
+        self._m_errors.inc(1, api=self.api.path, kind="shed")
+        self._slo.count("shed")
+        self._tenant_slo(seq.tenant).count("shed")
+        self._tracer.event(seq.trace_id, "shed", reason="budget")
+        self._tracer.finish(seq.trace_id, "shed")
+        self._safe_reply(seq.req.id, ServingReply(
+            429, json.dumps({"error": "tenant over rate budget"}).encode(),
+            {"Retry-After": str(max(1, int(math.ceil(retry_after_s)))),
+             **self._trace_headers(seq)}))
+
     def _admit_waiting(self) -> None:
         keep: List[_DecodeSeq] = []
         ready_fn = getattr(self.engine, "admission_ready", None)
-        for pos, seq in enumerate(self._waiting):
+        # per-tenant rate budgets first (charged ONCE per request, in
+        # tokens = the requested budget, through the PR-2 token-bucket
+        # RetryBudget): an over-budget tenant sheds 429 with its own
+        # refill horizon while every other tenant is untouched
+        pool: List[_DecodeSeq] = list(self._parked)
+        self._parked = []
+        for seq in self._waiting:
+            if not seq.budget_spent:
+                seq.budget_spent = True
+                ok, retry_after = self.qos.shed_verdict(
+                    seq.tenant, float(seq.max_new))
+                if not ok:
+                    self._shed_budget(seq, retry_after)
+                    continue
+            pool.append(seq)
+        # weighted-fair admission order: strict priority tiers, token-
+        # weighted deficit round robin across tenants within a tier
+        # (parked preempted sequences compete through the same order)
+        starved: List[_DecodeSeq] = []
+        for pos, seq in enumerate(self.qos.admission_order(pool)):
+            if seq.ticket is not None:
+                # preempted earlier: re-enter through engine.resume —
+                # restore + continue is token-exact (the PR 17 kvtier
+                # ticket contract), so pressure clearing auto-resumes
+                # the victim with zero wrong tokens
+                slot = (self.engine.resume(seq.ticket)
+                        if self.engine.free_slot_count > 0 else None)
+                if slot is None:
+                    starved.append(seq)
+                    keep.append(seq)
+                    continue
+                seq.ticket = None
+                seq.slot = slot
+                self._by_slot[slot] = seq
+                self._tracer.event(seq.trace_id, "resumed", slot=slot)
+                continue
             if ready_fn is not None and not ready_fn(len(seq.ids)):
                 # a program this admission needs is still compiling
                 # (the compile plane bumped it to the front of the
@@ -1551,10 +1718,14 @@ class _DecodeLoop:
                 self._shed(seq, "slo")
                 continue
             if self.engine.free_slot_count == 0:
+                starved.append(seq)
                 keep.append(seq)
                 continue
             try:
-                res = self.engine.admit(seq.ids, seq.max_new)
+                res = (self.engine.admit(seq.ids, seq.max_new,
+                                         tenant=seq.tenant)
+                       if self._engine_tenant_kw
+                       else self.engine.admit(seq.ids, seq.max_new))
             except ValueError as e:             # prompt cannot fit
                 self._m_errors.inc(1, api=self.api.path, kind="parse")
                 self._tracer.finish(seq.trace_id, "error", error=str(e))
@@ -1562,6 +1733,7 @@ class _DecodeLoop:
                     400, json.dumps({"error": str(e)}).encode()))
                 continue
             if res is None:                     # raced full — requeue
+                starved.append(seq)
                 keep.append(seq)
                 continue
             seq.slot = res.slot
@@ -1570,6 +1742,9 @@ class _DecodeLoop:
             self._m_ttft.observe(ttft, api=self.api.path)
             self._slo.observe_ttft(ttft)
             self._slo.count("admitted")
+            tslo = self._tenant_slo(seq.tenant)
+            tslo.observe_ttft(ttft)
+            tslo.count("admitted")
             self._tracer.event(
                 seq.trace_id, "admitted", slot=res.slot,
                 reused_tokens=getattr(res, "reused_tokens", 0))
@@ -1594,10 +1769,53 @@ class _DecodeLoop:
                 # tokens, so a SECOND crash replays prompt' = prompt +
                 # committed and stays token-exact
                 self._journal_safe(lambda s=seq: self.journal.begin(
-                    s.session, s.ids, s.max_new))
+                    s.session, s.ids, s.max_new, tenant=s.tenant)
+                    if self._journal_tenant_kw else
+                    self.journal.begin(s.session, s.ids, s.max_new))
             self._on_token(seq, res.token, res.finished,
                            getattr(res, "reason", None))
-        self._waiting = keep
+        self._waiting = [s for s in keep if s.ticket is None]
+        self._parked = [s for s in keep if s.ticket is not None]
+        self._maybe_preempt(starved)
+
+    def _maybe_preempt(self, starved: List[_DecodeSeq]) -> None:
+        """Preemption policy: when capacity-starved demand includes a
+        STRICTLY higher priority class than some active slot, evict the
+        lowest-priority longest-remaining slot through the engine's
+        ticket path (``preempt``/``resume``, PR 17) and park it — the
+        freed slot serves the higher class next tick and the victim
+        auto-resumes token-exactly when pressure clears.  Every verdict
+        is flight-recorded with the justifying pressure snapshot."""
+        if not starved or self.engine.free_slot_count > 0:
+            return
+        preempt_fn = getattr(self.engine, "preempt", None)
+        if preempt_fn is None or not self._by_slot:
+            return
+        demand = max(self.qos.priority_of(s) for s in starved)
+        victim = self.qos.preemption_victim(
+            demand, list(self._by_slot.values()))
+        if victim is None:
+            return
+        # snapshot the JUSTIFYING state before the eviction mutates it
+        # (preempt frees the slot, so free_slots would read post-hoc)
+        snap = self.qos.pressure_snapshot(starved,
+                                          self.engine.free_slot_count)
+        ticket = preempt_fn(victim.slot)
+        if ticket is None:
+            return
+        self._by_slot.pop(victim.slot, None)
+        victim.ticket = ticket
+        victim.slot = None
+        self._parked.append(victim)
+        self._m_preempt.inc(1, api=self.api.path, tenant=victim.tenant)
+        self._tracer.event(victim.trace_id, "preempted",
+                           demand_priority=demand)
+        _flight_record("qos_preemption", api=self.api.path,
+                       tenant=victim.tenant,
+                       victim_priority=self.qos.priority_of(victim),
+                       demand_priority=demand,
+                       victim_remaining=victim.remaining,
+                       pressure=snap)
 
     # -- token/retirement handling ----------------------------------------
     def _on_token(self, seq: _DecodeSeq, token: int, finished: bool,
@@ -1607,6 +1825,9 @@ class _DecodeLoop:
             # client received must survive a SIGKILL one instruction
             # later (the append is fsync'd)
             self._journal_safe(lambda s=seq, t=token:
+                               self.journal.append_tokens(
+                                   s.session, [int(t)], tenant=s.tenant)
+                               if self._journal_tenant_kw else
                                self.journal.append_tokens(s.session,
                                                           [int(t)]))
         seq.tokens.append(int(token))
@@ -1627,6 +1848,7 @@ class _DecodeLoop:
                                 if now - t < 5.0]
         self._retired_window.append(now)
         self._slo.count("retired")
+        self._tenant_slo(seq.tenant).count("retired")
         self._tracer.event(seq.trace_id, "retired",
                            tokens=len(seq.tokens), reason=reason)
         self._tracer.finish(seq.trace_id, "retired",
@@ -1637,6 +1859,9 @@ class _DecodeLoop:
             # disk — it is the failover source for the NEXT turn and
             # for a relaunch
             self._journal_safe(lambda s=seq:
+                               self.journal.retire(s.session,
+                                                   tenant=s.tenant)
+                               if self._journal_tenant_kw else
                                self.journal.retire(s.session))
         payload = self.output_formatter(seq.tokens)
         if seq.stream_obj is not None:
@@ -1676,6 +1901,25 @@ class _DecodeLoop:
                 self._tracer.event(seq.trace_id, "cancelled", reason=kind)
                 self._tracer.finish(seq.trace_id, kind,
                                     tokens=len(seq.tokens))
+        # a PARKED (preempted) sequence holds no slot but still owns a
+        # reply window/stream — the same expiry rules drop its ticket
+        live_parked: List[_DecodeSeq] = []
+        for seq in self._parked:
+            if seq.stream_obj is not None:
+                dead = seq.stream_obj.abandoned
+                kind = "disconnect"
+            else:
+                dead = (now - seq.req.enqueued_at
+                        > self.api.reply_timeout_s)
+                kind = "expired"
+            if dead:
+                self._m_errors.inc(1, api=self.api.path, kind=kind)
+                self._tracer.event(seq.trace_id, "cancelled", reason=kind)
+                self._tracer.finish(seq.trace_id, kind,
+                                    tokens=len(seq.tokens))
+            else:
+                live_parked.append(seq)
+        self._parked = live_parked
 
     # -- the loop ----------------------------------------------------------
     def _loop(self) -> None:
@@ -1718,6 +1962,12 @@ class _DecodeLoop:
             tok_s = dt / span[ev.slot]
             self._m_tok_lat.observe(tok_s, api=self.api.path)
             self._slo.observe_token_latency(tok_s)
+            self._tenant_slo(seq.tenant).observe_token_latency(tok_s)
+            # the DRR deficit is charged by COMMITTED tokens, one per
+            # step event — a speculative engine commits several per
+            # slot per step, so token-weighting (not request-counting)
+            # is what keeps the fair shares honest under spec decode
+            self.qos.charge(seq.tenant, 1)
             self._on_token(seq, ev.token, ev.finished, ev.reason)
         if events and dt > 0:
             self._m_rps.set(len(events) / dt, api=self.api.path)
@@ -1735,6 +1985,8 @@ class _DecodeLoop:
             self._slo.observe_occupancy(
                 self.engine.active_count / max(1, self.engine.n_slots))
             self._slo.export_gauges()
+            for w in self._tenant_windows.values():
+                w.export_gauges()
 
     def _fail_inflight(self, e: Exception) -> None:
         """Answer every in-flight sequence 500 (streams get a final
@@ -1773,10 +2025,11 @@ class _DecodeLoop:
         # stream would leak that (non-daemon) thread past close —
         # observed as a process that never exits.  After the join the
         # loop thread is gone, so this cannot race a push.
-        for seq in self._by_slot.values():
+        for seq in list(self._by_slot.values()) + self._parked:
             if seq.stream_obj is not None:
                 seq.stream_obj.finish()
         self._by_slot.clear()
+        self._parked.clear()
 
 
 def _default_format(value: Any) -> bytes:

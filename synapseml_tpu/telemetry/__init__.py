@@ -76,7 +76,7 @@ from .roofline import (ROOFLINE_BLOCK_KEYS, check_roofline_block,
                        paired_roofline, roofline_block)
 from .slo import (SLO_METRICS, SLOZ_SCHEMA, SLOZ_SCHEMA_VERSION, SloStore,
                   SloWindow, WindowedCounter, WindowedHistogram, check_sloz,
-                  get_slo_store)
+                  get_slo_store, plane_tenant, tenant_plane_name)
 from .tracing import (RequestTraceStore, Span, Tracer, get_request_tracer,
                       get_tracer, mint_trace_id, span)
 
@@ -88,7 +88,7 @@ __all__ = [
     "RequestTraceStore", "get_request_tracer", "mint_trace_id",
     "SloStore", "SloWindow", "WindowedCounter", "WindowedHistogram",
     "check_sloz", "get_slo_store", "SLOZ_SCHEMA", "SLOZ_SCHEMA_VERSION",
-    "SLO_METRICS",
+    "SLO_METRICS", "plane_tenant", "tenant_plane_name",
     "render_prometheus", "render_json", "PROMETHEUS_CONTENT_TYPE",
     "SchemaError", "check_schema", "dumps_checked", "write_json",
     "read_json",

@@ -51,7 +51,8 @@ from .registry import (SERVING_TOKEN_LATENCY_BUCKETS, SERVING_TTFT_BUCKETS,
 __all__ = ["WindowedHistogram", "WindowedCounter", "SloWindow", "SloStore",
            "get_slo_store", "check_sloz", "SLOZ_SCHEMA",
            "SLOZ_SCHEMA_VERSION", "SLO_METRICS",
-           "DEFAULT_WINDOW_S", "DEFAULT_SLICES"]
+           "DEFAULT_WINDOW_S", "DEFAULT_SLICES",
+           "TENANT_PLANE_SEP", "tenant_plane_name", "plane_tenant"]
 
 #: default sliding-window length (seconds) and slice count — six 10 s
 #: slices: the window advances in 10 s steps, so the digest spans
@@ -84,6 +85,27 @@ SLO_METRICS = frozenset({
 #: quantiles every window exports (gauge label + snapshot fields)
 _QUANTILES: Tuple[Tuple[str, float], ...] = (
     ("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+#: separator embedding a tenant id in a plane name.  Per-tenant SLO
+#: attribution rides the EXISTING get-or-create plane registry — a
+#: tenant's plane is just ``<base>@tenant=<id>`` — so ``/sloz`` needs
+#: no schema change (version 2 holds) and ``/sloz?tenant=`` is a pure
+#: plane-name filter.
+TENANT_PLANE_SEP = "@tenant="
+
+
+def tenant_plane_name(base: str, tenant: str) -> str:
+    """The plane name carrying ``base``'s per-tenant window for
+    ``tenant`` (e.g. ``"/llm@tenant=acme"``)."""
+    return f"{base}{TENANT_PLANE_SEP}{tenant}"
+
+
+def plane_tenant(name: str) -> Optional[str]:
+    """The tenant a plane name is attributed to (None for aggregate
+    planes)."""
+    if TENANT_PLANE_SEP not in name:
+        return None
+    return name.split(TENANT_PLANE_SEP, 1)[1]
 
 
 def _num(v) -> Optional[float]:
@@ -428,10 +450,14 @@ _SIGNAL_KEYS = ("count", "mean_s", "p50_s", "p95_s", "p99_s")
 _SLO_KEYS = ("threshold_s", "target", "attainment", "burn_rate")
 
 
-def check_sloz(obj: Any) -> None:
+def check_sloz(obj: Any, tenant: Optional[str] = None) -> None:
     """Validate a ``/sloz`` snapshot (raises ``ValueError``): required
     keys at every level, every leaf numeric or null — the contract the
-    ROADMAP-item-4 autoscaler consumes."""
+    ROADMAP-item-4 autoscaler consumes.  With ``tenant`` set the
+    snapshot must additionally be a tenant-filtered view: every plane
+    name carries exactly that tenant (the ``/sloz?tenant=`` contract —
+    a filter that leaked another tenant's plane is a validation error,
+    not a smaller bug)."""
     if not isinstance(obj, dict):
         raise ValueError("sloz snapshot must be a dict")
     for key in SLOZ_SCHEMA:
@@ -445,6 +471,12 @@ def check_sloz(obj: Any) -> None:
             "a foreign contract era")
     if not isinstance(obj["planes"], dict):
         raise ValueError("sloz planes must be a dict")
+    if tenant is not None:
+        for name in obj["planes"]:
+            if plane_tenant(name) != tenant:
+                raise ValueError(
+                    f"sloz plane {name!r} leaked into the tenant="
+                    f"{tenant!r} filtered view")
 
     def _leaf(path: str, v: Any) -> None:
         if v is not None and not isinstance(v, (int, float)):

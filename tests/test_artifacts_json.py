@@ -154,6 +154,34 @@ AUTOSCALE_REQUIRED = (
     "autoscale_arbiter_serving_dropped",
 )
 
+#: the multi-tenant QoS plane (ISSUE 18): a record carrying ANY
+#: ``qos_`` key must carry the whole set — the victim-TTFT triple
+#: (solo / FIFO-aggregate / QoS) with BOTH ratios, the preemption and
+#: flood-budget-shed counts, per-tenant attainment, and the weighted
+#: share-convergence block with its fairness indices — so a partially-
+#: failed QoS leg cannot ship an isolation win without its FIFO anchor
+#: or a share claim without its error-vs-weights honesty field
+QOS_REQUIRED = (
+    "qos_victim_ttft_p50_ms_solo",
+    "qos_victim_ttft_p99_ms_solo",
+    "qos_victim_ttft_p99_ms_fifo",
+    "qos_victim_ttft_p99_ms_qos",
+    "qos_victim_ttft_ratio_fifo",
+    "qos_victim_ttft_ratio_qos",
+    "qos_preemptions",
+    "qos_flood_budget_sheds",
+    "qos_victim_attainment_qos",
+    "qos_flood_attainment_qos",
+    "qos_share_heavy",
+    "qos_share_light",
+    "qos_share_target_heavy",
+    "qos_share_err_pct",
+    "qos_fairness_jain_raw",
+    "qos_fairness_jain_weighted",
+    "qos_probes",
+    "qos_flood_burst",
+)
+
 LLMSERVE_SPEC_REQUIRED = (
     "llmserve_spec_tokens_per_sec",
     "llmserve_spec_tokens_per_step",
@@ -350,6 +378,23 @@ def test_kvtier_fields_complete():
                if rec[k] is not None
                and not isinstance(rec[k], (int, float))]
         assert not bad, f"{name}: non-numeric kvtier fields: {bad}"
+
+
+def test_qos_fields_complete():
+    """ISSUE 18: a record carrying any ``qos_`` field (the multi-tenant
+    QoS plane) carries the WHOLE set, each numeric or null — no victim
+    isolation claim without its FIFO-aggregate anchor, no share claim
+    without its error-vs-weights field."""
+    for name, rec in _bench_records():
+        qos_keys = [k for k in rec if k.startswith("qos_")]
+        if not qos_keys or _labeled_partial(rec):
+            continue
+        missing = [k for k in QOS_REQUIRED if k not in rec]
+        assert not missing, f"{name}: incomplete qos block: {missing}"
+        bad = [k for k in qos_keys
+               if rec[k] is not None
+               and not isinstance(rec[k], (int, float))]
+        assert not bad, f"{name}: non-numeric qos fields: {bad}"
 
 
 def test_comms_topo_fields_complete():

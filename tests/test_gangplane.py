@@ -738,6 +738,18 @@ class TestMetricHygiene:
         missing = sorted(n for n in KVTIER_METRICS if n not in docs)
         assert not missing, f"kvtier metrics absent from docs: {missing}"
 
+    def test_every_qos_metric_is_documented(self):
+        """ISSUE 18: the multi-tenant QoS plane's metric names (the
+        preemption counter; the tenant label on the shed/admission/
+        eviction counters) are held to the same docs bar."""
+        from synapseml_tpu.serving.qos import QOS_METRICS
+        docs = "\n".join(p.read_text(encoding="utf-8")
+                         for p in (REPO / "docs" / "api").glob("*.md"))
+        missing = sorted(n for n in QOS_METRICS if n not in docs)
+        assert not missing, f"QoS metrics absent from docs: {missing}"
+        # the tenant label contract itself is documented
+        assert "X-SML-Tenant" in docs and "tenant=" in docs
+
     def test_registry_sees_no_duplicate_kind_at_runtime(self):
         """Importing the wired modules must not blow up on registration
         conflicts (the registry raises on kind/label mismatches)."""

@@ -336,13 +336,13 @@ class TestRestoreFromHostTokenExact:
 
         class _Racy:
             """Arena proxy whose entry vanishes after the probe."""
-            def longest_prefix(self, ids):
-                key, lcp = arena.longest_prefix(ids)
+            def longest_prefix(self, ids, tenant="default"):
+                key, lcp = arena.longest_prefix(ids, tenant=tenant)
                 arena.clear()
                 return key, lcp
 
-            def fetch(self, key, length):
-                return arena.fetch(key, length)
+            def fetch(self, key, length, tenant="default"):
+                return arena.fetch(key, length, tenant=tenant)
 
             def put(self, *a, **k):
                 return None

@@ -297,9 +297,9 @@ class TestSlotEngineScheduling:
         eng.run_to_completion()
         assert g.value(engine="t-occ") == 0.0
         assert get_registry().get("llm_admissions_total").value(
-            engine="t-occ") == 1.0
+            engine="t-occ", tenant="default") == 1.0
         assert get_registry().get("llm_evictions_total").value(
-            engine="t-occ", reason="length") == 1.0
+            engine="t-occ", reason="length", tenant="default") == 1.0
 
 
 def _post(url, payload, timeout=30):
@@ -511,7 +511,8 @@ class TestLLMServer:
                 time.sleep(0.01)
             assert srv.engine.active_count == 0
             assert get_registry().get("llm_evictions_total").value(
-                engine="t-disc", reason="cancelled") == 1.0
+                engine="t-disc", reason="cancelled",
+                tenant="default") == 1.0
         finally:
             srv.close()
 
@@ -570,7 +571,8 @@ class TestLLMServer:
                 time.sleep(0.01)
             assert srv.engine.active_count == 0
             assert get_registry().get("llm_evictions_total").value(
-                engine="t-exp", reason="cancelled") == 1.0
+                engine="t-exp", reason="cancelled",
+                tenant="default") == 1.0
         finally:
             srv.close()
 
@@ -625,20 +627,20 @@ class TestSessionAffinity:
         r.route(session="conv-3")
         # pin the session to the LAST replica, then shrink it away
         with r._lock:
-            r._sessions["conv-3"] = ("127.0.0.1", 9002)
+            r._sessions[("default", "conv-3")] = ("127.0.0.1", 9002)
         r.refresh([("127.0.0.1", 9000), ("127.0.0.1", 9001)])
-        assert "conv-3" not in r._sessions           # fell back cleanly
+        assert ("default", "conv-3") not in r._sessions   # fell back cleanly
         rank, _ = r.route(session="conv-3")          # never crashes
         assert rank in (0, 1)
-        assert r._sessions["conv-3"] in r.table
+        assert r._sessions[("default", "conv-3")] in r.table
 
     def test_session_cache_bounded_lru(self):
         r = self._router(session_cache_size=2)
         r.route(session="s1")
         r.route(session="s2")
         r.route(session="s3")
-        assert "s1" not in r._sessions
-        assert set(r._sessions) == {"s2", "s3"}
+        assert ("default", "s1") not in r._sessions
+        assert set(r._sessions) == {("default", "s2"), ("default", "s3")}
 
 
 def test_speculative_metrics_exported(tiny_model):

@@ -1,0 +1,354 @@
+"""Multi-tenant QoS scheduler-core tests (ISSUE 18).
+
+The contract under test (``synapseml_tpu/serving/qos.py`` — pure
+bookkeeping, deliberately jax-free, driven here on an injectable fake
+clock with no engine at all):
+
+- deficit accounting: refill by ``quantum x weight`` per round, charge
+  by COMMITTED tokens, clamped to ``±burst_quanta`` quanta so neither
+  banked credit nor dug holes are unbounded;
+- DRR admission order: weighted interleave within a priority class,
+  FIFO within a tenant, single-tenant queues come back in arrival
+  order (the old FIFO is the degenerate case);
+- priority classes: strictly descending tiers; preemption verdicts
+  name the lowest-priority longest-remaining victim, only for demand
+  STRICTLY above the victim's class, rate-limited by the anti-thrash
+  cooldown;
+- shed budgets: the PR 2 token bucket on the injectable clock — an
+  over-budget tenant sheds with a computed Retry-After and recovers
+  exactly when the bucket refills;
+- spec-decode token-weighting: charging multi-token commit spans (what
+  a speculative engine emits) moves the share/deficit by TOKENS, not
+  requests;
+- ``jain_fairness`` edge cases, and the module stays jax-free.
+"""
+
+import types
+
+import pytest
+
+from synapseml_tpu.serving.qos import (DEFAULT_PRIORITY, DEFAULT_TENANT,
+                                       QosScheduler, TenantPolicy,
+                                       jain_fairness)
+
+pytestmark = pytest.mark.qos
+
+
+def _item(tenant, max_new=8, priority=None, remaining=0, tag=None):
+    return types.SimpleNamespace(tenant=tenant, max_new=max_new,
+                                 priority=priority, remaining=remaining,
+                                 tag=tag)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# policies
+# ---------------------------------------------------------------------------
+
+def test_policy_validation_rejects_nonpositive_weight_and_rate():
+    with pytest.raises(ValueError):
+        TenantPolicy(weight=0.0)
+    with pytest.raises(ValueError):
+        TenantPolicy(weight=-1.0)
+    with pytest.raises(ValueError):
+        TenantPolicy(rate_tokens_per_s=0.0)
+    TenantPolicy(weight=2.0, rate_tokens_per_s=10.0)  # valid
+
+
+def test_default_policy_and_priority_resolution():
+    q = QosScheduler(policies={"gold": TenantPolicy(priority=5)})
+    assert q.policy("unknown") is q.default_policy
+    assert q.priority_of(_item("unknown")) == DEFAULT_PRIORITY
+    # tenant policy supplies the class when the item declares none
+    assert q.priority_of(_item("gold")) == 5
+    # an item-level priority overrides its tenant's policy
+    assert q.priority_of(_item("gold", priority=2)) == 2
+
+
+def test_set_policy_rearms_budget_from_new_rate():
+    clk = FakeClock()
+    q = QosScheduler(policies={"a": TenantPolicy(rate_tokens_per_s=1.0,
+                                                 burst_tokens=1.0)},
+                     clock=clk)
+    admit, _ = q.shed_verdict("a", 1.0)
+    assert admit
+    admit, _ = q.shed_verdict("a", 1.0)
+    assert not admit
+    # raising the rate re-arms the bucket at the new capacity
+    q.set_policy("a", TenantPolicy(rate_tokens_per_s=100.0,
+                                   burst_tokens=50.0))
+    admit, _ = q.shed_verdict("a", 40.0)
+    assert admit
+
+
+# ---------------------------------------------------------------------------
+# deficit accounting
+# ---------------------------------------------------------------------------
+
+def test_refill_tracks_committed_tokens_by_weight_share():
+    """Virtual-time DRR: a round refills each waiting tenant by its
+    weight share of the tokens committed since the LAST round — total
+    refill equals total charge, so deficits measure distance from the
+    fair share.  An idle loop ticking rounds with no commits refills
+    nothing (the old quantum-per-round refill would saturate every
+    tenant at the burst cap between token commits)."""
+    q = QosScheduler(policies={"a": TenantPolicy(weight=3.0),
+                               "b": TenantPolicy(weight=1.0)},
+                     quantum_tokens=10.0, burst_quanta=8.0,
+                     clock=FakeClock())
+    both = [_item("a"), _item("b")]
+    for _ in range(50):                # idle rounds: no commits
+        q.admission_order(both)
+    assert q.deficit("a") == 0.0
+    assert q.deficit("b") == 0.0
+    q.charge("a", 12)                  # 12 committed tokens, all by a
+    q.admission_order(both)            # refill: a += 9, b += 3
+    assert q.deficit("a") == pytest.approx(9.0 - 12.0)
+    assert q.deficit("b") == pytest.approx(3.0)
+    assert q.committed("a") == 12
+
+
+def test_deficit_clamped_to_burst_cap_both_directions():
+    q = QosScheduler(quantum_tokens=10.0, burst_quanta=2.0,
+                     clock=FakeClock())
+    cap = 10.0 * 1.0 * 2.0
+    # a starved waiting tenant cannot bank unbounded credit while a
+    # neighbor commits a flood of tokens
+    q.charge("flood", 10_000)
+    q.admission_order([_item("a")])
+    assert q.deficit("a") == pytest.approx(cap)
+    # and a flooding tenant cannot dig an unbounded hole
+    assert q.deficit("flood") == pytest.approx(-cap)
+
+
+def test_charge_accumulates_committed_and_share():
+    q = QosScheduler(clock=FakeClock())
+    q.charge("a", 30)
+    q.charge("b", 10)
+    share = q.committed_share()
+    assert share["a"] == pytest.approx(0.75)
+    assert share["b"] == pytest.approx(0.25)
+    q.reset()
+    assert q.committed("a") == 0
+    assert q.committed_share() == {}
+
+
+# ---------------------------------------------------------------------------
+# DRR admission order
+# ---------------------------------------------------------------------------
+
+def test_single_tenant_queue_is_fifo():
+    q = QosScheduler(clock=FakeClock())
+    items = [_item(DEFAULT_TENANT, tag=i) for i in range(6)]
+    assert [it.tag for it in q.admission_order(items)] == list(range(6))
+
+
+def test_weighted_interleave_within_one_class():
+    q = QosScheduler(policies={"a": TenantPolicy(weight=3.0),
+                               "b": TenantPolicy(weight=1.0)},
+                     quantum_tokens=8.0, clock=FakeClock())
+    items = [_item(t, max_new=8, tag=f"{t}{i}")
+             for t in ("a", "b") for i in range(4)]
+    order = q.admission_order(items)
+    tenants = [it.tenant for it in order]
+    # the 3:1 tenant lands 3 of the first 4 picks; neither tenant sweeps
+    assert tenants[:4].count("a") == 3
+    assert set(tenants[:2]) == {"a", "b"} or tenants[:3].count("a") == 3
+    # FIFO within each tenant
+    assert [it.tag for it in order if it.tenant == "a"] == \
+        ["a0", "a1", "a2", "a3"]
+    assert [it.tag for it in order if it.tenant == "b"] == \
+        ["b0", "b1", "b2", "b3"]
+
+
+def test_flooding_tenant_cannot_sweep_a_round():
+    q = QosScheduler(quantum_tokens=8.0, clock=FakeClock())
+    flood = [_item("flood", max_new=8, tag=f"f{i}") for i in range(20)]
+    victim = [_item("victim", max_new=8, tag="v0")]
+    order = q.admission_order(flood + victim)
+    # equal weights: the victim's single request lands in the first two
+    assert "v0" in [it.tag for it in order[:2]]
+
+
+def test_priority_classes_strictly_descending():
+    q = QosScheduler(clock=FakeClock())
+    lo = [_item("bulk", priority=0, tag=f"lo{i}") for i in range(3)]
+    hi = [_item("gold", priority=5, tag=f"hi{i}") for i in range(2)]
+    order = q.admission_order(lo + hi)
+    assert [it.tag for it in order] == ["hi0", "hi1", "lo0", "lo1", "lo2"]
+
+
+def test_depleted_deficit_defers_tenant_next_round():
+    q = QosScheduler(quantum_tokens=8.0, burst_quanta=8.0,
+                     clock=FakeClock())
+    # "hog" committed a pile of tokens; "quiet" committed none
+    q.charge("hog", 64)
+    order = q.admission_order([_item("hog", tag="h"),
+                               _item("quiet", tag="q")])
+    assert [it.tag for it in order] == ["q", "h"]
+
+
+def test_custom_cost_function_drives_the_scratch_debit():
+    q = QosScheduler(quantum_tokens=4.0, clock=FakeClock())
+    items = [_item("a", max_new=100, tag="a0"), _item("a", tag="a1"),
+             _item("b", max_new=1, tag="b0"), _item("b", tag="b1")]
+    # cost=1 per item: pure round-robin regardless of max_new
+    order = q.admission_order(items, cost=lambda it: 1.0)
+    assert [it.tenant for it in order[:2]] in (["a", "b"], ["b", "a"])
+
+
+# ---------------------------------------------------------------------------
+# spec-decode token-weighting
+# ---------------------------------------------------------------------------
+
+def test_spec_decode_commit_spans_charge_tokens_not_requests():
+    """A speculative engine commits multi-token spans per step event.
+    Equal REQUEST counts must still skew share/deficit by TOKENS."""
+    q = QosScheduler(quantum_tokens=8.0, burst_quanta=8.0,
+                     clock=FakeClock())
+    for _ in range(10):          # 10 step events each
+        q.charge("spec", 4)      # 4-token accepted spans
+        q.charge("plain", 1)     # one token at a time
+    assert q.committed("spec") == 40
+    assert q.committed("plain") == 10
+    assert q.committed_share()["spec"] == pytest.approx(0.8)
+    # the span tenant dug the deeper hole -> the plain tenant goes first
+    order = q.admission_order([_item("spec", tag="s"),
+                               _item("plain", tag="p")])
+    assert [it.tag for it in order] == ["p", "s"]
+
+
+# ---------------------------------------------------------------------------
+# shed budgets
+# ---------------------------------------------------------------------------
+
+def test_budget_shed_and_retry_after_math_on_fake_clock():
+    clk = FakeClock()
+    q = QosScheduler(policies={"a": TenantPolicy(rate_tokens_per_s=10.0,
+                                                 burst_tokens=20.0)},
+                     clock=clk)
+    admit, ra = q.shed_verdict("a", 20.0)      # drains the bucket
+    assert admit and ra == 0.0
+    admit, ra = q.shed_verdict("a", 10.0)
+    assert not admit
+    # empty bucket, want 10 tokens at 10 tok/s -> ~1s to refill
+    assert ra == pytest.approx(1.0, abs=1e-6)
+    assert q.budget_sheds == {"a": 1}
+    # advancing the clock past Retry-After admits again
+    clk.advance(1.0)
+    admit, _ = q.shed_verdict("a", 10.0)
+    assert admit
+
+
+def test_oversized_request_retry_after_clamped_to_capacity():
+    clk = FakeClock()
+    q = QosScheduler(policies={"a": TenantPolicy(rate_tokens_per_s=10.0,
+                                                 burst_tokens=5.0)},
+                     clock=clk)
+    assert q.shed_verdict("a", 5.0)[0]          # drain the bucket
+    admit, ra = q.shed_verdict("a", 1000.0)
+    assert not admit
+    # Retry-After waits for a FULL bucket, not an impossible 100s
+    assert 0.0 < ra <= 5.0 / 10.0 + 1e-6
+
+
+def test_unlimited_tenant_never_sheds():
+    q = QosScheduler(clock=FakeClock())
+    for _ in range(100):
+        admit, ra = q.shed_verdict(DEFAULT_TENANT, 1e6)
+        assert admit and ra == 0.0
+    assert q.budget_sheds == {}
+
+
+def test_budget_isolation_one_tenant_shed_other_untouched():
+    clk = FakeClock()
+    q = QosScheduler(policies={"limited": TenantPolicy(
+        rate_tokens_per_s=1.0, burst_tokens=1.0)}, clock=clk)
+    assert q.shed_verdict("limited", 1.0)[0]
+    assert not q.shed_verdict("limited", 1.0)[0]
+    for _ in range(10):
+        assert q.shed_verdict("other", 100.0)[0]
+    assert q.budget_sheds == {"limited": 1}
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+def test_preemption_victim_lowest_priority_then_longest_remaining():
+    clk = FakeClock()
+    q = QosScheduler(clock=clk, preempt_min_interval_s=0.25)
+    active = [_item("a", priority=2, remaining=50, tag="p2"),
+              _item("b", priority=0, remaining=10, tag="short"),
+              _item("b", priority=0, remaining=90, tag="long"),
+              _item("c", priority=1, remaining=99, tag="p1")]
+    v = q.preemption_victim(3, active)
+    assert v.tag == "long"        # lowest class, most tokens left
+    assert q.preemptions == 1
+
+
+def test_preemption_requires_strictly_higher_demand():
+    q = QosScheduler(clock=FakeClock())
+    active = [_item("a", priority=2, remaining=10)]
+    assert q.preemption_victim(2, active) is None    # equal class: no
+    assert q.preemption_victim(1, active) is None    # lower class: no
+    assert q.preemptions == 0
+
+
+def test_preemption_cooldown_rate_limits_verdicts():
+    clk = FakeClock()
+    q = QosScheduler(clock=clk, preempt_min_interval_s=0.25)
+    active = [_item("a", priority=0, remaining=10, tag="v1"),
+              _item("a", priority=0, remaining=20, tag="v2")]
+    assert q.preemption_victim(5, active) is not None
+    # inside the cooldown a flapping queue gets no second verdict
+    clk.advance(0.1)
+    assert q.preemption_victim(5, active) is None
+    clk.advance(0.2)
+    assert q.preemption_victim(5, active) is not None
+    assert q.preemptions == 2
+
+
+def test_pressure_snapshot_attributes_the_verdict():
+    q = QosScheduler(clock=FakeClock())
+    q.charge("bulk", 12)
+    waiting = [_item("gold", priority=5), _item("gold", priority=5),
+               _item("bulk", priority=0)]
+    snap = q.pressure_snapshot(waiting, free_slots=0)
+    assert snap["free_slots"] == 0
+    assert snap["waiting"] == 3
+    assert snap["waiting_by_priority"] == {"0": 1, "5": 2}
+    assert snap["deficits"]["bulk"] == pytest.approx(-12.0)
+
+
+# ---------------------------------------------------------------------------
+# fairness index + hygiene
+# ---------------------------------------------------------------------------
+
+def test_jain_fairness_index():
+    assert jain_fairness([]) == 1.0
+    assert jain_fairness([0.0, 0.0]) == 1.0
+    assert jain_fairness([1.0, 1.0, 1.0, 1.0]) == pytest.approx(1.0)
+    assert jain_fairness([1.0, 0.0]) == pytest.approx(0.5)
+    assert jain_fairness([1.0, 0.0, 0.0, 0.0]) == pytest.approx(0.25)
+    assert jain_fairness([3.0, 1.0]) == pytest.approx(16.0 / 20.0)
+
+
+def test_scheduler_core_is_jax_free():
+    """The QoS policy core must import (and run) without jax — the
+    whole point of the injectable clock is engine-free testing."""
+    import synapseml_tpu.serving.qos as qosmod
+    src = open(qosmod.__file__).read()
+    assert "import jax" not in src
+    import synapseml_tpu.serving.server as srvmod
+    assert "import jax" not in open(srvmod.__file__).read()

@@ -1,0 +1,422 @@
+"""Multi-tenant QoS serving-integration tests (ISSUE 18).
+
+The contract under test, end to end through ``LLMServer``:
+
+- tenant identity rides the ``X-SML-Tenant`` header (payload
+  ``tenant`` wins — a gateway may re-bill), lands tenant labels on the
+  engine/loop counters, and defaults to ``"default"`` so pre-QoS
+  clients are untouched;
+- tenant namespacing is an isolation boundary: a journaled session
+  resumes ONLY under its owning tenant — a foreign tenant reusing the
+  session id answers 404, never another tenant's context;
+- policy-side preemption through the PR 17 ticket path is LOSS-FREE:
+  a higher class arriving at a full engine evicts the lowest class,
+  gets served, and the victim auto-resumes TOKEN-EXACTLY vs the dense
+  greedy reference — plain and speculative engines; every verdict is
+  flight-recorded with its justifying pressure snapshot;
+- per-tenant rate budgets shed 429 + ``Retry-After`` for the
+  over-budget tenant only;
+- ``GET /sloz?tenant=`` serves exactly that tenant's attribution
+  planes and passes ``check_sloz(snap, tenant=...)``;
+- ``ReplicaRouter`` pin fairness: one tenant's session churn cannot
+  strip other tenants' affinity pins, and ``tenant_pin_cap`` makes a
+  tenant's overflow evict its OWN oldest pin;
+- a seeded noisy-neighbor chaos soak (tenant-gated corrupt faults +
+  preemption + budget sheds) leaves the victim tenant with ZERO wrong
+  tokens and all flood damage attributed to the flood tenant.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from synapseml_tpu.models.llm import (LlamaConfig, LlamaModel, generate)
+from synapseml_tpu.models.llm.kvtier import SessionJournal
+from synapseml_tpu.serving import LLMServer, QosScheduler, TenantPolicy
+from synapseml_tpu.serving.distributed import ReplicaRouter
+from synapseml_tpu.telemetry import check_sloz, get_registry
+
+pytestmark = pytest.mark.qos
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny(num_layers=2, max_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _prompts(cfg, n, length, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, cfg.vocab_size, (n, length)).astype(np.int32)
+
+
+def _post(url, payload, timeout=30, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _metric(name, **labels):
+    m = get_registry().get(name)
+    return 0.0 if m is None else m.value(**labels)
+
+
+# ---------------------------------------------------------------------------
+# tenant identity + attribution
+# ---------------------------------------------------------------------------
+
+class TestTenantAttribution:
+    def test_header_labels_engine_and_loop_counters(self, tiny_model):
+        """``X-SML-Tenant`` threads listener -> loop -> engine: the
+        admission lands under the tenant's label and an anonymous
+        request lands under ``default`` — same token-exact output."""
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 1, 7, seed=30)
+        ref = generate(model, variables, ids, max_new_tokens=6)[0]
+        srv = LLMServer(model, variables, n_slots=2, max_len=64,
+                        engine_kwargs={"name": "t-qos-hdr"})
+        try:
+            a0 = _metric("llm_admissions_total", engine="t-qos-hdr",
+                         tenant="acme")
+            d0 = _metric("llm_admissions_total", engine="t-qos-hdr",
+                         tenant="default")
+            status, body, _ = _post(
+                srv.url, {"ids": [int(t) for t in ids[0]],
+                          "max_new_tokens": 6},
+                headers={"X-SML-Tenant": "acme"})
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref]
+            status, _, _ = _post(srv.url, {
+                "ids": [int(t) for t in ids[0]], "max_new_tokens": 6})
+            assert status == 200
+            assert _metric("llm_admissions_total", engine="t-qos-hdr",
+                           tenant="acme") == a0 + 1
+            assert _metric("llm_admissions_total", engine="t-qos-hdr",
+                           tenant="default") == d0 + 1
+        finally:
+            srv.close()
+
+    def test_payload_tenant_overrides_header(self, tiny_model):
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 1, 7, seed=31)
+        srv = LLMServer(model, variables, n_slots=2, max_len=64,
+                        engine_kwargs={"name": "t-qos-ovr"})
+        try:
+            b0 = _metric("llm_admissions_total", engine="t-qos-ovr",
+                         tenant="billed")
+            status, _, _ = _post(
+                srv.url, {"ids": [int(t) for t in ids[0]],
+                          "max_new_tokens": 4, "tenant": "billed"},
+                headers={"X-SML-Tenant": "gateway"})
+            assert status == 200
+            assert _metric("llm_admissions_total", engine="t-qos-ovr",
+                           tenant="billed") == b0 + 1
+            assert _metric("llm_admissions_total", engine="t-qos-ovr",
+                           tenant="gateway") == 0
+        finally:
+            srv.close()
+
+    def test_sloz_tenant_filter_passes_check_sloz(self, tiny_model):
+        """``GET /sloz?tenant=`` serves EXACTLY that tenant's planes
+        (schema-checked with the tenant filter armed — a leaked foreign
+        plane would 500, not slip through)."""
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 1, 7, seed=32)
+        srv = LLMServer(model, variables, n_slots=2, max_len=64,
+                        engine_kwargs={"name": "t-qos-sloz"})
+        try:
+            for tenant in ("sloz-a", "sloz-b"):
+                _post(srv.url, {"ids": [int(t) for t in ids[0]],
+                                "max_new_tokens": 4},
+                      headers={"X-SML-Tenant": tenant})
+            base = srv.url.rsplit("/", 1)[0]
+            status, raw = _get(f"{base}/sloz?tenant=sloz-a")
+            assert status == 200
+            snap = json.loads(raw)
+            check_sloz(snap, tenant="sloz-a")      # raises on any leak
+            names = list(snap["planes"])
+            assert names and all(n.endswith("@tenant=sloz-a")
+                                 for n in names)
+            admitted = sum(p["rates"]["admitted_per_s"] or 0.0
+                           for p in snap["planes"].values())
+            assert admitted > 0
+            # the unfiltered view still carries the aggregate plane
+            status, raw = _get(f"{base}/sloz")
+            assert status == 200
+            full = json.loads(raw)
+            check_sloz(full)
+            assert any("@tenant=" not in n for n in full["planes"])
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-tenant isolation: journal namespace
+# ---------------------------------------------------------------------------
+
+class TestCrossTenantIsolation:
+    def test_resume_refused_across_tenants(self, tiny_model, tmp_path):
+        """The isolation pin: tenant B reusing tenant A's session id
+        gets 404 — never A's journaled context — while A itself
+        resumes token-exactly."""
+        cfg, model, variables = tiny_model
+        p = _prompts(cfg, 1, 12, seed=33)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=8)[0]
+        jdir = str(tmp_path / "jnl")
+        pre = SessionJournal(jdir, name="t-qos-iso")
+        pre.begin("conv", [int(t) for t in p], 8, tenant="alice")
+        pre.append_tokens("conv", [int(t) for t in ref[:3]],
+                          tenant="alice")
+        srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                        journal=SessionJournal(jdir, name="t-qos-iso"),
+                        engine_kwargs={"name": "t-qos-iso"})
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(srv.url, {"session": "conv", "resume": True},
+                      headers={"X-SML-Tenant": "bob"})
+            assert exc.value.code == 404
+            status, body, _ = _post(
+                srv.url, {"session": "conv", "resume": True},
+                headers={"X-SML-Tenant": "alice"})
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref]
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# loss-free preemption through the serving loop
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    @pytest.mark.parametrize("spec_draft_len", [0, 3],
+                             ids=["plain", "spec"])
+    def test_preempt_and_auto_resume_token_exact(self, tiny_model,
+                                                 spec_draft_len):
+        """One slot, a low-class sequence decoding: a strictly higher
+        class arriving starved evicts it through the ticket path, is
+        served, and the victim auto-resumes — BOTH replies bit-identical
+        to their dense greedy references (plain and spec engines), with
+        the verdict counted, flight-recorded, and pressure-stamped."""
+        cfg, model, variables = tiny_model
+        name = f"t-qos-pre-{spec_draft_len}"
+        ids = _prompts(cfg, 2, 7, seed=34)
+        ref_bulk = generate(model, variables, ids[0:1],
+                            max_new_tokens=40)[0]
+        ref_gold = generate(model, variables, ids[1:2],
+                            max_new_tokens=4)[0]
+        qos = QosScheduler(
+            policies={"bulk": TenantPolicy(priority=0),
+                      "gold": TenantPolicy(priority=5)},
+            preempt_min_interval_s=0.0)
+        srv = LLMServer(model, variables, n_slots=1, max_len=96,
+                        qos=qos, spec_draft_len=spec_draft_len,
+                        engine_kwargs={"name": name})
+        results = {}
+
+        def call(key, prompt, max_new, tenant):
+            results[key] = _post(
+                srv.url, {"ids": [int(t) for t in prompt],
+                          "max_new_tokens": max_new},
+                headers={"X-SML-Tenant": tenant}, timeout=60)
+        try:
+            p0 = _metric("llm_qos_preemptions_total",
+                         api="/generate", tenant="bulk")
+            t_bulk = threading.Thread(
+                target=call, args=("bulk", ids[0], 40, "bulk"))
+            t_bulk.start()
+            deadline = time.monotonic() + 10
+            while (srv.engine.active_count == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert srv.engine.active_count == 1
+            t_gold = threading.Thread(
+                target=call, args=("gold", ids[1], 4, "gold"))
+            t_gold.start()
+            t_gold.join(timeout=60)
+            t_bulk.join(timeout=60)
+            for key, ref in (("bulk", ref_bulk), ("gold", ref_gold)):
+                status, body, _ = results[key]
+                assert status == 200, key
+                assert json.loads(body)["ids"] == \
+                    [int(t) for t in ref], key
+            assert qos.preemptions >= 1
+            assert _metric("llm_qos_preemptions_total", api="/generate",
+                           tenant="bulk") >= p0 + 1
+            from synapseml_tpu.telemetry.flight import get_flight
+            evs = [e for e in get_flight().events()
+                   if e["kind"] == "qos_preemption"
+                   and e.get("tenant") == "bulk"]
+            assert evs
+            last = evs[-1]
+            assert last["demand_priority"] == 5
+            assert last["victim_priority"] == 0
+            assert last["pressure"]["free_slots"] == 0
+            assert last["pressure"]["waiting"] >= 1
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant shed budgets
+# ---------------------------------------------------------------------------
+
+class TestBudgetShed:
+    def test_over_budget_tenant_429_others_untouched(self, tiny_model):
+        cfg, model, variables = tiny_model
+        ids = _prompts(cfg, 1, 7, seed=35)
+        srv = LLMServer(
+            model, variables, n_slots=2, max_len=64,
+            tenant_policies={"limited": TenantPolicy(
+                rate_tokens_per_s=0.5, burst_tokens=8.0)},
+            engine_kwargs={"name": "t-qos-bud"})
+        try:
+            s0 = _metric("llm_sheds_total", api="/generate",
+                         reason="budget", tenant="limited")
+            payload = {"ids": [int(t) for t in ids[0]],
+                       "max_new_tokens": 8}
+            status, _, _ = _post(srv.url, payload,
+                                 headers={"X-SML-Tenant": "limited"})
+            assert status == 200              # burst covers the first
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(srv.url, payload,
+                      headers={"X-SML-Tenant": "limited"})
+            assert exc.value.code == 429
+            assert int(exc.value.headers["Retry-After"]) >= 1
+            assert _metric("llm_sheds_total", api="/generate",
+                           reason="budget",
+                           tenant="limited") == s0 + 1
+            # the un-limited tenant is untouched by the neighbor's shed
+            status, _, _ = _post(srv.url, payload,
+                                 headers={"X-SML-Tenant": "other"})
+            assert status == 200
+            assert srv.qos.budget_sheds == {"limited": 1}
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# router pin fairness
+# ---------------------------------------------------------------------------
+
+class TestRouterTenantFairness:
+    def test_flooding_tenant_cannot_strip_other_pins(self):
+        """Overflow evicts from the LARGEST-pinning tenant (its own
+        oldest), so one tenant churning sessions cannot evict another
+        tenant's single pin — the old global-LRU head."""
+        r = ReplicaRouter([("127.0.0.1", 9001), ("127.0.0.1", 9002)],
+                          name="t-qos-router", session_cache_size=4)
+        r.route("/g", session="keep", tenant="victim")
+        for i in range(20):
+            r.route("/g", session=f"s{i}", tenant="flood")
+        assert ("victim", "keep") in r._sessions
+        assert sum(1 for (t, _) in r._sessions if t == "flood") == 3
+        # and the flood tenant's own evictions were ITS oldest pins
+        assert ("flood", "s19") in r._sessions
+
+    def test_tenant_pin_cap_self_evicts_own_oldest(self):
+        r = ReplicaRouter([("127.0.0.1", 9001)], name="t-qos-cap",
+                          session_cache_size=64, tenant_pin_cap=2)
+        r.route("/g", session="other", tenant="b")
+        for s in ("s0", "s1", "s2"):
+            r.route("/g", session=s, tenant="a")
+        assert ("a", "s0") not in r._sessions     # own oldest evicted
+        assert ("a", "s1") in r._sessions
+        assert ("a", "s2") in r._sessions
+        assert ("b", "other") in r._sessions      # neighbor untouched
+
+
+# ---------------------------------------------------------------------------
+# noisy-neighbor chaos soak
+# ---------------------------------------------------------------------------
+
+class TestNoisyNeighborSoak:
+    @pytest.mark.fault
+    def test_victim_zero_wrong_tokens_bounded_shed(self, tiny_model,
+                                                   fault_registry):
+        """Seeded chaos: a flooding low-class rate-limited tenant with
+        tenant-gated corrupt faults on its KV spills, next to a
+        high-class victim.  Every victim reply is TOKEN-EXACT vs the
+        dense greedy reference (zero wrong tokens), the flood tenant's
+        sheds are bounded by its own budget (and attributed to it),
+        and the tenant-gated fault rule never fired on victim
+        traffic."""
+        cfg, model, variables = tiny_model
+        rule = fault_registry.inject("kvtier.spill", "corrupt",
+                                     tenant="flood")
+        name = "t-qos-soak"
+        srv = LLMServer(
+            model, variables, n_slots=2, max_len=96, min_prefix=8,
+            kv_arena_bytes=96 * 1024,
+            tenant_policies={
+                "flood": TenantPolicy(priority=0, weight=1.0,
+                                      rate_tokens_per_s=20.0,
+                                      burst_tokens=40.0),
+                "victim": TenantPolicy(priority=5, weight=1.0)},
+            engine_kwargs={"name": name})
+        flood_status = []
+        stop = threading.Event()
+
+        def flood():
+            i = 0
+            while not stop.is_set():
+                p = _prompts(cfg, 1, 10, seed=200 + i)[0]
+                try:
+                    s, _, _ = _post(
+                        srv.url, {"ids": [int(t) for t in p],
+                                  "max_new_tokens": 6},
+                        headers={"X-SML-Tenant": "flood"}, timeout=60)
+                    flood_status.append(s)
+                except urllib.error.HTTPError as e:
+                    flood_status.append(e.code)
+                i += 1
+        try:
+            v0 = _metric("llm_sheds_total", api="/generate",
+                         reason="budget", tenant="victim")
+            t = threading.Thread(target=flood)
+            t.start()
+            for rnd in range(6):
+                p = _prompts(cfg, 1, 10, seed=100 + rnd)[0]
+                ref = generate(model, variables, p[None],
+                               max_new_tokens=6)[0]
+                status, body, _ = _post(
+                    srv.url, {"ids": [int(t) for t in p],
+                              "max_new_tokens": 6},
+                    headers={"X-SML-Tenant": "victim"}, timeout=60)
+                assert status == 200          # the victim NEVER sheds
+                assert json.loads(body)["ids"] == [int(t) for t in ref]
+            stop.set()
+            t.join(timeout=60)
+            # flood damage is attributed to the flood tenant: its 429s
+            # match its budget_sheds count, the victim's stay zero
+            n_429 = sum(1 for s in flood_status if s == 429)
+            assert srv.qos.budget_sheds.get("flood", 0) == n_429
+            assert "victim" not in srv.qos.budget_sheds
+            assert _metric("llm_sheds_total", api="/generate",
+                           reason="budget", tenant="victim") == v0
+            # the tenant gate held: the rule saw ONLY flood spills
+            # (victim spills skip it before the match counter), and
+            # with p=1.0 every flood spill was corrupted — yet every
+            # victim reply above was still token-exact
+            assert rule.matched > 0
+            assert rule.fired == rule.matched
+        finally:
+            stop.set()
+            srv.close()
