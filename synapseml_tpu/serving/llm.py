@@ -77,6 +77,7 @@ class LLMServer:
                  journal_dir: Optional[str] = None,
                  qos: Any = None,
                  tenant_policies: Optional[Dict[str, Any]] = None,
+                 max_tenants: int = 256,
                  engine_kwargs: Optional[Dict[str, Any]] = None):
         # session survivability plane (docs/api/serving.md "Session
         # survivability & KV tiering"): kv_arena / kv_arena_bytes
@@ -126,7 +127,11 @@ class LLMServer:
         # pass a prebuilt QosScheduler via qos=, or just per-tenant
         # TenantPolicy contracts via tenant_policies= — requests carry
         # their tenant in the X-SML-Tenant header or "tenant" payload
-        # field, and everything without one bills the default tenant
+        # field, and everything without one bills the default tenant.
+        # max_tenants bounds how many DYNAMIC (unregistered) tenant
+        # ids may materialise attribution planes — past the cap an
+        # unknown tenant answers 429 (tenant ids are client-controlled;
+        # unbounded ids would grow memory and /sloz without bound)
         if qos is None and tenant_policies is not None:
             from .qos import QosScheduler
             qos = QosScheduler(policies=dict(tenant_policies))
@@ -138,7 +143,7 @@ class LLMServer:
             max_new_tokens_default=max_new_tokens_default,
             ttft_slo_s=ttft_slo_s, token_slo_s=token_slo_s,
             trace_sample_every=trace_sample_every,
-            journal=journal, qos=qos)
+            journal=journal, qos=qos, max_tenants=max_tenants)
         # the loop constructs a default scheduler when none was given —
         # surface THAT one so callers can set policies/read attribution
         if self.qos is None:

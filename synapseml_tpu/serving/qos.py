@@ -54,8 +54,8 @@ from typing import (Any, Callable, Dict, Iterable, List, Optional,
 
 from ..resilience.policy import RetryBudget
 
-__all__ = ["DEFAULT_TENANT", "DEFAULT_PRIORITY", "TenantPolicy",
-           "QosScheduler", "jain_fairness", "QOS_METRICS"]
+__all__ = ["DEFAULT_TENANT", "DEFAULT_PRIORITY", "OVERFLOW_TENANT",
+           "TenantPolicy", "QosScheduler", "jain_fairness", "QOS_METRICS"]
 
 #: QoS-plane metric names (the metric-hygiene sweep holds every one to
 #: the docs bar, like GANG/SLO/KVTIER_METRICS).  The per-tenant
@@ -70,6 +70,15 @@ DEFAULT_TENANT = "default"
 
 #: the priority class of a request that declares none
 DEFAULT_PRIORITY = 1
+
+#: the attribution label a request rejected by the decode loop's
+#: dynamic-tenant cap sheds under — tenant ids are client-controlled
+#: and unauthenticated, so per-tenant planes/labels/budgets are only
+#: materialised for registered tenants plus a bounded number of
+#: dynamic ones; everything past the cap is rejected and counted here,
+#: keeping metric/SLO cardinality bounded no matter how many ids a
+#: client cycles through
+OVERFLOW_TENANT = "~other"
 
 
 @dataclasses.dataclass
@@ -171,6 +180,12 @@ class QosScheduler:
         with self._lock:
             self._policies[tenant] = policy
             self._budgets.pop(tenant, None)   # re-arm from the new rate
+
+    def is_registered(self, tenant: str) -> bool:
+        """True when ``tenant`` carries an explicit :class:`TenantPolicy`
+        (the decode loop's dynamic-tenant cap never applies to these)."""
+        with self._lock:
+            return tenant in self._policies
 
     def priority_of(self, item: Any) -> int:
         """The item's effective class: its own ``.priority`` when
@@ -287,16 +302,24 @@ class QosScheduler:
         ``admit=False`` means the tenant's token bucket cannot cover the
         request's budget — shed it 429-style; ``retry_after_s`` is when
         the bucket will have refilled enough (the server's own recovery
-        estimate, exactly what ``Retry-After`` is for)."""
+        estimate, exactly what ``Retry-After`` is for).
+
+        A request costing MORE than the bucket's whole capacity is
+        charged the capacity instead of its true cost: a full bucket
+        admits it (draining to empty), so an oversized-but-legitimate
+        request is throttled like everything else rather than 429'd
+        forever with a Retry-After that can never come true (capacity
+        is the most a refill can ever restore, so ``cost > capacity``
+        would otherwise be permanently unadmittable)."""
         with self._lock:
             budget = self._budget(tenant)
         if budget is None:
             return True, 0.0
-        if budget.try_spend(tokens):
+        want = min(float(tokens), budget.capacity)
+        if budget.try_spend(want):
             return True, 0.0
         pol = self.policy(tenant)
         rate = pol.rate_tokens_per_s or 1.0
-        want = min(float(tokens), budget.capacity)
         retry_after = max(0.0, (want - budget.tokens()) / rate)
         with self._lock:
             self.budget_sheds[tenant] = self.budget_sheds.get(tenant, 0) + 1
@@ -309,8 +332,13 @@ class QosScheduler:
         request: the LOWEST-priority, LONGEST-remaining active item
         whose class is STRICTLY below the demand — or None (nothing
         preemptible, or the anti-thrash cooldown has not elapsed).
-        The caller routes the verdict through the PR 17 ticket path and
-        flight-records it with the justifying pressure snapshot."""
+        The caller routes the verdict through the PR 17 ticket path,
+        flight-records it with the justifying pressure snapshot, and
+        calls :meth:`commit_preemption` ONLY once the engine actually
+        issued a ticket — a verdict the engine declined (``preempt``
+        returned None) neither counts as a preemption nor burns the
+        cooldown window, so a legitimate eviction is never delayed by
+        a failed attempt."""
         now = self.clock()
         with self._lock:
             if now - self._last_preempt < self.preempt_min_interval_s:
@@ -319,14 +347,19 @@ class QosScheduler:
                  if self.priority_of(a) < int(demand_priority)]
         if not cands:
             return None
-        victim = min(cands, key=lambda a: (self.priority_of(a),
-                                           -float(getattr(a, "remaining",
-                                                          0.0)),
-                                           id(a)))
+        return min(cands, key=lambda a: (self.priority_of(a),
+                                         -float(getattr(a, "remaining",
+                                                        0.0)),
+                                         id(a)))
+
+    def commit_preemption(self) -> None:
+        """Confirm a :meth:`preemption_victim` verdict went through the
+        engine (a ticket was issued): count it and arm the anti-thrash
+        cooldown.  Kept separate from the verdict so an eviction the
+        engine declined rolls back to 'never happened'."""
         with self._lock:
-            self._last_preempt = now
+            self._last_preempt = self.clock()
             self.preemptions += 1
-        return victim
 
     # -- attribution -------------------------------------------------------
     def pressure_snapshot(self, waiting: Sequence[Any],

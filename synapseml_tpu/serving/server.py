@@ -1302,7 +1302,7 @@ class _DecodeLoop:
                  idle_timeout_s: float = 0.02,
                  trace_sample_every: Optional[int] = None,
                  request_tracer=None, slo_window=None, journal=None,
-                 qos=None):
+                 qos=None, max_tenants: int = 256):
         self.server = server
         self.api = api
         self.engine = engine
@@ -1326,8 +1326,19 @@ class _DecodeLoop:
         #: come from here (jax-free; a default scheduler treats every
         #: tenant equally, so single-tenant traffic behaves exactly as
         #: the old FIFO did)
-        from .qos import QosScheduler
+        from .qos import DEFAULT_TENANT, OVERFLOW_TENANT, QosScheduler
+        self._overflow_tenant = OVERFLOW_TENANT
         self.qos = qos if qos is not None else QosScheduler()
+        #: cardinality bound on CLIENT-MINTED tenant ids: every distinct
+        #: tenant permanently materialises an SLO plane, metric label
+        #: sets, and QoS deficit/budget state — all unauthenticated
+        #: client-controlled, so without a cap a client cycling random
+        #: ids grows server memory and /sloz payloads without bound.
+        #: Tenants with a registered TenantPolicy always get their own
+        #: plane; dynamic (unregistered) ids are granted planes up to
+        #: this cap and rejected 429 past it.
+        self.max_tenants = max(1, int(max_tenants))
+        self._tenant_ids = {DEFAULT_TENANT}
         self._waiting: List[_DecodeSeq] = []
         #: preempted sequences holding a resume ticket instead of a
         #: slot — auto-resumed token-exactly once pressure clears
@@ -1426,16 +1437,24 @@ class _DecodeLoop:
     # -- admission ---------------------------------------------------------
     def _pump_queue(self) -> None:
         """Move newly-arrived requests into the waiting list.  Blocks
-        only when the loop is otherwise idle.  The pull DRAINS the api
-        queue: QoS admission (priority tiers, weighted-fair order,
-        tenant budgets) can only reorder what it has seen, so capping
-        the pull at a few slots' worth would leave a high-priority
-        tenant head-of-line-blocked in the raw FIFO behind a flooding
-        neighbor's burst.  Saturation backpressure still holds — the
-        api queue itself is bounded (``max_queue`` ⇒ enqueue-time
-        503), and the waiting list is bounded by that same cap."""
-        room = max(2 * self.engine.n_slots,
-                   getattr(self.api, "max_queue", 1024))
+        only when the loop is otherwise idle.  The pull is sized to
+        FILL the waiting list up to its cap — ``max(2·n_slots,
+        max_queue)`` — rather than a few slots' worth, because QoS
+        admission (priority tiers, weighted-fair order, tenant
+        budgets) can only reorder what it has seen: a small fixed pull
+        would leave a high-priority tenant head-of-line-blocked in the
+        raw FIFO behind a flooding neighbor's burst.  Crucially the
+        pull is the cap MINUS the backlog already held
+        (waiting + parked): once the backlog reaches the cap the pump
+        stops draining, the api queue fills, and enqueue-time 503
+        backpressure fires — without the subtraction a sustained flood
+        would be drained into ``_waiting`` every tick and accumulate
+        there without bound while the queue-full 503 never tripped."""
+        cap = max(2 * self.engine.n_slots,
+                  getattr(self.api, "max_queue", 1024))
+        room = max(0, cap - len(self._waiting) - len(self._parked))
+        if room == 0:
+            return
         if self.engine.active_count or self._waiting:
             batch = self.api.poll(room)
         else:
@@ -1455,12 +1474,34 @@ class _DecodeLoop:
                 # may inject the header; an authenticated body field is
                 # more specific); absent both ⇒ the default tenant
                 tenant = str(spec.get("tenant") or req.tenant or "default")
+                if len(tenant) > 256:
+                    # a tenant id is a namespace key (journals, arena,
+                    # affinity) — an arbitrarily long one is abuse, and
+                    # truncating would silently merge two namespaces
+                    raise ValueError("tenant id exceeds 256 chars")
                 prio = spec.get("priority", req.priority)
                 prio = int(prio) if prio is not None else None
             except Exception as e:  # noqa: BLE001 — isolated to record
                 self._m_errors.inc(1, api=self.api.path, kind="parse")
                 self._safe_reply(req.id, ServingReply(400, json.dumps(
                     {"error": f"unparseable record: {e}"}).encode()))
+                continue
+            if not self._tenant_admitted(tenant):
+                # dynamic-tenant cardinality cap: tenant ids are
+                # client-controlled and each distinct one permanently
+                # allocates an SLO plane, metric labels, and QoS state
+                # — past the cap an unregistered id is rejected, under
+                # the bounded overflow label so the rejection itself
+                # cannot be used to grow cardinality either
+                self._m_sheds.inc(1, api=self.api.path,
+                                  reason="tenant_cap",
+                                  tenant=self._overflow_tenant)
+                self._m_errors.inc(1, api=self.api.path, kind="shed")
+                self._slo.count("shed")
+                self._safe_reply(req.id, ServingReply(429, json.dumps(
+                    {"error": "tenant plane limit reached: register a "
+                     "TenantPolicy for this tenant or raise "
+                     "max_tenants"}).encode()))
                 continue
             seq = _DecodeSeq(req, ids, max_new,
                              bool(spec.get("stream", False)),
@@ -1616,6 +1657,23 @@ class _DecodeLoop:
                                 if now - t < 5.0]
         rps = len(self._retired_window) / 5.0
         return {"Retry-After": str(retry_after_from_depth(depth, rps))}
+
+    def _tenant_admitted(self, tenant: str) -> bool:
+        """Bound the universe of tenant ids this plane materialises
+        state for: always the default tenant and every tenant with a
+        registered :class:`TenantPolicy`; dynamic (client-minted) ids
+        are granted a plane first-come up to ``max_tenants`` and
+        rejected past it — an unauthenticated client cycling random
+        ids cannot grow the SLO store, metric label sets, or QoS
+        ledgers without bound."""
+        if tenant in self._tenant_ids:
+            return True
+        registered = getattr(self.qos, "is_registered", None)
+        if ((registered is not None and registered(tenant))
+                or len(self._tenant_ids) < self.max_tenants):
+            self._tenant_ids.add(tenant)
+            return True
+        return False
 
     def _tenant_slo(self, tenant: str):
         """Get-or-create the per-tenant attribution plane (same
@@ -1802,7 +1860,12 @@ class _DecodeLoop:
                                           self.engine.free_slot_count)
         ticket = preempt_fn(victim.slot)
         if ticket is None:
+            # the engine declined (slot raced to retirement, arena
+            # full): the verdict never happened — committing it here
+            # would overcount preemptions and burn the anti-thrash
+            # cooldown, delaying the next legitimate eviction
             return
+        self.qos.commit_preemption()
         self._by_slot.pop(victim.slot, None)
         victim.ticket = ticket
         victim.slot = None
@@ -1920,6 +1983,22 @@ class _DecodeLoop:
             else:
                 live_parked.append(seq)
         self._parked = live_parked
+        # a WAITING request past its reply window is dead weight: the
+        # listener already answered 504 and forgot the exchange, so
+        # admitting it would decode tokens nobody can receive (and
+        # SLO-shed live requests queued behind it).  Streams have no
+        # window here — a waiting stream has not been replied yet, so
+        # the same expiry applies.
+        live_waiting: List[_DecodeSeq] = []
+        for seq in self._waiting:
+            if now - seq.req.enqueued_at > self.api.reply_timeout_s:
+                self._m_errors.inc(1, api=self.api.path, kind="expired")
+                self._tracer.event(seq.trace_id, "cancelled",
+                                   reason="expired")
+                self._tracer.finish(seq.trace_id, "expired", tokens=0)
+            else:
+                live_waiting.append(seq)
+        self._waiting = live_waiting
 
     # -- the loop ----------------------------------------------------------
     def _loop(self) -> None:
@@ -1990,21 +2069,23 @@ class _DecodeLoop:
 
     def _fail_inflight(self, e: Exception) -> None:
         """Answer every in-flight sequence 500 (streams get a final
-        error line) and free its slot after an engine failure."""
+        error line) and free its slot after an engine failure.
+        PARKED (preempted) sequences are in flight too — their resume
+        tickets reference engine/arena state the failure (and the
+        recovery reset below) invalidates, so they get the same 500
+        instead of hanging un-notified until their reply window
+        expires on a persistently-broken engine."""
         body = json.dumps({"error": str(e)}).encode()
         for slot, seq in list(self._by_slot.items()):
             try:
                 self.engine.cancel(slot)
             except Exception:  # noqa: BLE001 — engine may be broken
                 pass
-            if seq.stream_obj is not None:
-                seq.stream_obj.push(json.dumps(
-                    {"error": str(e)}).encode() + b"\n")
-                seq.stream_obj.finish()
-            else:
-                self._safe_reply(seq.req.id, ServingReply(500, body))
-            self._tracer.finish(seq.trace_id, "error", error=str(e))
+            self._fail_seq(seq, e, body)
             self._by_slot.pop(slot, None)
+        for seq in self._parked:
+            self._fail_seq(seq, e, body)
+        self._parked = []
         self._m_errors.inc(1, api=self.api.path, kind="transform")
         # the engine's jitted programs donate their cache buffers: an
         # exception mid-call can leave the cache pointing at DELETED
@@ -2016,6 +2097,18 @@ class _DecodeLoop:
                 reset()
             except Exception:  # noqa: BLE001 — stay alive regardless
                 pass
+
+    def _fail_seq(self, seq: _DecodeSeq, e: Exception,
+                  body: bytes) -> None:
+        """Terminate one in-flight sequence with the engine error
+        (final stream line or a 500 reply) and close its timeline."""
+        if seq.stream_obj is not None:
+            seq.stream_obj.push(json.dumps(
+                {"error": str(e)}).encode() + b"\n")
+            seq.stream_obj.finish()
+        else:
+            self._safe_reply(seq.req.id, ServingReply(500, body))
+        self._tracer.finish(seq.trace_id, "error", error=str(e))
 
     def stop(self) -> None:
         self._stop.set()

@@ -260,6 +260,24 @@ def test_oversized_request_retry_after_clamped_to_capacity():
     assert not admit
     # Retry-After waits for a FULL bucket, not an impossible 100s
     assert 0.0 < ra <= 5.0 / 10.0 + 1e-6
+    # and the hint is HONEST: waiting it out really does admit —
+    # cost > capacity charges the capacity, not the impossible cost
+    clk.advance(ra)
+    assert q.shed_verdict("a", 1000.0)[0]
+
+
+def test_oversized_request_admits_on_a_full_bucket():
+    """cost > burst capacity must not be a permanent 429: a full
+    bucket admits the oversized request (charged the whole capacity,
+    draining to empty) so it is throttled like everything else."""
+    clk = FakeClock()
+    q = QosScheduler(policies={"a": TenantPolicy(rate_tokens_per_s=10.0,
+                                                 burst_tokens=5.0)},
+                     clock=clk)
+    admit, ra = q.shed_verdict("a", 1000.0)     # fresh bucket: full
+    assert admit and ra == 0.0
+    assert q.shed_verdict("a", 1.0)[0] is False  # it really drained
+    assert q.budget_sheds == {"a": 1}
 
 
 def test_unlimited_tenant_never_sheds():
@@ -294,6 +312,10 @@ def test_preemption_victim_lowest_priority_then_longest_remaining():
               _item("c", priority=1, remaining=99, tag="p1")]
     v = q.preemption_victim(3, active)
     assert v.tag == "long"        # lowest class, most tokens left
+    # the verdict alone counts nothing — only the caller's confirm
+    # (after the engine actually issued a ticket) does
+    assert q.preemptions == 0
+    q.commit_preemption()
     assert q.preemptions == 1
 
 
@@ -311,12 +333,31 @@ def test_preemption_cooldown_rate_limits_verdicts():
     active = [_item("a", priority=0, remaining=10, tag="v1"),
               _item("a", priority=0, remaining=20, tag="v2")]
     assert q.preemption_victim(5, active) is not None
+    q.commit_preemption()
     # inside the cooldown a flapping queue gets no second verdict
     clk.advance(0.1)
     assert q.preemption_victim(5, active) is None
     clk.advance(0.2)
     assert q.preemption_victim(5, active) is not None
+    q.commit_preemption()
     assert q.preemptions == 2
+
+
+def test_declined_verdict_burns_neither_counter_nor_cooldown():
+    """``engine.preempt`` returning None abandons the eviction — the
+    uncommitted verdict must not count as a preemption or delay the
+    NEXT (legitimate) one by the anti-thrash interval."""
+    clk = FakeClock()
+    q = QosScheduler(clock=clk, preempt_min_interval_s=0.25)
+    active = [_item("a", priority=0, remaining=10)]
+    assert q.preemption_victim(5, active) is not None
+    # ...the engine declined: no commit_preemption() call.  A retry on
+    # the very next tick is allowed immediately, not 0.25s later.
+    assert q.preemption_victim(5, active) is not None
+    assert q.preemptions == 0
+    q.commit_preemption()
+    assert q.preemptions == 1
+    assert q.preemption_victim(5, active) is None   # NOW it cools down
 
 
 def test_pressure_snapshot_attributes_the_verdict():
@@ -352,3 +393,192 @@ def test_scheduler_core_is_jax_free():
     assert "import jax" not in src
     import synapseml_tpu.serving.server as srvmod
     assert "import jax" not in open(srvmod.__file__).read()
+
+
+# ---------------------------------------------------------------------------
+# decode-loop policy plumbing (fake engine + fake api — still jax-free):
+# the overload/failure contracts the scheduler core cannot see on its
+# own: bounded pump backpressure, the dynamic-tenant cardinality cap,
+# reply-window expiry of queued requests, and engine-failure
+# notification of PARKED (preempted) sequences.
+# ---------------------------------------------------------------------------
+
+import json as _json
+import time as _time
+import uuid as _uuid
+
+from synapseml_tpu.serving.server import (ServingRequest, _DecodeLoop,
+                                          _DecodeSeq)
+
+
+class _FakeApi:
+    """Duck-typed ApiHandle: records pull sizes, captures replies."""
+
+    def __init__(self, max_queue=8, reply_timeout_s=30.0):
+        self.path = f"/qos-fake-{_uuid.uuid4().hex[:8]}"
+        self.max_queue = max_queue
+        self.reply_timeout_s = reply_timeout_s
+        self.queue = []
+        self.replies = {}
+        self.poll_rooms = []
+
+    def poll(self, n):
+        self.poll_rooms.append(int(n))
+        out, self.queue = self.queue[:int(n)], self.queue[int(n):]
+        return out
+
+    def get_batch(self, n, timeout_s):
+        return self.poll(n)
+
+    def reply(self, rid, rep):
+        self.replies[rid] = rep
+        return True
+
+
+class _FakeEngine:
+    """Duck-typed engine: slots bookkeeping only, no decoding."""
+
+    def __init__(self, n_slots=2):
+        self.n_slots = n_slots
+        self.slots = {}
+        self._next = 0
+
+    @property
+    def active_count(self):
+        return len(self.slots)
+
+    @property
+    def free_slot_count(self):
+        return self.n_slots - len(self.slots)
+
+    def admit(self, ids, max_new):
+        if self.free_slot_count == 0:
+            return None
+        slot, self._next = self._next, self._next + 1
+        self.slots[slot] = (list(ids), int(max_new))
+        import types as _types
+        return _types.SimpleNamespace(slot=slot, token=1, finished=False,
+                                      reason=None)
+
+    def step(self):
+        return []
+
+    def cancel(self, slot):
+        self.slots.pop(slot, None)
+
+    def min_remaining_tokens(self):
+        return None
+
+
+def _make_loop(api=None, engine=None, **kw):
+    """A _DecodeLoop driven synchronously: the background thread is
+    stopped before any request exists, then ticks run by hand."""
+    api = api or _FakeApi()
+    engine = engine or _FakeEngine()
+    loop = _DecodeLoop(None, api, engine,
+                       input_parser=lambda req: _json.loads(req.body),
+                       **kw)
+    loop._stop.set()
+    loop._thread.join(timeout=5)
+    api.poll_rooms.clear()      # drop the idle spins before the join
+    return loop, api, engine
+
+
+def _req(payload, tenant="default", rid=None):
+    return ServingRequest(id=rid or _uuid.uuid4().hex, method="POST",
+                          path="/", headers={},
+                          body=_json.dumps(payload).encode(),
+                          enqueued_at=_time.monotonic(), tenant=tenant)
+
+
+def _seq(req, max_new=4):
+    return _DecodeSeq(req, [1, 2, 3], max_new, False)
+
+
+def test_pump_stops_pulling_once_the_backlog_reaches_the_cap():
+    """room = cap - (waiting + parked): a full backlog pulls NOTHING
+    (so the api queue fills and enqueue-time 503 backpressure fires)
+    instead of draining the queue into an unbounded waiting list."""
+    api = _FakeApi(max_queue=6)
+    loop, api, engine = _make_loop(api=api, engine=_FakeEngine(n_slots=1))
+    cap = max(2 * engine.n_slots, api.max_queue)          # = 6
+    loop._waiting = [_seq(_req({"ids": [1]})) for _ in range(cap)]
+    api.queue = [_req({"ids": [1]}) for _ in range(10)]
+    loop._pump_queue()
+    assert api.poll_rooms == []          # no room: no pull at all
+    assert len(loop._waiting) == cap
+    assert len(api.queue) == 10          # left queued -> queue-full 503s
+    # parked sequences count against the same cap
+    loop._waiting, loop._parked = loop._waiting[:3], loop._waiting[3:]
+    loop._pump_queue()
+    assert api.poll_rooms == []
+    # freeing backlog frees exactly that much room
+    loop._parked = []
+    loop._pump_queue()
+    assert api.poll_rooms == [cap - 3]
+    assert len(loop._waiting) == cap
+
+
+def test_dynamic_tenant_cap_rejects_429_but_registered_admits():
+    """Client-minted tenant ids materialise planes only up to
+    max_tenants; past it an unregistered id answers 429 while a
+    REGISTERED tenant is always granted its plane."""
+    loop, api, _ = _make_loop(max_tenants=2,
+                              qos=QosScheduler(policies={
+                                  "vip": TenantPolicy(priority=3)},
+                                  clock=FakeClock()))
+    api.queue = [_req({"ids": [1]}, tenant="dyn1", rid="r-dyn1"),
+                 _req({"ids": [1]}, tenant="dyn2", rid="r-dyn2"),
+                 _req({"ids": [1]}, tenant="vip", rid="r-vip"),
+                 _req({"ids": [1]}, tenant="dyn1", rid="r-dyn1b")]
+    loop._pump_queue()
+    # default + dyn1 fill the cap; dyn2 is rejected with the honest
+    # remediation; vip rides its registered policy past the cap; dyn1
+    # keeps being admitted (its plane already exists)
+    assert "r-dyn1" not in api.replies
+    assert "r-dyn1b" not in api.replies
+    assert "r-vip" not in api.replies
+    assert api.replies["r-dyn2"].status == 429
+    assert b"tenant plane limit" in api.replies["r-dyn2"].body
+    assert sorted(s.tenant for s in loop._waiting) == \
+        ["dyn1", "dyn1", "vip"]
+
+
+def test_overlong_tenant_id_is_a_parse_error():
+    loop, api, _ = _make_loop()
+    api.queue = [_req({"ids": [1], "tenant": "t" * 300}, rid="r-long")]
+    loop._pump_queue()
+    assert api.replies["r-long"].status == 400
+    assert loop._waiting == []
+
+
+def test_expired_waiting_requests_are_dropped_not_decoded():
+    """A queued request past its reply window is dead weight — the
+    listener already answered 504 — so the sweep drops it instead of
+    letting it occupy a slot (and SLO-shed live traffic behind it)."""
+    api = _FakeApi(reply_timeout_s=5.0)
+    loop, api, _ = _make_loop(api=api)
+    stale = _req({"ids": [1]}, rid="r-stale")
+    stale.enqueued_at = _time.monotonic() - 60.0
+    fresh = _req({"ids": [1]}, rid="r-fresh")
+    loop._waiting = [_seq(stale), _seq(fresh)]
+    loop._cancel_expired()
+    assert [s.req.id for s in loop._waiting] == ["r-fresh"]
+
+
+def test_engine_failure_also_fails_parked_sequences():
+    """_fail_inflight must notify PARKED (preempted) sequences too —
+    their resume tickets die with the engine; leaving them silent
+    would hang the clients until reply-timeout on a broken engine."""
+    loop, api, engine = _make_loop()
+    running = _seq(_req({"ids": [1]}, rid="r-run"))
+    running.slot = 0
+    engine.slots[0] = ([1], 4)
+    loop._by_slot[0] = running
+    parked = _seq(_req({"ids": [1]}, rid="r-parked"))
+    parked.ticket = {"fake": "ticket"}
+    loop._parked = [parked]
+    loop._fail_inflight(RuntimeError("engine down"))
+    assert api.replies["r-run"].status == 500
+    assert api.replies["r-parked"].status == 500
+    assert loop._parked == [] and loop._by_slot == {}
