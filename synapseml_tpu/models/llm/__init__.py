@@ -12,9 +12,11 @@ from .model import (LLM_LOGICAL_RULES, CausalAttention, DecoderBlock,
                     causal_lm_loss, init_cache, llama_from_pretrained,
                     rope_frequencies)
 from .drafter import NgramDrafter
-from .kvtier import (KVTIER_METRICS, ChecksumError, HostKVArena,
-                     RadixPrefixIndex, SessionJournal, SessionState,
-                     kvtier_metrics)
+from .kvtier import (KVTIER_METRICS, TRANSFER_MAGIC, ChecksumError,
+                     HostKVArena, KVTransfer, RadixPrefixIndex,
+                     SessionJournal, SessionState, kvtier_metrics,
+                     pack_kv_transfer, token_prefix_hash,
+                     unpack_kv_transfer)
 from .pallas_attn import (ATTENTION_BACKENDS, PagedGeometry,
                           dense_read_bytes, paged_decode_attention,
                           paged_geometry, paged_read_bytes,
@@ -27,7 +29,7 @@ from .warmup import (CompilePlane, ProgramSpec, engine_jit_cache_size,
 __all__ = [
     "ATTENTION_BACKENDS",
     "ChecksumError", "CompilePlane",
-    "HostKVArena", "KVTIER_METRICS",
+    "HostKVArena", "KVTIER_METRICS", "KVTransfer", "TRANSFER_MAGIC",
     "LLM_LOGICAL_RULES", "AdmitResult", "CausalAttention", "DecoderBlock",
     "LLMTransformer",
     "LlamaConfig", "LlamaModel", "NgramDrafter", "PagedGeometry",
@@ -35,7 +37,8 @@ __all__ = [
     "RMSNorm", "RadixPrefixIndex", "SessionJournal", "SessionState",
     "SlotEngine",
     "StepEvent",
-    "kvtier_metrics",
+    "kvtier_metrics", "pack_kv_transfer", "token_prefix_hash",
+    "unpack_kv_transfer",
     "apply_rope", "causal_lm_loss",
     "cast_params", "dense_read_bytes", "engine_jit_cache_size",
     "finetune_lm", "generate",

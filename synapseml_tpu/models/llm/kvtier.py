@@ -65,8 +65,9 @@ from ...telemetry import get_registry
 from ...telemetry.flight import record as flight_record
 
 __all__ = ["ChecksumError", "HostKVArena", "KVTIER_METRICS",
-           "RadixPrefixIndex", "SessionJournal", "SessionState",
-           "kvtier_metrics"]
+           "KVTransfer", "RadixPrefixIndex", "SessionJournal",
+           "SessionState", "TRANSFER_MAGIC", "kvtier_metrics",
+           "pack_kv_transfer", "token_prefix_hash", "unpack_kv_transfer"]
 
 #: every metric this plane registers — the docs-hygiene sweep holds
 #: these to the GANG_METRICS bar (each name must appear in
@@ -470,6 +471,133 @@ def _unpack(blob: bytes, shape: Tuple[int, ...], dtype_name: str,
         raw = np.frombuffer(blob, np.uint16).reshape(shape)
         return raw.view(ml_dtypes.bfloat16)
     return np.frombuffer(blob, np.dtype(dtype_name)).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# KV handoff transfer framing (disaggregated prefill → decode)
+# ---------------------------------------------------------------------------
+
+#: wire magic of a packed KV transfer (version baked in: a decode
+#: replica speaking a different frame era refuses loudly, it never
+#: guesses at foreign bytes)
+TRANSFER_MAGIC = b"SMLKV1\n"
+
+
+@dataclasses.dataclass
+class KVTransfer:
+    """A decoded prefill→decode handoff: the prompt ids the K/V covers,
+    the per-layer ``{"k", "v"}`` rows in cache-native dtype, and the
+    identity triple (session, tenant, token-prefix hash) the lease is
+    keyed on.  Produced only by :func:`unpack_kv_transfer` — by
+    construction every row passed its CRC and the prefix hash matched
+    the ids, so adopting these rows can never seed a wrong token."""
+    session: Optional[str]
+    tenant: str
+    ids: List[int]
+    rows: List[Dict[str, np.ndarray]]
+    prefix_hash: str
+
+
+def token_prefix_hash(ids) -> str:
+    """Order-sensitive identity of a token prefix: sha1 over the int32
+    byte stream, truncated to 16 hex chars.  Carried in every transfer
+    header so a frame whose ids were damaged (or swapped with another
+    session's) is rejected before its K/V can be adopted."""
+    arr = np.asarray(ids, np.int32).reshape(-1)
+    return hashlib.sha1(arr.tobytes()).hexdigest()[:16]
+
+
+def pack_kv_transfer(ids, rows: List[Dict[str, np.ndarray]],
+                     session: Optional[str] = None,
+                     tenant: str = "default") -> bytes:
+    """Frame one finished prefill as wire bytes: magic, a CRC-framed
+    JSON header line (session, tenant, ids, token-prefix hash, per-row
+    shape/dtype and a CRC32 **per row**), then the per-layer row blobs
+    in cache-native packing (bf16 as uint16 bit patterns — the
+    :func:`_pack` layout the arena itself stores).  Every check
+    :func:`unpack_kv_transfer` applies is derived from this header, so
+    a single flipped byte anywhere in the frame is detected."""
+    ids = np.asarray(ids, np.int32).reshape(-1)
+    if len(ids) == 0 or not rows:
+        raise ValueError("a KV transfer needs a non-empty prompt and rows")
+    blobs: List[bytes] = []
+    crcs: List[int] = []
+    lens: List[int] = []
+    shape: Optional[Tuple[int, ...]] = None
+    dtype_name = ""
+    packed_bf16 = False
+    for row in rows:
+        stacked = np.stack([np.asarray(row["k"]),
+                            np.asarray(row["v"])])   # (2, span, KH, DH)
+        blob, packed_bf16, dtype_name = _pack(stacked)
+        if shape is None:
+            shape = stacked.shape
+        elif tuple(stacked.shape) != tuple(shape):
+            raise ValueError("KV transfer rows must share one shape")
+        blobs.append(blob)
+        crcs.append(zlib.crc32(blob))
+        lens.append(len(blob))
+    header = {
+        "session": None if session is None else str(session),
+        "tenant": str(tenant),
+        "ids": [int(t) for t in ids],
+        "prefix_hash": token_prefix_hash(ids),
+        "shape": [int(d) for d in shape],
+        "dtype": dtype_name,
+        "packed_bf16": bool(packed_bf16),
+        "row_bytes": lens,
+        "row_crcs": crcs,
+    }
+    # the journal's CRC-framed-line idiom guards the header itself
+    return TRANSFER_MAGIC + SessionJournal._frame(header) + b"".join(blobs)
+
+
+def unpack_kv_transfer(blob: bytes) -> KVTransfer:
+    """Decode and VERIFY a wire frame from :func:`pack_kv_transfer`.
+    Raises ``ValueError`` when the bytes are not a KV transfer at all
+    (wrong magic / missing header line) and :class:`ChecksumError` when
+    they are one that was damaged in flight — header CRC mismatch, any
+    row CRC mismatch, a short body, or a token-prefix hash that no
+    longer matches the ids.  Either way nothing is adopted: the caller
+    counts ``corrupt`` and cold-prefills."""
+    if not blob.startswith(TRANSFER_MAGIC):
+        raise ValueError("not a KV transfer frame (bad magic)")
+    rest = blob[len(TRANSFER_MAGIC):]
+    nl = rest.find(b"\n")
+    if nl < 0:
+        raise ValueError("KV transfer frame has no header line")
+    line, body = rest[:nl].decode("utf-8", "replace"), rest[nl + 1:]
+    crc_hex, _, text = line.partition(" ")
+    try:
+        want_crc = int(crc_hex, 16)
+    except ValueError:
+        raise ChecksumError("KV transfer header frame is malformed")
+    if zlib.crc32(text.encode()) != want_crc:
+        raise ChecksumError("KV transfer header failed its checksum")
+    header = json.loads(text)
+    ids = [int(t) for t in header["ids"]]
+    if token_prefix_hash(ids) != header["prefix_hash"]:
+        raise ChecksumError("KV transfer token-prefix hash mismatch")
+    lens = [int(n) for n in header["row_bytes"]]
+    crcs = [int(c) for c in header["row_crcs"]]
+    if len(lens) != len(crcs) or len(body) != sum(lens):
+        raise ChecksumError(
+            f"KV transfer body is torn ({len(body)} bytes, "
+            f"expected {sum(lens)})")
+    shape = tuple(int(d) for d in header["shape"])
+    rows: List[Dict[str, np.ndarray]] = []
+    off = 0
+    for i, (n, crc) in enumerate(zip(lens, crcs)):
+        chunk = body[off:off + n]
+        off += n
+        if zlib.crc32(chunk) != crc:
+            raise ChecksumError(f"KV transfer row {i} failed its checksum")
+        stacked = _unpack(chunk, shape, header["dtype"],
+                          bool(header["packed_bf16"]))
+        rows.append({"k": stacked[0], "v": stacked[1]})
+    return KVTransfer(session=header["session"], tenant=header["tenant"],
+                      ids=ids, rows=rows,
+                      prefix_hash=str(header["prefix_hash"]))
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,15 @@ Fault kinds:
                 :meth:`FaultRegistry.corrupt_point` site — silent
                 bit-rot for checksum/fallback paths (only fires at
                 corrupt points; other sites ignore the kind)
+``drop``        lose an in-flight payload at a
+                :meth:`FaultRegistry.transfer_point` site — the sender
+                believes it sent, the receiver never sees it, and only
+                a deadline can observe the loss (other sites ignore
+                the kind)
+``delay``       hold an in-flight payload for ``delay`` seconds at a
+                :meth:`FaultRegistry.transfer_point` site, then deliver
+                it intact — a slow wire, for lease-expiry paths (a
+                recorded sleep, so ``no_sleep`` tests stay fast)
 ==============  ============================================================
 
 Rule grammar (``SML_FAULTS``, rules joined by ``;``)::
@@ -51,11 +60,14 @@ RNG), ``delay`` (seconds, for ``slow``/``slow_rank``/``hang``), ``status``
 ``Retry-After`` header), ``rank`` (the rule fires only on the process
 whose :attr:`FaultRegistry.rank` matches — workers set it from
 ``SMLTPU_PROCESS_ID``, so one ``SML_FAULTS`` string shared by a whole
-gang can target a single rank) and ``tenant`` (the rule fires only for
+gang can target a single rank), ``tenant`` (the rule fires only for
 calls whose context carries that tenant id — the multi-tenant QoS plane
 passes ``tenant=`` at its kvtier/journal sites, so a noisy-neighbor
 chaos soak can corrupt or kill ONE tenant's spills while the victim
-tenant's are untouched).
+tenant's are untouched) and ``phase`` (the serving mirror of ``tenant``
+for the disaggregated prefill/decode plane — sites pass
+``phase="prefill"``/``"decode"``, so a chaos soak can drop prefill-side
+transfers while decode traffic is untouched).
 ``SML_FAULTS_SEED`` seeds the RNG (default 0).  Example::
 
     SML_FAULTS="http.send=http_503:times=2:retry_after=0.05;gbdt.checkpoint=kill:after=1:times=1"
@@ -135,6 +147,10 @@ class FaultRule:
     #: multi-tenant mirror of ``rank``; a call with NO tenant in its
     #: ctx never matches a tenant-gated rule)
     tenant: Optional[str] = None
+    #: only fire for calls whose ctx carries this serving phase
+    #: (``"prefill"``/``"decode"`` — the disaggregation mirror of
+    #: ``tenant``; a call with NO phase never matches a phase-gated rule)
+    phase: Optional[str] = None
     #: programmatic-only context predicate — the rule fires only for
     #: calls whose ctx satisfies it (a non-matching call does not even
     #: count toward ``after``)
@@ -178,9 +194,9 @@ class FaultRegistry:
                status: Optional[int] = None,
                retry_after_s: Optional[float] = None,
                rank: Optional[int] = None, tenant: Optional[str] = None,
-               when=None) -> FaultRule:
+               phase: Optional[str] = None, when=None) -> FaultRule:
         rule = FaultRule(site, kind, times, after, p, delay_s, status,
-                         retry_after_s, rank, tenant, when)
+                         retry_after_s, rank, tenant, phase, when)
         with self._lock:
             self._rules.append(rule)
         return rule
@@ -216,6 +232,8 @@ class FaultRegistry:
                     kw["rank"] = int(v)
                 elif k == "tenant":
                     kw["tenant"] = str(v)
+                elif k == "phase":
+                    kw["phase"] = str(v)
                 else:
                     raise ValueError(f"unknown fault option {k!r} in {part!r}")
             self.inject(site.strip(), kind, **kw)
@@ -269,6 +287,9 @@ class FaultRegistry:
                 if rule.tenant is not None \
                         and ctx.get("tenant") != rule.tenant:
                     continue           # another tenant's fault, not ours
+                if rule.phase is not None \
+                        and ctx.get("phase") != rule.phase:
+                    continue           # another phase's fault, not ours
                 if rule.when is not None and not rule.when(ctx):
                     continue           # ctx miss: not a matching call at all
                 rule.matched += 1
@@ -309,6 +330,18 @@ class FaultRegistry:
             os.kill(os.getpid(), signal.SIGKILL)
         self._execute_raise(site, rule)
 
+    @staticmethod
+    def _flip(rule: FaultRule, payload: bytes) -> bytes:
+        """Deterministic single-byte flip: Knuth-hash the firing ordinal
+        into an offset — stable across runs, scattered across the
+        payload."""
+        if not len(payload):
+            return payload
+        buf = bytearray(payload)
+        off = ((rule.fired - 1) * 2654435761 + 1) % len(buf)
+        buf[off] ^= 0xFF
+        return bytes(buf)
+
     def corrupt_point(self, site: str, payload: bytes, **ctx) -> bytes:
         """Payload-carrying site: returns ``payload``, byte-flipped when
         a ``corrupt`` rule fires (deterministic offset per firing, so a
@@ -319,14 +352,31 @@ class FaultRegistry:
         if rule is None:
             return payload
         if rule.kind == "corrupt":
-            if not len(payload):
-                return payload
-            buf = bytearray(payload)
-            # Knuth-hash the firing ordinal into an offset: stable
-            # across runs, scattered across the payload
-            off = ((rule.fired - 1) * 2654435761 + 1) % len(buf)
-            buf[off] ^= 0xFF
-            return bytes(buf)
+            return self._flip(rule, payload)
+        if rule.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._execute_raise(site, rule)
+        return payload
+
+    def transfer_point(self, site: str, payload: bytes,
+                       **ctx) -> Optional[bytes]:
+        """In-flight payload site (a wire hop): everything
+        :meth:`corrupt_point` does, plus the two kinds only a network
+        has — ``drop`` loses the payload (returns ``None``: the sender
+        believes it sent, only the receiver's deadline can notice) and
+        ``delay`` holds it for ``delay`` seconds before delivering it
+        intact (a recorded sleep, so the lease-expiry path is testable
+        under ``no_sleep``)."""
+        rule = self.check(site, **ctx)
+        if rule is None:
+            return payload
+        if rule.kind == "corrupt":
+            return self._flip(rule, payload)
+        if rule.kind == "drop":
+            return None
+        if rule.kind == "delay":
+            self.sleep(rule.delay_s, site=site)
+            return payload
         if rule.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
         self._execute_raise(site, rule)

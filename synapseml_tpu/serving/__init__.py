@@ -4,8 +4,10 @@ from .autoscaler import (AutoscalePolicy, Autoscaler, CapacityArbiter,
                          ScaleDecision, ServingReplicaSet, SupervisorPool,
                          sloz_signals)
 from .continuous import ContinuousClient
-from .distributed import (DistributedServingServer, NoHealthyReplicaError,
-                          ReplicaRouter, exchange_routing_table,
+from .disagg import PrefillPool, PrefillWorker
+from .distributed import (ROLE_NAMES, DistributedServingServer,
+                          NoHealthyReplicaError, ReplicaRouter,
+                          RouteResult, exchange_routing_table,
                           probe_replica)
 from .llm import LLMServer
 from .qos import QosScheduler, TenantPolicy, jain_fairness
@@ -16,7 +18,9 @@ __all__ = ["ApiHandle", "AutoscalePolicy", "Autoscaler", "CapacityArbiter",
            "ContinuousClient", "DistributedServingServer",
            "LLMServer",
            "MultiPipelineServer", "NoHealthyReplicaError", "PipelineServer",
-           "QosScheduler", "ReplicaRouter", "ScaleDecision",
+           "PrefillPool", "PrefillWorker",
+           "QosScheduler", "ROLE_NAMES", "ReplicaRouter", "RouteResult",
+           "ScaleDecision",
            "ServingReplicaSet", "ServingReply", "ServingRequest",
            "ServingServer", "SupervisorPool", "TenantPolicy",
            "exchange_routing_table", "jain_fairness",

@@ -98,15 +98,27 @@ def _fetch_sloz(source, timeout_s: float = 2.0) -> Dict[str, Any]:
     return snap
 
 
-def sloz_signals(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+def sloz_signals(snapshot: Dict[str, Any],
+                 phase: Optional[str] = None) -> Dict[str, Any]:
     """The decision inputs, reduced across planes: worst (max) burn
     rate over every declared objective, worst (max) shed ratio, lowest
     (min) mean occupancy, and the total evidence count (latency
     observations + occupancy samples — zero means the windows are
-    empty and no verdict has support)."""
+    empty and no verdict has support).
+
+    ``phase`` restricts the reduction to one disaggregated pool's
+    planes (``<base>@phase=<prefill|decode>``) — two controllers each
+    reducing their own phase scale the pools independently: prefill
+    burn grows the prefill pool without touching decode, and vice
+    versa."""
+    from ..telemetry.slo import plane_phase
     max_burn = max_shed = min_occ = None
     samples = 0
-    for plane in snapshot.get("planes", {}).values():
+    planes = snapshot.get("planes", {})
+    if phase is not None:
+        planes = {name: plane for name, plane in planes.items()
+                  if plane_phase(name) == phase}
+    for plane in planes.values():
         for block in plane.get("slo", {}).values():
             burn = block.get("burn_rate")
             if burn is not None:
@@ -122,7 +134,7 @@ def sloz_signals(snapshot: Dict[str, Any]) -> Dict[str, Any]:
             samples += int(sig.get("count") or 0)
     return {"max_burn": max_burn, "max_shed": max_shed,
             "min_occupancy": min_occ, "samples": samples,
-            "planes": len(snapshot.get("planes", {}))}
+            "planes": len(planes)}
 
 
 # ---------------------------------------------------------------------------
@@ -582,13 +594,20 @@ class Autoscaler:
                  name: str = "serving", poll_interval_s: float = 1.0,
                  clock: Callable[[], float] = time.monotonic,
                  fetch_timeout_s: float = 2.0,
-                 keep_decisions: int = 256):
+                 keep_decisions: int = 256,
+                 phase: Optional[str] = None):
         from ..telemetry.slo import get_slo_store
         self.pool = pool
         self.source = source if source is not None else get_slo_store()
         self.policy = policy or AutoscalePolicy()
         self.arbiter = arbiter
         self.name = name
+        #: restrict decision inputs to one disaggregated pool's
+        #: ``@phase=`` planes (None = reduce across every plane, the
+        #: colocated deployment).  Two controllers — phase="prefill"
+        #: over a PrefillPool, phase="decode" over a ServingReplicaSet
+        #: — scale the pools independently off one shared /sloz.
+        self.phase = phase
         self.poll_interval_s = float(poll_interval_s)
         self.fetch_timeout_s = float(fetch_timeout_s)
         self._clock = clock
@@ -622,7 +641,7 @@ class Autoscaler:
             return self._finish(ScaleDecision(
                 ts=now, verdict="error", reason=f"sloz fetch: {exc}",
                 replicas=self._safe_count(), target=None))
-        signals = sloz_signals(snapshot)
+        signals = sloz_signals(snapshot, phase=self.phase)
         decision = self._decide(now, signals, snapshot)
         if self.arbiter is not None:
             self.arbiter.reclaim(now)

@@ -38,7 +38,7 @@ import threading
 import urllib.error
 import urllib.request
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -55,6 +55,43 @@ from .server import ServingServer
 #: nobody's breaker opens over a replica that is merely compiling.
 HEALTHY, DRAINING, DEAD, WARMING = ("healthy", "draining", "dead",
                                     "warming")
+
+#: replica roles a routing table can carry (disaggregated serving:
+#: decode replicas hold slots and stream tokens, prefill replicas are
+#: compute-bound batch prefillers that hand their K/V off).  The index
+#: of a name here is what rides the routing-table collective.
+ROLE_NAMES: Tuple[str, ...] = ("decode", "prefill")
+
+
+def _role_index(role: str) -> int:
+    try:
+        return ROLE_NAMES.index(role)
+    except ValueError:
+        raise ValueError(f"unknown replica role {role!r} "
+                         f"(expected one of {ROLE_NAMES})")
+
+
+class RouteResult(NamedTuple):
+    """One routing decision, named.  The positional tuples this
+    replaces grew a field per PR (rank → url → addr → affinity outcome
+    → trace headers) and broke arity-sensitive unpacking once already;
+    every router surface now returns THIS shape and call sites read
+    fields by name.  ``headers`` is only populated by
+    :meth:`DistributedServingServer.route_request` (trace/tenant
+    propagation) — plain :meth:`~ReplicaRouter.route` fills it with a
+    fresh empty dict."""
+    #: table index of the routed replica (valid until the next refresh)
+    rank: int
+    #: the routed ``(host, port)`` captured under the router lock —
+    #: hand back to ``report(addr=)`` so the report survives renumbering
+    addr: Tuple[str, int]
+    #: full request url for the routed replica
+    url: str
+    #: session-affinity outcome: ``hit`` / ``miss`` / ``repin``
+    #: (repin ⇒ the pinned replica was lost: engage failover-restore)
+    outcome: str
+    #: headers to attach to the forwarded request
+    headers: Dict[str, str]
 
 
 class NoHealthyReplicaError(RuntimeError):
@@ -80,11 +117,15 @@ def _decode_addr(ip_u32: int, port: int) -> Tuple[str, int]:
 
 def exchange_routing_table(host: str, port: int,
                            deadline=None,
-                           timeout_s: Optional[float] = None
-                           ) -> List[Tuple[str, int]]:
+                           timeout_s: Optional[float] = None,
+                           role: int = 0
+                           ) -> Tuple[List[Tuple[str, int]], List[int]]:
     """All-gather this process's listener address over the global device
-    mesh → ``[(host, port)]`` indexed by process.  Single-process: the
-    local address alone (no collective).
+    mesh → ``([(host, port)], [role])`` indexed by process.  ``role`` is
+    this process's :data:`ROLE_NAMES` index (0 = decode), gathered
+    alongside the address so a disaggregated deployment publishes WHICH
+    pool each listener belongs to through the same collective.
+    Single-process: the local address and role alone (no collective).
 
     ``deadline``/``timeout_s`` bound the gather itself: when a peer died
     mid-restart the collective would block forever, and the bound turns
@@ -95,7 +136,7 @@ def exchange_routing_table(host: str, port: int,
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     if jax.process_count() == 1:
-        return [(host, port)]
+        return [(host, port)], [int(role)]
     from ..parallel.mesh import DATA_AXIS
     from ..parallel.collectives import (all_gather, dispatch_watchdog,
                                         shard_map_over)
@@ -104,16 +145,18 @@ def exchange_routing_table(host: str, port: int,
     mesh = Mesh(np.array(devs), (DATA_AXIS,))
     n = len(devs)
     ip_u32, port_i = _encode_addr(host, port)
-    # each DEVICE row carries its owning process's (ip, port, process_idx)
+    # each DEVICE row carries its owning process's
+    # (ip, port, process_idx, role)
     my_proc = jax.process_index()
-    local = np.array([[ip_u32, port_i, my_proc]] *
+    local = np.array([[ip_u32, port_i, my_proc, int(role)]] *
                      jax.local_device_count(), dtype=np.int64)
     # int32 collective: the ip splits into 16-bit halves (each fits int32
     # unmasked — masking bit 31 would corrupt addresses >= 128.0.0.0)
     rows = np.stack([local[:, 0] >> 16, local[:, 0] & 0xffff,
-                     local[:, 1], local[:, 2]], axis=1).astype(np.int32)
+                     local[:, 1], local[:, 2],
+                     local[:, 3]], axis=1).astype(np.int32)
     garr = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P(DATA_AXIS)), rows, (n, 4))
+        NamedSharding(mesh, P(DATA_AXIS)), rows, (n, 5))
     gathered_fn = jax.jit(shard_map_over(mesh, P(DATA_AXIS), P(DATA_AXIS))(
         lambda x: all_gather(x, tiled=True)))
     if deadline is not None or timeout_s is not None:
@@ -125,11 +168,12 @@ def exchange_routing_table(host: str, port: int,
         gathered = gathered_fn(garr)
     table_rows = np.asarray(
         jax.device_get(gathered.addressable_shards[0].data))[:n]
-    by_proc: Dict[int, Tuple[str, int]] = {}
-    for hi, lo, p_port, proc in table_rows:
+    by_proc: Dict[int, Tuple[Tuple[str, int], int]] = {}
+    for hi, lo, p_port, proc, p_role in table_rows:
         ip = (int(hi) << 16) | (int(lo) & 0xffff)
-        by_proc[int(proc)] = _decode_addr(ip, p_port)
-    return [by_proc[i] for i in sorted(by_proc)]
+        by_proc[int(proc)] = (_decode_addr(ip, p_port), int(p_role))
+    ordered = [by_proc[i] for i in sorted(by_proc)]
+    return [addr for addr, _ in ordered], [r for _, r in ordered]
 
 
 def probe_replica(host: str, port: int,
@@ -183,7 +227,8 @@ class ReplicaRouter:
                  failure_threshold: int = 3, cooldown_s: float = 5.0,
                  probe_timeout_s: float = 1.0,
                  session_cache_size: int = 4096,
-                 tenant_pin_cap: Optional[int] = None):
+                 tenant_pin_cap: Optional[int] = None,
+                 roles: Optional[List[str]] = None):
         self.name = name
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
@@ -225,15 +270,26 @@ class ReplicaRouter:
         self._m_affinity = get_registry().counter(
             "serving_affinity_total",
             "session-affinity routing outcomes", ("router", "outcome"))
-        self._apply_table(table)
+        self._apply_table(table, roles=roles)
 
     def _breaker_key(self, host: str, port: int) -> str:
         return f"replica:{self.name}:{host}:{port}"
 
-    def _apply_table(self, table: List[Tuple[str, int]]) -> None:
+    def _apply_table(self, table: List[Tuple[str, int]],
+                     roles: Optional[List[str]] = None) -> None:
         prev_table = list(getattr(self, "table", ()))
         prev = len(prev_table)
         self.table = [(h, int(p)) for h, p in table]
+        # per-rank pool membership (disaggregated serving); a role-less
+        # table is the colocated deployment — every replica decodes
+        if roles is None:
+            self.roles = ["decode"] * len(self.table)
+        else:
+            if len(roles) != len(self.table):
+                raise ValueError(
+                    f"roles ({len(roles)}) must match the table "
+                    f"({len(self.table)})")
+            self.roles = [str(r) for r in roles]
         # a shrunk table must not leave departed replicas' last verdicts
         # on /metrics as phantom healthy rows
         for r in range(len(self.table), prev):
@@ -381,8 +437,9 @@ class ReplicaRouter:
 
     def route(self, path: str = "/",
               session: Optional[str] = None,
-              tenant: str = "default") -> Tuple[int, str]:
-        """Next routable replica (round-robin) → ``(rank, url)``.
+              tenant: str = "default",
+              role: Optional[str] = None) -> "RouteResult":
+        """Next routable replica (round-robin) → :class:`RouteResult`.
 
         Skips replicas probed dead or draining and replicas whose
         breaker refuses the call (open, or half-open past its probe
@@ -398,15 +455,18 @@ class ReplicaRouter:
         elastic resize), the session falls back to round-robin and
         RE-PINS to the replica it gets — a cold prefill, never a
         failure.  Pins are namespaced by ``tenant``: two tenants
-        reusing one session id never share a replica pin."""
-        rank, addr, url, _outcome = self.route_addr(path, session=session,
-                                                    tenant=tenant)
-        return rank, url
+        reusing one session id never share a replica pin.
+
+        ``role`` restricts routing to one pool of a disaggregated
+        table (``"decode"``/``"prefill"``); None routes over every
+        replica (the colocated deployment)."""
+        return self.route_addr(path, session=session, tenant=tenant,
+                               role=role)
 
     def route_addr(self, path: str = "/",
                    session: Optional[str] = None,
-                   tenant: str = "default"
-                   ) -> Tuple[int, Tuple[str, int], str, str]:
+                   tenant: str = "default",
+                   role: Optional[str] = None) -> "RouteResult":
         """:meth:`route` plus the routed ``(host, port)`` captured under
         the same lock — hand that address back to :meth:`report` and the
         report survives a concurrent :meth:`refresh` renumbering the
@@ -417,7 +477,10 @@ class ReplicaRouter:
         session), ``"repin"`` (the pinned replica was LOST — the
         session's device prefix cache is gone, so the caller should
         engage a restore path instead of silently serving
-        context-free)."""
+        context-free).  A pinned replica whose role no longer matches
+        the requested pool counts as LOST the same way: the session
+        repins into the right pool and the repin outcome still fires
+        the caller's failover-restore path."""
         with self._lock:
             n = len(self.table)
             pinned = False
@@ -429,6 +492,7 @@ class ReplicaRouter:
                 if addr is not None:
                     r = self._addr_rank.get(addr)
                     if (r is not None and self._status[r] == HEALTHY
+                            and (role is None or self.roles[r] == role)
                             and self._breakers[r].allow()):
                         # affinity hit: round-robin cursor untouched —
                         # pinned traffic must not skew the rotation the
@@ -436,10 +500,13 @@ class ReplicaRouter:
                         self._sessions.move_to_end(key)
                         self._m_affinity.inc(1, router=self.name,
                                              outcome="hit")
-                        return r, addr, self.url_for(r, path), "hit"
+                        return RouteResult(r, addr, self.url_for(r, path),
+                                           "hit", {})
             start = self._rr
             for i in range(n):
                 r = (start + i) % n
+                if role is not None and self.roles[r] != role:
+                    continue
                 if self._status[r] != HEALTHY:
                     continue
                 if not self._breakers[r].allow():
@@ -454,10 +521,12 @@ class ReplicaRouter:
                     self._m_affinity.inc(
                         1, router=self.name,
                         outcome="repin" if pinned else "miss")
-                return (r, self.table[r], self.url_for(r, path),
-                        "repin" if pinned else "miss")
+                return RouteResult(r, self.table[r], self.url_for(r, path),
+                                   "repin" if pinned else "miss", {})
             statuses = {
-                r: (self._status[r] if self._status[r] != HEALTHY
+                r: (f"role {self.roles[r]}" if role is not None
+                    and self.roles[r] != role
+                    else self._status[r] if self._status[r] != HEALTHY
                     else f"breaker {self._breakers[r].state}")
                 for r in range(n)}
         raise NoHealthyReplicaError(statuses)
@@ -491,7 +560,8 @@ class ReplicaRouter:
         with self._lock:
             self._update_gauge()
 
-    def refresh(self, table: List[Tuple[str, int]]) -> None:
+    def refresh(self, table: List[Tuple[str, int]],
+                roles: Optional[List[str]] = None) -> None:
         """Adopt a re-gathered table (after an elastic restart or
         resize): statuses reset optimistic; breakers persist per
         endpoint still IN the table (a replica that came back on the
@@ -502,7 +572,7 @@ class ReplicaRouter:
         table (their replica drains, it does not vanish) or the new —
         never a mix."""
         with self._lock:
-            self._apply_table(table)
+            self._apply_table(table, roles=roles)
 
 
 class DistributedServingServer:
@@ -524,18 +594,24 @@ class DistributedServingServer:
                  api_path: str = "/", reply_timeout_s: float = 30.0,
                  max_queue: int = 1024,
                  max_body_bytes: int = 16 * 1024 * 1024,
-                 gather_timeout_s: Optional[float] = None):
+                 gather_timeout_s: Optional[float] = None,
+                 role: str = "decode"):
         self.local = ServingServer(host=host, port=port, api_path=api_path,
                                    reply_timeout_s=reply_timeout_s,
                                    max_queue=max_queue,
                                    max_body_bytes=max_body_bytes)
         lh, lp = self.local.address
         self._gather_timeout_s = gather_timeout_s
-        self.routing_table = exchange_routing_table(
-            lh, lp, timeout_s=gather_timeout_s)
+        #: this process's pool membership, published through the gather
+        self.role = str(role)
+        self.routing_table, role_ids = exchange_routing_table(
+            lh, lp, timeout_s=gather_timeout_s,
+            role=_role_index(self.role))
+        self.routing_roles = [ROLE_NAMES[i] for i in role_ids]
         import jax
         self.router = ReplicaRouter(
-            self.routing_table, name=f"dserv-p{jax.process_index()}")
+            self.routing_table, name=f"dserv-p{jax.process_index()}",
+            roles=self.routing_roles)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -549,36 +625,39 @@ class DistributedServingServer:
     # -- failover ----------------------------------------------------------
     def route(self, path: str = "/",
               session: Optional[str] = None,
-              tenant: str = "default") -> Tuple[int, str]:
+              tenant: str = "default",
+              role: Optional[str] = None) -> "RouteResult":
         """Next healthy replica for a request; ``session`` pins
         multi-turn requests to the replica holding their prefix cache,
-        namespaced by ``tenant`` (see :meth:`ReplicaRouter.route`)."""
-        return self.router.route(path, session=session, tenant=tenant)
+        namespaced by ``tenant`` (see :meth:`ReplicaRouter.route`);
+        ``role`` restricts the route to one disaggregated pool."""
+        return self.router.route(path, session=session, tenant=tenant,
+                                 role=role)
 
     def route_addr(self, path: str = "/",
                    session: Optional[str] = None,
-                   tenant: str = "default"
-                   ) -> Tuple[int, Tuple[str, int], str, str]:
+                   tenant: str = "default",
+                   role: Optional[str] = None) -> "RouteResult":
         """:meth:`route` plus the routed ``(host, port)`` — pass it back
         through :meth:`report_result`'s ``addr=`` so the report survives
         a concurrent table refresh renumbering the ranks — plus the
         affinity outcome (see :meth:`ReplicaRouter.route_addr`)."""
-        return self.router.route_addr(path, session=session, tenant=tenant)
+        return self.router.route_addr(path, session=session, tenant=tenant,
+                                      role=role)
 
     def route_request(self, path: str = "/",
                       session: Optional[str] = None,
                       trace_id: Optional[str] = None,
-                      tenant: str = "default"
-                      ) -> Tuple[int, Tuple[str, int], str,
-                                 Dict[str, str], str]:
+                      tenant: str = "default",
+                      role: Optional[str] = None) -> "RouteResult":
         """:meth:`route_addr` plus request-trace propagation: mints a
         trace id at THIS hop when the caller has none, records the
         routing decision on the hop's flight recorder (trace id, rank,
-        session, affinity outcome), and returns the headers to attach
-        to the forwarded request (``X-SML-Trace-Id``) — the replica's
-        decode loop adopts the id (propagated ids are always sampled),
-        so a session-affinity hop chain stays attributable end to end:
-        ``(rank, (host, port), url, headers, outcome)``.
+        session, affinity outcome), and fills :attr:`RouteResult.
+        headers` with what to attach to the forwarded request
+        (``X-SML-Trace-Id``) — the replica's decode loop adopts the id
+        (propagated ids are always sampled), so a session-affinity hop
+        chain stays attributable end to end.
 
         ``outcome == "repin"`` is the failover-restore trigger: the
         session's pinned replica is GONE and with it the device prefix
@@ -589,15 +668,15 @@ class DistributedServingServer:
         from ..telemetry.tracing import mint_trace_id
         from .server import TENANT_HEADER, TRACE_HEADER
         tid = trace_id or mint_trace_id()
-        rank, addr, url, outcome = self.router.route_addr(
-            path, session=session, tenant=tenant)
+        res = self.router.route_addr(path, session=session, tenant=tenant,
+                                     role=role)
         flight_record("route", router=self.router.name, trace_id=tid,
-                      rank=rank, session=session, tenant=tenant,
-                      affinity=outcome)
+                      rank=res.rank, session=session, tenant=tenant,
+                      affinity=res.outcome)
         headers = {TRACE_HEADER: tid}
         if tenant != "default":
             headers[TENANT_HEADER] = tenant
-        return rank, addr, url, headers, outcome
+        return res._replace(headers=headers)
 
     def probe_replicas(self) -> Dict[int, str]:
         return self.router.probe_all()
@@ -616,9 +695,11 @@ class DistributedServingServer:
         their breakers, and in-flight exchanges against a departing
         replica finish through its :meth:`leave` drain."""
         lh, lp = self.local.address
-        self.routing_table = exchange_routing_table(
-            lh, lp, timeout_s=timeout_s or self._gather_timeout_s)
-        self.router.refresh(self.routing_table)
+        self.routing_table, role_ids = exchange_routing_table(
+            lh, lp, timeout_s=timeout_s or self._gather_timeout_s,
+            role=_role_index(self.role))
+        self.routing_roles = [ROLE_NAMES[i] for i in role_ids]
+        self.router.refresh(self.routing_table, roles=self.routing_roles)
         return self.routing_table
 
     def leave(self, timeout_s: float = 30.0) -> bool:

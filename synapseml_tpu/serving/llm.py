@@ -78,6 +78,7 @@ class LLMServer:
                  qos: Any = None,
                  tenant_policies: Optional[Dict[str, Any]] = None,
                  max_tenants: int = 256,
+                 prefill_pool: Any = None,
                  engine_kwargs: Optional[Dict[str, Any]] = None):
         # session survivability plane (docs/api/serving.md "Session
         # survivability & KV tiering"): kv_arena / kv_arena_bytes
@@ -136,6 +137,20 @@ class LLMServer:
             from .qos import QosScheduler
             qos = QosScheduler(policies=dict(tenant_policies))
         self.qos = qos
+        # disaggregated prefill/decode (docs/api/serving.md
+        # "Disaggregated prefill/decode"): pass a serving.disagg.
+        # PrefillPool and every fresh prompt is offered to the pool
+        # before admission — its finished K/V ships into THIS replica's
+        # host arena (a handoff needs one: pass kv_arena/kv_arena_bytes
+        # too) and the admit warm-restores it token-exactly.  Every
+        # handoff failure mode degrades to local colocated prefill,
+        # counted in disagg_handoffs_total.  The pool is bound to this
+        # server's api path so /sloz grows @phase=prefill|decode planes
+        # the per-phase autoscalers consume.
+        self.prefill_pool = prefill_pool
+        if prefill_pool is not None:
+            prefill_pool.bind(api_path, self.kv_arena,
+                              ttft_slo_s=ttft_slo_s)
         self._loop = _DecodeLoop(
             self.server, self.server._default, engine,
             input_parser=self._parse,
@@ -143,7 +158,8 @@ class LLMServer:
             max_new_tokens_default=max_new_tokens_default,
             ttft_slo_s=ttft_slo_s, token_slo_s=token_slo_s,
             trace_sample_every=trace_sample_every,
-            journal=journal, qos=qos, max_tenants=max_tenants)
+            journal=journal, qos=qos, max_tenants=max_tenants,
+            disagg=prefill_pool)
         # the loop constructs a default scheduler when none was given —
         # surface THAT one so callers can set policies/read attribution
         if self.qos is None:

@@ -52,7 +52,8 @@ __all__ = ["WindowedHistogram", "WindowedCounter", "SloWindow", "SloStore",
            "get_slo_store", "check_sloz", "SLOZ_SCHEMA",
            "SLOZ_SCHEMA_VERSION", "SLO_METRICS",
            "DEFAULT_WINDOW_S", "DEFAULT_SLICES",
-           "TENANT_PLANE_SEP", "tenant_plane_name", "plane_tenant"]
+           "TENANT_PLANE_SEP", "tenant_plane_name", "plane_tenant",
+           "PHASE_PLANE_SEP", "phase_plane_name", "plane_phase"]
 
 #: default sliding-window length (seconds) and slice count — six 10 s
 #: slices: the window advances in 10 s steps, so the digest spans
@@ -106,6 +107,30 @@ def plane_tenant(name: str) -> Optional[str]:
     if TENANT_PLANE_SEP not in name:
         return None
     return name.split(TENANT_PLANE_SEP, 1)[1]
+
+
+#: separator embedding a serving phase in a plane name — the
+#: disaggregated prefill/decode mirror of :data:`TENANT_PLANE_SEP`.
+#: A phase's plane is just ``<base>@phase=<prefill|decode>`` riding the
+#: same get-or-create registry, so ``/sloz`` needs no schema change
+#: (version 2 still holds, exactly as per-tenant planes established)
+#: and ``/sloz?phase=`` is a pure plane-name filter the autoscaler can
+#: scale each pool off independently.
+PHASE_PLANE_SEP = "@phase="
+
+
+def phase_plane_name(base: str, phase: str) -> str:
+    """The plane name carrying ``base``'s per-phase window for
+    ``phase`` (e.g. ``"/generate@phase=prefill"``)."""
+    return f"{base}{PHASE_PLANE_SEP}{phase}"
+
+
+def plane_phase(name: str) -> Optional[str]:
+    """The serving phase a plane name is attributed to (None for
+    aggregate and per-tenant planes)."""
+    if PHASE_PLANE_SEP not in name:
+        return None
+    return name.split(PHASE_PLANE_SEP, 1)[1]
 
 
 def _num(v) -> Optional[float]:
@@ -450,14 +475,17 @@ _SIGNAL_KEYS = ("count", "mean_s", "p50_s", "p95_s", "p99_s")
 _SLO_KEYS = ("threshold_s", "target", "attainment", "burn_rate")
 
 
-def check_sloz(obj: Any, tenant: Optional[str] = None) -> None:
+def check_sloz(obj: Any, tenant: Optional[str] = None,
+               phase: Optional[str] = None) -> None:
     """Validate a ``/sloz`` snapshot (raises ``ValueError``): required
     keys at every level, every leaf numeric or null — the contract the
     ROADMAP-item-4 autoscaler consumes.  With ``tenant`` set the
     snapshot must additionally be a tenant-filtered view: every plane
     name carries exactly that tenant (the ``/sloz?tenant=`` contract —
     a filter that leaked another tenant's plane is a validation error,
-    not a smaller bug)."""
+    not a smaller bug).  ``phase`` is the same contract for the
+    disaggregated ``/sloz?phase=`` view: every plane name must carry
+    exactly that serving phase."""
     if not isinstance(obj, dict):
         raise ValueError("sloz snapshot must be a dict")
     for key in SLOZ_SCHEMA:
@@ -477,6 +505,12 @@ def check_sloz(obj: Any, tenant: Optional[str] = None) -> None:
                 raise ValueError(
                     f"sloz plane {name!r} leaked into the tenant="
                     f"{tenant!r} filtered view")
+    if phase is not None:
+        for name in obj["planes"]:
+            if plane_phase(name) != phase:
+                raise ValueError(
+                    f"sloz plane {name!r} leaked into the phase="
+                    f"{phase!r} filtered view")
 
     def _leaf(path: str, v: Any) -> None:
         if v is not None and not isinstance(v, (int, float)):

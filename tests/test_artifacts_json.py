@@ -182,6 +182,39 @@ QOS_REQUIRED = (
     "qos_flood_burst",
 )
 
+#: the disaggregated prefill/decode plane (ISSUE 19): a record carrying
+#: ANY ``disagg_`` key must carry the whole set — the decode-side admit
+#: TTFT pair (disagg vs colocated) with the end-to-end honesty anchor,
+#: EVERY handoff outcome counter in the closed set (a lone ``ok`` count
+#: can't hide attributed degradations), the token-exactness count with
+#: the turn total it must equal, per-phase utilization, and both sides
+#: of the independent-resize demonstration — so a partially-failed
+#: disagg leg cannot ship an admit win without its colocated anchor or
+#: an outcome claim without the full attribution
+DISAGG_REQUIRED = (
+    "disagg_ttft_p50_ms",
+    "disagg_ttft_p99_ms",
+    "disagg_colocated_ttft_p50_ms",
+    "disagg_colocated_ttft_p99_ms",
+    "disagg_admit_speedup_p50",
+    "disagg_e2e_ttft_p50_ms",
+    "disagg_e2e_ttft_p99_ms",
+    "disagg_handoffs_ok",
+    "disagg_handoffs_corrupt",
+    "disagg_handoffs_timeout",
+    "disagg_handoffs_expired",
+    "disagg_handoffs_fallback",
+    "disagg_prefill_util",
+    "disagg_decode_util",
+    "disagg_sessions",
+    "disagg_turns",
+    "disagg_token_exact_turns",
+    "disagg_prefill_replicas_before",
+    "disagg_prefill_replicas_after",
+    "disagg_decode_replicas_before",
+    "disagg_decode_replicas_after",
+)
+
 LLMSERVE_SPEC_REQUIRED = (
     "llmserve_spec_tokens_per_sec",
     "llmserve_spec_tokens_per_step",
@@ -395,6 +428,23 @@ def test_qos_fields_complete():
                if rec[k] is not None
                and not isinstance(rec[k], (int, float))]
         assert not bad, f"{name}: non-numeric qos fields: {bad}"
+
+
+def test_disagg_fields_complete():
+    """ISSUE 19: a record carrying any ``disagg_`` field (the
+    disaggregated prefill/decode plane) carries the WHOLE set, each
+    numeric or null — no admit-TTFT win without its colocated anchor,
+    no handoff claim without every outcome counter in the closed set."""
+    for name, rec in _bench_records():
+        disagg_keys = [k for k in rec if k.startswith("disagg_")]
+        if not disagg_keys or _labeled_partial(rec):
+            continue
+        missing = [k for k in DISAGG_REQUIRED if k not in rec]
+        assert not missing, f"{name}: incomplete disagg block: {missing}"
+        bad = [k for k in disagg_keys
+               if rec[k] is not None
+               and not isinstance(rec[k], (int, float))]
+        assert not bad, f"{name}: non-numeric disagg fields: {bad}"
 
 
 def test_comms_topo_fields_complete():

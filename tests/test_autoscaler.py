@@ -508,7 +508,7 @@ class TestControllerShrinkZeroDrop:
             answered, routed_after = [], []
             shrunk = threading.Event()
             for k in range(60):
-                rank, url = router.route()
+                rank, _, url = router.route()[:3]
                 if shrunk.is_set():
                     routed_after.append(url)
                 body = json.dumps({"x": k}).encode()

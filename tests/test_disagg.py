@@ -1,0 +1,831 @@
+"""Disaggregated prefill/decode tests (ISSUE 19).
+
+The contract under test:
+
+- the KV transfer codec (``pack_kv_transfer``/``unpack_kv_transfer``)
+  roundtrips the cache-native rows bit-exactly and detects every
+  corruption shape BEFORE adoption: flipped body byte, torn body, bad
+  header CRC, bad magic, prefix-hash mismatch;
+- ``PrefillPool.handoff`` resolves every attempt to exactly one
+  attributed outcome (``ok``/``corrupt``/``timeout``/``expired``/
+  ``fallback``), never raises, and every non-ok outcome leaves the
+  decode arena untouched — the caller's local prefill is the universal
+  fallback, so a disaggregated turn is TOKEN-EXACT vs the colocated
+  reference (plain and speculative engines), including under injected
+  corrupt/drop/delay/error faults;
+- delivery is idempotent: a re-sent transfer supersedes via
+  ``arena.put``, it never tears the resident entry;
+- the pool is an autoscaler actuator (grow/shrink, per-worker breakers
+  released on shrink) and feeds an ``@phase=prefill`` SLO plane while
+  the decode loop feeds ``@phase=decode`` — ``GET /sloz?phase=`` serves
+  each filtered view schema-checked;
+- ``ReplicaRouter`` role-aware routing never hands a prefill replica to
+  decode traffic (and vice versa), and a repin under the role-aware
+  router still triggers journal failover-restore token-exactly through
+  ``DistributedServingServer.route_request`` (satellite 3);
+- a SIGKILLed prefill replica mid-handoff (subprocess, armed ``kill``
+  at ``disagg.prefill``) and a corrupt-transfer chaos soak at p=0.35
+  both converge with ZERO wrong tokens, every degradation attributed
+  in ``disagg_handoffs_total`` (satellite 2).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from synapseml_tpu.models.llm import (HostKVArena, LlamaConfig, LlamaModel,
+                                      SlotEngine, generate)
+from synapseml_tpu.models.llm.kvtier import (ChecksumError, TRANSFER_MAGIC,
+                                             pack_kv_transfer,
+                                             token_prefix_hash,
+                                             unpack_kv_transfer)
+from synapseml_tpu.serving.disagg import (DISAGG_METRICS, HANDOFF_OUTCOMES,
+                                          PrefillPool, PrefillWorker)
+from synapseml_tpu.telemetry import get_registry
+from synapseml_tpu.telemetry.slo import check_sloz, phase_plane_name
+
+pytestmark = pytest.mark.disagg
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny(num_layers=2, max_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    return cfg, model, variables
+
+
+def _prompts(cfg, n, length, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, cfg.vocab_size, (n, length)).astype(np.int32)
+
+
+def _metric(name, **labels):
+    m = get_registry().get(name)
+    return 0.0 if m is None else m.value(**labels)
+
+
+def _rows(rng, layers=2, span=6, kh=2, dh=8):
+    return [{"k": rng.normal(size=(span, kh, dh)).astype(np.float32),
+             "v": rng.normal(size=(span, kh, dh)).astype(np.float32)}
+            for _ in range(layers)]
+
+
+def _post(url, payload, timeout=60, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+# ---------------------------------------------------------------------------
+# KV transfer codec
+# ---------------------------------------------------------------------------
+
+class TestTransferCodec:
+    def test_roundtrip_bit_exact_with_identity(self):
+        rng = np.random.default_rng(1)
+        ids = [3, 1, 4, 1, 5, 9]
+        rows = _rows(rng, span=len(ids))
+        blob = pack_kv_transfer(ids, rows, session="conv", tenant="acme")
+        assert blob.startswith(TRANSFER_MAGIC)
+        xfer = unpack_kv_transfer(blob)
+        assert xfer.session == "conv" and xfer.tenant == "acme"
+        assert xfer.ids == ids
+        assert xfer.prefix_hash == token_prefix_hash(ids)
+        assert len(xfer.rows) == len(rows)
+        for got, want in zip(xfer.rows, rows):
+            np.testing.assert_array_equal(got["k"], want["k"])
+            np.testing.assert_array_equal(got["v"], want["v"])
+
+    def test_flipped_body_byte_detected(self):
+        rng = np.random.default_rng(2)
+        ids = [1, 2, 3, 4]
+        blob = bytearray(pack_kv_transfer(ids, _rows(rng, span=4)))
+        blob[-10] ^= 0xFF                      # deep in the last row
+        with pytest.raises(ChecksumError):
+            unpack_kv_transfer(bytes(blob))
+
+    def test_torn_body_detected(self):
+        rng = np.random.default_rng(3)
+        blob = pack_kv_transfer([1, 2, 3], _rows(rng, span=3))
+        with pytest.raises(ChecksumError):
+            unpack_kv_transfer(blob[:-7])      # SIGKILL-shaped tear
+
+    def test_corrupt_header_detected(self):
+        rng = np.random.default_rng(4)
+        blob = bytearray(pack_kv_transfer([1, 2, 3], _rows(rng, span=3)))
+        # flip a byte inside the framed JSON header (past the magic)
+        blob[len(TRANSFER_MAGIC) + 4] ^= 0x01
+        with pytest.raises((ChecksumError, ValueError)):
+            unpack_kv_transfer(bytes(blob))
+
+    def test_wrong_magic_rejected(self):
+        with pytest.raises(ValueError):
+            unpack_kv_transfer(b"NOTKV1\n" + b"x" * 64)
+
+    def test_prefix_hash_binds_frame_to_prompt(self):
+        """A frame whose header advertises different ids than it was
+        hashed for is refused — the wrong-prompt wire shape."""
+        rng = np.random.default_rng(5)
+        blob = pack_kv_transfer([1, 2, 3], _rows(rng, span=3))
+        head_end = blob.index(b"\n", len(TRANSFER_MAGIC)) + 1
+        frame = blob[len(TRANSFER_MAGIC):head_end].decode()
+        crc_hex, payload = frame.rstrip("\n").split(" ", 1)
+        header = json.loads(payload)
+        header["ids"] = [9, 9, 9]              # tampered prompt
+        import binascii
+        new_payload = json.dumps(header, separators=(",", ":"))
+        new_crc = format(binascii.crc32(new_payload.encode()) & 0xFFFFFFFF,
+                         "08x")
+        forged = (TRANSFER_MAGIC + f"{new_crc} {new_payload}\n".encode()
+                  + blob[head_end:])
+        with pytest.raises(ChecksumError):
+            unpack_kv_transfer(forged)
+
+    def test_mismatched_row_shapes_refused_at_pack(self):
+        rng = np.random.default_rng(6)
+        rows = _rows(rng, span=4)
+        rows[1] = _rows(rng, span=5)[0]        # one layer, wrong span
+        with pytest.raises(ValueError):
+            pack_kv_transfer([1, 2, 3, 4], rows)
+
+
+# ---------------------------------------------------------------------------
+# PrefillPool outcome state machine (fake workers — no model needed)
+# ---------------------------------------------------------------------------
+
+class _FakeWorker:
+    """Deterministic K/V source: rows derived from the prompt, so two
+    workers given the same prompt produce identical transfers."""
+
+    def __init__(self, fail_times=0, sleep_s=0.0, exc=RuntimeError):
+        self.fail_times = fail_times
+        self.sleep_s = sleep_s
+        self.exc = exc
+        self.calls = 0
+
+    def prefill(self, ids, tenant="default"):
+        self.calls += 1
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise self.exc("prefill replica unreachable")
+        if self.sleep_s:
+            import time
+            time.sleep(self.sleep_s)
+        rng = np.random.default_rng(sum(ids))
+        return _rows(rng, span=len(ids))
+
+
+def _pool(name, workers=None, **kw):
+    kw.setdefault("cooldown_s", 60.0)
+    pool = PrefillPool(workers=workers if workers is not None
+                       else [_FakeWorker()], name=name, **kw)
+    return pool
+
+
+class TestHandoffOutcomes:
+    def _bound(self, name, workers=None, arena_bytes=1 << 22, **kw):
+        pool = _pool(name, workers=workers, **kw)
+        arena = HostKVArena(arena_bytes, name=name)
+        pool.bind(f"/{name}", arena, ttft_slo_s=0.5)
+        return pool, arena
+
+    def test_ok_adopts_into_arena(self, fault_registry):
+        pool, arena = self._bound("t-dsg-ok")
+        n0 = _metric("disagg_handoffs_total", pool="t-dsg-ok", outcome="ok")
+        assert pool.handoff(list(range(1, 13)), session="s") == "ok"
+        assert len(arena) == 1
+        assert _metric("disagg_handoffs_total", pool="t-dsg-ok",
+                       outcome="ok") == n0 + 1
+        hist = get_registry().get("disagg_handoff_latency_seconds")
+        assert hist.stats(pool="t-dsg-ok")["count"] >= 1
+
+    def test_unbound_or_short_prompt_is_fallback(self, fault_registry):
+        pool = _pool("t-dsg-unbound")
+        assert pool.handoff([1, 2, 3]) == "fallback"    # no arena bound
+        pool2, arena = self._bound("t-dsg-short", min_prompt=8)
+        assert pool2.handoff([1, 2, 3]) == "fallback"   # prompt too short
+        assert len(arena) == 0
+
+    def test_empty_pool_is_fallback(self, fault_registry):
+        pool, arena = self._bound("t-dsg-empty", workers=[])
+        assert pool.handoff(list(range(1, 13))) == "fallback"
+        assert len(arena) == 0
+
+    def test_corrupt_transfer_detected_nothing_adopted(self,
+                                                       fault_registry):
+        pool, arena = self._bound("t-dsg-rot")
+        fault_registry.inject("disagg.transfer", "corrupt")
+        n0 = _metric("disagg_handoffs_total", pool="t-dsg-rot",
+                     outcome="corrupt")
+        assert pool.handoff(list(range(1, 13))) == "corrupt"
+        assert len(arena) == 0                 # refused before adoption
+        assert _metric("disagg_handoffs_total", pool="t-dsg-rot",
+                       outcome="corrupt") == n0 + 1
+
+    def test_dropped_transfer_is_timeout(self, fault_registry):
+        pool, arena = self._bound("t-dsg-drop")
+        fault_registry.inject("disagg.transfer", "drop")
+        assert pool.handoff(list(range(1, 13))) == "timeout"
+        assert len(arena) == 0
+
+    def test_late_transfer_expires_under_lease(self, fault_registry):
+        """A worker slower than the lease: the transfer arrives intact
+        but stale — refused as ``expired``, never adopted."""
+        pool, arena = self._bound(
+            "t-dsg-late", workers=[_FakeWorker(sleep_s=0.08)],
+            lease_s=0.04)
+        assert pool.handoff(list(range(1, 13))) == "expired"
+        assert len(arena) == 0
+
+    def test_delay_fault_expires_the_lease(self, fault_registry):
+        """The ``delay`` wire fault holds the frame past the deadline
+        (real sleep: the lease is wall-clock)."""
+        fault_registry.no_sleep = False
+        fault_registry.inject("disagg.transfer", "delay", delay_s=0.08)
+        pool, arena = self._bound("t-dsg-delay", lease_s=0.04)
+        assert pool.handoff(list(range(1, 13))) == "expired"
+        assert fault_registry.sleeps_for("disagg.transfer") == [0.08]
+        assert len(arena) == 0
+
+    def test_worker_errors_retry_then_fallback(self, fault_registry):
+        """Transient worker failures are retried under the lease (with
+        backoffs on the ``disagg.retry`` site); persistent failure is a
+        fallback, and enough of them trip the worker's breaker so the
+        NEXT handoff doesn't even try (pool effectively empty)."""
+        pool, arena = self._bound(
+            "t-dsg-flaky", workers=[_FakeWorker(fail_times=2)],
+            retry=None, failure_threshold=3)
+        # two failures then success: retries absorb it inside the lease
+        assert pool.handoff(list(range(1, 13))) == "ok"
+        assert len(fault_registry.sleeps_for("disagg.retry")) == 2
+        # a persistently-failing worker: retries exhaust → fallback
+        pool2, arena2 = self._bound(
+            "t-dsg-down", workers=[_FakeWorker(fail_times=99)],
+            failure_threshold=3)
+        assert pool2.handoff(list(range(1, 13))) == "fallback"
+        assert len(arena2) == 0
+        # the breaker tripped open: the next attempt finds no admissible
+        # worker and falls back WITHOUT calling it
+        w = pool2._workers[0]
+        calls = w.calls
+        assert pool2.handoff(list(range(1, 13))) == "fallback"
+        assert w.calls == calls
+
+    def test_redelivery_supersedes_idempotently(self, fault_registry):
+        pool, arena = self._bound("t-dsg-dup")
+        ids = list(range(1, 13))
+        assert pool.handoff(ids, session="s") == "ok"
+        assert pool.handoff(ids, session="s") == "ok"   # re-delivered
+        assert len(arena) == 1                 # superseded, not doubled
+        key, lcp = arena.longest_prefix(ids)
+        assert lcp == len(ids)
+
+    def test_phase_gated_fault_targets_prefill_only(self, fault_registry):
+        """A ``phase="decode"`` rule at the transfer site must NOT fire
+        on the prefill-phase wire; retargeted to ``prefill`` it does."""
+        pool, arena = self._bound("t-dsg-phase")
+        rule = fault_registry.inject("disagg.transfer", "corrupt",
+                                     phase="decode")
+        assert pool.handoff(list(range(1, 13))) == "ok"
+        assert rule.fired == 0
+        fault_registry.clear()
+        fault_registry.inject("disagg.transfer", "corrupt",
+                              phase="prefill")
+        assert pool.handoff(list(range(20, 40))) == "corrupt"
+
+    def test_handoff_never_raises(self, fault_registry):
+        """Belt over the contract: even an arena whose put() explodes
+        resolves to an attributed fallback, not an exception in the
+        decode loop."""
+
+        class _Bomb:
+            def put(self, *a, **k):
+                raise RuntimeError("adoption exploded")
+
+        pool = _pool("t-dsg-bomb")
+        pool.bind("/t-dsg-bomb", _Bomb())
+        assert pool.handoff(list(range(1, 13))) == "fallback"
+
+    def test_prefill_slo_plane_fed(self, fault_registry):
+        pool, arena = self._bound("t-dsg-slo")
+        pool.handoff(list(range(1, 13)))
+        snap = pool.slo.snapshot()
+        assert snap["rates"]["admitted_per_s"] is not None
+        assert snap["slo"]["ttft"]["threshold_s"] == 0.5
+        assert snap["signals"]["ttft"]["count"] >= 1
+
+
+class TestPoolActuator:
+    def test_grow_shrink_track_gauge_and_release_breakers(self):
+        from synapseml_tpu.resilience.breaker import _breakers
+        made = []
+
+        def factory():
+            made.append(_FakeWorker())
+            return made[-1]
+
+        pool = PrefillPool(factory=factory, name="t-dsg-scale",
+                           failure_threshold=1, cooldown_s=60.0)
+        assert pool.replica_count() == 0 and pool.warming_count() == 0
+        assert pool.grow(3) == 3
+        assert pool.replica_count() == 3 and len(made) == 3
+        assert _metric("disagg_pool_replicas", pool="t-dsg-scale") == 3
+        # trip worker 2's breaker, then shrink it away: released
+        pool._breaker(2).record_failure()
+        key = pool._breaker_key(2)
+        assert key in _breakers
+        assert pool.shrink(2) == 2
+        assert pool.replica_count() == 1
+        assert key not in _breakers
+        assert _metric("disagg_pool_replicas", pool="t-dsg-scale") == 1
+        assert pool.shrink(5) == 1             # clamped at empty
+        assert pool.grow(1) == 1               # regrows cleanly
+
+    def test_growless_pool_without_factory(self):
+        pool = PrefillPool(workers=[_FakeWorker()], name="t-dsg-nofac")
+        assert pool.grow(2) == 0
+        assert pool.replica_count() == 1
+
+    def test_per_phase_autoscalers_scale_pools_independently(self):
+        """Two Autoscalers over one /sloz snapshot, each filtered to its
+        phase: prefill shed-pressure grows ONLY the prefill pool while
+        the idle decode pool shrinks — the ISSUE's two-pool pin."""
+        from synapseml_tpu.serving.autoscaler import (AutoscalePolicy,
+                                                      Autoscaler)
+        from synapseml_tpu.telemetry.slo import SloStore
+
+        store = SloStore()
+        pw = store.window(phase_plane_name("/dsg", "prefill"))
+        pw.set_objective("ttft", 0.05)
+        dw = store.window(phase_plane_name("/dsg", "decode"))
+        dw.set_objective("ttft", 0.05)
+        for _ in range(60):                    # prefill: shedding hard
+            pw.count("admitted"), pw.count("shed")
+            pw.observe_ttft(0.2)
+            pw.observe_occupancy(1.0)
+            dw.count("admitted"), dw.count("retired")
+            dw.observe_ttft(0.001)
+            dw.observe_occupancy(0.01)         # decode: idle
+        snap = store.snapshot()
+
+        prefill_pool = PrefillPool(factory=_FakeWorker,
+                                   name="t-dsg-as-pf")
+        prefill_pool.grow(1)
+        decode_pool = PrefillPool(factory=_FakeWorker,
+                                  name="t-dsg-as-dc")
+        decode_pool.grow(3)
+        policy = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                 sustain_polls=1, grow_cooldown_s=0.0,
+                                 shrink_cooldown_s=0.0)
+        clock = [1000.0]
+        a_pf = Autoscaler(prefill_pool, source=lambda: snap,
+                          policy=policy, phase="prefill",
+                          name="t-dsg-as-pf", clock=lambda: clock[0])
+        a_dc = Autoscaler(decode_pool, source=lambda: snap,
+                          policy=policy, phase="decode",
+                          name="t-dsg-as-dc", clock=lambda: clock[0])
+        d1 = a_pf.poll_once()
+        assert d1.verdict == "grow", d1.reason
+        assert prefill_pool.replica_count() == 2
+        d2 = a_dc.poll_once()
+        assert d2.verdict == "shrink", d2.reason
+        assert decode_pool.replica_count() == 2
+        # each controller only saw its own phase's planes
+        assert d1.signals["planes"] == 1
+        assert d2.signals["planes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# token-exactness: disaggregated turn vs colocated reference
+# ---------------------------------------------------------------------------
+
+class TestDisaggTokenExact:
+    @pytest.mark.parametrize("spec", [0, 4], ids=["plain", "spec"])
+    def test_handoff_then_admit_matches_colocated(self, tiny_model,
+                                                  fault_registry, spec):
+        """The acceptance pin: prefill on a DEDICATED engine, K/V
+        shipped through the codec into the decode replica's arena, then
+        the decode engine's admit warm-restores it — the continuation
+        is token-identical to the colocated (local-prefill) reference,
+        plain and speculative."""
+        cfg, model, variables = tiny_model
+        name = f"t-dsg-exact-{spec}"
+        arena = HostKVArena(1 << 22, name=name)
+        prefill_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                 name=f"{name}-pf")
+        pool = PrefillPool(workers=[PrefillWorker(prefill_eng)],
+                           name=name)
+        pool.bind(f"/{name}", arena)
+        decode_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                min_prefix=8, name=name, kv_arena=arena,
+                                spec_draft_len=spec)
+        p = _prompts(cfg, 1, 14, seed=100 + spec)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=6)[0]
+        assert pool.handoff(p, session="conv") == "ok"
+        ok0 = _metric("kvtier_restores_total", engine=name,
+                      source="host", outcome="ok")
+        r = decode_eng.admit(p, 6)
+        assert r.reused_tokens > 0             # adopted, not cold
+        assert _metric("kvtier_restores_total", engine=name,
+                       source="host", outcome="ok") == ok0 + 1
+        np.testing.assert_array_equal(
+            decode_eng.run_to_completion()[r.slot], ref)
+
+    def test_every_degraded_outcome_still_token_exact(self, tiny_model,
+                                                      fault_registry):
+        """corrupt / drop→timeout / pool-down→fallback: the decode
+        engine cold-prefills locally and the tokens are IDENTICAL —
+        degradation costs latency, never correctness."""
+        cfg, model, variables = tiny_model
+        name = "t-dsg-degrade"
+        arena = HostKVArena(1 << 22, name=name)
+        prefill_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                 name=f"{name}-pf")
+        pool = PrefillPool(workers=[PrefillWorker(prefill_eng)],
+                           name=name, failure_threshold=99,
+                           cooldown_s=60.0)
+        pool.bind(f"/{name}", arena)
+        decode_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                min_prefix=8, name=name, kv_arena=arena)
+        scenarios = [("corrupt", "corrupt"), ("drop", "timeout"),
+                     ("error", "fallback")]
+        for i, (kind, want) in enumerate(scenarios):
+            fault_registry.clear()
+            site = ("disagg.prefill" if kind == "error"
+                    else "disagg.transfer")
+            fault_registry.inject(site, kind, times=10)
+            p = _prompts(cfg, 1, 12, seed=120 + i)[0]
+            ref = generate(model, variables, p[None], max_new_tokens=5)[0]
+            n0 = _metric("disagg_handoffs_total", pool=name, outcome=want)
+            assert pool.handoff(p) == want
+            assert _metric("disagg_handoffs_total", pool=name,
+                           outcome=want) == n0 + 1
+            r = decode_eng.admit(p, 5)
+            assert r.reused_tokens == 0        # cold local prefill
+            np.testing.assert_array_equal(
+                decode_eng.run_to_completion()[r.slot], ref)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through LLMServer (admission offers the pool, /sloz phases)
+# ---------------------------------------------------------------------------
+
+class TestDisaggServerE2E:
+    def test_server_turn_matches_colocated_and_sloz_phases(
+            self, tiny_model):
+        from synapseml_tpu.serving import LLMServer
+        cfg, model, variables = tiny_model
+        name = "dsg-e2e"
+        prefill_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                 name=f"{name}-pf")
+        pool = PrefillPool(workers=[PrefillWorker(prefill_eng)],
+                           name=name)
+        p = _prompts(cfg, 1, 14, seed=140)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=6)[0]
+        srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                        api_path=f"/{name}", kv_arena_bytes=1 << 22,
+                        prefill_pool=pool, ttft_slo_s=5.0,
+                        min_prefix=8, engine_kwargs={"name": name})
+        try:
+            ok0 = _metric("disagg_handoffs_total", pool=name,
+                          outcome="ok")
+            r0 = _metric("kvtier_restores_total", engine=name,
+                         source="host", outcome="ok")
+            status, body, _ = _post(srv.url, {
+                "ids": [int(t) for t in p], "max_new_tokens": 6,
+                "session": "conv"})
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref]
+            assert _metric("disagg_handoffs_total", pool=name,
+                           outcome="ok") == ok0 + 1
+            # the admit WARM-RESTORED the handed-off K/V
+            assert _metric("kvtier_restores_total", engine=name,
+                           source="host", outcome="ok") == r0 + 1
+            base = srv.url.rsplit("/", 1)[0]
+            for phase in ("prefill", "decode"):
+                status, raw = _get(f"{base}/sloz?phase={phase}")
+                assert status == 200
+                snap = json.loads(raw)
+                check_sloz(snap, phase=phase)  # raises on any leak
+                names = list(snap["planes"])
+                assert names and all(
+                    n.endswith(f"@phase={phase}") for n in names)
+            # the unfiltered view still carries the aggregate plane
+            status, raw = _get(f"{base}/sloz")
+            full = json.loads(raw)
+            check_sloz(full)
+            assert any("@phase=" not in n for n in full["planes"])
+        finally:
+            srv.close()
+
+    def test_server_corrupt_handoff_degrades_token_exact(
+            self, tiny_model, fault_registry):
+        """Through the full serving path with the wire corrupting at
+        p=1: the reply is still the colocated reference (local
+        prefill), with the outcome attributed."""
+        from synapseml_tpu.serving import LLMServer
+        cfg, model, variables = tiny_model
+        name = "dsg-e2e-rot"
+        prefill_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                 name=f"{name}-pf")
+        pool = PrefillPool(workers=[PrefillWorker(prefill_eng)],
+                           name=name)
+        fault_registry.inject("disagg.transfer", "corrupt", times=10)
+        p = _prompts(cfg, 1, 14, seed=141)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=5)[0]
+        srv = LLMServer(model, variables, n_slots=2, max_len=96,
+                        api_path=f"/{name}", kv_arena_bytes=1 << 22,
+                        prefill_pool=pool, min_prefix=8,
+                        engine_kwargs={"name": name})
+        try:
+            c0 = _metric("disagg_handoffs_total", pool=name,
+                         outcome="corrupt")
+            status, body, _ = _post(srv.url, {
+                "ids": [int(t) for t in p], "max_new_tokens": 5})
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref]
+            assert _metric("disagg_handoffs_total", pool=name,
+                           outcome="corrupt") == c0 + 1
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# role-aware routing + repin → journal failover-restore (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestRoleAwareRouting:
+    def test_single_process_exchange_carries_role(self):
+        from synapseml_tpu.serving.distributed import (
+            ROLE_NAMES, exchange_routing_table)
+        table, roles = exchange_routing_table("127.0.0.1", 9321, role=1)
+        assert table == [("127.0.0.1", 9321)] and roles == [1]
+        assert ROLE_NAMES[roles[0]] == "prefill"
+
+    def test_route_filters_by_role(self):
+        from synapseml_tpu.serving import ReplicaRouter
+        from synapseml_tpu.serving.distributed import NoHealthyReplicaError
+        table = [("127.0.0.1", 9301), ("127.0.0.1", 9302),
+                 ("127.0.0.1", 9303)]
+        router = ReplicaRouter(table, name="t-dsg-roles",
+                               roles=["decode", "prefill", "decode"])
+        for _ in range(6):
+            assert router.route(role="prefill").rank == 1
+            assert router.route(role="decode").rank in (0, 2)
+        # roleless traffic round-robins over everyone (colocated mode)
+        assert {router.route().rank for _ in range(6)} == {0, 1, 2}
+        # a role nobody holds: structured refusal naming the mismatch
+        with pytest.raises(NoHealthyReplicaError) as ei:
+            router.route(role="ghost")
+        assert "role" in str(ei.value)
+
+    def test_pinned_wrong_role_repins(self):
+        """A session pinned while colocated must repin when the caller
+        starts asking for a role its pinned replica doesn't hold."""
+        from synapseml_tpu.serving import ReplicaRouter
+        table = [("127.0.0.1", 9311), ("127.0.0.1", 9312)]
+        router = ReplicaRouter(table, name="t-dsg-repin-role",
+                               roles=["prefill", "decode"])
+        res = router.route_addr(session="conv", role="prefill")
+        assert res.rank == 0 and res.outcome == "miss"
+        res2 = router.route_addr(session="conv", role="decode")
+        assert res2.rank == 1 and res2.outcome == "repin"
+        assert router.route_addr(session="conv",
+                                 role="decode").outcome == "hit"
+
+    def test_roles_length_mismatch_refused(self):
+        from synapseml_tpu.serving import ReplicaRouter
+        with pytest.raises(ValueError):
+            ReplicaRouter([("127.0.0.1", 9331)], name="t-dsg-badroles",
+                          roles=["decode", "decode"])
+
+    def test_repin_triggers_journal_failover_restore_e2e(
+            self, tiny_model, tmp_path):
+        """Satellite 3: two decode replicas sharing a journal root
+        behind a role-aware router (plus a prefill rank decode traffic
+        must never land on).  The session's pinned replica dies
+        mid-conversation; ``route_request(role="decode")`` surfaces
+        ``repin``, the client marks the forwarded turn ``resume``, and
+        the surviving replica replays the journal — the reply equals
+        the uninterrupted greedy reference token-for-token."""
+        from synapseml_tpu.serving import LLMServer, ReplicaRouter
+        from synapseml_tpu.serving.distributed import (
+            DistributedServingServer)
+        from synapseml_tpu.models.llm import SessionJournal
+        cfg, model, variables = tiny_model
+        jdir = str(tmp_path / "jnl")
+        p1 = _prompts(cfg, 1, 12, seed=150)[0]
+        ref1 = generate(model, variables, p1[None], max_new_tokens=5)[0]
+        replicas = [LLMServer(model, variables, n_slots=2, max_len=96,
+                              journal=SessionJournal(jdir,
+                                                     name=f"t-dsg-fo{i}"),
+                              engine_kwargs={"name": f"t-dsg-fo{i}"})
+                    for i in range(2)]
+        # rank 2 is a PREFILL replica: decode routing must skip it even
+        # while it answers health probes (reserve a port nothing holds)
+        table = [r.server.address for r in replicas] + [("127.0.0.1", 9341)]
+
+        class _Stub:
+            router = ReplicaRouter(table, name="t-dsg-fo",
+                                   roles=["decode", "decode", "prefill"],
+                                   failure_threshold=1)
+
+        stub = _Stub()
+        try:
+            res = DistributedServingServer.route_request(
+                stub, session="conv", role="decode")
+            assert res.outcome == "miss" and res.rank in (0, 1)
+            url = replicas[res.rank].url
+            status, body, _ = _post(url, {
+                "ids": [int(t) for t in p1], "session": "conv",
+                "max_new_tokens": 5}, headers=res.headers)
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref1]
+            stub.router.report(res.rank, ok=True, addr=res.addr)
+            assert DistributedServingServer.route_request(
+                stub, session="conv", role="decode").outcome == "hit"
+            # the pinned replica dies mid-conversation
+            dead = res.rank
+            replicas[dead].close()
+            stub.router.report(dead, ok=False, addr=res.addr)
+            res2 = DistributedServingServer.route_request(
+                stub, session="conv", role="decode")
+            assert res2.outcome == "repin"     # the failover trigger
+            assert res2.rank not in (dead, 2)  # survivor, never prefill
+            # repin ⇒ the client sends the turn as a resume: the
+            # survivor replays the shared journal token-exactly
+            status, body, _ = _post(replicas[res2.rank].url, {
+                "session": "conv", "resume": True}, headers=res2.headers)
+            assert status == 200
+            assert json.loads(body)["ids"] == [int(t) for t in ref1]
+        finally:
+            for r in replicas:
+                r.close()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-handoff + corrupt-transfer chaos soak (satellite 2)
+# ---------------------------------------------------------------------------
+
+_KILL_CHILD = textwrap.dedent("""
+    import jax, jax.numpy as jnp, numpy as np
+
+    from synapseml_tpu.models.llm import (HostKVArena, LlamaConfig,
+                                          LlamaModel, SlotEngine)
+    from synapseml_tpu.resilience import get_faults
+    from synapseml_tpu.serving.disagg import PrefillPool, PrefillWorker
+
+    cfg = LlamaConfig.tiny(num_layers=2, max_len=96, dtype=jnp.float32)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                     name="kill-child-pf")
+    pool = PrefillPool(workers=[PrefillWorker(eng)], name="kill-child")
+    pool.bind("/kill-child", HostKVArena(1 << 22, name="kill-child"))
+    p = np.random.default_rng(160).integers(
+        1, cfg.vocab_size, 12).astype(np.int32)
+    assert pool.handoff(p, session="conv") == "ok"
+    print("HANDOFF1 ok", flush=True)
+    # the prefill replica dies MID-HANDOFF on the next attempt
+    get_faults().configure("disagg.prefill=kill")
+    pool.handoff(list(p) + [3, 1, 4], session="conv")
+    print("UNREACHABLE", flush=True)
+""")
+
+
+class TestPrefillCrashSIGKILL:
+    def test_sigkill_fires_mid_handoff(self, tiny_model):
+        """The armed ``kill`` at ``disagg.prefill`` SIGKILLs the
+        prefill process between pick and transfer — the crash shape the
+        lease exists for (a same-process test can only pin that the
+        site fires; the surviving-decode-side behavior is pinned by
+        ``test_dead_prefill_replica_degrades_token_exact``)."""
+        env = dict(os.environ)
+        env.pop("SML_FAULTS", None)
+        proc = subprocess.run([sys.executable, "-c", _KILL_CHILD],
+                              capture_output=True, text=True,
+                              timeout=240, env=env, cwd="/root/repo")
+        assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+        assert "HANDOFF1 ok" in proc.stdout
+        assert "UNREACHABLE" not in proc.stdout
+
+    def test_dead_prefill_replica_degrades_token_exact(self, tiny_model,
+                                                       fault_registry):
+        """What the decode side observes of a SIGKILLed worker is a
+        dead connection: every call raises.  The pool retries, trips
+        the breaker, falls back — and the turn is still token-exact."""
+        cfg, model, variables = tiny_model
+        name = "t-dsg-deadpf"
+        arena = HostKVArena(1 << 22, name=name)
+
+        class _DeadWorker:
+            def prefill(self, ids, tenant="default"):
+                raise ConnectionError("replica SIGKILLed")
+
+        pool = PrefillPool(workers=[_DeadWorker()], name=name,
+                           failure_threshold=2, cooldown_s=60.0)
+        pool.bind(f"/{name}", arena)
+        decode_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                min_prefix=8, name=name, kv_arena=arena)
+        p = _prompts(cfg, 1, 12, seed=161)[0]
+        ref = generate(model, variables, p[None], max_new_tokens=5)[0]
+        f0 = _metric("disagg_handoffs_total", pool=name,
+                     outcome="fallback")
+        assert pool.handoff(p) == "fallback"
+        assert _metric("disagg_handoffs_total", pool=name,
+                       outcome="fallback") == f0 + 1
+        r = decode_eng.admit(p, 5)
+        np.testing.assert_array_equal(
+            decode_eng.run_to_completion()[r.slot], ref)
+
+
+class TestChaosSoak:
+    @pytest.mark.fault
+    def test_corrupt_wire_soak_zero_wrong_tokens(self, tiny_model,
+                                                 fault_registry):
+        """Satellite 2: seeded corrupt transfers at p=0.35 + an
+        intermittently-dying prefill worker across a multi-turn,
+        multi-session soak.  EVERY turn of every session decodes
+        token-exactly vs the dense greedy reference, and every handoff
+        lands in exactly one attributed outcome (the outcome-counter
+        delta sums to the number of handoffs)."""
+        cfg, model, variables = tiny_model
+        fault_registry.inject("disagg.transfer", "corrupt", p=0.35)
+        # every 5th worker call dies (the retry/breaker pair absorbs it)
+        fault_registry.inject("disagg.prefill", "error", p=0.2)
+        name = "t-dsg-soak"
+        arena = HostKVArena(1 << 22, name=name)
+        prefill_eng = SlotEngine(model, variables, n_slots=2, max_len=96,
+                                 name=f"{name}-pf")
+        pool = PrefillPool(workers=[PrefillWorker(prefill_eng)],
+                           name=name, failure_threshold=99,
+                           cooldown_s=60.0)
+        pool.bind(f"/{name}", arena)
+        decode_eng = SlotEngine(model, variables, n_slots=3, max_len=96,
+                                min_prefix=8, name=name, kv_arena=arena)
+        before = {o: _metric("disagg_handoffs_total", pool=name,
+                             outcome=o) for o in HANDOFF_OUTCOMES}
+        sessions = {i: _prompts(cfg, 1, 10, seed=170 + i)[0]
+                    for i in range(3)}
+        handoffs = 0
+        seen = set()
+        for rnd in range(3):
+            for i, ids in sorted(sessions.items()):
+                ref = generate(model, variables, ids[None],
+                               max_new_tokens=5)[0]
+                outcome = pool.handoff(ids, session=f"s{i}")
+                handoffs += 1
+                seen.add(outcome)
+                assert outcome in HANDOFF_OUTCOMES
+                r = decode_eng.admit(ids, 5)
+                decode_eng.run_to_completion()
+                got = decode_eng.generated_ids(r.slot)
+                np.testing.assert_array_equal(got, ref)   # NEVER wrong
+                sessions[i] = np.concatenate(
+                    [ids, got, _prompts(cfg, 1, 4,
+                                        seed=180 + 10 * rnd + i)[0]])
+        assert "ok" in seen                    # the plane did deliver
+        assert len(seen) > 1                   # ...and did degrade
+        delta = sum(_metric("disagg_handoffs_total", pool=name,
+                            outcome=o) - before[o]
+                    for o in HANDOFF_OUTCOMES)
+        assert delta == handoffs               # every handoff attributed
+
+
+# ---------------------------------------------------------------------------
+# surface hygiene
+# ---------------------------------------------------------------------------
+
+class TestDisaggSurface:
+    def test_metric_names_follow_conventions(self):
+        assert len(DISAGG_METRICS) == len(set(DISAGG_METRICS))
+        for n in DISAGG_METRICS:
+            assert n.startswith("disagg_")
+        from synapseml_tpu.serving.disagg import _disagg_metrics
+        _disagg_metrics()                      # registers (idempotent)
+        reg = get_registry()
+        for n in DISAGG_METRICS:
+            assert reg.get(n) is not None, n
+
+    def test_outcomes_closed_set(self):
+        assert HANDOFF_OUTCOMES == ("ok", "corrupt", "timeout",
+                                    "expired", "fallback")

@@ -331,8 +331,8 @@ class TestServingFailover:
             servers[0].health.begin_drain()
             assert router.probe(0) == "draining"
             for _ in range(4):         # round-robin must never pick 0
-                rank, url = router.route("/api")
-                assert rank == 1 and url.endswith("/api")
+                res = router.route("/api")
+                assert res.rank == 1 and res.url.endswith("/api")
         finally:
             for s in servers:
                 s.close()
@@ -625,7 +625,7 @@ class TestRouterResizeAbsorption:
 
             def client():
                 for k in range(60):
-                    rank, url = router.route()
+                    rank, _, url = router.route()[:3]
                     if refreshed.is_set():
                         routed_after.append(rank)
                     body = json.dumps({"x": k}).encode()
@@ -693,7 +693,8 @@ class TestRouterResizeAbsorption:
             old_addr = table[0]
             # route_addr hands back the routed endpoint under the same
             # lock — the report token a renumber-safe caller carries
-            rank, addr, url, _outcome = router.route_addr()
+            res = router.route_addr()
+            rank, addr, url = res.rank, res.addr, res.url
             assert addr == table[rank] and url.startswith(
                 f"http://{addr[0]}:{addr[1]}")
             # rank 0's replica departs; ranks renumber: index 0 now

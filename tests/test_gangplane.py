@@ -750,6 +750,18 @@ class TestMetricHygiene:
         # the tenant label contract itself is documented
         assert "X-SML-Tenant" in docs and "tenant=" in docs
 
+    def test_every_disagg_metric_is_documented(self):
+        """ISSUE 19: the disaggregated prefill/decode plane's metric
+        names (handoff outcome counter, handoff latency histogram,
+        pool replica gauge) are held to the same docs bar."""
+        from synapseml_tpu.serving.disagg import DISAGG_METRICS
+        docs = "\n".join(p.read_text(encoding="utf-8")
+                         for p in (REPO / "docs" / "api").glob("*.md"))
+        missing = sorted(n for n in DISAGG_METRICS if n not in docs)
+        assert not missing, f"disagg metrics absent from docs: {missing}"
+        # the outcome attribution + phase-plane contracts are documented
+        assert "outcome=" in docs and "@phase=" in docs
+
     def test_registry_sees_no_duplicate_kind_at_runtime(self):
         """Importing the wired modules must not blow up on registration
         conflicts (the registry raises on kind/label mismatches)."""

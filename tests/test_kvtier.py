@@ -526,27 +526,27 @@ class TestRouterAffinityOutcome:
     def test_miss_hit_repin_surfaced(self):
         """``route_addr`` returns the affinity outcome so the serving
         layer can tell 'pinned replica lost — engage restore' (repin)
-        from a first route (miss); ``route`` keeps its 2-tuple shape."""
-        from synapseml_tpu.serving import ReplicaRouter
+        from a first route (miss); both return a named ``RouteResult``."""
+        from synapseml_tpu.serving import ReplicaRouter, RouteResult
         table = [("127.0.0.1", 9001), ("127.0.0.1", 9002)]
         router = ReplicaRouter(table, name="t-kvtier-aff",
                                failure_threshold=1)
-        rank, addr, url, outcome = router.route_addr(session="conv")
-        assert outcome == "miss" and addr == table[rank]
-        assert router.route_addr(session="conv")[3] == "hit"
-        assert router.route_addr()[3] == "miss"    # no session: miss
+        res = router.route_addr(session="conv")
+        assert res.outcome == "miss" and res.addr == table[res.rank]
+        assert router.route_addr(session="conv").outcome == "hit"
+        assert router.route_addr().outcome == "miss"   # no session: miss
         # the pinned replica dies: the session repins — the caller's
         # cue that the device prefix cache is gone and journal/arena
         # restore must engage
-        router.report(rank, ok=False, addr=addr)
-        r2, a2, _, outcome2 = router.route_addr(session="conv")
-        assert outcome2 == "repin" and a2 != addr
-        assert router.route_addr(session="conv")[3] == "hit"
-        assert len(router.route()) == 2
+        router.report(res.rank, ok=False, addr=res.addr)
+        res2 = router.route_addr(session="conv")
+        assert res2.outcome == "repin" and res2.addr != res.addr
+        assert router.route_addr(session="conv").outcome == "hit"
+        assert isinstance(router.route(), RouteResult)
 
     def test_route_request_threads_outcome(self):
         """``DistributedServingServer.route_request`` hands the outcome
-        through (5-tuple) alongside the trace headers."""
+        through (``RouteResult``) alongside the trace headers."""
         from synapseml_tpu.serving import ReplicaRouter
         from synapseml_tpu.serving.distributed import (
             DistributedServingServer)
@@ -557,11 +557,10 @@ class TestRouterAffinityOutcome:
                                    name="t-kvtier-req")
 
         stub = _Stub()
-        rank, addr, url, headers, outcome = \
-            DistributedServingServer.route_request(stub, session="conv2")
-        assert outcome == "miss" and TRACE_HEADER in headers
+        res = DistributedServingServer.route_request(stub, session="conv2")
+        assert res.outcome == "miss" and TRACE_HEADER in res.headers
         assert DistributedServingServer.route_request(
-            stub, session="conv2")[4] == "hit"
+            stub, session="conv2").outcome == "hit"
 
 
 # ---------------------------------------------------------------------------

@@ -604,10 +604,9 @@ class TestSessionAffinity:
 
     def test_session_sticks_while_routable(self):
         r = self._router()
-        rank0, _ = r.route(session="conv-1")
+        rank0 = r.route(session="conv-1").rank
         for _ in range(5):
-            rank, _ = r.route(session="conv-1")
-            assert rank == rank0
+            assert r.route(session="conv-1").rank == rank0
         # unpinned traffic still round-robins over everyone
         seen = {r.route()[0] for _ in range(6)}
         assert seen == {0, 1, 2}
@@ -615,10 +614,10 @@ class TestSessionAffinity:
     def test_pinned_replica_down_falls_back_and_repins(self):
         from synapseml_tpu.serving.distributed import DEAD
         r = self._router()
-        rank0, _ = r.route(session="conv-2")
+        rank0 = r.route(session="conv-2").rank
         with r._lock:
             r._status[rank0] = DEAD
-        rank1, _ = r.route(session="conv-2")
+        rank1 = r.route(session="conv-2").rank
         assert rank1 != rank0
         assert r.route(session="conv-2")[0] == rank1     # re-pinned
 
@@ -630,7 +629,7 @@ class TestSessionAffinity:
             r._sessions[("default", "conv-3")] = ("127.0.0.1", 9002)
         r.refresh([("127.0.0.1", 9000), ("127.0.0.1", 9001)])
         assert ("default", "conv-3") not in r._sessions   # fell back cleanly
-        rank, _ = r.route(session="conv-3")          # never crashes
+        rank = r.route(session="conv-3").rank        # never crashes
         assert rank in (0, 1)
         assert r._sessions[("default", "conv-3")] in r.table
 

@@ -338,8 +338,7 @@ class TestRouterWarmingState:
             # lattice done: next probe readmits without breaker drama
             state["state"] = "warm"
             assert router.probe(0) == "healthy"
-            rank, url = router.route()
-            assert rank == 0
+            assert router.route().rank == 0
         finally:
             srv.close()
 

@@ -646,7 +646,7 @@ class TestAffinityCounters:
     def test_hit_miss_repin_counted(self):
         from synapseml_tpu.serving.distributed import DEAD
         r = self._router()
-        rank0, _ = r.route(session="conv-1")       # first route: miss
+        rank0 = r.route(session="conv-1").rank     # first route: miss
         assert self._val(r, "miss") == 1.0
         for _ in range(3):
             r.route(session="conv-1")              # pinned: hits
