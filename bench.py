@@ -673,26 +673,11 @@ def bench_guard_overhead():
         # the best estimate of the true cost).  The order ALTERNATES
         # within pairs so monotone host-load drift cannot bias whichever
         # leg habitually runs second.
-        best = None
-        for _ in range(3):
-            base_t, deltas = [], []
-            for i in range(20):
-                first, second = ((plain, guarded) if i % 2 == 0
-                                 else (guarded, plain))
-                t0 = time.perf_counter()
-                first.transform(ds)
-                t1 = time.perf_counter()
-                second.transform(ds)
-                t2 = time.perf_counter()
-                b, g = ((t1 - t0, t2 - t1) if i % 2 == 0
-                        else (t2 - t1, t1 - t0))
-                base_t.append(b)
-                deltas.append(g - b)
-            blk_base = sorted(base_t)[len(base_t) // 2] * 1e3
-            blk_delta = sorted(deltas)[len(deltas) // 2] * 1e3
-            if best is None or blk_delta < best[1]:
-                best = (blk_base, blk_delta)
-        base_ms, delta_ms = best
+        from synapseml_tpu.telemetry.gangplane import StepProfiler
+        base_s, delta_s = StepProfiler.measure(
+            (lambda: plain.transform(ds), lambda: guarded.transform(ds)),
+            blocks=3, pairs=20)
+        base_ms, delta_ms = base_s * 1e3, delta_s * 1e3
         guard_ms = base_ms + delta_ms
     overhead = delta_ms / base_ms * 100.0
     return overhead, base_ms, guard_ms
@@ -1182,21 +1167,9 @@ def bench_obs_overhead():
 
     bare()
     observed()                   # both paths share one warm XLA cache
-    best = None
-    for _ in range(3):
-        bases, deltas = [], []
-        for i in range(6):
-            if i % 2 == 0:
-                b, o = bare(), observed()
-            else:
-                o, b = observed(), bare()
-            bases.append(b)
-            deltas.append(o - b)
-        blk_base = sorted(bases)[len(bases) // 2] * 1e3
-        blk_delta = sorted(deltas)[len(deltas) // 2] * 1e3
-        if best is None or blk_delta < best[1]:
-            best = (blk_base, blk_delta)
-    base_ms, delta_ms = best
+    base_s, delta_s = StepProfiler.measure((bare, observed),
+                                           blocks=3, pairs=6)
+    base_ms, delta_ms = base_s * 1e3, delta_s * 1e3
     per_step = {seg: round(s, 6) for seg, s in
                 last_summary.get("per_step_avg_seconds", {}).items()}
     return delta_ms / base_ms * 100.0, base_ms, base_ms + delta_ms, per_step
@@ -1268,13 +1241,11 @@ try:
                 np.asarray(f(x, timeout_s=600.0))
         return prof.summary()["per_step_avg_seconds"]["collective"]
 
-    best = {}
-    for b in range(3):                                     # alternating legs,
-        order = ("f32", "int8", "bf16") if b % 2 == 0 else ("bf16", "int8",
-                                                            "f32")
-        for name in order:                                 # min of blocks
-            s = leg(name)
-            best[name] = min(best.get(name, s), s)
+    # alternating leg order, min of blocks — StepProfiler.measure's
+    # multi shape (the legs self-time through the profiler's accounting)
+    best = StepProfiler.measure(
+        {name: (lambda name=name: leg(name))
+         for name in ("f32", "int8", "bf16")}, blocks=3)
     out["allreduce_f32_ms"] = best["f32"] * 1e3
     out["allreduce_int8_ms"] = best["int8"] * 1e3
     out["allreduce_bf16_ms"] = best["bf16"] * 1e3
@@ -1549,8 +1520,10 @@ try:
     plans = reg.get("collective_plans_total")
     counts = {}
     if plans is not None:
-        for (strategy, reason), v in plans.series().items():
-            counts[strategy] = counts.get(strategy, 0.0) + float(v)
+        for key, v in plans.series().items():
+            labels = dict(zip(plans.labelnames, key))
+            s = labels.get("strategy", "flat")
+            counts[s] = counts.get(s, 0.0) + float(v)
     for s in ("flat", "ring", "tree", "hierarchical"):
         out[f"comms_topo_plans_{s}"] = counts.get(s, 0.0)
     wires = reg.get("collective_wire_bytes_total")
@@ -2454,21 +2427,10 @@ def bench_llm_trace_overhead():
 
     run(False)
     run(True)                    # both paths share one warm XLA cache
-    best = None
-    for _ in range(3):
-        bases, deltas = [], []
-        for i in range(6):
-            if i % 2 == 0:
-                b, o = run(False), run(True)
-            else:
-                o, b = run(True), run(False)
-            bases.append(b)
-            deltas.append(o - b)
-        blk_base = sorted(bases)[len(bases) // 2] * 1e3
-        blk_delta = sorted(deltas)[len(deltas) // 2] * 1e3
-        if best is None or blk_delta < best[1]:
-            best = (blk_base, blk_delta)
-    base_ms, delta_ms = best
+    from synapseml_tpu.telemetry.gangplane import StepProfiler
+    base_s, delta_s = StepProfiler.measure(
+        (lambda: run(False), lambda: run(True)), blocks=3, pairs=6)
+    base_ms, delta_ms = base_s * 1e3, delta_s * 1e3
     return delta_ms / base_ms * 100.0, base_ms, base_ms + delta_ms
 
 
@@ -3238,6 +3200,126 @@ def _nullify_nonfinite(obj):
     return obj
 
 
+
+def bench_autotune():
+    """The self-tuning performance plane end to end (ISSUE 20): run all
+    four registered search spaces through the measured
+    :class:`~synapseml_tpu.telemetry.autotune.Autotuner` against a
+    throwaway tuning table, then fit the collective cost model from
+    watched allreduce dispatch timings across payload sizes and
+    contrast its derived tree-vs-ring cutoff with the spec constant.
+
+    Honesty: on CPU the kernels run interpret-mode and the collective
+    is a host psum — the measured ms are THIS host's real wall clock,
+    keyed by its device_kind in the table (never mistakable for chip
+    numbers), and anything unmeasurable stays null.  → dict of
+    ``autotune_*`` fields, all-or-nothing and schema-held by
+    tests/test_artifacts_json.py."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.parallel.collectives import allreduce_fn
+    from synapseml_tpu.parallel.mesh import data_parallel_mesh
+    from synapseml_tpu.parallel.planner import TREE_CUTOFF_BYTES
+    from synapseml_tpu.telemetry.autotune import (
+        COST_MODEL_GEOMETRY, COST_MODEL_SPACE, Autotuner,
+        CollectiveCostModel, registered_spaces)
+    from synapseml_tpu.telemetry.gangplane import StepProfiler
+    from synapseml_tpu.telemetry.tunetable import (
+        TUNE_TABLE_BASENAME, TunePlane, set_tuneplane)
+
+    #: per-space winner field → the key inside that space's winner dict
+    WINNER_KEYS = {"paged_attn_tile": ("winner_tile", "tile"),
+                   "gbdt_hist_chunk": ("winner_chunk", "chunk"),
+                   "llm_bucket_grid": ("winner_min_bucket", "min_bucket"),
+                   "int8_chunk": ("winner_chunk", "chunk")}
+    fields = {}
+    for name, (suffix, _) in WINNER_KEYS.items():
+        fields[f"autotune_{name}_trials"] = None
+        fields[f"autotune_{name}_ms"] = None
+        fields[f"autotune_{name}_{suffix}"] = None
+    fields.update(autotune_total_trials=None, autotune_table_bytes=None,
+                  autotune_costmodel_alpha_us=None,
+                  autotune_costmodel_beta_us_per_mib=None,
+                  autotune_costmodel_fitted_cutoff_bytes=None,
+                  autotune_costmodel_spec_cutoff_bytes=None,
+                  autotune_costmodel_cutoff_ratio=None)
+
+    with tempfile.TemporaryDirectory() as tdir:
+        plane = TunePlane(directory=tdir)
+        prev = set_tuneplane(plane)
+        try:
+            tuner = Autotuner()
+            total = 0
+            for name, space in sorted(registered_spaces().items()):
+                try:
+                    result = tuner.run(space)
+                except Exception as e:
+                    print(f"[secondary]   autotune space {name} failed: "
+                          f"{e}", file=sys.stderr)
+                    continue
+                if result is None:          # nothing measurable here
+                    continue
+                suffix, wkey = WINNER_KEYS[name]
+                fields[f"autotune_{name}_trials"] = result["trial_count"]
+                fields[f"autotune_{name}_ms"] = round(
+                    result["measured_ms"], 4)
+                fields[f"autotune_{name}_{suffix}"] = (
+                    result["winner"].get(wkey))
+                total += result["trial_count"]
+            if total:
+                fields["autotune_total_trials"] = total
+            table_path = os.path.join(tdir, TUNE_TABLE_BASENAME)
+            if os.path.exists(table_path):
+                fields["autotune_table_bytes"] = os.path.getsize(table_path)
+
+            # -- fitted collective cost model: watched allreduce timings
+            #    across payload sizes -> alpha-beta -> the tree-vs-ring
+            #    cutoff the planner would derive, vs the spec constant
+            try:
+                n = jax.local_device_count()
+                mesh = data_parallel_mesh(n)
+                f = allreduce_fn(mesh)
+                legs = {}
+                for numel in (1 << 14, 1 << 16, 1 << 18, 1 << 20):
+                    x = jnp.ones((n, numel), jnp.float32)
+                    np.asarray(f(x, timeout_s=600.0))        # warm
+
+                    def leg(x=x):
+                        np.asarray(f(x, timeout_s=600.0))
+
+                    legs[str(numel * 4)] = leg
+                measured = StepProfiler.measure(legs, blocks=3)
+                samples = [(float(b), s) for b, s in
+                           ((int(k), v) for k, v in measured.items())]
+                fitted = CollectiveCostModel.fitted(samples)
+                alpha, beta = fitted.alpha_s, fitted.beta_s_per_byte
+                plane.record(
+                    COST_MODEL_SPACE, COST_MODEL_GEOMETRY,
+                    {"alpha_s": alpha, "beta_s_per_byte": beta},
+                    measured_ms=max(s for _, s in samples) * 1e3,
+                    trials=len(samples))
+                fields["autotune_costmodel_alpha_us"] = round(
+                    alpha * 1e6, 4)
+                fields["autotune_costmodel_beta_us_per_mib"] = round(
+                    beta * 1e6 * (1 << 20), 6)
+                cutoff = fitted.tree_cutoff_bytes(8)
+                fields["autotune_costmodel_fitted_cutoff_bytes"] = cutoff
+                fields["autotune_costmodel_spec_cutoff_bytes"] = (
+                    TREE_CUTOFF_BYTES)
+                fields["autotune_costmodel_cutoff_ratio"] = round(
+                    cutoff / TREE_CUTOFF_BYTES, 6)
+                fields["autotune_table_bytes"] = os.path.getsize(table_path)
+            except Exception as e:
+                print(f"[secondary]   autotune cost-model fit failed: {e}",
+                      file=sys.stderr)
+        finally:
+            set_tuneplane(prev)
+    return fields
+
+
 class _SkippedLeg(Exception):
     """Raised inside a leg's try-block when ``--only`` deselects it —
     rides the section's existing except so skipped legs cost nothing."""
@@ -3255,7 +3337,7 @@ BENCH_LEGS = ("bert", "llm", "spec", "llm8b", "resnet_onnx", "vision",
               "gbdt", "gbdt_pair", "anchor", "streamed", "serving",
               "gang", "resize", "guard", "comms", "comms_topo", "llmserve",
               "llmserve_spec", "llmserve_trace", "llmserve_warmup", "obs",
-              "autoscale", "kvtier", "qos", "disagg")
+              "autoscale", "kvtier", "qos", "disagg", "autotune")
 
 
 def main(only=None):
@@ -3738,6 +3820,26 @@ def main(only=None):
         print(f"[secondary] multi-tenant QoS bench failed: {e}",
               file=sys.stderr)
 
+    autotune_fields = None
+    try:
+        if not want("autotune"):
+            raise _SkippedLeg()
+        autotune_fields = bench_autotune()
+        af = autotune_fields
+        tt = af.get("autotune_total_trials")
+        fc = af.get("autotune_costmodel_fitted_cutoff_bytes")
+        sc = af.get("autotune_costmodel_spec_cutoff_bytes")
+        print(f"[secondary] autotune: {tt} measured trials across "
+              f"{sum(1 for k, v in af.items() if k.endswith('_trials') and v)}"
+              f" spaces; fitted tree-vs-ring cutoff "
+              f"{fc if fc is not None else 'unfit'} bytes vs spec {sc}",
+              file=sys.stderr)
+        print("[secondary]   NOTE: CPU interpret-mode winners are THIS "
+              "host's, keyed by device_kind=cpu in the table — a TPU "
+              "process will never load them", file=sys.stderr)
+    except Exception as e:
+        print(f"[secondary] autotune bench failed: {e}", file=sys.stderr)
+
     disagg_fields = None
     try:
         if not want("disagg"):
@@ -3936,6 +4038,10 @@ def main(only=None):
         # emitted all-or-nothing and schema-held by test_artifacts_json
         **(qos_fields or {}),
         **(disagg_fields or {}),
+        # self-tuning plane (ISSUE 20): per-space trial counts + winners,
+        # table bytes, fitted-vs-spec cost-model cutoffs — emitted
+        # all-or-nothing and schema-held by test_artifacts_json
+        **(autotune_fields or {}),
         "serving_continuous_ms_per_record": (
             round(serving_marg_ms, 4) if serving_marg_ms else None),
         "serving_solo_rtt_ms": (round(serving_solo_ms, 3)

@@ -158,11 +158,17 @@ class BoostingConfig:
     fused_ingest: Any = "auto"
     pass_through: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
-    def growth_params(self) -> GrowthParams:
+    def growth_params(self, num_features: int = 0) -> GrowthParams:
         mono = None
         if self.monotone_constraints and any(self.monotone_constraints):
             mono = tuple(int(c) for c in self.monotone_constraints)
+        hist_chunk = 0
+        if num_features:
+            hist_chunk = _tuned_hist_chunk(
+                int(num_features), self.max_bin + 1,
+                default_n_slots(self.num_leaves))
         return GrowthParams(
+            hist_chunk=hist_chunk,
             num_leaves=self.num_leaves,
             max_depth=self.max_depth,
             min_data_in_leaf=float(self.min_data_in_leaf),
@@ -179,6 +185,36 @@ class BoostingConfig:
                 self.two_level_hist, str(self.two_level_hist))),
             refine_k=int(self.refine_features),
         )
+
+
+def _tuned_hist_chunk(num_features: int, total_bins: int,
+                      n_slots: int) -> int:
+    """Tuned rows-per-chunk for the Pallas histogram kernels, or 0.
+
+    Only a ``gbdt_hist_chunk`` tuning-table entry measured on THIS device
+    at exactly this (features, total_bins) geometry applies, and only when
+    ``hist_chunk_ok`` re-admits the chunk for the slot count this fit will
+    use; anything else keeps the ``_tile_for`` ladder default, so fits
+    without a table dispatch byte-identical programs."""
+    try:
+        from ...telemetry.tunetable import geometry_key, get_tuneplane
+        from .pallas_hist import hist_chunk_ok
+
+        def _gate(winner):
+            c = winner.get("chunk")
+            return (isinstance(c, int) and not isinstance(c, bool)
+                    and hist_chunk_ok(num_features, total_bins, n_slots, c))
+
+        won = get_tuneplane().consult(
+            "BoostingConfig.growth_params", "gbdt_hist_chunk",
+            geometry_key(features=int(num_features),
+                         total_bins=int(total_bins)),
+            validate=_gate)
+        if won is not None:
+            return int(won["chunk"])
+    except Exception:
+        pass
+    return 0
 
 
 class Booster:
@@ -497,7 +533,7 @@ class Booster:
 # --------------------------------------------------------------------------
 
 def _step_factory_args(config: "BoostingConfig", K: int, mesh, featpar: bool,
-                       use_pallas, objective_fn=None):
+                       use_pallas, objective_fn=None, num_features: int = 0):
     """The exact ``_make_step`` (args, kwargs) — built in ONE place so the
     warm-compile thread and the training loop hit the same lru_cache entry
     (any drift would silently compile a program that is never used).
@@ -517,7 +553,8 @@ def _step_factory_args(config: "BoostingConfig", K: int, mesh, featpar: bool,
     is_rf = config.boosting_type == "rf"
     use_bagging = (config.bagging_fraction < 1.0
                    and (is_rf or config.bagging_freq > 0))
-    args = (config.growth_params(), objective_fn, K,
+    args = (config.growth_params(num_features=num_features if use_pallas
+                                 else 0), objective_fn, K,
             1.0 if is_rf else config.learning_rate, mesh,
             config.boosting_type == "goss",
             config.top_rate, config.other_rate)
@@ -1443,7 +1480,7 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     if (use_pallas and mesh is None and K == 1 and not config.enable_bundle
             and config.objective != "lambdarank" and n >= 200_000):
         _wargs, _wkw = _step_factory_args(config, K, mesh, featpar,
-                                          use_pallas)
+                                          use_pallas, num_features=F)
         # warm the program the run will actually use: the scanned
         # whole-run program for fire-and-forget fits, else the one-step
         _w_scan_ok = (not (config.boosting_type == "dart" or valid is not None
@@ -1757,8 +1794,21 @@ def train(X: np.ndarray, y: np.ndarray, config: BoostingConfig,
     use_goss = config.boosting_type == "goss"
     lr = 1.0 if is_rf else config.learning_rate
 
+    # the histogram kernels see the BUNDLED / per-rank feature width, and
+    # the tuned-chunk consult keys on exactly that width (a mismatched
+    # geometry falls back to the ladder default).  Must mirror the warm-
+    # compile call above (plain path: width == F) or the lru cache forks.
+    if rank_bundlers:
+        _hist_F = Fb_rank
+    elif bundler is not None:
+        _hist_F = bundler.num_bundles
+    elif featpar:
+        _hist_F = Fp // shards
+    else:
+        _hist_F = F
     _sargs, _skw = _step_factory_args(config, K, mesh, featpar, use_pallas,
-                                      objective_fn=objective_fn)
+                                      objective_fn=objective_fn,
+                                      num_features=_hist_F)
     # lambdarank's objective closes over per-dataset arrays: a cache entry
     # would both never hit again and pin the arrays — bypass the cache
     make = (_make_step.__wrapped__ if config.objective == "lambdarank"

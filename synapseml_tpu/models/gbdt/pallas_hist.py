@@ -112,14 +112,22 @@ def _feat_tile(num_features: int, cap: int) -> int:
 _VMEM_BUDGET = 13 * 1024 * 1024
 
 
-def fused_geometry(num_features: int, total_bins: int, n_slots: int):
+def fused_geometry(num_features: int, total_bins: int, n_slots: int,
+                   chunk_override: int = 0):
     """(ft, chunk) for the fused route+hist kernel, or None if no geometry
     fits VMEM.  Unlike the per-tile nodes kernel, the fused kernel's
     accumulator is fully resident (routing is computed once per chunk, so
     the grid runs chunk-major and every feature tile must stay hot) — its
     footprint scales with F, and wide matrices must shrink the chunk or
-    fall back to the scatter path."""
+    fall back to the scatter path.
+
+    ``chunk_override`` (the tuned ``gbdt_hist_chunk`` winner) replaces
+    the ladder's starting chunk; the SAME shrink-to-fit loop still
+    applies, so an override can never overcommit VMEM — it can only
+    start the search somewhere else."""
     cap, chunk = _tile_for(total_bins)
+    if chunk_override:
+        chunk = int(chunk_override)
     ft = _feat_tile(num_features, cap)
     VN = n_slots * SLOT_LANES
     while chunk >= 1024:
@@ -131,6 +139,27 @@ def fused_geometry(num_features: int, total_bins: int, n_slots: int):
             return ft, chunk
         chunk //= 2
     return None
+
+
+def hist_chunk_ok(num_features: int, total_bins: int, n_slots: int,
+                  chunk: int) -> bool:
+    """Whether ``chunk`` is a legal tuned rows-per-chunk override for
+    BOTH histogram entry points at this geometry: a multiple dividing
+    :data:`PAD_MULTIPLE` at or above the fused kernel's 1024 floor,
+    admitted by :func:`fused_geometry` WITHOUT shrinking (a winner the
+    fit loop would halve is not the config that was measured), and
+    fitting the nodes kernel's one-hot scratch.  The ``gbdt_hist_chunk``
+    consult site validates winners through this single gate."""
+    chunk = int(chunk)
+    if chunk < 1024 or PAD_MULTIPLE % chunk:
+        return False
+    geo = fused_geometry(num_features, total_bins, n_slots,
+                         chunk_override=chunk)
+    if geo is None or geo[1] != chunk:
+        return False
+    cap, _ = _tile_for(total_bins)
+    ft = _feat_tile(num_features, cap)
+    return ft * total_bins * chunk <= _VMEM_BUDGET
 
 
 def _reshape_feat(bins_t: jnp.ndarray, ft: int):
@@ -284,7 +313,7 @@ def _bins_tiles(bins_t: jnp.ndarray, total_bins: int) -> tuple:
 
 @functools.partial(jax.jit,
                    static_argnames=("n_slots", "total_bins", "hist_shift",
-                                    "interpret"))
+                                    "interpret", "hist_chunk"))
 def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                             slot: jnp.ndarray,     # (N,) int32 in [-1, n_slots)
                             vals: jnp.ndarray,     # (N, 8) int8 limbs
@@ -292,14 +321,28 @@ def build_hist_nodes_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                             n_slots: int,
                             total_bins: int,
                             hist_shift: int = 0,
-                            interpret: bool = False) -> jnp.ndarray:
+                            interpret: bool = False,
+                            hist_chunk: int = 0) -> jnp.ndarray:
     """→ (n_slots, F, Bh, 3) float32 [grad, hess, count] histograms
     (Bh = :func:`coarse_bins` when ``hist_shift`` > 0 — the leaf-wise
-    grower's two-level coarse build)."""
+    grower's two-level coarse build).
+
+    ``hist_chunk`` overrides the ladder's rows-per-chunk (the tuned
+    ``gbdt_hist_chunk`` winner, threaded from
+    ``GrowthParams.hist_chunk``).  A jit STATIC on purpose: a tuned
+    chunk is a different compiled program and must key the dispatch
+    cache — a module-global override would silently serve the first
+    compile to every later candidate."""
     B = total_bins
     Bh = coarse_bins(B, hist_shift) if hist_shift else B
     bins_r, F, G, ft, N = _bins_tiles(bins_t, B)
     _, chunk = _tile_for(B)
+    if hist_chunk:
+        chunk = int(hist_chunk)
+        assert ft * Bh * chunk <= _VMEM_BUDGET, (
+            f"hist_chunk={chunk}: one-hot scratch ({ft}x{Bh}x{chunk}) "
+            "exceeds the VMEM budget — validate overrides through "
+            "hist_chunk_ok()")
     assert N % chunk == 0, f"N={N} must be a multiple of {chunk}"
     VN = n_slots * SLOT_LANES
 
@@ -478,7 +521,8 @@ def _make_fused_kernel(ft: int, shift: int = 0, refine: bool = False):
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots", "total_bins",
-                                             "hist_shift", "interpret"))
+                                             "hist_shift", "interpret",
+                                             "hist_chunk"))
 def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                           node_id: jnp.ndarray,  # (N,) int32
                           leaf: jnp.ndarray,     # (S,) int32 leaf being split
@@ -495,7 +539,8 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
                           total_bins: int,
                           hist_shift: int = 0,
                           sel_k: jnp.ndarray = None,   # (K, N) int32 refined
-                          interpret: bool = False):
+                          interpret: bool = False,
+                          hist_chunk: int = 0):
     """One pass: → (new_node_id (N,), hists (n_slots, F, Bh, 3)[,
     fine_hists (n_slots, K, B, 3) when ``sel_k`` is given]).
 
@@ -510,12 +555,18 @@ def route_and_hist_pallas(bins_t: jnp.ndarray,   # (F, N) | (G, ft, N) int32
     routing stays at fine resolution.  ``sel_k`` (the refined features'
     pre-gathered bin rows) additionally builds their FULL-resolution
     histograms in the same pass, off the same routing and slot-masked
-    value matrix — one bins read and one vn build for both levels."""
+    value matrix — one bins read and one vn build for both levels.
+
+    ``hist_chunk`` is the tuned rows-per-chunk override (jit-static for
+    the same dispatch-cache reason as in
+    :func:`build_hist_nodes_pallas`); the fused fit loop still applies,
+    so an oversized override shrinks to fit rather than overcommitting
+    VMEM."""
     B = total_bins
     Bh = coarse_bins(B, hist_shift) if hist_shift else B
     refine = sel_k is not None
     bins_r, F, G, ft, N = _bins_tiles(bins_t, B)
-    geo = fused_geometry(F, B, n_slots)
+    geo = fused_geometry(F, B, n_slots, chunk_override=hist_chunk)
     assert geo is not None, (
         f"fused kernel does not fit VMEM at F={F}, B={B}, S={n_slots}; "
         "the caller must gate on fused_geometry(...)")

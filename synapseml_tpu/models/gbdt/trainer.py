@@ -100,6 +100,13 @@ class GrowthParams(NamedTuple):
     two_level: str = "off"
     #: features refined at full resolution when two-level is on
     refine_k: int = 0
+    #: tuned rows-per-chunk for the Pallas histogram kernels (0 = the
+    #: ``_tile_for`` ladder default).  Set from the ``gbdt_hist_chunk``
+    #: tuning-table winner by ``BoostingConfig.growth_params()`` —
+    #: part of this NamedTuple (and therefore the jit static key) so a
+    #: tuned geometry compiles its own program instead of silently
+    #: reusing the default's
+    hist_chunk: int = 0
 
 
 class Tree(NamedTuple):
@@ -142,7 +149,7 @@ def _leaf_output(g, h, l1, l2):
 
 
 def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas,
-                vals8=None, scales=None, hist_shift=0):
+                vals8=None, scales=None, hist_shift=0, hist_chunk=0):
     """Histogram for masked rows → (F*Bh, 3) f32 [grad, hess, count]
     (Bh = coarse width when ``hist_shift`` > 0 — the leaf-wise grower's
     two-level coarse build).
@@ -166,7 +173,8 @@ def _build_hist(bins_t, flat_bins, grad, hess, mask, F, B, use_pallas,
         Bh = coarse_bins(B, hist_shift) if hist_shift else B
         return build_hist_nodes_pallas(
             bins_t, slot, vals8, scales, 1, B, hist_shift=hist_shift,
-            interpret=(use_pallas == "interpret"))[0].reshape(F * Bh, 3)
+            interpret=(use_pallas == "interpret"),
+            hist_chunk=hist_chunk)[0].reshape(F * Bh, 3)
     upd = _hist_updates(grad, hess, mask)                                 # (N,3)
     upd = jnp.broadcast_to(upd[None, :, :], (F,) + upd.shape)             # (F,N,3)
     hist = jnp.zeros((F * B, 3), jnp.float32)
@@ -807,7 +815,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
     root_hist = ar(_build_hist(bins_pl, flat_bins, grad, hess,
                                row_valid, F, B, use_pallas,
                                vals8, scales,
-                               hist_shift=(SH if tl else 0))
+                               hist_shift=(SH if tl else 0),
+                               hist_chunk=p.hist_chunk)
                    ).reshape(F, Bh, 3)
     root_stats = jnp.sum(root_hist[0], axis=0)
     if voting:
@@ -825,7 +834,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
                 slot = jnp.where(mask > 0, 0, -1).astype(jnp.int32)
                 return build_hist_nodes_pallas(
                     bkp, slot, vals8, scales, 1, B,
-                    interpret=(use_pallas == "interpret"))
+                    interpret=(use_pallas == "interpret"),
+                    hist_chunk=p.hist_chunk)
             return _build_hist_nodes_xla(
                 bkp, grad, hess, mask,
                 jnp.where(mask > 0, 0, -1).astype(jnp.int32), 1, K, B)
@@ -896,7 +906,8 @@ def grow_tree(bins_t: jnp.ndarray,          # (F, N) int32 (transposed bins)
         lmask = (new_node_id == l_id).astype(jnp.float32) * row_valid
         l_hist = ar(_build_hist(bins_pl, flat_bins, grad, hess, lmask, F, B,
                                 use_pallas, vals8, scales,
-                                hist_shift=(SH if tl else 0)))
+                                hist_shift=(SH if tl else 0),
+                                hist_chunk=p.hist_chunk))
         parent_slot = s["slot"][leaf]
         r_hist = s["hist"][parent_slot] - l_hist
         r_slot = s["next_slot"]
@@ -1075,7 +1086,7 @@ def _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot, n_slots, F, B):
 
 
 def _build_hist_nodes(bins_t, flat_bins, vals8, scales, grad, hess, mask,
-                      slot, n_slots, F, B, use_pallas):
+                      slot, n_slots, F, B, use_pallas, hist_chunk=0):
     """``bins_t`` may be the flat (F, N) matrix OR the pre-reshaped
     (G, ft, N) tile layout (prepare_feature_tiles, F == G*ft always) —
     growers hoist the reshape out of their loops because it materializes
@@ -1084,7 +1095,8 @@ def _build_hist_nodes(bins_t, flat_bins, vals8, scales, grad, hess, mask,
         from .pallas_hist import build_hist_nodes_pallas
         return build_hist_nodes_pallas(bins_t, slot, vals8, scales, n_slots,
                                        B,
-                                       interpret=(use_pallas == "interpret"))
+                                       interpret=(use_pallas == "interpret"),
+                                       hist_chunk=hist_chunk)
     return _build_hist_nodes_xla(flat_bins, grad, hess, mask, slot,
                                  n_slots, F, B)
 
@@ -1207,7 +1219,7 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
     def build(slot):
         return ar(_build_hist_nodes(bins_pl, flat_bins, vals8, scales, grad,
                                     hess, row_valid, slot, S, F, B,
-                                    use_pallas))
+                                    use_pallas, hist_chunk=p.hist_chunk))
 
     F_search = num_bins.shape[0]           # ORIGINAL feature count
     mono_c = _mono_vec(p, F_search)
@@ -1258,7 +1270,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             from .pallas_hist import build_hist_nodes_pallas
             return build_hist_nodes_pallas(
                 bins_kp, slot_vec, vals8, scales, n_slots_, B,
-                interpret=(use_pallas == "interpret"))
+                interpret=(use_pallas == "interpret"),
+                hist_chunk=p.hist_chunk)
         return _build_hist_nodes_xla(bins_kp, grad, hess, row_valid,
                                      slot_vec, n_slots_, K, B)
 
@@ -1280,7 +1293,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
             jnp.ones(S, jnp.int32), jnp.zeros(S, jnp.int32),
             jnp.zeros(S, jnp.int32), vals8, scales, S, B,
             hist_shift=(SH if tl else 0),
-            interpret=(use_pallas == "interpret"))
+            interpret=(use_pallas == "interpret"),
+            hist_chunk=p.hist_chunk)
         root_hist = ar(root_hists)[0]                      # (F, Bh, 3)
     else:
         root_hist = build(jnp.zeros(N, jnp.int32))[0]      # (F, B, 3)
@@ -1379,7 +1393,8 @@ def grow_tree_depthwise(bins_t: jnp.ndarray,     # (F, N) int32
                     rt_hi, rt_df, l_ids, r_ids, vals8, scales, S, B,
                     hist_shift=(SH if tl else 0),
                     sel_k=(sel_k if tl else None),
-                    interpret=(use_pallas == "interpret"))
+                    interpret=(use_pallas == "interpret"),
+                    hist_chunk=p.hist_chunk)
                 # under tl the SAME pass also emits the refined features'
                 # full-resolution left-child histograms (one bins read,
                 # one routing, one slot-masked value build for both
@@ -1640,7 +1655,8 @@ def grow_tree_feature_parallel(
     def build(slot):
         # LOCAL histograms only — the defining property of feature-parallel
         return _build_hist_nodes(bins_pl, flat_bins, vals8, scales, grad,
-                                 hess, row_valid, slot, S, FL, B, use_pallas)
+                                 hess, row_valid, slot, S, FL, B, use_pallas,
+                                 hist_chunk=p.hist_chunk)
 
     # constraints come from the static tuple in p, so the GLOBAL vector is
     # available on every rank; each rank's gain pass slices its own span

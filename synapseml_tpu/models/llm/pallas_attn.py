@@ -87,7 +87,8 @@ class PagedGeometry:
 
 def paged_geometry(max_len: int, num_heads: int, num_kv_heads: int,
                    d_head: int, dtype: Any = jnp.bfloat16,
-                   max_query_span: int = 1) -> Optional[PagedGeometry]:
+                   max_query_span: int = 1,
+                   tile: Optional[int] = None) -> Optional[PagedGeometry]:
     """The VMEM gate: pick the key-tile length for a
     ``(max_len, num_kv_heads, d_head)`` cache row, or None when no
     geometry fits (the 'auto' backend then stays dense — the
@@ -102,20 +103,39 @@ def paged_geometry(max_len: int, num_heads: int, num_kv_heads: int,
     scale with ``max_query_span`` (the speculative verify step's S:
     its q/out blocks are ``(1, S, H, D)`` and its scratch rows
     ``S*H``), so a spec-enabled engine must gate at the WIDEST verify
-    it can launch, not at S=1."""
+    it can launch, not at S=1.
+
+    ``tile`` pins a single candidate instead of the ladder — the tuned
+    override path.  It passes through the SAME divisibility/VMEM gate:
+    a tuning-table winner that stopped fitting (config drift since it
+    was measured) resolves to None, and the caller keeps the default
+    geometry — tables can suggest, only the gate admits."""
     itemsize = np.dtype(dtype).itemsize
     sub = _sublane(dtype)
     s = max(1, int(max_query_span))
-    for tile in _TILE_CANDIDATES:
-        if tile % sub or max_len % tile or tile > max_len // 2:
+    candidates = _TILE_CANDIDATES if tile is None else (int(tile),)
+    for cand in candidates:
+        if cand <= 0 or cand % sub or max_len % cand \
+                or cand > max_len // 2:
             continue
-        need = (2 * 2 * tile * num_kv_heads * d_head * itemsize  # K+V x2 buf
+        need = (2 * 2 * cand * num_kv_heads * d_head * itemsize  # K+V x2 buf
                 + s * 2 * num_heads * d_head * itemsize          # q + out
                 + s * num_heads * d_head * 4                     # f32 acc
                 + s * 2 * num_heads * 128 * 4)                   # m + l
         if need <= _VMEM_BUDGET:
-            return PagedGeometry(tile, max_len // tile, need)
+            return PagedGeometry(cand, max_len // cand, need)
     return None
+
+
+def paged_geometry_key(max_len: int, num_kv_heads: int, d_head: int,
+                       dtype: Any, max_query_span: int = 1) -> str:
+    """The tuning-table geometry key for a paged cache shape — the
+    ``paged_attn_tile`` space records under it and ``SlotEngine``
+    consults with it; one builder so the two can never drift."""
+    from ...telemetry.tunetable import geometry_key
+    return geometry_key(max_len=int(max_len), kv_heads=int(num_kv_heads),
+                        d_head=int(d_head), dtype=np.dtype(dtype).name,
+                        span=max(1, int(max_query_span)))
 
 
 def resolve_attention_backend(backend: str, *, max_len: int,

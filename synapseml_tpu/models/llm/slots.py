@@ -279,7 +279,8 @@ class SlotEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,
                  pad_id: int = 0, min_prefix: int = 8,
-                 min_bucket: int = 8, seed: int = 0, name: str = "llm",
+                 min_bucket: Optional[int] = None, seed: int = 0,
+                 name: str = "llm",
                  attention_backend: str = "auto", step_profiler=None,
                  spec_draft_len: int = 0, spec_ngram: int = 3,
                  spec_adapt: bool = True, trace_sink=None,
@@ -308,6 +309,14 @@ class SlotEngine:
                               self.max_len, self.cfg.num_heads,
                               self.cfg.num_kv_heads, self.cfg.d_head,
                               self.cfg.dtype, max_query_span=spec_span))
+        # tuned K/V tile: the ``paged_attn_tile`` tuning-table winner
+        # for THIS cache geometry, admitted only through the same
+        # divisibility/VMEM gate the ladder uses — no table (or a tile
+        # the gate rejects) keeps the default geometry, so dispatch is
+        # program-key-identical to a table-less process
+        if self._paged_geo is not None:
+            self._paged_geo = self._consult_paged_tile(
+                spec_span, self._paged_geo)
         #: optional telemetry.gangplane.StepProfiler — decode steps run
         #: under step/mark and (capture_xla) the per-bucket step program
         #: goes through capture_cost for the roofline gauges
@@ -342,7 +351,12 @@ class SlotEngine:
         self._key = jax.random.PRNGKey(seed)
         self.cache = init_cache(self.cfg, self.n_slots, self.max_len)
         # prompt-length buckets: powers of two, so the prefill compiles
-        # O(log max_len) programs however ragged the traffic
+        # O(log max_len) programs however ragged the traffic.  The grid
+        # floor defaults to 8; an explicit min_bucket wins outright, and
+        # the None sentinel consults the ``llm_bucket_grid`` tuning
+        # table (absent/mismatched table → 8, the HEAD-identical grid)
+        if min_bucket is None:
+            min_bucket = self._consult_min_bucket()
         buckets = []
         b = max(1, int(min_bucket))
         while b < self.max_len:
@@ -469,6 +483,49 @@ class SlotEngine:
         self.spec_draft_hits = 0
         self.spec_draft_misses = 0
         self._tps_ewma: Optional[float] = None
+
+    # -- tuning-table consults ---------------------------------------------
+    def _consult_paged_tile(self, spec_span: int, default_geo):
+        """``paged_attn_tile`` winner for this cache geometry → the
+        tuned :class:`PagedGeometry`, or the default when the table is
+        absent/mismatched/stale or the winner fails the VMEM gate."""
+        from .pallas_attn import paged_geometry_key
+        from ...telemetry.tunetable import get_tuneplane
+
+        def _gate(winner):
+            t = winner.get("tile")
+            return (isinstance(t, int) and not isinstance(t, bool)
+                    and paged_geometry(
+                        self.max_len, self.cfg.num_heads,
+                        self.cfg.num_kv_heads, self.cfg.d_head,
+                        self.cfg.dtype, max_query_span=spec_span,
+                        tile=t) is not None)
+
+        winner = get_tuneplane().consult(
+            "SlotEngine", "paged_attn_tile",
+            paged_geometry_key(self.max_len, self.cfg.num_kv_heads,
+                               self.cfg.d_head, self.cfg.dtype, spec_span),
+            validate=_gate)
+        if winner is None:
+            return default_geo
+        return paged_geometry(self.max_len, self.cfg.num_heads,
+                              self.cfg.num_kv_heads, self.cfg.d_head,
+                              self.cfg.dtype, max_query_span=spec_span,
+                              tile=int(winner["tile"]))
+
+    def _consult_min_bucket(self) -> int:
+        """``llm_bucket_grid`` winner for this ``max_len`` → the tuned
+        bucket-grid floor, or the default 8."""
+        from ...telemetry.tunetable import geometry_key, get_tuneplane
+        winner = get_tuneplane().consult(
+            "SlotEngine", "llm_bucket_grid",
+            geometry_key(max_len=self.max_len),
+            validate=lambda w: (
+                isinstance(w.get("min_bucket"), int)
+                and not isinstance(w["min_bucket"], bool)
+                and 1 <= w["min_bucket"] <= self.max_len
+                and (w["min_bucket"] & (w["min_bucket"] - 1)) == 0))
+        return int(winner["min_bucket"]) if winner is not None else 8
 
     # -- capacity ----------------------------------------------------------
     @property

@@ -70,6 +70,14 @@ REGISTERED_ENTRY_POINTS = {
         "_copy_prefix_jit", "_restore_span_jit"}),
     "synapseml_tpu.models.llm.pallas_attn": frozenset({
         "paged_decode_attention"}),
+    # non-LLM tunable entry points: not part of the serving lattice, but
+    # the autotune source-scan lint requires every registered search
+    # space to time a program listed here — the registry doubles as the
+    # "what can be warmed/tuned" contract across the codebase
+    "synapseml_tpu.models.gbdt.pallas_hist": frozenset({
+        "build_hist_nodes_pallas", "route_and_hist_pallas"}),
+    "synapseml_tpu.parallel.compression": frozenset({
+        "int8_roundtrip_jit"}),
 }
 
 #: the entry points whose jit dispatch caches the zero-in-loop-compile

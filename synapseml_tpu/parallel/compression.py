@@ -38,6 +38,7 @@ the property GBDT's identical-tree-on-every-rank growth relies on.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -147,10 +148,33 @@ def resolve_collective_config(value: Any) -> Optional[CollectiveConfig]:
             raise ValueError(
                 f"collectiveCompression={value!r}: must be one of {CODECS} "
                 "or a CollectiveConfig")
-        return CollectiveConfig(compression=value, error_feedback=True)
+        cfg = CollectiveConfig(compression=value, error_feedback=True)
+        if value == "int8":
+            tuned = _tuned_int8_chunk()
+            if tuned is not None:
+                cfg = dataclasses.replace(cfg, chunk=tuned)
+        return cfg
     raise TypeError(
         f"collectiveCompression accepts a str codec or CollectiveConfig, "
         f"got {type(value).__name__}")
+
+
+def _tuned_int8_chunk() -> Optional[int]:
+    """The ``int8_chunk`` tuning-table winner for this device, or None
+    (keep the 256 default).  Only the codec SHORTHAND consults the
+    table: an explicit ``CollectiveConfig`` (or its checkpointed dict
+    form) is the caller's decision and passes through untouched."""
+    try:
+        from ..telemetry.tunetable import geometry_key, get_tuneplane
+        winner = get_tuneplane().consult(
+            "resolve_collective_config", "int8_chunk",
+            geometry_key(numel=1 << 18),
+            validate=lambda w: (isinstance(w.get("chunk"), int)
+                                and not isinstance(w["chunk"], bool)
+                                and w["chunk"] >= 8))
+    except Exception:
+        return None
+    return int(winner["chunk"]) if winner is not None else None
 
 
 def stream_eligible(shape, dtype,
@@ -304,6 +328,17 @@ def int8_decode(q, scales) -> jnp.ndarray:
     """Inverse of :func:`int8_encode` → flat f32 (NaN-scale chunks decode
     to all-NaN)."""
     return (q.astype(jnp.float32) * scales[:, None]).reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def int8_roundtrip_jit(flat: jnp.ndarray, chunk: int) -> jnp.ndarray:
+    """Jitted encode→decode round trip of a flat f32 vector — the int8
+    codec's standalone entry point: the ``int8_chunk`` autotune space
+    times it per candidate chunk, and it is registered with the warmup
+    lattice (``REGISTERED_ENTRY_POINTS``) like every other tunable
+    program.  The in-collective codec runs inside larger jitted bodies;
+    this isolates the quantization cost itself."""
+    return int8_decode(*int8_encode(flat, chunk))
 
 
 def _channel_major_padded(x, chunk: int):

@@ -30,8 +30,12 @@ snapshot refreshed → plans rebuilt; already-compiled programs keep
 their traced route — gang workers are fresh processes, so the refresh
 lands with the relaunch).
 
-Telemetry: ``collective_plans_total{strategy,reason}`` per synthesized
-plan, ``plan_decide``/``plan_invalidate`` flight events, the
+Telemetry: ``collective_plans_total{strategy,reason,model}`` per
+synthesized plan (``model`` names what priced the auto decision —
+``fitted`` a measured α-β fit from the tuning table, ``spec`` the
+hardcoded cutoff constants, ``fallback`` no cost model consulted at
+all: forced strategies, single rank, unknown topology),
+``plan_decide``/``plan_invalidate`` flight events, the
 ``collective_wire_bytes_total{op,axis,codec,strategy}`` strategy label,
 and the StepProfiler collective segment split by strategy — every
 routing choice is attributable in /metrics, flight rings and bench.
@@ -40,6 +44,7 @@ routing choice is attributable in /metrics, flight rings and bench.
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
 from typing import Any, Dict, Optional, Tuple
 
@@ -461,8 +466,13 @@ class CollectivePlanner:
         self._plans: Dict[Tuple, ReductionPlan] = {}
         self._c_plans = get_registry().counter(
             "collective_plans_total",
-            "reduction plans synthesized, by resolved strategy and "
-            "decision reason", ("strategy", "reason"))
+            "reduction plans synthesized, by resolved strategy, decision "
+            "reason and the cost model that priced the auto decision "
+            "(fitted|spec|fallback)", ("strategy", "reason", "model"))
+        #: resolved once per epoch: a measured α-β fit from the tuning
+        #: table when one matches this device, else the spec-constant
+        #: model (byte-identical decisions to the hardcoded cutoff)
+        self._cost_model: Optional[Any] = None
 
     # -- topology ----------------------------------------------------------
     def spec(self) -> Optional[TopologySpec]:
@@ -503,6 +513,7 @@ class CollectivePlanner:
                     world_size: Optional[int] = None) -> None:
         dropped = len(self._plans)
         self._plans.clear()
+        self._cost_model = None          # re-consult the table next plan
         self._epoch += 1
         get_faults().note("plan.refresh", reason=reason,
                           world_size=world_size, dropped_plans=dropped,
@@ -513,6 +524,28 @@ class CollectivePlanner:
                           epoch=self._epoch)
         except Exception:
             pass
+
+    # -- cost model --------------------------------------------------------
+    def cost_model(self):
+        """The :class:`~synapseml_tpu.telemetry.autotune.
+        CollectiveCostModel` pricing this planner's 'auto' decisions:
+        a measured α-β fit when the tuning table holds one for this
+        device's link class, else the spec-constant model whose cutoff
+        IS ``TREE_CUTOFF_BYTES`` (decisions byte-identical to the
+        pre-model planner).  Resolved lazily, re-resolved after every
+        :meth:`refresh`/:meth:`set_spec` epoch bump."""
+        with self._lock:
+            if self._cost_model is None:
+                self._cost_model = _resolve_cost_model()
+            return self._cost_model
+
+    def set_cost_model(self, model):
+        """Inject a cost model (tests) → the previous one; ``None``
+        restores lazy table resolution at the next plan."""
+        with self._lock:
+            prev = self._cost_model
+            self._cost_model = model
+            return prev
 
     # -- planning ----------------------------------------------------------
     def cache_size(self) -> int:
@@ -537,17 +570,21 @@ class CollectivePlanner:
             plan = self._plans.get(key)
             if plan is not None:
                 return plan
-            strategy, reason, inner = _decide(payload_bytes, world, spec,
-                                              config)
+            if self._cost_model is None:
+                self._cost_model = _resolve_cost_model()
+            strategy, reason, inner, model = _decide(
+                payload_bytes, world, spec, config,
+                cost_model=self._cost_model)
             plan = ReductionPlan(strategy=strategy, reason=reason,
                                  world=world, inner=inner,
                                  payload_bucket=bucket, config=config)
             self._plans[key] = plan
-            self._c_plans.inc(1, strategy=strategy, reason=reason)
+            self._c_plans.inc(1, strategy=strategy, reason=reason,
+                              model=model)
         try:
             flight_record("plan_decide", strategy=strategy, reason=reason,
                           world=world, inner=inner,
-                          payload_bucket=bucket, op=op,
+                          payload_bucket=bucket, op=op, model=model,
                           codec=(config.compression if config is not None
                                  else "none"))
         except Exception:
@@ -597,53 +634,102 @@ class CollectivePlanner:
         return s
 
 
-def _decide(payload_bytes: int, world: int,
-            spec: Optional[TopologySpec], config):
-    """The decision table → ``(strategy, reason, inner)``.
+def _resolve_cost_model():
+    """The planner's cost model: a measured α-β fit when the tuning
+    table holds one for this device's ICI link class (honesty: the fit
+    was recorded from real watched-dispatch timings on a matching
+    ``device_kind``), else :meth:`CollectiveCostModel.spec` whose
+    cutoff is exactly ``TREE_CUTOFF_BYTES`` — no table, byte-identical
+    decisions.  Never raises (planning must not break on a torn table
+    or an import cycle during teardown)."""
+    try:
+        from ..telemetry.autotune import (COST_MODEL_GEOMETRY,
+                                          COST_MODEL_SPACE,
+                                          CollectiveCostModel)
+        from ..telemetry.tunetable import get_tuneplane
 
-    Structural rules over payload bytes × world size × link class —
-    deliberately NOT a fabricated cost model (the honesty pattern):
-    unknown topology plans flat, small payloads ride the tree, large
-    single-host payloads the ring, and a multi-host gang goes two-level
-    hierarchical (quantized inter-host when the codec engages)."""
+        def _gate(w):
+            a, b = w.get("alpha_s"), w.get("beta_s_per_byte")
+
+            def num(v):
+                return (isinstance(v, (int, float))
+                        and not isinstance(v, bool)
+                        and math.isfinite(v))
+
+            return num(a) and num(b) and a >= 0.0 and b > 0.0
+
+        won = get_tuneplane().consult(
+            "CollectivePlanner", COST_MODEL_SPACE, COST_MODEL_GEOMETRY,
+            validate=_gate)
+        if won is not None:
+            return CollectiveCostModel(
+                alpha_s=float(won["alpha_s"]),
+                beta_s_per_byte=float(won["beta_s_per_byte"]),
+                source="fitted")
+        return CollectiveCostModel.spec(TREE_CUTOFF_BYTES)
+    except Exception:
+        return None
+
+
+def _decide(payload_bytes: int, world: int,
+            spec: Optional[TopologySpec], config, cost_model=None):
+    """The decision table → ``(strategy, reason, inner, model)``.
+
+    Structural rules over payload bytes × world size × link class.
+    The ONE numeric threshold — the 'auto' tree-vs-ring payload
+    crossover — routes through ``cost_model.tree_cutoff_bytes(world)``:
+    a measured α-β fit when the tuning table holds one (``model=
+    'fitted'``), else the spec-constant model whose cutoff is the
+    hardcoded ``TREE_CUTOFF_BYTES`` (``model='spec'``, decisions
+    byte-identical to the pre-model planner).  Paths that consult no
+    cost model at all — forced strategies, single rank, unknown
+    topology — label ``model='fallback'``: unknown topology still
+    plans flat and nothing is ever priced from fabricated numbers."""
     requested = getattr(config, "strategy", "flat") if config is not None \
         else "flat"
     if requested == "flat":
-        return "flat", "forced", world
+        return "flat", "forced", world, "fallback"
     if world <= 1:
-        return "flat", "single_rank", world
+        return "flat", "single_rank", world, "fallback"
     known = spec is not None and spec.trusted
     inner = spec.devices_per_host if known else world
     hier_ok = (known and spec.multi_host and 1 <= inner < world
                and world % inner == 0)
     if requested == "ring":
-        return "ring", "forced", world
+        return "ring", "forced", world, "fallback"
     if requested == "tree":
         if _is_pow2(world):
-            return "tree", "forced", world
-        return "flat", "non_pow2_world", world
+            return "tree", "forced", world, "fallback"
+        return "flat", "non_pow2_world", world, "fallback"
     if requested == "hierarchical":
         if hier_ok:
-            return "hierarchical", "forced", inner
+            return "hierarchical", "forced", inner, "fallback"
         return "flat", ("no_topology" if not known
-                        else "indivisible_world"), world
+                        else "indivisible_world"), world, "fallback"
     if requested != "auto":
         raise ValueError(f"strategy={requested!r}: must be one of "
                          f"{STRATEGIES}")
     # -- auto --------------------------------------------------------------
     if not known:
-        return "flat", "unknown_topology", world
-    if payload_bytes <= TREE_CUTOFF_BYTES:
+        return "flat", "unknown_topology", world, "fallback"
+    cutoff, mlabel = TREE_CUTOFF_BYTES, "spec"
+    if cost_model is not None:
+        try:
+            cutoff = cost_model.tree_cutoff_bytes(world)
+            mlabel = cost_model.source
+        except Exception:
+            cutoff, mlabel = TREE_CUTOFF_BYTES, "spec"
+    if payload_bytes <= cutoff:
         if _is_pow2(world):
-            return "tree", "latency_bound", world
-        return "flat", "non_pow2_world", world
+            return "tree", "latency_bound", world, mlabel
+        return "flat", "non_pow2_world", world, mlabel
     compresses_here = (config is not None and config.compresses
                        and payload_bytes >= config.min_size * 4)
     if hier_ok and compresses_here:
-        return "hierarchical", "multi_host_codec", inner
+        return "hierarchical", "multi_host_codec", inner, mlabel
     if hier_ok:
-        return "hierarchical", "multi_host", inner
-    return "ring", "bandwidth_bound", world
+        return "hierarchical", "multi_host", inner, mlabel
+    return "ring", "bandwidth_bound", world, mlabel
 
 
 _default_planner = CollectivePlanner()

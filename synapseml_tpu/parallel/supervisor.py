@@ -297,7 +297,8 @@ class GangSupervisor:
                  resize_cooldown_s: float = 0.0,
                  max_resizes: int = 8,
                  capacity_fn: Optional[Callable[[], int]] = None,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 tune_table_dir: Optional[str] = None):
         self.task = task
         self.n_processes = int(n_processes)
         self.devices_per_process = int(devices_per_process)
@@ -329,6 +330,14 @@ class GangSupervisor:
             from .compilecache import COMPILE_CACHE_ENV
             self.env_extra.setdefault(COMPILE_CACHE_ENV,
                                       self.compile_cache_dir)
+        # persisted autotune tuning tables (ISSUE 20): same threading as
+        # the compile cache — every worker (and every relaunch/resize
+        # generation) resolves its TunePlane against the shared dir, so
+        # a winner measured once serves the whole gang's lifetime
+        self.tune_table_dir = str(tune_table_dir) if tune_table_dir else None
+        if self.tune_table_dir:
+            from ..telemetry.tunetable import TUNE_TABLE_ENV
+            self.env_extra.setdefault(TUNE_TABLE_ENV, self.tune_table_dir)
         self.term_grace_s = float(term_grace_s)
         self.tail_lines = int(tail_lines)
         # the gang-wide observability plane: an obs dir turns wire export

@@ -79,7 +79,8 @@ TENANT_HEADER_CANONICAL = "-".join(
 #: and ``ServingServer._reserved_handler`` agree with the dispatch
 #: source and (b) each path is documented in docs/api/serving.md — a
 #: future endpoint cannot land undocumented.
-RESERVED_GET_PATHS = ("/metrics", "/healthz", "/readyz", "/tracez", "/sloz")
+RESERVED_GET_PATHS = ("/metrics", "/healthz", "/readyz", "/tracez", "/sloz",
+                      "/tunez")
 
 
 @dataclass
@@ -661,7 +662,8 @@ class ServingServer:
                 "/healthz": self._serve_healthz,
                 "/readyz": self._serve_readyz,
                 "/tracez": self._serve_tracez,
-                "/sloz": self._serve_sloz}.get(bare)
+                "/sloz": self._serve_sloz,
+                "/tunez": self._serve_tunez}.get(bare)
 
     def _serve_healthz(self, query: str, headers: Dict[str, str]):
         return self.health.healthz()
@@ -735,6 +737,33 @@ class ServingServer:
         except ValueError as e:
             return (500, json.dumps(
                 {"error": f"sloz snapshot failed validation: {e}"}).encode(),
+                {"Content-Type": "application/json"})
+        return 200, json.dumps(snap).encode("utf-8"), {
+            "Content-Type": "application/json"}
+
+    def _serve_tunez(self, query: str, headers: Dict[str, str]):
+        """The autotune tuning-table snapshot: per-space winner with its
+        measured ms and provenance (``source``/``measured_unix``/
+        ``device_kind``), staleness against the plane's max age, and the
+        consult log — which construction sites loaded (or refused) the
+        table in THIS process.  Schema-validated BEFORE serving (the
+        ``/sloz`` discipline); ``?space=<name>`` filters entries and
+        consults to one search space."""
+        from urllib.parse import parse_qs
+        from ..telemetry.tunetable import check_tunez, get_tuneplane
+        params = parse_qs(query)
+        space = (params.get("space") or [None])[0]
+        snap = get_tuneplane().snapshot()
+        if space is not None:
+            snap["entries"] = [e for e in snap["entries"]
+                               if e.get("space") == space]
+            snap["consults"] = [c for c in snap["consults"]
+                                if c.get("space") == space]
+        try:
+            check_tunez(snap)
+        except ValueError as e:
+            return (500, json.dumps(
+                {"error": f"tunez snapshot failed validation: {e}"}).encode(),
                 {"Content-Type": "application/json"})
         return 200, json.dumps(snap).encode("utf-8"), {
             "Content-Type": "application/json"}

@@ -64,6 +64,9 @@ Instrumented layers (all write into the default registry):
 
 from .artifact import (SchemaError, check_schema, dumps_checked, read_json,
                        write_json)
+from .autotune import (AUTOTUNE_METRICS, Autotuner, CollectiveCostModel,
+                       TuneSpace, fit_alpha_beta, register_space,
+                       registered_spaces, resolve_entry_point)
 from .exposition import (PROMETHEUS_CONTENT_TYPE, render_json,
                          render_prometheus)
 from .flight import FlightRecorder, get_flight
@@ -79,6 +82,9 @@ from .slo import (SLO_METRICS, SLOZ_SCHEMA, SLOZ_SCHEMA_VERSION, SloStore,
                   get_slo_store, plane_tenant, tenant_plane_name)
 from .tracing import (RequestTraceStore, Span, Tracer, get_request_tracer,
                       get_tracer, mint_trace_id, span)
+from .tunetable import (TUNE_TABLE_ENV, TUNE_TABLE_SCHEMA_VERSION, TunePlane,
+                        check_tune_table, check_tunez, device_kind,
+                        geometry_key, get_tuneplane, set_tuneplane)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
@@ -97,4 +103,10 @@ __all__ = [
     "parse_telemetry", "write_postmortem",
     "ROOFLINE_BLOCK_KEYS", "check_roofline_block", "paired_roofline",
     "roofline_block",
+    "AUTOTUNE_METRICS", "Autotuner", "CollectiveCostModel", "TuneSpace",
+    "fit_alpha_beta", "register_space", "registered_spaces",
+    "resolve_entry_point",
+    "TUNE_TABLE_ENV", "TUNE_TABLE_SCHEMA_VERSION", "TunePlane",
+    "check_tune_table", "check_tunez", "device_kind", "geometry_key",
+    "get_tuneplane", "set_tuneplane",
 ]

@@ -41,7 +41,7 @@ import os
 import sys
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from .artifact import SchemaError, write_json
 from .flight import get_flight, sanitize_floats as _sanitize
@@ -552,6 +552,84 @@ class StepProfiler:
     """
 
     SEGMENTS = ("data", "compute", "collective", "other")
+
+    @staticmethod
+    def measure(legs, *, blocks: int = 3, pairs: int = 6,
+                timer: Callable[[], float] = time.perf_counter):
+        """The bench's alternating min-of-blocks timing protocol as a
+        library call (it had grown three hand-rolled copies in bench.py;
+        the autotuner is its fourth caller).
+
+        Two shapes of ``legs``:
+
+        - **paired** — a 2-tuple ``(base_fn, other_fn)``: each block
+          runs ``pairs`` interleaved executions whose leg order
+          alternates pair to pair (cancelling monotone host-load
+          drift), takes the per-block MEDIAN of the base times and of
+          the other-minus-base differences, and reports the block with
+          the minimum difference → ``(base_seconds, delta_seconds)``.
+          The median-of-differences statistic is what makes small
+          overheads resolvable on a noisy host.
+        - **multi** — a dict ``name -> fn``: each block runs every leg
+          once, in an order that reverses block to block, and each
+          leg's statistic is its MINIMUM across blocks →
+          ``{name: seconds}``.  Min-of-blocks is the right statistic
+          for "how fast CAN this candidate go" questions (autotuning,
+          codec comparisons); contention only ever inflates a block.
+
+        A leg that returns an ``int``/``float`` is trusted as its own
+        measurement in seconds (self-timing legs — e.g. a leg that
+        reads a profiler's accounting); any other return value means
+        the wall clock between ``timer()`` calls is the measurement.
+        ``timer`` is injectable so tests can pin the statistics with a
+        deterministic clock.
+        """
+
+        def _seconds(ret, t0, t1):
+            if isinstance(ret, (int, float)) and not isinstance(ret, bool):
+                return float(ret)
+            return t1 - t0
+
+        blocks = max(1, int(blocks))
+        if isinstance(legs, dict):
+            names = list(legs)
+            best: Dict[str, float] = {}
+            for b in range(blocks):
+                order = names if b % 2 == 0 else list(reversed(names))
+                for name in order:
+                    t0 = timer()
+                    ret = legs[name]()
+                    s = _seconds(ret, t0, timer())
+                    prev = best.get(name)
+                    best[name] = s if prev is None else min(prev, s)
+            return best
+        if (isinstance(legs, (tuple, list)) and len(legs) == 2
+                and all(callable(f) for f in legs)):
+            base_fn, other_fn = legs
+            pairs = max(1, int(pairs))
+            winner = None
+            for _ in range(blocks):
+                bases, deltas = [], []
+                for i in range(pairs):
+                    first, second = ((base_fn, other_fn) if i % 2 == 0
+                                     else (other_fn, base_fn))
+                    t0 = timer()
+                    r1 = first()
+                    t1 = timer()
+                    r2 = second()
+                    t2 = timer()
+                    d1 = _seconds(r1, t0, t1)
+                    d2 = _seconds(r2, t1, t2)
+                    base_s, other_s = (d1, d2) if i % 2 == 0 else (d2, d1)
+                    bases.append(base_s)
+                    deltas.append(other_s - base_s)
+                blk_base = sorted(bases)[len(bases) // 2]
+                blk_delta = sorted(deltas)[len(deltas) // 2]
+                if winner is None or blk_delta < winner[1]:
+                    winner = (blk_base, blk_delta)
+            return winner
+        raise TypeError("measure() wants a (base_fn, other_fn) pair or a "
+                        f"{{name: fn}} dict, got {type(legs).__name__}")
 
     def __init__(self, model: str,
                  registry: Optional[MetricsRegistry] = None,

@@ -116,6 +116,7 @@ MODULE_COST_S = {
     "test_llm_serving": 55, "test_llm_paged": 26, "test_llm_spec": 35,
     "test_llm_warmup": 18,
     "test_serving_obs": 14, "test_collective_planner": 25,
+    "test_autotune": 8,
     "test_autoscaler": 8, "test_disagg": 40,
     "test_perf_roofline": 150,
     "test_llm": 78, "test_gbdt_efb": 86, "test_onnx_resnet50": 89,

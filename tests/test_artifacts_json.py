@@ -215,6 +215,36 @@ DISAGG_REQUIRED = (
     "disagg_decode_replicas_after",
 )
 
+#: the self-tuning performance plane (ISSUE 20): a record carrying ANY
+#: ``autotune_`` key must carry the whole set — every search space's
+#: trial count, winner timing, and winner config (null when nothing was
+#: measurable on the backend), the table size, and BOTH sides of the
+#: cost-model story (the fitted α-β, the fitted crossover, AND the spec
+#: constant it replaces with their ratio) — so a partially-failed
+#: autotune leg cannot ship a fitted cutoff without the measured fit it
+#: came from, or a winner claim without its measured milliseconds
+AUTOTUNE_REQUIRED = (
+    "autotune_paged_attn_tile_trials",
+    "autotune_paged_attn_tile_ms",
+    "autotune_paged_attn_tile_winner_tile",
+    "autotune_gbdt_hist_chunk_trials",
+    "autotune_gbdt_hist_chunk_ms",
+    "autotune_gbdt_hist_chunk_winner_chunk",
+    "autotune_llm_bucket_grid_trials",
+    "autotune_llm_bucket_grid_ms",
+    "autotune_llm_bucket_grid_winner_min_bucket",
+    "autotune_int8_chunk_trials",
+    "autotune_int8_chunk_ms",
+    "autotune_int8_chunk_winner_chunk",
+    "autotune_total_trials",
+    "autotune_table_bytes",
+    "autotune_costmodel_alpha_us",
+    "autotune_costmodel_beta_us_per_mib",
+    "autotune_costmodel_fitted_cutoff_bytes",
+    "autotune_costmodel_spec_cutoff_bytes",
+    "autotune_costmodel_cutoff_ratio",
+)
+
 LLMSERVE_SPEC_REQUIRED = (
     "llmserve_spec_tokens_per_sec",
     "llmserve_spec_tokens_per_step",
@@ -445,6 +475,23 @@ def test_disagg_fields_complete():
                if rec[k] is not None
                and not isinstance(rec[k], (int, float))]
         assert not bad, f"{name}: non-numeric disagg fields: {bad}"
+
+
+def test_autotune_fields_complete():
+    """ISSUE 20: a record carrying any ``autotune_`` field (the
+    self-tuning plane's measured sweep) carries the WHOLE set, each
+    numeric or null — no fitted cost-model cutoff without the α-β fit
+    it came from, no winner config without its measured trials."""
+    for name, rec in _bench_records():
+        tune_keys = [k for k in rec if k.startswith("autotune_")]
+        if not tune_keys or _labeled_partial(rec):
+            continue
+        missing = [k for k in AUTOTUNE_REQUIRED if k not in rec]
+        assert not missing, f"{name}: incomplete autotune block: {missing}"
+        bad = [k for k in tune_keys
+               if rec[k] is not None
+               and not isinstance(rec[k], (int, float))]
+        assert not bad, f"{name}: non-numeric autotune fields: {bad}"
 
 
 def test_comms_topo_fields_complete():

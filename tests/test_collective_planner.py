@@ -159,12 +159,14 @@ class TestDecisionTable:
     def test_plan_cache_bucketed_and_counted(self, planner):
         cfg = CollectiveConfig(compression="int8", strategy="auto")
         c = get_registry().get("collective_plans_total")
-        before = c.value(strategy="hierarchical", reason="multi_host_codec")
+        before = c.value(strategy="hierarchical", reason="multi_host_codec",
+                         model="spec")
         p1 = planner.plan(LARGE - 100, 8, cfg)
         p2 = planner.plan(LARGE, 8, cfg)            # same pow2 bucket
         assert p1 is p2
         assert planner.cache_size() >= 1
-        after = c.value(strategy="hierarchical", reason="multi_host_codec")
+        after = c.value(strategy="hierarchical", reason="multi_host_codec",
+                        model="spec")
         assert after == before + 1                  # one synthesis, one count
         # a different payload class is a different plan
         p3 = planner.plan(SMALL, 8, cfg)

@@ -762,6 +762,18 @@ class TestMetricHygiene:
         # the outcome attribution + phase-plane contracts are documented
         assert "outcome=" in docs and "@phase=" in docs
 
+    def test_every_autotune_metric_is_documented(self):
+        """ISSUE 20: the self-tuning plane's metric names (the trial
+        counter + the table-consult counter with its closed outcome
+        set) are held to the same docs bar."""
+        from synapseml_tpu.telemetry.autotune import AUTOTUNE_METRICS
+        docs = "\n".join(p.read_text(encoding="utf-8")
+                         for p in (REPO / "docs" / "api").glob("*.md"))
+        missing = sorted(n for n in AUTOTUNE_METRICS if n not in docs)
+        assert not missing, f"autotune metrics absent from docs: {missing}"
+        # the plan-provenance label contract itself is documented
+        assert "model=" in docs
+
     def test_registry_sees_no_duplicate_kind_at_runtime(self):
         """Importing the wired modules must not blow up on registration
         conflicts (the registry raises on kind/label mismatches)."""
